@@ -1,0 +1,25 @@
+"""neuronx_distributed_tpu: a TPU-native (JAX/XLA/Pallas) distributed training
+and inference framework with the capabilities of AWS neuronx-distributed.
+
+Public surface mirrors the reference package root
+(/root/reference/src/neuronx_distributed/__init__.py): ``parallel`` (the
+reference's parallel_layers), ``pipeline``, ``trainer``, ``kernels``, ``utils``
+plus the trainer config/checkpoint entry points.
+"""
+
+from neuronx_distributed_tpu import parallel, utils
+from neuronx_distributed_tpu.parallel import (
+    destroy_model_parallel,
+    initialize_model_parallel,
+    model_parallel_is_initialized,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "parallel",
+    "utils",
+    "initialize_model_parallel",
+    "destroy_model_parallel",
+    "model_parallel_is_initialized",
+]

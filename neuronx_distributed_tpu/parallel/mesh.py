@@ -1,0 +1,302 @@
+"""Parallel state for the TPU-native stack: one device mesh instead of process groups.
+
+This is the TPU-first replacement for the reference's
+``parallel_layers/parallel_state.py`` (``initialize_model_parallel``
+parallel_state.py:343 and the dozens of ``get_*_group/rank/size`` getters). The
+reference builds torch.distributed process groups from a rank-array reshape
+``[PP, DP, CP, TP]`` (worked examples at parallel_state.py:351-504) and a second
+expert view ``[PP, DPexp, EP, TP]`` (parallel_state.py:372-382). On TPU with
+single-controller JAX the same structure is ONE ``jax.sharding.Mesh`` with named
+axes ``("pp", "dp", "cp", "tp")`` plus an expert-view mesh over the same devices
+reshaped to ``("pp", "edp", "ep", "tp")`` — "groups" become mesh axes, group
+collectives become ``lax.psum/all_gather/psum_scatter/all_to_all/ppermute`` with
+an ``axis_name``, and XLA lowers them onto ICI.
+
+What intentionally disappears relative to the reference:
+  * process-group bootstrap / dummy warm-up all-reduce (parallel_state.py:597-607)
+    — jit handles program loading;
+  * replica-group compression, TCP store, gloo side channels — no processes;
+  * LOGIC1/LOGIC2 topology rank orderings (parallel_state.py:102,173) — subsumed
+    by ``mesh_utils.create_device_mesh`` which maps the mesh onto the physical
+    ICI torus (minor-most axis gets nearest neighbours, so keep "tp" last);
+  * KV-replication groups (parallel_state.py:1368) — handled at the layer level
+    by weight replication in `modules/qkv_linear.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+# Canonical mesh axis names. Order matters: minor-most (last) axis maps to the
+# closest ICI neighbours, so tensor parallelism — the most latency-sensitive
+# collective traffic — stays innermost, mirroring the reference's rank grid
+# [PP, DP, CP, TP] with TP fastest-varying (parallel_state.py:351-504).
+PP_AXIS = "pp"
+DP_AXIS = "dp"
+CP_AXIS = "cp"
+TP_AXIS = "tp"
+# Expert view axes (same devices, dp*cp reshaped into edp*ep).
+EDP_AXIS = "edp"
+EP_AXIS = "ep"
+
+MESH_AXES = (PP_AXIS, DP_AXIS, CP_AXIS, TP_AXIS)
+EXPERT_MESH_AXES = (PP_AXIS, EDP_AXIS, EP_AXIS, TP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Degrees of every parallelism strategy. ``data_parallel_size`` is inferred
+    from the device count when None (reference: parallel_state.py:530)."""
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    context_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    data_parallel_size: Optional[int] = None
+
+    def infer_dp(self, n_devices: int) -> int:
+        denom = (
+            self.tensor_parallel_size
+            * self.pipeline_parallel_size
+            * self.context_parallel_size
+        )
+        if n_devices % denom != 0:
+            raise ValueError(
+                f"world size {n_devices} not divisible by "
+                f"tp*pp*cp = {denom} "
+                f"(tp={self.tensor_parallel_size}, pp={self.pipeline_parallel_size}, "
+                f"cp={self.context_parallel_size})"
+            )
+        dp = n_devices // denom
+        if self.data_parallel_size is not None and self.data_parallel_size != dp:
+            raise ValueError(
+                f"explicit data_parallel_size={self.data_parallel_size} inconsistent "
+                f"with inferred {dp} for world size {n_devices}"
+            )
+        return dp
+
+
+@dataclasses.dataclass
+class ParallelState:
+    """Holds the live meshes. Built by :func:`initialize_model_parallel`."""
+
+    config: MeshConfig
+    mesh: Mesh          # axes (pp, dp, cp, tp)
+    expert_mesh: Mesh   # axes (pp, edp, ep, tp) over the same devices
+    aot_mode: bool = False
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(tuple(self.mesh.shape.values())))
+
+
+_STATE: Optional[ParallelState] = None
+
+
+def _build_device_grid(
+    shape: Sequence[int], devices: Optional[Sequence[jax.Device]]
+) -> np.ndarray:
+    """Arrange devices into the (pp, dp, cp, tp) grid, topology-aware when possible.
+
+    ``mesh_utils.create_device_mesh`` plays the role of the reference's LOGIC1/
+    LOGIC2 ring orderings (parallel_state.py:102,173,293): it permutes devices so
+    that minor mesh axes land on physically adjacent chips of the ICI torus.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(f"mesh shape {tuple(shape)} needs {n} devices, have {len(devices)}")
+    try:
+        from jax.experimental import mesh_utils
+
+        return mesh_utils.create_device_mesh(tuple(shape), devices=devices)
+    except Exception as e:  # non-TPU topologies / virtual device sets
+        if devices and getattr(devices[0], "platform", "") == "tpu":
+            logger.warning(
+                "topology-aware device mesh failed (%s); falling back to "
+                "enumeration-order reshape — tp axis may not map to nearest "
+                "ICI neighbours",
+                e,
+            )
+        return np.asarray(devices, dtype=object).reshape(tuple(shape))
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    expert_model_parallel_size: int = 1,
+    data_parallel_size: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    aot_mode: bool = False,
+) -> ParallelState:
+    """Build the global mesh state (reference: parallel_state.py:343).
+
+    Keyword names mirror the reference API so users can port call sites
+    mechanically. Returns the new :class:`ParallelState` and installs it
+    globally for the getter functions below.
+    """
+    global _STATE
+    if _STATE is not None:
+        raise RuntimeError(
+            "model parallel state already initialized; call destroy_model_parallel() first"
+        )
+    cfg = MeshConfig(
+        tensor_parallel_size=tensor_model_parallel_size,
+        pipeline_parallel_size=pipeline_model_parallel_size,
+        context_parallel_size=context_parallel_size,
+        expert_parallel_size=expert_model_parallel_size,
+        data_parallel_size=data_parallel_size,
+    )
+    devices = list(devices if devices is not None else jax.devices())
+    dp = cfg.infer_dp(len(devices))
+    pp, cp, tp, ep = (
+        cfg.pipeline_parallel_size,
+        cfg.context_parallel_size,
+        cfg.tensor_parallel_size,
+        cfg.expert_parallel_size,
+    )
+    if (dp * cp) % ep != 0:
+        raise ValueError(
+            f"expert_parallel_size={ep} must divide dp*cp={dp * cp} "
+            "(the expert view reshapes the dp×cp block into edp×ep)"
+        )
+    edp = dp * cp // ep
+
+    grid = _build_device_grid((pp, dp, cp, tp), devices)
+    mesh = Mesh(grid, MESH_AXES)
+    # Expert view: same device order, dp×cp block reshaped to edp×ep. This is
+    # exactly the reference's second rank-grid reshape [PP, DPexp, EP, TP]
+    # (parallel_state.py:372-382) — EP ranks are consecutive dp×cp neighbours.
+    expert_grid = grid.reshape(pp, edp, ep, tp)
+    expert_mesh = Mesh(expert_grid, EXPERT_MESH_AXES)
+
+    _STATE = ParallelState(config=cfg, mesh=mesh, expert_mesh=expert_mesh, aot_mode=aot_mode)
+    logger.info(
+        "initialized model parallel: pp=%d dp=%d cp=%d tp=%d ep=%d edp=%d over %d devices",
+        pp, dp, cp, tp, ep, edp, len(devices),
+    )
+    return _STATE
+
+
+def model_parallel_is_initialized() -> bool:
+    return _STATE is not None
+
+
+def destroy_model_parallel() -> None:
+    global _STATE
+    _STATE = None
+
+
+def get_parallel_state() -> ParallelState:
+    if _STATE is None:
+        raise RuntimeError(
+            "model parallel not initialized; call initialize_model_parallel() first"
+        )
+    return _STATE
+
+
+def get_mesh() -> Mesh:
+    return get_parallel_state().mesh
+
+
+def get_expert_mesh() -> Mesh:
+    return get_parallel_state().expert_mesh
+
+
+# --- size getters (reference get_*_size; sizes are static mesh properties) ----
+
+def get_world_size() -> int:
+    return get_parallel_state().world_size
+
+
+def get_tensor_model_parallel_size() -> int:
+    return get_mesh().shape[TP_AXIS]
+
+
+def get_pipeline_model_parallel_size() -> int:
+    return get_mesh().shape[PP_AXIS]
+
+
+def get_data_parallel_size() -> int:
+    return get_mesh().shape[DP_AXIS]
+
+
+def get_context_parallel_size() -> int:
+    return get_mesh().shape[CP_AXIS]
+
+
+def get_expert_model_parallel_size() -> int:
+    return get_expert_mesh().shape[EP_AXIS]
+
+
+def get_expert_data_parallel_size() -> int:
+    return get_expert_mesh().shape[EDP_AXIS]
+
+
+# --- rank getters (meaningful only inside shard_map'ed code) ------------------
+
+def _axis_rank(axis: str):
+    return jax.lax.axis_index(axis)
+
+
+def get_tensor_model_parallel_rank():
+    """Rank along the tp axis. Only valid inside ``shard_map`` (single-controller
+    JAX has no per-process rank; reference per-process getter:
+    parallel_state.py rank getters)."""
+    return _axis_rank(TP_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PP_AXIS)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DP_AXIS)
+
+
+def get_context_parallel_rank():
+    return _axis_rank(CP_AXIS)
+
+
+def get_expert_model_parallel_rank():
+    return _axis_rank(EP_AXIS)
+
+
+# --- sharding helpers ---------------------------------------------------------
+
+def named_sharding(*spec) -> NamedSharding:
+    """NamedSharding over the global mesh for the given PartitionSpec entries."""
+    return NamedSharding(get_mesh(), P(*spec))
+
+
+def zero1_sharding_axes() -> tuple:
+    """Axes over which ZeRO-1 optimizer state is sharded: DP×CP, matching the
+    reference's zero-1 sharding groups (parallel_state.py:1579)."""
+    return (DP_AXIS, CP_AXIS)
+
+
+def get_context_parallel_ring(forward: bool = True):
+    """Source/target pairs for ring attention over the cp axis, replacing the
+    reference's NKI ``CollectivesConfig`` src/tgt derivation
+    (parallel_state.py:16,678-690). Returns a ppermute-style permutation list."""
+    cp = get_context_parallel_size()
+    if forward:
+        return [(i, (i + 1) % cp) for i in range(cp)]
+    return [(i, (i - 1) % cp) for i in range(cp)]
+
+
+def mesh_device_counts() -> dict:
+    m = get_mesh()
+    return {k: int(v) for k, v in m.shape.items()}

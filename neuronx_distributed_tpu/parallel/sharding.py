@@ -1,0 +1,85 @@
+"""GSPMD sharding helpers: constraints and param-spec extraction.
+
+The reference attaches TP metadata to tensors (``tensor_model_parallel``,
+``partition_dim`` — parallel_layers/utils.py:51) and moves data with explicit
+collectives. In GSPMD mode the equivalent is (a) flax ``nn.Partitioned``
+metadata on params, created by the parallel layers, and (b)
+``with_sharding_constraint`` on activations at layer boundaries; XLA's SPMD
+partitioner inserts the collectives the reference writes by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import linen as nn
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+UNC = P.UNCONSTRAINED
+
+
+def constrain(x, spec: P):
+    """``with_sharding_constraint`` over the global mesh; no-op when the mesh is
+    not initialized (pure single-device use)."""
+    if not mesh_lib.model_parallel_is_initialized():
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh_lib.get_mesh(), spec)
+    )
+
+
+def shard_last_dim(x, axis=mesh_lib.TP_AXIS):
+    """Constrain only the last dim (leading dims left to XLA propagation)."""
+    return constrain(x, P(*([UNC] * (x.ndim - 1)), axis))
+
+
+def replicate_dim(x, dim: int):
+    spec = [UNC] * x.ndim
+    spec[dim] = None
+    return constrain(x, P(*spec))
+
+
+def shard_dim(x, dim: int, axis):
+    spec = [UNC] * x.ndim
+    spec[dim % x.ndim] = axis
+    return constrain(x, P(*spec))
+
+
+def shard_activation(x, *, sequence_parallel: bool = False, batch_dim: int = 0, seq_dim: int = 1):
+    """Canonical activation sharding for (batch, seq, hidden...)-shaped tensors:
+    batch over dp, sequence over cp (plus tp when Megatron-SP is active)."""
+    spec = [UNC] * x.ndim
+    spec[batch_dim] = mesh_lib.DP_AXIS
+    if sequence_parallel:
+        spec[seq_dim] = (mesh_lib.CP_AXIS, mesh_lib.TP_AXIS)
+    else:
+        spec[seq_dim] = mesh_lib.CP_AXIS
+    return constrain(x, P(*spec))
+
+
+def param_partition_specs(variables) -> Any:
+    """Pytree of PartitionSpecs from flax ``nn.Partitioned`` metadata
+    (unannotated leaves → fully replicated P())."""
+    return nn.get_partition_spec(variables)
+
+
+def param_shardings(variables) -> Any:
+    """Pytree of NamedShardings over the global mesh for a variables pytree."""
+    mesh = mesh_lib.get_mesh()
+    specs = nn.get_partition_spec(variables)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def logical_to_mesh(*names):
+    """Helper for ``nn.with_partitioning`` axis tuples: passthrough today (we
+    name mesh axes directly), kept as the single place to add a logical-axis
+    indirection later."""
+    return tuple(names)

@@ -1,0 +1,3 @@
+from neuronx_distributed_tpu.utils.logger import get_logger, rmsg
+
+__all__ = ["get_logger", "rmsg"]

@@ -1,0 +1,66 @@
+"""Process-aware logger (reference: utils/logger.py rank-0-gated logger and the
+``rmsg`` rank-prefix helper at parallel_state.py:1543).
+
+Single-controller JAX normally has one process; under multi-host each host has a
+``jax.process_index()``. Log level comes from ``NXD_TPU_LOG_LEVEL``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _process_index() -> int:
+    # Only consult jax once a backend exists: calling jax.process_index() would
+    # itself initialize the backend, and this must never happen at import time
+    # (it would break jax.distributed.initialize() / platform selection later).
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return 0
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class _Rank0Filter(logging.Filter):
+    """Suppress sub-ERROR records on non-zero hosts, evaluated lazily at emit
+    time (by then the jax backend is live, so process_index is meaningful)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        return record.levelno >= logging.ERROR or _process_index() == 0
+
+
+def get_logger(name: str = "neuronx_distributed_tpu", rank0_only: bool = True) -> logging.Logger:
+    global _CONFIGURED
+    logger = logging.getLogger(name)
+    if not _CONFIGURED:
+        level = os.environ.get("NXD_TPU_LOG_LEVEL", "INFO").upper()
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter(
+                fmt="[%(asctime)s %(levelname)s %(name)s] %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+        root = logging.getLogger("neuronx_distributed_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        root.addFilter(_Rank0Filter())
+        _CONFIGURED = True
+    return logger
+
+
+def rmsg(msg: str) -> str:
+    """Prefix a message with host-process context (reference rmsg:
+    parallel_state.py:1543 prefixes tp/pp/dp ranks; here ranks live in the mesh,
+    so the host index is the meaningful runtime context)."""
+    return f"[host {_process_index()}] {msg}"

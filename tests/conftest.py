@@ -1,0 +1,46 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+This is the TPU-stack analogue of the reference's ``NXD_CPU_MODE`` gloo fork
+(utils/__init__.py:6, comm.py:137-220): instead of a second collective backend,
+JAX's CPU platform with ``--xla_force_host_platform_device_count=8`` runs the
+exact same SPMD programs on 8 virtual devices.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU platform; tests always run on
+# the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    """Each test starts with a clean global mesh state."""
+    mesh_lib.destroy_model_parallel()
+    yield
+    mesh_lib.destroy_model_parallel()
+
+
+@pytest.fixture
+def tp4_mesh():
+    """pp=1, dp=2, cp=1, tp=4 over the 8 virtual devices."""
+    state = mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=4, pipeline_model_parallel_size=1
+    )
+    return state.mesh
+
+
+@pytest.fixture
+def tp8_mesh():
+    state = mesh_lib.initialize_model_parallel(tensor_model_parallel_size=8)
+    return state.mesh

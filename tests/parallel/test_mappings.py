@@ -1,0 +1,130 @@
+"""Differentiable-mapping tests: forward values and custom-VJP conjugates
+(reference analogue: mappings are exercised implicitly by
+test/unit_test/parallel_layers/test_layers.py; here we assert the conjugate
+rule directly)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mappings as mp
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    )
+
+
+def test_copy_to_region_fwd_bwd(tp4_mesh):
+    # forward: identity per rank; backward: psum of per-rank cotangents.
+    def per_shard(x, w):
+        loss = lambda v: jnp.sum(mp.copy_to_tensor_model_parallel_region(v) * w)
+        return jax.grad(loss)(x)
+
+    w = jnp.arange(1.0, 9.0)  # sharded over tp: rank r sees w[2r:2r+2]
+    grad = _smap(per_shard, tp4_mesh, (P(), P("tp")), P())(jnp.ones(2), w)
+    # bwd psums rank-local w chunks: sum over ranks of w_chunk
+    expected = np.asarray(w).reshape(4, 2).sum(axis=0)
+    np.testing.assert_allclose(grad, expected)
+
+
+def test_reduce_from_region_fwd_bwd(tp4_mesh):
+    def fwd(x):
+        return mp.reduce_from_tensor_model_parallel_region(x)
+
+    x = jnp.arange(4.0)  # rank r holds [r]
+    out = _smap(fwd, tp4_mesh, P("tp"), P("tp"))(x)
+    np.testing.assert_allclose(out, np.full(4, 6.0))
+
+    def per_shard_grad(x, c):
+        loss = lambda v: jnp.sum(mp.reduce_from_tensor_model_parallel_region(v) * c)
+        return jax.grad(loss)(x)
+
+    c = jnp.arange(4.0)
+    grad = _smap(per_shard_grad, tp4_mesh, (P("tp"), P("tp")), P("tp"))(x, c)
+    # backward is identity: grad per rank = that rank's cotangent c
+    np.testing.assert_allclose(grad, np.arange(4.0))
+
+
+def test_scatter_gather_roundtrip(tp4_mesh):
+    x = jnp.arange(8.0)
+
+    def round_trip(v):
+        chunk = mp.scatter_to_tensor_model_parallel_region(v, dim=0)
+        return mp.gather_from_tensor_model_parallel_region(chunk, dim=0)
+
+    out = _smap(round_trip, tp4_mesh, P(), P())(x)
+    np.testing.assert_allclose(out, x)
+
+
+def test_gather_bwd_is_slice(tp4_mesh):
+    x = jnp.arange(8.0)  # sharded over tp: rank r holds 2 values
+
+    def per_shard(xs):
+        loss = lambda v: 0.5 * jnp.sum(mp.gather_from_tensor_model_parallel_region(v, dim=0) ** 2)
+        return jax.grad(loss)(xs)
+
+    grad = _smap(per_shard, tp4_mesh, P("tp"), P("tp"))(x)
+    np.testing.assert_allclose(grad, x)  # d/dx of sum(x^2)/2 sliced back = x
+
+
+def test_scatter_bwd_is_allgather(tp4_mesh):
+    x = jnp.arange(8.0)
+
+    def per_shard(v):
+        loss = lambda u: jnp.sum(mp.scatter_to_tensor_model_parallel_region(u, dim=0))
+        return jax.grad(loss)(v)
+
+    grad = _smap(per_shard, tp4_mesh, P(), P())(x)
+    np.testing.assert_allclose(grad, np.ones(8))  # each element selected exactly once
+
+
+def test_sequence_parallel_gather_rs_conjugates(tp4_mesh):
+    # gather_from_sequence_parallel fwd = all_gather(seq); bwd = reduce_scatter
+    x = jnp.arange(8.0)
+
+    def per_shard(xs, c):
+        loss = lambda v: jnp.sum(mp.gather_from_sequence_parallel_region(v, dim=0) * c)
+        return jax.grad(loss)(xs, )
+
+    c = jnp.ones(8)
+    grad = _smap(per_shard, tp4_mesh, (P("tp"), P()), P("tp"))(x, c)
+    # cotangent ones(8) reduce-scattered: each rank chunk = 4 (tp=4 ranks summed)
+    np.testing.assert_allclose(grad, np.full(8, 4.0))
+
+
+def test_reduce_scatter_to_sp_fwd(tp4_mesh):
+    def fwd(r):
+        v = (r[0] + 1.0) * jnp.ones(8)
+        return mp.reduce_scatter_to_sequence_parallel_region(v, dim=0)
+
+    ranks = jnp.arange(4.0)
+    out = _smap(fwd, tp4_mesh, P("tp"), P("tp"))(ranks)
+    # sum over ranks of (r+1) = 10, scattered: every position = 10
+    np.testing.assert_allclose(np.asarray(out), 10.0)
+
+
+def test_expert_all_to_all_roundtrip():
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    state = mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=2, expert_model_parallel_size=2
+    )
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def round_trip(v):
+        inner = mp.enter_expert_parallel_region(v, split_dim=0, concat_dim=1)
+        return mp.exit_expert_parallel_region(inner, split_dim=1, concat_dim=0)
+
+    out = jax.jit(
+        jax.shard_map(
+            round_trip,
+            mesh=state.expert_mesh,
+            in_specs=P(("edp", "ep")),
+            out_specs=P(("edp", "ep")),
+            check_vma=False,
+        )
+    )(x)
+    np.testing.assert_allclose(out, x)

@@ -1,0 +1,81 @@
+"""Mesh/parallel-state tests (reference analogue:
+test/unit_test/parallel_layers/test_parallel_state.py rank-grouping tests)."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+
+def test_initialize_basic():
+    state = mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+    assert mesh_lib.get_tensor_model_parallel_size() == 4
+    assert mesh_lib.get_data_parallel_size() == 2
+    assert mesh_lib.get_pipeline_model_parallel_size() == 1
+    assert mesh_lib.get_context_parallel_size() == 1
+    assert mesh_lib.get_world_size() == 8
+    assert state.mesh.axis_names == ("pp", "dp", "cp", "tp")
+
+
+def test_double_init_raises():
+    mesh_lib.initialize_model_parallel()
+    with pytest.raises(RuntimeError):
+        mesh_lib.initialize_model_parallel()
+
+
+def test_uninitialized_raises():
+    with pytest.raises(RuntimeError):
+        mesh_lib.get_mesh()
+
+
+def test_bad_divisibility():
+    with pytest.raises(ValueError):
+        mesh_lib.initialize_model_parallel(tensor_model_parallel_size=3)
+
+
+def test_pp_cp_tp():
+    mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=2,
+        pipeline_model_parallel_size=2,
+        context_parallel_size=2,
+    )
+    assert mesh_lib.get_data_parallel_size() == 1
+    assert mesh_lib.get_context_parallel_size() == 2
+    counts = mesh_lib.mesh_device_counts()
+    assert counts == {"pp": 2, "dp": 1, "cp": 2, "tp": 2}
+
+
+def test_expert_mesh_reshape():
+    """Expert view reshapes the dp×cp block into edp×ep over the SAME devices
+    in the same order (reference parallel_state.py:372-382)."""
+    state = mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=2, expert_model_parallel_size=2
+    )
+    assert mesh_lib.get_expert_model_parallel_size() == 2
+    assert mesh_lib.get_expert_data_parallel_size() == 2
+    base = state.mesh.devices
+    expert = state.expert_mesh.devices
+    assert expert.shape == (1, 2, 2, 2)
+    np.testing.assert_array_equal(
+        np.array([d.id for d in base.flat]), np.array([d.id for d in expert.flat])
+    )
+
+
+def test_expert_divisibility_error():
+    with pytest.raises(ValueError):
+        mesh_lib.initialize_model_parallel(
+            tensor_model_parallel_size=4, expert_model_parallel_size=4
+        )  # ep=4 cannot divide dp*cp=2
+
+
+def test_cp_ring_pairs():
+    mesh_lib.initialize_model_parallel(context_parallel_size=4, tensor_model_parallel_size=2)
+    fwd = mesh_lib.get_context_parallel_ring(forward=True)
+    assert fwd == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    bwd = mesh_lib.get_context_parallel_ring(forward=False)
+    assert bwd == [(0, 3), (1, 0), (2, 1), (3, 2)]
+
+
+def test_zero1_axes():
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=2)
+    assert mesh_lib.zero1_sharding_axes() == ("dp", "cp")
