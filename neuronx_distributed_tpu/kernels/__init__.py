@@ -1,0 +1,3 @@
+from neuronx_distributed_tpu.kernels.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

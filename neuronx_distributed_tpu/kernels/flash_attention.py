@@ -1,0 +1,322 @@
+"""Pallas TPU flash attention, forward + backward
+(reference: ``kernels/flash_attn.py`` — autograd shims over closed NKI
+``flash_fwd``/``flash_attn_bwd`` kernels; here the kernels themselves).
+
+Structure (canonical TPU flash attention):
+  * layout (B, H, S, D); grid (B, H, nQ, nK) with the K dimension innermost and
+    sequential, carrying the online-softmax state (running max m, sum l, and
+    the output accumulator) in VMEM scratch across K blocks;
+  * causal skipping: K blocks strictly above the diagonal are skipped;
+  * forward also emits LSE (= m + log l) per row, the residual the backward
+    uses to recompute attention probabilities blockwise — so no S×S matrix is
+    ever materialized in HBM (the reference kernel keeps the same residual);
+  * backward = two kernels over the same block structure: dK/dV (grid over K
+    blocks, loops Q) and dQ (grid over Q blocks, loops K), plus the standard
+    delta = rowsum(dO ⊙ O) preprocession.
+
+GQA is handled in the wrapper by repeating KV heads (cheap at the block level;
+per-head index mapping is a later optimization). Sequence lengths must divide
+the block size; the model layer falls back to the XLA einsum path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _pick_block(s: int, preferred: int = 512) -> int:
+    b = min(preferred, s)
+    while s % b != 0:
+        b //= 2
+    return max(b, 1)
+
+
+# --- forward ------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, causal, scale, block_q, block_k, num_k_blocks):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # k block
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: skip K blocks entirely above the diagonal
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)           # (BQ, D)
+        k = k_ref[0, 0].astype(jnp.float32)           # (BK, D)
+        v = v_ref[0, 0].astype(jnp.float32)           # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                      # (BQ, BK)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:]                              # (BQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                         # (BQ, BK)
+        alpha = jnp.exp(m_prev - m_new)                # (BQ, 1)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finish():
+        l = l_scr[:]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool):
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    nq, nk = s // block_q, sk // block_k
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# --- backward -----------------------------------------------------------------
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                 dk_scr, dv_scr, *, causal, scale, block_q, block_k, num_q_blocks):
+    j = pl.program_id(2)  # k block
+    i = pl.program_id(3)  # q block (sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)            # (BQ, D)
+        k = k_ref[0, 0].astype(jnp.float32)            # (BK, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)          # (BQ, D)
+        lse = lse_ref[0, 0]                            # (BQ, 1)
+        delta = delta_ref[0, 0]                        # (BQ, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                       # (BQ, BK)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                            # (BQ, BK)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                               # (BK, D)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                               # (BQ, BK)
+        ds = p * (dp - delta) * scale                   # (BQ, BK)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                               # (BK, D)
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+               *, causal, scale, block_q, block_k, num_k_blocks):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # k block (sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale                   # (BQ, BK)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(res, g, causal: bool, block_q: int, block_k: int, interpret: bool):
+    q, k, v, o, lse = res
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    nq, nk = s // block_q, sk // block_k
+    scale = 1.0 / (d ** 0.5)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,S,1)
+
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, x, y: (b_, h_, x, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, x, y: (b_, h_, y, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, x, y: (b_, h_, x, 0))
+
+    # dK/dV: grid over k blocks, q sequential — q-indexed inputs use the LAST
+    # grid dim, k-indexed the third.
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkdv_kernel, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        ),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0)),  # q
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),  # k
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),  # v
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0)),  # do
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0)),  # lse
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        ),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# --- public API ---------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_bhsd(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd_rule(causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, causal, block_q, block_k, interpret)
+
+
+_flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention on (B, S, H, D) inputs (reference API
+    ``nki_flash_attn_func``, flash_attn.py:156 — minus its seqlen%2048
+    restriction; any block-divisible length works). GQA (Hkv < H) supported."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    if h != hkv:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    bq = block_q or _pick_block(s)
+    bk = block_k or _pick_block(k.shape[1])
+    # (B, S, H, D) → (B, H, S, D)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash_attention_bhsd(qt, kt, vt, causal, bq, bk, interpret)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,19 @@
+from neuronx_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama2_7b,
+    llama2_70b,
+    llama3_8b,
+    tiny_llama,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "LlamaForCausalLM",
+    "LlamaModel",
+    "llama2_7b",
+    "llama2_70b",
+    "llama3_8b",
+    "tiny_llama",
+]

@@ -1,0 +1,304 @@
+"""Llama-2/3 model family, TPU-native (flagship; reference analogue:
+``examples/training/llama`` modeling files + the sharded-layer stack of §2.1).
+
+Structure: ParallelEmbedding → N × (RMSNorm → GQA attention → RMSNorm → SwiGLU
+MLP) → RMSNorm → column-parallel LM head → vocab-parallel cross entropy.
+All TP/SP behaviour comes from the parallel layers' sharding metadata; the
+model code is pure global-logical math. Attention dispatches to the Pallas
+flash kernel on TPU (kernels/flash_attention.py) or a reference XLA einsum
+path (used on CPU meshes and as the numerics golden).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.modules.qkv_linear import GQAQKVColumnParallelLinear
+from neuronx_distributed_tpu.modules.rms_norm import RMSNorm
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+    RowParallelLinear,
+)
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+from neuronx_distributed_tpu.parallel.sharding import UNC, constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    sequence_parallel: bool = False
+    remat: bool = True  # activation checkpointing per decoder layer
+    scan_layers: bool = True  # lax.scan over layers (fast compile at depth)
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+
+def llama2_7b(**over) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_layers=32, num_heads=32, num_kv_heads=32, max_seq_len=4096,
+    ), **over})
+
+
+def llama2_70b(**over) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, max_seq_len=4096,
+    ), **over})
+
+
+def llama3_8b(**over) -> LlamaConfig:
+    return LlamaConfig(**{**dict(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+        rope_theta=500000.0,
+    ), **over})
+
+
+def tiny_llama(**over) -> LlamaConfig:
+    """4-layer full-width-style shrunk config for tests (the reference's
+    integration trick: tiny depth, real structure —
+    test/integration/llama2_70B_4layers_PP)."""
+    return LlamaConfig(**{**dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=128,
+        dtype=jnp.float32, remat=False, scan_layers=False,
+    ), **over})
+
+
+# --- RoPE ---------------------------------------------------------------------
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float) -> jax.Array:
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (S, D/2)
+    return freqs
+
+
+def apply_rope(x: jax.Array, freqs: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+    """x: (B, S, H, D); freqs: (max_S, D/2); positions: (B, S) int or None."""
+    if positions is None:
+        f = freqs[: x.shape[1]][None, :, None, :]  # (1, S, 1, D/2)
+    else:
+        f = freqs[positions][:, :, None, :]  # (B, S, 1, D/2)
+    cos, sin = jnp.cos(f), jnp.sin(f)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# --- attention ----------------------------------------------------------------
+
+def _xla_attention(q, k, v, causal: bool = True):
+    """Reference einsum attention (golden path; CPU meshes). q:(B,S,H,D),
+    k/v:(B,S,Hkv,D) with Hkv | H (GQA broadcast)."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    qg = q.reshape(b, sq, hkv, group, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def _flash_attention(q, k, v, causal: bool = True):
+    from neuronx_distributed_tpu.kernels.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=causal)
+
+
+def attention_op(q, k, v, causal: bool = True, impl: str = "auto"):
+    if impl == "auto":
+        impl = "flash" if jax.devices()[0].platform == "tpu" else "xla"
+    if impl == "flash":
+        return _flash_attention(q, k, v, causal=causal)
+    return _xla_attention(q, k, v, causal=causal)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, freqs, positions=None):
+        cfg = self.config
+        d = cfg.head_dim_
+        q, k, v = GQAQKVColumnParallelLinear(
+            hidden_size=cfg.hidden_size,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=d,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="qkv",
+        )(x)
+        b, s = q.shape[0], q.shape[1]
+        q = q.reshape(b, s, cfg.num_heads, d)
+        k = k.reshape(b, s, cfg.num_kv_heads, d)
+        v = v.reshape(b, s, cfg.num_kv_heads, d)
+        # heads sharded over tp (kv heads too when divisible)
+        q = constrain(q, P(UNC, UNC, mesh_lib.TP_AXIS, None))
+        if self._kv_heads_shardable():
+            k = constrain(k, P(UNC, UNC, mesh_lib.TP_AXIS, None))
+            v = constrain(v, P(UNC, UNC, mesh_lib.TP_AXIS, None))
+        q = apply_rope(q, freqs, positions)
+        k = apply_rope(k, freqs, positions)
+        out = attention_op(q, k, v, causal=True, impl=self.attention_impl)
+        out = out.reshape(b, s, cfg.num_heads * d)
+        return RowParallelLinear(
+            cfg.num_heads * d,
+            cfg.hidden_size,
+            use_bias=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="o_proj",
+        )(out)
+
+    def _kv_heads_shardable(self) -> bool:
+        if not mesh_lib.model_parallel_is_initialized():
+            return True
+        tp = mesh_lib.get_tensor_model_parallel_size()
+        return self.config.num_kv_heads % tp == 0
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        common = dict(
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+        )
+        gate = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size, name="gate_proj", **common)(x)
+        up = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size, name="up_proj", **common)(x)
+        h = jax.nn.silu(gate) * up
+        return RowParallelLinear(cfg.intermediate_size, cfg.hidden_size, name="down_proj", **common)(h)
+
+
+class LlamaDecoderLayer(nn.Module):
+    config: LlamaConfig
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, freqs, positions=None):
+        cfg = self.config
+        norm = dict(
+            eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+        )
+        h = RMSNorm(cfg.hidden_size, name="input_norm", **norm)(x)
+        x = x + LlamaAttention(cfg, self.attention_impl, name="attn")(h, freqs, positions)
+        h = RMSNorm(cfg.hidden_size, name="post_attn_norm", **norm)(x)
+        x = x + LlamaMLP(cfg, name="mlp")(h)
+        return x
+
+
+class _ScanLayerAdapter(nn.Module):
+    """Adapts LlamaDecoderLayer to the (carry, out) signature ``nn.scan`` wants."""
+
+    config: LlamaConfig
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, freqs, positions):
+        layer_cls = nn.remat(LlamaDecoderLayer) if self.config.remat else LlamaDecoderLayer
+        x = layer_cls(self.config, self.attention_impl, name="layer")(x, freqs, positions)
+        return x, None
+
+
+class LlamaModel(nn.Module):
+    """Backbone without the LM head."""
+
+    config: LlamaConfig
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.config
+        x = ParallelEmbedding(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            name="embed",
+        )(input_ids)
+        freqs = rope_frequencies(cfg.head_dim_, cfg.max_seq_len, cfg.rope_theta)
+
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                _ScanLayerAdapter,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                in_axes=(nn.broadcast, nn.broadcast),
+                metadata_params={nn.PARTITION_NAME: None},
+            )(cfg, self.attention_impl, name="layers")
+            x, _ = scanned(x, freqs, positions)
+        else:
+            layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, self.attention_impl, name=f"layers_{i}")(
+                    x, freqs, positions
+                )
+        x = RMSNorm(
+            cfg.hidden_size, eps=cfg.rms_eps, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            sequence_parallel_enabled=cfg.sequence_parallel, name="final_norm",
+        )(x)
+        return x
+
+
+class LlamaForCausalLM(nn.Module):
+    config: LlamaConfig
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.config
+        x = LlamaModel(cfg, self.attention_impl, name="model")(input_ids, positions)
+        if cfg.sequence_parallel and x.ndim >= 3:
+            # leave SP for the logits: gather the sequence back
+            x = constrain(x, P(UNC, None, None))
+        logits = ColumnParallelLinear(
+            cfg.hidden_size, cfg.vocab_size, use_bias=False,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
+        )(x)
+        return logits
+
+    def loss(self, params, input_ids, labels):
+        logits = self.apply(params, input_ids)
+        return parallel_cross_entropy(logits, labels).mean()

@@ -1,0 +1,4 @@
+from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
+from neuronx_distributed_tpu.modules.rms_norm import RMSNorm
+
+__all__ = ["LayerNorm", "RMSNorm"]

@@ -1,0 +1,39 @@
+"""LayerNorm (reference: ``parallel_layers/layer_norm.py`` — a
+``torch.nn.LayerNorm`` subclass that tags weights for SP grad reduction and
+fp64-upcasts under ``XLA_DOWNCAST_BF16``). Here: flax LayerNorm computed in
+fp32 with an optional SP output constraint; sharded weight-grad reductions are
+XLA's job."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.sharding import UNC, constrain
+
+
+class LayerNorm(nn.Module):
+    hidden_size: int
+    eps: float = 1e-5
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    sequence_parallel_enabled: bool = False
+    axis: str = mesh_lib.TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(
+            epsilon=self.eps,
+            use_bias=self.use_bias,
+            dtype=jnp.float32,
+            param_dtype=self.param_dtype,
+            name="ln",
+        )(x.astype(jnp.float32)).astype(self.dtype)
+        if self.sequence_parallel_enabled and y.ndim >= 3:
+            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis, None))
+        return y

@@ -1,0 +1,103 @@
+"""GQA QKV projection with TP-aware KV-head handling
+(reference: ``modules/qkv_linear.py`` ``GQAQKVColumnParallelLinear:371``).
+
+The reference fuses Q/K/V into strided column-parallel weights and, when
+``tp_size > num_kv_heads``, physically replicates each KV head
+``kv_size_multiplier`` times with per-hardware replication orders
+(trn1 interleaved vs trn2 adjacent, parallel_state.arrange_kv_groups:1500) so
+every rank owns a KV head copy, plus a custom autograd doing the SP
+all-gather/reduce-scatter with separate q/k/v grads (qkv_linear.py:121).
+
+TPU-native translation:
+  * Q/K/V are separate params (XLA fuses independent matmuls; torch's reason
+    for strided fusion — one big GEMM — doesn't apply).
+  * KV-head replication becomes a *sharding decision*: when tp divides the KV
+    projection we shard it; when tp > num_kv_heads we leave the (small) KV
+    params replicated — numerically identical to the reference's replication,
+    with XLA deciding whether to all-gather activations or replicate compute.
+  * The SP gather/scatter pair is the same sharding-constraint mechanism as
+    ColumnParallelLinear.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear, default_kernel_init
+
+
+class GQAQKVColumnParallelLinear(nn.Module):
+    """Computes (q, k, v) projections. ``hidden_size → (H·D, Hkv·D, Hkv·D)``."""
+
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    use_bias: bool = False
+    sequence_parallel_enabled: bool = False
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = default_kernel_init
+    axis: str = mesh_lib.TP_AXIS
+
+    def _kv_shardable(self) -> bool:
+        if not mesh_lib.model_parallel_is_initialized():
+            return True
+        tp = mesh_lib.get_mesh().shape[self.axis]
+        return (self.num_kv_heads * self.head_dim) % tp == 0 and self.num_kv_heads % tp == 0
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        q = ColumnParallelLinear(
+            self.hidden_size,
+            self.num_heads * self.head_dim,
+            use_bias=self.use_bias,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=self.kernel_init,
+            axis=self.axis,
+            name="q_proj",
+        )(x)
+        kv_axis = self.axis if self._kv_shardable() else None
+        kv_kwargs = dict(
+            use_bias=self.use_bias,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=self.kernel_init,
+        )
+        if kv_axis is None:
+            # tp > num_kv_heads: replicated KV params (the reference's
+            # kv_size_multiplier replication, expressed as sharding)
+            k = nn.DenseGeneral(
+                self.num_kv_heads * self.head_dim,
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=self.kernel_init,
+                name="k_proj",
+            )(x.astype(self.dtype))
+            v = nn.DenseGeneral(
+                self.num_kv_heads * self.head_dim,
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=self.kernel_init,
+                name="v_proj",
+            )(x.astype(self.dtype))
+        else:
+            k = ColumnParallelLinear(
+                self.hidden_size, self.num_kv_heads * self.head_dim,
+                axis=self.axis, name="k_proj", **kv_kwargs,
+            )(x)
+            v = ColumnParallelLinear(
+                self.hidden_size, self.num_kv_heads * self.head_dim,
+                axis=self.axis, name="v_proj", **kv_kwargs,
+            )(x)
+        return q, k, v

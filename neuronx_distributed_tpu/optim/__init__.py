@@ -1,0 +1,6 @@
+from neuronx_distributed_tpu.optim.zero1 import (
+    zero1_partition_spec,
+    zero1_shardings_for_opt_state,
+)
+
+__all__ = ["zero1_partition_spec", "zero1_shardings_for_opt_state"]

@@ -1,0 +1,103 @@
+"""ZeRO-1 optimizer-state sharding (reference: ``optimizer/
+zero_redundancy_optimizer.py`` ``NeuronZero1Optimizer:29``).
+
+The reference wraps torch_xla's ZeroRedundancyOptimizer: reduce-scatter grads
+over the DP(×CP) sharding groups, step a local shard, all-gather params. On
+TPU the same dataflow falls out of sharding annotations: give each optimizer
+moment (mu/nu) a PartitionSpec that extends its param's spec by sharding the
+largest still-unsharded dimension over the zero-1 axes (dp, cp), and jit the
+train step with those as in/out shardings. XLA's partitioner then turns the DP
+grad all-reduce into reduce-scatter + the param update's all-gather — exactly
+the ZeRO-1 schedule, chosen per-tensor, overlapping with compute.
+
+``zero1_partition_spec`` is the policy; ``zero1_shardings_for_opt_state``
+applies it to an arbitrary optax state pytree by matching param paths (mu/nu
+subtrees carry the same relative paths as the param tree).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+
+def zero1_partition_spec(
+    param_spec: P, shape: Tuple[int, ...], mesh=None, axes: Optional[Tuple[str, ...]] = None
+) -> P:
+    """Extend ``param_spec`` by sharding the largest unsharded, divisible dim
+    over the zero-1 axes (dp, cp — reference get_zero1_sharding_groups,
+    parallel_state.py:1579). Falls back to the param spec when nothing fits."""
+    mesh = mesh or mesh_lib.get_mesh()
+    axes = axes or mesh_lib.zero1_sharding_axes()
+    axes = tuple(a for a in axes if a in mesh.shape)
+    n = int(np.prod([mesh.shape[a] for a in axes]))
+    if n == 1 or not shape:
+        return param_spec
+    entries = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    # largest-first so the big dim (e.g. vocab or ffn) takes the dp shard
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in order:
+        if entries[i] is None and shape[i] % n == 0:
+            entries[i] = axes if len(axes) > 1 else axes[0]
+            return P(*entries)
+    return param_spec
+
+
+def _flatten_with_path(tree):
+    return jax.tree_util.tree_flatten_with_path(tree)
+
+
+def _path_suffix_key(path) -> Tuple[str, ...]:
+    out = []
+    for p in path:
+        if hasattr(p, "key"):
+            out.append(str(p.key))
+        elif hasattr(p, "idx"):
+            out.append(str(p.idx))
+        elif hasattr(p, "name"):
+            out.append(str(p.name))
+    return tuple(out)
+
+
+def zero1_shardings_for_opt_state(
+    opt_state_shapes: Any,
+    params: Any,
+    param_specs: Any,
+    mesh=None,
+    enabled: bool = True,
+) -> Any:
+    """Build a NamedSharding pytree for an optax state.
+
+    ``opt_state_shapes``: ``jax.eval_shape(optimizer.init, params)``.
+    Leaves whose path-suffix matches a param path get that param's zero-1 spec;
+    everything else (step counts, scalars) is replicated. With
+    ``enabled=False`` moments get the plain param spec (non-ZeRO baseline).
+    """
+    mesh = mesh or mesh_lib.get_mesh()
+    param_leaves, _ = _flatten_with_path(params)
+    spec_leaves, _ = _flatten_with_path(param_specs)
+    by_suffix = {}
+    for (ppath, pleaf), (_, sleaf) in zip(param_leaves, spec_leaves):
+        by_suffix[_path_suffix_key(ppath)] = (pleaf.shape, sleaf)
+
+    def resolve(path, leaf):
+        key = _path_suffix_key(path)
+        # match the longest param-path suffix embedded in the opt-state path
+        for start in range(len(key)):
+            suffix = key[start:]
+            if suffix in by_suffix:
+                shape, spec = by_suffix[suffix]
+                if tuple(leaf.shape) == tuple(shape):
+                    if enabled:
+                        return NamedSharding(mesh, zero1_partition_spec(spec, shape, mesh))
+                    return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    flat, treedef = _flatten_with_path(opt_state_shapes)
+    return jax.tree_util.tree_unflatten(treedef, [resolve(p, l) for p, l in flat])

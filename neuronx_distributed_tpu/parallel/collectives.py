@@ -37,20 +37,26 @@ def all_reduce_min(x, axis_name: AxisName):
 
 def all_gather(x, axis_name: AxisName, dim: int = 0):
     """Concatenate shards along ``dim`` (reference comm.py:163 all_gather)."""
-    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+    return lax.all_gather(x, axis_name, axis=dim % x.ndim, tiled=True)
 
 
 def reduce_scatter(x, axis_name: AxisName, dim: int = 0):
     """Sum then scatter along ``dim`` (reference comm.py:124 reduce_scatter;
     on gloo the reference hand-rolls it — XLA has it natively)."""
-    return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+    return lax.psum_scatter(
+        x, axis_name, scatter_dimension=dim % x.ndim, tiled=True
+    )
 
 
 def all_to_all(x, axis_name: AxisName, split_dim: int, concat_dim: int):
     """Exchange equal splits between all members of the axis
     (reference mappings.py:165 via ``xm.all_to_all``)."""
     return lax.all_to_all(
-        x, axis_name, split_axis=split_dim, concat_axis=concat_dim, tiled=True
+        x,
+        axis_name,
+        split_axis=split_dim % x.ndim,
+        concat_axis=concat_dim % x.ndim,
+        tiled=True,
     )
 
 

@@ -1,0 +1,40 @@
+"""Gradient norm / clipping across sharded pytrees
+(reference: ``parallel_layers/grads.py``).
+
+The reference computes TP/EP/PP-aware global grad norms with hand-placed
+all-reduces and a force-SPMD dedup trick (grads.py:41), bucketed DP all-reduce
+(grads.py:259), and marked-parameter SP/CP reductions (grads.py:330,:348).
+Under GSPMD none of that bookkeeping exists: every gradient leaf is one global
+logical tensor (sharded however its param is), so a plain sum-of-squares psums
+over exactly the right axes, DP grad reduction happens inside the jitted train
+step's autodiff (as reduce-scatter when ZeRO-1 shards the update), and there is
+no duplicate-gradient double counting to correct. These helpers are jit-ready
+and operate on global logical values.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def global_grad_norm(grads) -> jax.Array:
+    """L2 norm over every leaf, computed in fp32 (reference get_grad_norm,
+    grads.py:41 — minus the TP dedup games, which GSPMD makes unnecessary)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    )
+
+
+def clip_grad_norm(grads, max_norm: float, eps: float = 1e-6) -> Tuple[object, jax.Array]:
+    """Scale grads so the global norm is at most ``max_norm``
+    (reference clip_grad_norm, grads.py:192). Returns (clipped, pre-clip norm)."""
+    norm = global_grad_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + eps))
+    clipped = jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+    return clipped, norm

@@ -1,0 +1,63 @@
+"""Vocab-parallel loss functions (reference: ``parallel_layers/loss_functions.py``).
+
+``parallel_cross_entropy`` (reference :217) computes cross-entropy over
+tp-sharded logits without materializing the full softmax on any rank: the
+reference hand-writes the max/sum all-reduces over the TP group
+(loss_functions.py:10-128). Here the logits carry a vocab-dim sharding and the
+reductions are ordinary ``max``/``logsumexp`` — XLA partitions them into
+exactly those collectives. Numerics: fp32 upcast + max-subtraction, optional
+label smoothing (same formulation as reference :96-104).
+
+``from_parallel_logits_to_logprobs`` (reference :206) is the RLHF/DPO helper
+returning per-token logprobs of the taken action.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def parallel_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Per-token cross entropy. ``logits``: (..., V) possibly vocab-sharded;
+    ``labels``: (...) int32. Returns (...) fp32 losses (no reduction, matching
+    the reference which returns per-token loss for the caller to average)."""
+    logits = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+    label_logit = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    loss = lse - label_logit
+    if label_smoothing > 0.0:
+        # smoothed target: (1-eps) one-hot + eps/V uniform
+        # loss = (1-eps) * nll + eps * mean_v (lse - logit_v)
+        eps = label_smoothing
+        mean_logit = jnp.mean(logits, axis=-1)
+        loss = (1.0 - eps) * loss + eps * (lse - mean_logit)
+    return loss
+
+
+def parallel_log_softmax(logits: jax.Array) -> jax.Array:
+    """Distributed log-softmax over the (sharded) vocab dim (reference
+    DistributedLogprob, loss_functions.py:131-152)."""
+    logits = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
+    return shifted - lse
+
+
+def from_parallel_logits_to_logprobs(
+    logits: jax.Array, targets: jax.Array
+) -> jax.Array:
+    """Logprob of each target token under next-token prediction: logits[t]
+    scores targets[t+1] (reference loss_functions.py:206 shifts the same way).
+    ``logits``: (B, S, V), ``targets``: (B, S) → returns (B, S-1)."""
+    logp = parallel_log_softmax(logits[:, :-1, :])
+    return jnp.take_along_axis(
+        logp, targets[:, 1:, None].astype(jnp.int32), axis=-1
+    )[..., 0]

@@ -1,0 +1,25 @@
+from neuronx_distributed_tpu.trainer.trainer import (
+    OptimizerConfig,
+    TrainingConfig,
+    TrainState,
+    build_train_step,
+    create_train_state,
+    initialize_parallel_model,
+    initialize_parallel_optimizer,
+    make_optimizer,
+    neuronx_distributed_tpu_config,
+    shard_batch,
+)
+
+__all__ = [
+    "OptimizerConfig",
+    "TrainingConfig",
+    "TrainState",
+    "build_train_step",
+    "create_train_state",
+    "initialize_parallel_model",
+    "initialize_parallel_optimizer",
+    "make_optimizer",
+    "neuronx_distributed_tpu_config",
+    "shard_batch",
+]

@@ -38,7 +38,7 @@ class _Rank0Filter(logging.Filter):
         return record.levelno >= logging.ERROR or _process_index() == 0
 
 
-def get_logger(name: str = "neuronx_distributed_tpu", rank0_only: bool = True) -> logging.Logger:
+def get_logger(name: str = "neuronx_distributed_tpu") -> logging.Logger:
     global _CONFIGURED
     logger = logging.getLogger(name)
     if not _CONFIGURED:
@@ -50,11 +50,13 @@ def get_logger(name: str = "neuronx_distributed_tpu", rank0_only: bool = True) -
                 datefmt="%H:%M:%S",
             )
         )
+        # the filter must live on the HANDLER: records from child loggers
+        # (get_logger(__name__)) propagate up without running logger filters
+        handler.addFilter(_Rank0Filter())
         root = logging.getLogger("neuronx_distributed_tpu")
         root.addHandler(handler)
         root.setLevel(level)
         root.propagate = False
-        root.addFilter(_Rank0Filter())
         _CONFIGURED = True
     return logger
 

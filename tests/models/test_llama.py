@@ -1,0 +1,146 @@
+"""Llama model tests: TP-degree invariance, GQA math, scan/loop equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from neuronx_distributed_tpu.models.llama import (
+    LlamaForCausalLM,
+    _xla_attention,
+    apply_rope,
+    rope_frequencies,
+    tiny_llama,
+)
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+from neuronx_distributed_tpu.parallel.sharding import param_shardings
+
+
+def _materialize(model, key, ids):
+    boxed = jax.jit(model.init)(key, ids)
+    return jax.device_put(meta.unbox(boxed), param_shardings(boxed))
+
+
+def _run(config, ids, key):
+    model = LlamaForCausalLM(config, attention_impl="xla")
+    params = _materialize(model, key, ids)
+    logits = jax.jit(model.apply)(params, ids)
+    return model, params, logits
+
+
+def test_forward_tp_invariance():
+    key = jax.random.PRNGKey(0)
+    ids = jax.random.randint(jax.random.fold_in(key, 1), (2, 16), 0, 256)
+    outs = []
+    for tp in (1, 4):
+        mesh_lib.destroy_model_parallel()
+        mesh_lib.initialize_model_parallel(tensor_model_parallel_size=tp)
+        _, _, logits = _run(tiny_llama(), ids, key)
+        outs.append(np.asarray(logits, dtype=np.float32))
+    np.testing.assert_allclose(outs[0], outs[1], atol=2e-5)
+
+
+def test_grads_tp_invariance():
+    key = jax.random.PRNGKey(2)
+    ids = jax.random.randint(jax.random.fold_in(key, 1), (2, 16), 0, 256)
+    labels = jax.random.randint(jax.random.fold_in(key, 2), (2, 16), 0, 256)
+
+    norms = []
+    for tp in (1, 4):
+        mesh_lib.destroy_model_parallel()
+        mesh_lib.initialize_model_parallel(tensor_model_parallel_size=tp)
+        model = LlamaForCausalLM(tiny_llama(), attention_impl="xla")
+        params = _materialize(model, key, ids)
+
+        def loss_fn(p):
+            logits = model.apply(p, ids)
+            return parallel_cross_entropy(logits, labels).mean()
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+        gnorm = jnp.sqrt(
+            sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in jax.tree.leaves(grads))
+        )
+        norms.append((float(loss), float(gnorm)))
+    assert abs(norms[0][0] - norms[1][0]) < 1e-4, norms
+    assert abs(norms[0][1] - norms[1][1]) / norms[0][1] < 1e-4, norms
+
+
+def test_gqa_attention_matches_mha_expansion():
+    """GQA grouped einsum == full MHA with kv heads repeated."""
+    key = jax.random.PRNGKey(3)
+    b, s, h, hkv, d = 2, 8, 8, 2, 16
+    q = jax.random.normal(key, (b, s, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, hkv, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, hkv, d))
+    out = _xla_attention(q, k, v, causal=True)
+    k_full = jnp.repeat(k, h // hkv, axis=2)
+    v_full = jnp.repeat(v, h // hkv, axis=2)
+    ref = _xla_attention(q, k_full, v_full, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_attention_causality():
+    key = jax.random.PRNGKey(4)
+    b, s, h, d = 1, 8, 2, 8
+    q = jax.random.normal(key, (b, s, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, h, d))
+    out1 = _xla_attention(q, k, v, causal=True)
+    # perturbing future positions must not change earlier outputs
+    k2 = k.at[:, -1].add(100.0)
+    v2 = v.at[:, -1].add(100.0)
+    out2 = _xla_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), atol=1e-5)
+    assert not np.allclose(np.asarray(out1[:, -1]), np.asarray(out2[:, -1]))
+
+
+def test_rope_relative_property():
+    """RoPE: <q_m, k_n> depends only on m-n (shift both positions → same score)."""
+    d = 16
+    freqs = rope_frequencies(d, 64, 10000.0)
+    key = jax.random.PRNGKey(5)
+    q = jax.random.normal(key, (1, 1, 1, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, 1, 1, d))
+
+    def score(pos_q, pos_k):
+        qr = apply_rope(q, freqs, jnp.array([[pos_q]]))
+        kr = apply_rope(k, freqs, jnp.array([[pos_k]]))
+        return float(jnp.sum(qr * kr))
+
+    assert abs(score(5, 3) - score(25, 23)) < 1e-4
+    assert abs(score(0, 0) - score(40, 40)) < 1e-4
+
+
+def test_scan_and_loop_match(tp4_mesh):
+    key = jax.random.PRNGKey(7)
+    ids = jax.random.randint(jax.random.fold_in(key, 1), (1, 8), 0, 256)
+    cfg_loop = tiny_llama()
+    cfg_scan = tiny_llama(scan_layers=True, remat=True)
+
+    model_loop = LlamaForCausalLM(cfg_loop, attention_impl="xla")
+    params_loop = _materialize(model_loop, key, ids)
+    out_loop = jax.jit(model_loop.apply)(params_loop, ids)
+
+    model_scan = LlamaForCausalLM(cfg_scan, attention_impl="xla")
+    params_scan = _materialize(model_scan, key, ids)
+    out_scan = jax.jit(model_scan.apply)(params_scan, ids)
+
+    # different init (per-layer rng folding differs) → compare shapes + finite
+    assert out_loop.shape == out_scan.shape == (1, 8, 256)
+    assert np.isfinite(np.asarray(out_loop, dtype=np.float32)).all()
+    assert np.isfinite(np.asarray(out_scan, dtype=np.float32)).all()
+
+
+def test_gqa_kv_replicated_when_tp_exceeds_kv_heads(tp8_mesh):
+    """tp=8 > kv_heads=4 → KV params replicated (reference kv_size_multiplier
+    path, qkv_linear.py:371), model still correct."""
+    key = jax.random.PRNGKey(8)
+    ids = jax.random.randint(jax.random.fold_in(key, 1), (1, 8), 0, 256)
+    model = LlamaForCausalLM(tiny_llama(), attention_impl="xla")
+    params = _materialize(model, key, ids)
+    k_kernel = params["params"]["model"]["layers_0"]["attn"]["qkv"]["k_proj"]["kernel"]
+    assert k_kernel.sharding.is_fully_replicated
+    logits = jax.jit(model.apply)(params, ids)
+    assert np.isfinite(np.asarray(logits, dtype=np.float32)).all()

@@ -1,0 +1,97 @@
+"""End-to-end training tests on the virtual mesh: loss goes down, ZeRO-1 state
+is actually dp-sharded, and ZeRO-1 vs replicated optimizer states produce
+identical parameters (the reference's zero-1 equivalence check,
+test/integration/convert_checkpoints/check_zero1_equal.py, done live)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.trainer import (
+    OptimizerConfig,
+    build_train_step,
+    create_train_state,
+    make_optimizer,
+    neuronx_distributed_tpu_config,
+    shard_batch,
+)
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+
+def _setup(zero1=True, tp=4, lr=1e-2):
+    cfg = neuronx_distributed_tpu_config(
+        tensor_parallel_size=tp,
+        optimizer=OptimizerConfig(learning_rate=lr, zero1=zero1, weight_decay=0.0),
+    )
+    model = LlamaForCausalLM(tiny_llama(), attention_impl="xla")
+    optimizer = make_optimizer(cfg.optimizer)
+    key = jax.random.PRNGKey(0)
+    ids = jax.random.randint(jax.random.fold_in(key, 1), (4, 16), 0, 256)
+    state, p_sh, s_sh = create_train_state(
+        model, optimizer, key, ids, zero1=zero1
+    )
+    step = build_train_step(
+        model, optimizer, p_sh, s_sh, max_grad_norm=cfg.optimizer.max_grad_norm
+    )
+    batch = shard_batch({"input_ids": ids, "labels": jnp.roll(ids, -1, axis=1)})
+    return state, step, batch
+
+
+def test_loss_decreases():
+    state, step, batch = _setup()
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses
+    assert int(state.step) == 10
+    assert np.isfinite(losses).all()
+
+
+def test_zero1_state_is_dp_sharded():
+    state, step, batch = _setup(zero1=True)
+    # find an adam moment for a big param and check dp appears in its spec
+    leaves = jax.tree_util.tree_leaves_with_path(state.opt_state)
+    dp_sharded = [
+        (path, leaf)
+        for path, leaf in leaves
+        if hasattr(leaf, "sharding")
+        and leaf.ndim >= 1
+        and any("dp" in str(e) for e in (leaf.sharding.spec or ()))
+    ]
+    assert dp_sharded, "no optimizer-state leaf is dp-sharded under zero1"
+
+
+def test_non_zero1_state_matches_param_sharding():
+    state, step, batch = _setup(zero1=False)
+    leaves = jax.tree_util.tree_leaves_with_path(state.opt_state)
+    for path, leaf in leaves:
+        if hasattr(leaf, "sharding") and leaf.ndim >= 1:
+            assert not any(
+                "dp" in str(e) for e in (leaf.sharding.spec or ())
+            ), f"{path} dp-sharded without zero1"
+
+
+def test_zero1_equivalence():
+    """Same seed/batch: zero1 and non-zero1 runs produce identical params."""
+    outs = []
+    for zero1 in (True, False):
+        mesh_lib.destroy_model_parallel()
+        state, step, batch = _setup(zero1=zero1)
+        for _ in range(3):
+            state, metrics = step(state, batch)
+        outs.append(jax.device_get(state.params))
+    flat0 = jax.tree.leaves(outs[0])
+    flat1 = jax.tree.leaves(outs[1])
+    for a, b in zip(flat0, flat1):
+        # collective reduction order differs (reduce-scatter vs all-reduce) →
+        # allow fp32 accumulation noise
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_grad_norm_metric_reported():
+    state, step, batch = _setup()
+    _, metrics = step(state, batch)
+    assert float(metrics["grad_norm"]) > 0
