@@ -292,6 +292,41 @@ def _bwd_rule(causal, block_q, block_k, interpret, res, g):
 _flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
 
 
+def _sharded_kernel_call(qt, kt, vt, causal, bq, bk, interpret):
+    """GSPMD cannot auto-partition Mosaic custom calls ("Mosaic kernels cannot
+    be automatically partitioned") — the kernel must sit inside an explicit
+    shard_map over the data-parallel axes: batch over dp, heads over tp (the
+    kernel's grid is embarrassingly parallel over both). Sequence stays whole —
+    cp sequence sharding belongs to ring attention, so the in_specs force a
+    gather over cp if the caller left seq cp-sharded."""
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    if not mesh_lib.model_parallel_is_initialized():
+        return _flash_attention_bhsd(qt, kt, vt, causal, bq, bk, interpret)
+    mesh = mesh_lib.get_mesh()
+    b, h = qt.shape[0], qt.shape[1]
+    dp = mesh.shape[mesh_lib.DP_AXIS]
+    tp = mesh.shape[mesh_lib.TP_AXIS]
+    bspec = mesh_lib.DP_AXIS if (dp > 1 and b % dp == 0) else None
+    hspec = mesh_lib.TP_AXIS if (tp > 1 and h % tp == 0) else None
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(bspec, hspec, None, None)
+    # when tracing inside another (partial-manual) shard_map — e.g. the
+    # pipeline engine's pp region — the nested call must bind the context's
+    # AbstractMesh, not the concrete one
+    ctx_mesh = jax.sharding.get_abstract_mesh()
+    fn = jax.shard_map(
+        lambda a, b_, c: _flash_attention_bhsd(a, b_, c, causal, bq, bk, interpret),
+        mesh=mesh if ctx_mesh.empty else ctx_mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={mesh_lib.DP_AXIS, mesh_lib.CP_AXIS, mesh_lib.TP_AXIS},
+        check_vma=False,
+    )
+    return fn(qt, kt, vt)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -318,5 +353,5 @@ def flash_attention(
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash_attention_bhsd(qt, kt, vt, causal, bq, bk, interpret)
+    out = _sharded_kernel_call(qt, kt, vt, causal, bq, bk, interpret)
     return jnp.swapaxes(out, 1, 2)

@@ -25,6 +25,9 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
 
 
 def zero1_partition_spec(
@@ -71,6 +74,7 @@ def zero1_shardings_for_opt_state(
     param_specs: Any,
     mesh=None,
     enabled: bool = True,
+    axes: Optional[Tuple[str, ...]] = None,
 ) -> Any:
     """Build a NamedSharding pytree for an optax state.
 
@@ -80,6 +84,17 @@ def zero1_shardings_for_opt_state(
     ``enabled=False`` moments get the plain param spec (non-ZeRO baseline).
     """
     mesh = mesh or mesh_lib.get_mesh()
+    if enabled and mesh.shape.get(mesh_lib.PP_AXIS, 1) > 1:
+        # Known XLA SPMD-partitioner CHECK crash (spmd_partitioner_util.cc:495,
+        # jaxlib 0.9) when optimizer moments carry pp+dp mixed shardings fed by
+        # grads from a partial-manual shard_map. Fall back to param-sharded
+        # optimizer state under pipeline parallelism until the explicit
+        # shard_map ZeRO-1 path lands.
+        logger.warning(
+            "zero1 optimizer-state sharding disabled under pipeline parallelism "
+            "(XLA partitioner limitation); optimizer state uses param shardings"
+        )
+        enabled = False
     param_leaves, _ = _flatten_with_path(params)
     spec_leaves, _ = _flatten_with_path(param_specs)
     by_suffix = {}
@@ -95,7 +110,9 @@ def zero1_shardings_for_opt_state(
                 shape, spec = by_suffix[suffix]
                 if tuple(leaf.shape) == tuple(shape):
                     if enabled:
-                        return NamedSharding(mesh, zero1_partition_spec(spec, shape, mesh))
+                        return NamedSharding(
+                            mesh, zero1_partition_spec(spec, shape, mesh, axes=axes)
+                        )
                     return NamedSharding(mesh, spec)
         return NamedSharding(mesh, P())
 

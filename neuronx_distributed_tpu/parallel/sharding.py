@@ -21,12 +21,20 @@ from neuronx_distributed_tpu.parallel import mesh as mesh_lib
 
 UNC = P.UNCONSTRAINED
 
-
 def constrain(x, spec: P):
     """``with_sharding_constraint`` over the global mesh; no-op when the mesh is
-    not initialized (pure single-device use)."""
+    not initialized (pure single-device use).
+
+    Inside a partial-manual ``shard_map`` (e.g. the pipeline engine, manual
+    over pp with tp/dp auto) the tracing context carries an AbstractMesh with
+    Manual axis types, and a NamedSharding over the concrete mesh is rejected —
+    there the bare PartitionSpec form binds to the context mesh instead. Manual
+    axes must simply not appear in ``spec`` (ours name only tp/cp/dp)."""
     if not mesh_lib.model_parallel_is_initialized():
         return x
+    ctx_mesh = jax.sharding.get_abstract_mesh()
+    if not ctx_mesh.empty and not ctx_mesh.are_all_axes_auto:
+        return jax.lax.with_sharding_constraint(x, spec)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh_lib.get_mesh(), spec)
     )

@@ -1,0 +1,16 @@
+from neuronx_distributed_tpu.pipeline.model import PipelineEngine, microbatch
+from neuronx_distributed_tpu.pipeline.scheduler import (
+    InferenceSchedule,
+    Train1F1BSchedule,
+    TrainInterleavedSchedule,
+    validate_schedule,
+)
+
+__all__ = [
+    "PipelineEngine",
+    "microbatch",
+    "InferenceSchedule",
+    "Train1F1BSchedule",
+    "TrainInterleavedSchedule",
+    "validate_schedule",
+]

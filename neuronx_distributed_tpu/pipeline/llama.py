@@ -1,0 +1,132 @@
+"""Llama ↔ PipelineEngine adapter: the "manual partition" path
+(reference: ``pipeline/manual_pipe_stage.py`` ``PipelineStageModule`` — the
+user-supplied-layer-list mode, which SURVEY.md §7 identifies as the idiomatic
+one for a scan-form JAX model; FX graph tracing is a torch-ism with no TPU
+equivalent needed)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaDecoderLayer,
+    rope_frequencies,
+)
+from neuronx_distributed_tpu.modules.rms_norm import RMSNorm
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+)
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+from neuronx_distributed_tpu.pipeline.model import PipelineEngine
+
+
+def llama_pipeline_engine(
+    config: LlamaConfig, num_microbatches: int, attention_impl: str = "auto"
+) -> PipelineEngine:
+    """Build a PipelineEngine for a scan-form Llama (config.scan_layers=True)."""
+    embed = ParallelEmbedding(
+        num_embeddings=config.vocab_size,
+        features=config.hidden_size,
+        dtype=config.dtype,
+        param_dtype=config.param_dtype,
+        sequence_parallel_enabled=config.sequence_parallel,
+    )
+    layer = LlamaDecoderLayer(config, attention_impl)
+    final_norm = RMSNorm(
+        config.hidden_size,
+        eps=config.rms_eps,
+        dtype=config.dtype,
+        param_dtype=config.param_dtype,
+        sequence_parallel_enabled=config.sequence_parallel,
+    )
+    lm_head = ColumnParallelLinear(
+        config.hidden_size,
+        config.vocab_size,
+        use_bias=False,
+        dtype=config.dtype,
+        param_dtype=config.param_dtype,
+    )
+    freqs = rope_frequencies(config.head_dim_, config.max_seq_len, config.rope_theta)
+
+    def embed_apply(ep, mb_batch):
+        return embed.apply({"params": ep}, mb_batch["input_ids"])
+
+    def layer_apply(lp, x):
+        return layer.apply({"params": lp}, x, freqs, None)
+
+    def head_apply(hp, x, mb_batch):
+        h = final_norm.apply({"params": hp["final_norm"]}, x)
+        logits = lm_head.apply({"params": hp["lm_head"]}, h)
+        losses = parallel_cross_entropy(logits, mb_batch["labels"])
+        mask = mb_batch.get("loss_mask")
+        if mask is None:
+            mask = jnp.ones_like(losses)
+        return (losses * mask).sum(), mask.sum().astype(jnp.float32)
+
+    return PipelineEngine(
+        embed_apply=embed_apply,
+        layer_apply=layer_apply,
+        head_apply=head_apply,
+        num_layers=config.num_layers,
+        num_microbatches=num_microbatches,
+        remat_layers=config.remat,
+    )
+
+
+def llama_params_to_pipeline(params: Dict[str, Any], engine: PipelineEngine):
+    """Convert scan-form LlamaForCausalLM params into the engine's layout.
+    The scan adapter nests each layer under 'layer'
+    (models/llama.py _ScanLayerAdapter)."""
+    p = params["params"]
+    return {
+        "embed": p["model"]["embed"],
+        "layers": engine.reshape_layer_params(p["model"]["layers"]["layer"]),
+        "head": {
+            "final_norm": p["model"]["final_norm"],
+            "lm_head": p["lm_head"],
+        },
+    }
+
+
+def llama_pipeline_shardings(boxed_variables, engine: PipelineEngine):
+    """NamedShardings for the pipeline param layout, from the scan-form model's
+    flax metadata: layers get (pp, None, *param-spec), embed/head keep theirs."""
+    from flax import linen as nn
+    from jax.sharding import NamedSharding
+
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.get_mesh()
+    specs = nn.get_partition_spec(boxed_variables)["params"]
+    pp_specs = {
+        "embed": specs["model"]["embed"],
+        "layers": engine.stack_layer_specs(specs["model"]["layers"]["layer"]),
+        "head": {
+            "final_norm": specs["model"]["final_norm"],
+            "lm_head": specs["lm_head"],
+        },
+    }
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        pp_specs,
+        is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec),
+    )
+
+
+def pipeline_params_to_llama(pp_params: Dict[str, Any], engine: PipelineEngine):
+    """Inverse conversion (for checkpoint interchange)."""
+    return {
+        "params": {
+            "model": {
+                "embed": pp_params["embed"],
+                "layers": {"layer": engine.unshape_layer_params(pp_params["layers"])},
+                "final_norm": pp_params["head"]["final_norm"],
+            },
+            "lm_head": pp_params["head"]["lm_head"],
+        }
+    }

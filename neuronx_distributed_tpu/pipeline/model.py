@@ -1,0 +1,193 @@
+"""Pipeline-parallel runtime (reference: ``pipeline/model.py`` ``NxDPPModel:80``).
+
+The reference FX-traces the model, partitions the graph, and hand-executes
+scheduler task lists per process with send/recv-as-allgather and a mark_step
+per task (model.py:1737, comm.py:40). The TPU-native runtime instead compiles
+the ENTIRE schedule into one XLA program:
+
+  * ``shard_map`` over ONLY the ``pp`` mesh axis — tp/dp/cp stay "auto", so the
+    GSPMD layers (ColumnParallel/RowParallel/...) keep working inside each
+    stage and XLA still inserts/overlaps their collectives;
+  * stage weights are the scan-stacked layer params reshaped (L,...) →
+    (S, L/S, ...) with the stage dim sharded over pp — each rank holds its
+    stage's layers;
+  * the microbatch loop is a ``lax.scan`` of M + S - 1 ticks; each tick every
+    stage applies its layers and passes activations to the next stage with a
+    non-wrapping ``lax.ppermute`` (the TPU-native replacement for the
+    reference's 2-rank-allgather p2p, pipeline/comm.py:40);
+  * backward comes from ``jax.grad`` through the scan: XLA reverses the
+    ppermutes, giving the mirrored drain schedule. Per-layer ``jax.checkpoint``
+    bounds activation memory (the role 1F1B plays in the reference; here the
+    schedule is GPipe-shaped with rematerialized stages — same bubble fraction,
+    bounded memory). The pure-Python 1F1B/interleaved task streams live in
+    pipeline/scheduler.py as the semantic contract and for an explicitly
+    scheduled runtime.
+
+Shared-weight (tied embedding) grad sync (reference model.py:1687) is automatic:
+embedding params enter the loss once via stage 0's compute, and autodiff sums
+contributions — there is no second copy to reconcile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass
+class PipelineEngine:
+    """Compiles a (embed → scanned layers → head) model into a pp-pipelined
+    loss function.
+
+    ``embed_apply(embed_params, mb_batch) -> x``
+    ``layer_apply(layer_params, x) -> x``            (one layer; scanned)
+    ``head_apply(head_params, x, mb_batch) -> (loss_sum, weight_sum)``
+    """
+
+    embed_apply: Callable
+    layer_apply: Callable
+    head_apply: Callable
+    num_layers: int
+    num_microbatches: int
+    remat_layers: bool = True
+
+    def _stages(self) -> int:
+        return mesh_lib.get_pipeline_model_parallel_size()
+
+    # --- param layout ---------------------------------------------------------
+
+    def stack_layer_specs(self, layer_specs):
+        """(L, ...) per-layer specs → (S, L/S, ...) with pp on the stage dim."""
+
+        def fix(spec):
+            entries = list(spec)
+            # leading dim is the stacked layer dim: becomes (pp, layers/stage)
+            rest = entries[1:] if entries else []
+            return P(mesh_lib.PP_AXIS, None, *rest)
+
+        return jax.tree.map(fix, layer_specs, is_leaf=lambda s: isinstance(s, P))
+
+    def reshape_layer_params(self, layer_params):
+        """Physically reshape stacked layer leaves (L, ...) → (S, L/S, ...)."""
+        S = self._stages()
+        L = self.num_layers
+        if L % S != 0:
+            raise ValueError(f"num_layers {L} not divisible by {S} pipeline stages")
+
+        def reshape(a):
+            return a.reshape((S, L // S) + a.shape[1:])
+
+        return jax.tree.map(reshape, layer_params)
+
+    def unshape_layer_params(self, layer_params):
+        def reshape(a):
+            return a.reshape((self.num_layers,) + a.shape[2:])
+
+        return jax.tree.map(reshape, layer_params)
+
+    # --- the pipelined loss ---------------------------------------------------
+
+    def loss_fn(self, params, batch):
+        """params = {"embed":…, "layers": (S, L/S, …) leaves, "head":…};
+        batch leaves shaped (M, mb, ...). Returns mean loss.
+
+        Only embed + layers run inside the pp-manual region; the head runs
+        OUTSIDE in plain GSPMD on the last stage's collected outputs. (Besides
+        being cleaner, this sidesteps an XLA SPMD-partitioner CHECK crash —
+        spmd_partitioner_util.cc:495, jaxlib 0.9 — triggered by lax.cond
+        branches touching sharded operands inside a partial-manual shard_map.)
+        XLA slices the collected-output tensor at the last stage, so only that
+        stage's activations move."""
+        mesh = mesh_lib.get_mesh()
+        S = self._stages()
+        M = self.num_microbatches
+        layer_apply = (
+            jax.checkpoint(self.layer_apply) if self.remat_layers else self.layer_apply
+        )
+
+        def stage_fn(layers_local, x):
+            def body(h, one_layer):
+                return layer_apply(one_layer, h), None
+
+            out, _ = lax.scan(body, x, layers_local)
+            return out
+
+        def pipelined(layers_local, embed_params, batch):
+            rank = lax.axis_index(mesh_lib.PP_AXIS)
+            layers_local = jax.tree.map(lambda a: a[0], layers_local)  # drop stage dim
+            ids0 = jax.tree.map(lambda a: a[0], batch)
+            buf = jnp.zeros_like(self.embed_apply(embed_params, ids0))
+
+            def tick(buf, t):
+                mb_in = jnp.clip(t, 0, M - 1)
+                mb_batch = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, mb_in, 0, keepdims=False),
+                    batch,
+                )
+                x_in = self.embed_apply(embed_params, mb_batch)
+                x = jnp.where(rank == 0, x_in, buf)
+                y = stage_fn(layers_local, x)
+                if S > 1:
+                    buf_next = lax.ppermute(
+                        y, mesh_lib.PP_AXIS, [(i, i + 1) for i in range(S - 1)]
+                    )
+                else:
+                    buf_next = y
+                return buf_next, y
+
+            _, ys = lax.scan(tick, buf, jnp.arange(M + S - 1))
+            return ys  # (M+S-1, mb, ...): this rank's stage outputs per tick
+
+        fn = jax.shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=(P(mesh_lib.PP_AXIS), P(), P()),
+            out_specs=P(mesh_lib.PP_AXIS),
+            check_vma=False,
+            axis_names={mesh_lib.PP_AXIS},
+        )
+        ys = fn(params["layers"], params["embed"], batch)
+        # (S·(M+S-1), mb, ...) → last stage's valid window = microbatch outputs
+        ticks = M + S - 1
+        ys = ys.reshape((S, ticks) + ys.shape[1:])
+        final = ys[S - 1, S - 1 :]  # (M, mb, ...)
+        lsum, wsum = self.head_apply(params["head"], final, batch)
+        return lsum / jnp.maximum(wsum, 1.0)
+
+
+def shard_microbatched_batch(batch):
+    """Place a microbatched host batch (M, mb, ...): microbatch dim replicated,
+    per-microbatch batch dim over dp, sequence over cp."""
+    mesh = mesh_lib.get_mesh()
+
+    def put(x):
+        spec = [None] * x.ndim
+        if x.ndim >= 2:
+            spec[1] = mesh_lib.DP_AXIS
+        if x.ndim >= 3:
+            spec[2] = mesh_lib.CP_AXIS
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree.map(put, dict(batch))
+
+
+def microbatch(batch, num_microbatches: int):
+    """(B, ...) → (M, B/M, ...) on every leaf (reference: microbatch dataloader
+    wrapping, pipeline/model.py:1955)."""
+
+    def split(a):
+        if a.shape[0] % num_microbatches != 0:
+            raise ValueError(
+                f"batch dim {a.shape[0]} not divisible by {num_microbatches} microbatches"
+            )
+        return a.reshape((num_microbatches, a.shape[0] // num_microbatches) + a.shape[1:])
+
+    return jax.tree.map(split, batch)

@@ -1,0 +1,224 @@
+"""Pipeline schedules as pure-Python task streams
+(reference: ``pipeline/scheduler.py`` — ``InferenceSchedule:144``,
+``Train1F1BSchedule:157``, ``TrainInterleavedSchedule:256``).
+
+Device-agnostic and unit-testable standalone, exactly like the reference. Task
+objects carry (microbatch, model_chunk); the runtime decides what a task means.
+The XLA runtime (pipeline/model.py) compiles the whole schedule into one
+program — these streams are the *semantic* contract (what executes in which
+order on which stage) used for schedule validation, memory-planning, and the
+timeline profiler; an explicitly-scheduled runtime can consume them directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    mb: int            # microbatch index
+    chunk: int = 0     # model chunk (virtual pipeline stage), 0 unless interleaved
+
+
+@dataclasses.dataclass(frozen=True)
+class ForwardTask(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BackwardTask(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RecvForwardTask(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SendForwardTask(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class RecvBackwardTask(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SendBackwardTask(Task):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ReduceGradsTask(Task):
+    pass
+
+
+class PipelineSchedule:
+    def __init__(self, num_microbatches: int, num_stages: int, stage_rank: int):
+        if not 0 <= stage_rank < num_stages:
+            raise ValueError(f"stage_rank {stage_rank} out of range for {num_stages} stages")
+        if num_microbatches < 1:
+            raise ValueError("need at least one microbatch")
+        self.num_microbatches = num_microbatches
+        self.num_stages = num_stages
+        self.stage_rank = stage_rank
+
+    @property
+    def is_first(self) -> bool:
+        return self.stage_rank == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.stage_rank == self.num_stages - 1
+
+    def tasks(self) -> Iterator[Task]:
+        raise NotImplementedError
+
+    def steps(self) -> List[Task]:
+        return list(self.tasks())
+
+
+class InferenceSchedule(PipelineSchedule):
+    """Straight-line: recv → fwd → send per microbatch (reference :144)."""
+
+    def tasks(self) -> Iterator[Task]:
+        for mb in range(self.num_microbatches):
+            if not self.is_first:
+                yield RecvForwardTask(mb)
+            yield ForwardTask(mb)
+            if not self.is_last:
+                yield SendForwardTask(mb)
+
+
+class Train1F1BSchedule(PipelineSchedule):
+    """Warmup / steady 1F1B / cooldown (reference :157).
+
+    warmup = min(M, S - 1 - rank) forwards; steady state alternates one forward
+    with one backward; cooldown drains remaining backwards; ends with grad
+    reduction."""
+
+    @property
+    def num_warmup(self) -> int:
+        return min(self.num_microbatches, self.num_stages - self.stage_rank - 1)
+
+    def tasks(self) -> Iterator[Task]:
+        M = self.num_microbatches
+        warmup = self.num_warmup
+        fwd_mb = 0
+        bwd_mb = 0
+        for _ in range(warmup):
+            if not self.is_first:
+                yield RecvForwardTask(fwd_mb)
+            yield ForwardTask(fwd_mb)
+            if not self.is_last:
+                yield SendForwardTask(fwd_mb)
+            fwd_mb += 1
+        steady = M - warmup
+        for i in range(steady):
+            if not self.is_first:
+                yield RecvForwardTask(fwd_mb)
+            yield ForwardTask(fwd_mb)
+            if not self.is_last:
+                yield SendForwardTask(fwd_mb)
+            fwd_mb += 1
+            if not self.is_last:
+                yield RecvBackwardTask(bwd_mb)
+            yield BackwardTask(bwd_mb)
+            if not self.is_first:
+                yield SendBackwardTask(bwd_mb)
+            bwd_mb += 1
+        while bwd_mb < M:
+            if not self.is_last:
+                yield RecvBackwardTask(bwd_mb)
+            yield BackwardTask(bwd_mb)
+            if not self.is_first:
+                yield SendBackwardTask(bwd_mb)
+            bwd_mb += 1
+        yield ReduceGradsTask(mb=-1)
+
+
+class TrainInterleavedSchedule(PipelineSchedule):
+    """Megatron interleaved / virtual-pipeline schedule (reference :256).
+
+    Each rank owns ``num_chunks`` model chunks; microbatches stream through
+    chunk 0 of every stage, then chunk 1, etc. Forward order follows the
+    Megatron formulation: in units of ``num_stages`` microbatches, cycling
+    chunks; backward mirrors it."""
+
+    def __init__(self, num_microbatches: int, num_stages: int, stage_rank: int,
+                 num_chunks: int = 1):
+        super().__init__(num_microbatches, num_stages, stage_rank)
+        if num_microbatches % num_stages != 0:
+            raise ValueError(
+                "interleaved schedule requires num_microbatches divisible by "
+                f"num_stages (got {num_microbatches} % {num_stages})"
+            )
+        self.num_chunks = num_chunks
+
+    def _fwd_order(self) -> List[Task]:
+        M, S, C = self.num_microbatches, self.num_stages, self.num_chunks
+        out = []
+        for group_start in range(0, M, S):
+            for chunk in range(C):
+                for mb in range(group_start, min(group_start + S, M)):
+                    out.append(ForwardTask(mb, chunk))
+        return out
+
+    def _bwd_order(self) -> List[Task]:
+        # Megatron ordering: within each group of S microbatches, chunks run in
+        # REVERSE (last virtual stage's backward first), microbatches in order.
+        M, S, C = self.num_microbatches, self.num_stages, self.num_chunks
+        out = []
+        for group_start in range(0, M, S):
+            for chunk in reversed(range(C)):
+                for mb in range(group_start, min(group_start + S, M)):
+                    out.append(BackwardTask(mb, chunk))
+        return out
+
+    def tasks(self) -> Iterator[Task]:
+        M, S, C = self.num_microbatches, self.num_stages, self.num_chunks
+        fwd = self._fwd_order()
+        bwd = self._bwd_order()
+        total_fwd = len(fwd)
+        # Megatron warmup count for interleaved: (S - rank - 1) * 2 + (C - 1) * S
+        warmup = min(total_fwd, (S - self.stage_rank - 1) * 2 + (C - 1) * S)
+        fi = bi = 0
+        for _ in range(warmup):
+            yield fwd[fi]; fi += 1
+        while fi < total_fwd:
+            yield fwd[fi]; fi += 1
+            yield bwd[bi]; bi += 1
+        while bi < total_fwd:
+            yield bwd[bi]; bi += 1
+        yield ReduceGradsTask(mb=-1)
+
+
+def validate_schedule(schedule: PipelineSchedule) -> None:
+    """Invariants every training schedule must satisfy (used by tests and as a
+    guard when users supply custom schedules): every microbatch/chunk runs
+    forward exactly once and backward exactly once, a backward never precedes
+    its forward, and grads reduce exactly once at the end. Raises ValueError."""
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            raise ValueError(f"invalid pipeline schedule: {msg}")
+
+    fwd_seen = {}
+    bwd_seen = {}
+    steps = schedule.steps()
+    check(isinstance(steps[-1], ReduceGradsTask), "must end with grad reduction")
+    for idx, t in enumerate(steps):
+        if isinstance(t, ForwardTask):
+            key = (t.mb, t.chunk)
+            check(key not in fwd_seen, f"duplicate forward {key}")
+            fwd_seen[key] = idx
+        elif isinstance(t, BackwardTask):
+            key = (t.mb, t.chunk)
+            check(key not in bwd_seen, f"duplicate backward {key}")
+            check(key in fwd_seen and fwd_seen[key] < idx, f"backward before forward {key}")
+            bwd_seen[key] = idx
+    check(set(fwd_seen) == set(bwd_seen), "forward/backward mismatch")

@@ -1,0 +1,87 @@
+"""Schedule math tested purely in Python (reference:
+test/unit_test/pipeline/test_scheduler.py — equivalence sweeps over
+pp∈{2..16}, mb∈{1..32} and exact task-stream assertions)."""
+
+import pytest
+
+from neuronx_distributed_tpu.pipeline.scheduler import (
+    BackwardTask,
+    ForwardTask,
+    InferenceSchedule,
+    RecvForwardTask,
+    ReduceGradsTask,
+    SendForwardTask,
+    Train1F1BSchedule,
+    TrainInterleavedSchedule,
+    validate_schedule,
+)
+
+
+@pytest.mark.parametrize("pp", [2, 4, 8, 16])
+@pytest.mark.parametrize("mb", [1, 2, 8, 32])
+def test_1f1b_valid_all_ranks(pp, mb):
+    for rank in range(pp):
+        validate_schedule(Train1F1BSchedule(mb, pp, rank))
+
+
+@pytest.mark.parametrize("pp,mb", [(4, 8), (2, 4)])
+def test_1f1b_warmup_counts(pp, mb):
+    for rank in range(pp):
+        s = Train1F1BSchedule(mb, pp, rank)
+        assert s.num_warmup == min(mb, pp - rank - 1)
+
+
+def test_1f1b_last_rank_alternates():
+    s = Train1F1BSchedule(4, 4, 3)  # last rank: warmup 0 → strict 1F1B
+    compute = [t for t in s.steps() if isinstance(t, (ForwardTask, BackwardTask))]
+    kinds = [type(t).__name__[0] for t in compute]
+    assert kinds == ["F", "B"] * 4
+
+
+def test_1f1b_first_rank_stream():
+    s = Train1F1BSchedule(3, 2, 0)
+    steps = s.steps()
+    # rank 0 of 2: warmup 1 fwd, then 2×(fwd,bwd), then drain 1 bwd
+    compute = [
+        (type(t).__name__[0], t.mb)
+        for t in steps
+        if isinstance(t, (ForwardTask, BackwardTask))
+    ]
+    assert compute == [("F", 0), ("F", 1), ("B", 0), ("F", 2), ("B", 1), ("B", 2)]
+    assert isinstance(steps[-1], ReduceGradsTask)
+
+
+def test_inference_schedule_stream():
+    s = InferenceSchedule(2, 3, 1)
+    assert s.steps() == [
+        RecvForwardTask(0),
+        ForwardTask(0),
+        SendForwardTask(0),
+        RecvForwardTask(1),
+        ForwardTask(1),
+        SendForwardTask(1),
+    ]
+
+
+@pytest.mark.parametrize("pp,mb,chunks", [(2, 4, 2), (4, 8, 2), (4, 8, 4)])
+def test_interleaved_valid(pp, mb, chunks):
+    for rank in range(pp):
+        validate_schedule(TrainInterleavedSchedule(mb, pp, rank, num_chunks=chunks))
+
+
+def test_interleaved_requires_divisibility():
+    with pytest.raises(ValueError):
+        TrainInterleavedSchedule(3, 2, 0, num_chunks=2)
+
+
+def test_interleaved_chunk_coverage():
+    s = TrainInterleavedSchedule(4, 2, 0, num_chunks=2)
+    fwd = [t for t in s.steps() if isinstance(t, ForwardTask)]
+    assert {(t.mb, t.chunk) for t in fwd} == {(m, c) for m in range(4) for c in range(2)}
+
+
+def test_bad_args():
+    with pytest.raises(ValueError):
+        Train1F1BSchedule(0, 2, 0)
+    with pytest.raises(ValueError):
+        Train1F1BSchedule(2, 2, 5)
