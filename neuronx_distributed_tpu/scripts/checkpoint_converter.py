@@ -1,0 +1,212 @@
+"""HF ↔ native Llama checkpoint conversion.
+
+Reference analogue: ``scripts/checkpoint_converter.py`` (``CheckpointConverterBase``,
+fused/split-QKV transforms :21-252, merge/split entry points :269,:445). The
+reference converts between a HF state dict and per-rank TP/PP/EP-sharded
+NxD checkpoints; here a "native" checkpoint is a *global* (unsharded-logical)
+flax param tree — sharding is a property of how it is loaded (``NamedSharding``
+targets in ``trainer.checkpoint.load_checkpoint``), so the per-TP-degree
+split/merge machinery of the reference is unnecessary by construction. What
+remains is pure name/layout mapping:
+
+* HF linear weights are ``(out, in)``; native kernels are ``(in, out)`` — transpose.
+* HF stores rotary q/k in the half-split layout (same convention as
+  ``models/llama.apply_rope``), so no permutation is needed.
+* ``scan_layers=True`` models hold one stacked subtree ``model/layers/layer/...``
+  with a leading layer axis; conversion stacks/unstacks it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+_LAYER_MAP = {
+    # HF suffix (under model.layers.{i}.) → native path (under layers_{i}/), transpose?
+    "self_attn.q_proj.weight": ("attn/qkv/q_proj/kernel", True),
+    "self_attn.k_proj.weight": ("attn/qkv/k_proj/kernel", True),
+    "self_attn.v_proj.weight": ("attn/qkv/v_proj/kernel", True),
+    "self_attn.o_proj.weight": ("attn/o_proj/kernel", True),
+    "mlp.gate_proj.weight": ("mlp/gate_proj/kernel", True),
+    "mlp.up_proj.weight": ("mlp/up_proj/kernel", True),
+    "mlp.down_proj.weight": ("mlp/down_proj/kernel", True),
+    "input_layernorm.weight": ("input_norm/weight", False),
+    "post_attention_layernorm.weight": ("post_attn_norm/weight", False),
+}
+
+_TOP_MAP = {
+    "model.embed_tokens.weight": ("model/embed/embedding", False),
+    "model.norm.weight": ("model/final_norm/weight", False),
+    "lm_head.weight": ("lm_head/kernel", True),
+}
+
+
+def _set(tree: Dict[str, Any], path: str, value: np.ndarray) -> None:
+    parts = path.split("/")
+    node = tree
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def _get(tree: Mapping[str, Any], path: str) -> np.ndarray:
+    node: Any = tree
+    for p in path.split("/"):
+        node = node[p]
+    return node
+
+
+def hf_to_native(
+    hf_state: Mapping[str, np.ndarray], scan_layers: bool = False
+) -> Dict[str, Any]:
+    """Map a HF Llama state dict to the native param tree ``{"params": ...}``."""
+    params: Dict[str, Any] = {}
+    num_layers = 0
+    for name, tensor in hf_state.items():
+        tensor = np.asarray(tensor)
+        if name in _TOP_MAP:
+            path, transpose = _TOP_MAP[name]
+            _set(params, path, tensor.T if transpose else tensor)
+            continue
+        if name.startswith("model.layers."):
+            rest = name[len("model.layers.") :]
+            idx_str, suffix = rest.split(".", 1)
+            idx = int(idx_str)
+            num_layers = max(num_layers, idx + 1)
+            if suffix not in _LAYER_MAP:
+                raise KeyError(f"unmapped HF layer tensor: {name}")
+            path, transpose = _LAYER_MAP[suffix]
+            _set(
+                params,
+                f"model/layers_{idx}/{path}",
+                tensor.T if transpose else tensor,
+            )
+            continue
+        if name == "model.rotary_emb.inv_freq" or name.endswith("rotary_emb.inv_freq"):
+            continue  # recomputed from config
+        raise KeyError(f"unmapped HF tensor: {name}")
+
+    # Tied-embedding models (e.g. some Llama-3.2 exports) omit lm_head.
+    if "lm_head" not in params:
+        _set(params, "lm_head/kernel", _get(params, "model/embed/embedding").T)
+
+    if scan_layers:
+        params["model"] = _stack_layers(params["model"], num_layers)
+    return {"params": params}
+
+
+def native_to_hf(
+    params: Mapping[str, Any], tie_word_embeddings: bool = False
+) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`hf_to_native`. Accepts scan or unstacked layouts.
+    ``tie_word_embeddings=True`` omits ``lm_head.weight`` (HF tied exports
+    carry no separate head; the native side synthesized it on import)."""
+    tree = dict(params.get("params", params))
+    model = dict(tree["model"])
+    if "layers" in model:
+        model = _unstack_layers(model)
+    tree = dict(tree)
+    tree["model"] = model
+
+    out: Dict[str, np.ndarray] = {}
+    for hf_name, (path, transpose) in _TOP_MAP.items():
+        if tie_word_embeddings and hf_name == "lm_head.weight":
+            continue
+        t = np.asarray(_get(tree, path))
+        out[hf_name] = t.T if transpose else t
+    idx = 0
+    while f"layers_{idx}" in model:
+        for hf_suffix, (path, transpose) in _LAYER_MAP.items():
+            t = np.asarray(_get(model, f"layers_{idx}/{path}"))
+            out[f"model.layers.{idx}.{hf_suffix}"] = t.T if transpose else t
+        idx += 1
+    return out
+
+
+def _stack_layers(model: Dict[str, Any], num_layers: int) -> Dict[str, Any]:
+    """layers_{i}/... → layers/layer/... with leading layer axis (the
+    ``nn.scan`` parameter layout)."""
+    import jax
+
+    per_layer = [model.pop(f"layers_{i}") for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs, axis=0), *per_layer)
+    model["layers"] = {"layer": stacked}
+    return model
+
+
+def _unstack_layers(model: Dict[str, Any]) -> Dict[str, Any]:
+    import jax
+
+    stacked = model.pop("layers")["layer"]
+    num_layers = jax.tree.leaves(stacked)[0].shape[0]
+    for i in range(num_layers):
+        model[f"layers_{i}"] = jax.tree.map(lambda x: np.asarray(x[i]), stacked)
+    return model
+
+
+def _load_hf_dir(hf_dir: str) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    state: Dict[str, np.ndarray] = {}
+    files = sorted(f for f in os.listdir(hf_dir) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {hf_dir}")
+    for fname in files:
+        with safe_open(os.path.join(hf_dir, fname), framework="numpy") as f:
+            for key in f.keys():
+                state[key] = f.get_tensor(key)
+    return state
+
+
+def convert_hf_to_native(
+    hf_dir: str, output_dir: str, tag: str = "hf_import", scan_layers: bool = False
+) -> None:
+    from neuronx_distributed_tpu.trainer.checkpoint import save_checkpoint
+
+    params = hf_to_native(_load_hf_dir(hf_dir), scan_layers=scan_layers)
+    save_checkpoint(output_dir, tag, items={"model": params})
+
+
+def convert_native_to_hf(
+    checkpoint_dir: str,
+    output_dir: str,
+    tag: str = None,
+    tie_word_embeddings: bool = False,
+) -> None:
+    from safetensors.numpy import save_file
+
+    from neuronx_distributed_tpu.trainer.checkpoint import load_checkpoint
+
+    items, _, tag = load_checkpoint(checkpoint_dir, tag, items_target={"model": None})
+    hf_state = native_to_hf(items["model"], tie_word_embeddings=tie_word_embeddings)
+    os.makedirs(output_dir, exist_ok=True)
+    save_file(hf_state, os.path.join(output_dir, "model.safetensors"))
+    with open(os.path.join(output_dir, "conversion_info.json"), "w") as f:
+        json.dump({"source": checkpoint_dir, "tag": tag}, f)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="HF ↔ native Llama checkpoint converter")
+    p.add_argument("--direction", choices=["hf2native", "native2hf"], required=True)
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--tag", default=None)
+    p.add_argument("--scan-layers", action="store_true")
+    p.add_argument("--tie-embeddings", action="store_true")
+    args = p.parse_args()
+    if args.direction == "hf2native":
+        convert_hf_to_native(
+            args.input, args.output, args.tag or "hf_import", args.scan_layers
+        )
+    else:
+        convert_native_to_hf(
+            args.input, args.output, args.tag, tie_word_embeddings=args.tie_embeddings
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,425 @@
+"""Checkpoint save/load with the reference's tag/done/retention protocol.
+
+Reference analogue: ``trainer/checkpoint.py`` (``save_checkpoint:653``,
+``load_checkpoint:837``) and ``trainer/checkpoint_storage.py``. The reference's
+machinery — per-tag directories with ``done`` markers and a ``newest`` pointer,
+retention of ``num_kept_ckpts`` with corrupted-tag cleanup
+(``_determine_remove_tags:65``), async saves through a single-worker executor
+with an atexit flush (``CheckpointIOState``, ``:109-323``), xser
+tensor-per-file writes load-balanced by Karmarkar-Karp bin packing
+(``_xser_save_data:476``) and broadcast-based replicated loads
+(``_xser_load_data:346``) — maps onto TPU/JAX as:
+
+* tensor IO: orbax/tensorstore sharded array writes. Each host writes exactly
+  its addressable shards, which subsumes the reference's bin-packing
+  load-balancing; replicated loads are single-read + XLA broadcast, which
+  subsumes the all-reduce-as-broadcast trick.
+* resharding across (tp, pp, dp, ep) layout changes: restore against a target
+  tree of ``jax.ShapeDtypeStruct`` carrying the *new* ``NamedSharding`` —
+  tensorstore reads each device's slice directly, replacing the reference's
+  offline ``convert_zero_checkpoints`` DP reshard for the common cases.
+* the tag/done/newest/retention control protocol is kept as-is (pure
+  filesystem metadata, deliberately identical semantics).
+
+Master-weight dedup (``avoid_saving_lower_precision_weights``,
+checkpoint.py:643,761): when the optimizer state carries fp32 master copies,
+pass ``items={"optimizer": ...}`` only and rebuild bf16 params on load — here
+that is a user-level choice, not a flag, because params/opt-state are explicit
+pytrees rather than module attributes.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import shutil
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax
+
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+DONE_MARKER = "done"
+NEWEST_FILE = "newest"
+META_FILE = "user_content.json"
+_ITEMS_DIRNAME = "state"
+
+
+# --- storage abstraction ------------------------------------------------------
+# Reference: BaseCheckpointStorage (checkpoint_storage.py:46) with local-FS
+# (:138) and S3 (:236) implementations. Tensor IO goes through orbax/
+# tensorstore; this abstraction covers the control-plane metadata only.
+
+
+class BaseCheckpointStorage:
+    def __init__(self, dirname: str):
+        self._dirname = dirname
+
+    @property
+    def dirname(self) -> str:
+        return self._dirname
+
+    def file_exists(self, filename: str) -> bool:
+        raise NotImplementedError
+
+    def file_mtime(self, filename: str) -> float:
+        raise NotImplementedError
+
+    def remove_file(self, filename: str) -> None:
+        raise NotImplementedError
+
+    def save_text(self, text: str, filename: str) -> None:
+        raise NotImplementedError
+
+    def load_text(self, filename: str) -> str:
+        raise NotImplementedError
+
+    def list_checkpoint_tags(self) -> List[str]:
+        raise NotImplementedError
+
+    def remove_checkpoint(self, tag: str) -> None:
+        raise NotImplementedError
+
+
+class FilesystemCheckpointStorage(BaseCheckpointStorage):
+    """Local/NFS directory storage (reference checkpoint_storage.py:138)."""
+
+    def file_exists(self, filename: str) -> bool:
+        return os.path.exists(os.path.join(self._dirname, filename))
+
+    def file_mtime(self, filename: str) -> float:
+        return os.path.getmtime(os.path.join(self._dirname, filename))
+
+    def remove_file(self, filename: str) -> None:
+        path = os.path.join(self._dirname, filename)
+        if os.path.exists(path):
+            os.remove(path)
+
+    def save_text(self, text: str, filename: str) -> None:
+        path = os.path.join(self._dirname, filename)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+
+    def load_text(self, filename: str) -> str:
+        with open(os.path.join(self._dirname, filename)) as f:
+            return f.read()
+
+    def list_checkpoint_tags(self) -> List[str]:
+        if not os.path.isdir(self._dirname):
+            return []
+        return sorted(
+            d
+            for d in os.listdir(self._dirname)
+            if os.path.isdir(os.path.join(self._dirname, d))
+        )
+
+    def remove_checkpoint(self, tag: str) -> None:
+        shutil.rmtree(os.path.join(self._dirname, tag), ignore_errors=True)
+
+
+def create_checkpoint_storage(dirname: str) -> BaseCheckpointStorage:
+    """Reference: create_checkpoint_storage (checkpoint_storage.py) — S3 paths
+    would return an S3 storage; object stores are reached on TPU through
+    tensorstore/gcsfs URIs instead, so only the filesystem backend is native
+    here."""
+    if dirname.startswith("s3://") or dirname.startswith("gs://"):
+        raise NotImplementedError(
+            "object-store checkpointing: point orbax/tensorstore at the bucket "
+            "URI directly (gs:// works out of the box on TPU VMs); the tag/"
+            "done/retention layer currently supports filesystem paths"
+        )
+    return FilesystemCheckpointStorage(dirname)
+
+
+# --- async IO state -----------------------------------------------------------
+
+
+class CheckpointIOState:
+    """Tracks in-flight async saves (reference CheckpointIOState,
+    trainer/checkpoint.py:109-323). Commits run on a single-worker executor —
+    like the reference's single-worker ThreadPoolExecutor — so done-marker /
+    ``newest`` / retention updates happen strictly in submission order even
+    when an earlier save's tensorstore flush outlives a later one's.
+    In-flight tags are exposed so retention never deletes a save that simply
+    has not committed yet."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._executor: Optional[Any] = None
+        self._pending: List[Any] = []  # futures
+        self._in_flight: List[str] = []
+
+    def in_flight_tags(self) -> List[str]:
+        with self._lock:
+            return list(self._in_flight)
+
+    def register(self, tag: str) -> None:
+        """Mark ``tag`` as being written BEFORE any bytes land on disk, so a
+        concurrent retention pass never classifies it as corrupted."""
+        with self._lock:
+            if tag not in self._in_flight:
+                self._in_flight.append(tag)
+
+    def unregister(self, tag: str) -> None:
+        with self._lock:
+            if tag in self._in_flight:
+                self._in_flight.remove(tag)
+
+    def begin(
+        self,
+        checkpointer: Any,
+        storage: BaseCheckpointStorage,
+        tag: str,
+        num_kept_ckpts: Optional[int],
+    ) -> None:
+        """``tag`` must already be :meth:`register`-ed."""
+        import concurrent.futures
+
+        def _finish() -> None:
+            try:
+                checkpointer.wait_until_finished()
+                _commit(storage, tag, num_kept_ckpts, current_tag=tag)
+                logger.info("async checkpoint '%s' committed", tag)
+            finally:
+                self.unregister(tag)
+                checkpointer.close()
+
+        with self._lock:
+            if self._executor is None:
+                self._executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ckpt-commit"
+                )
+            # Surface failures of already-finished commits instead of silently
+            # dropping them (a failed save must not go unnoticed).
+            still_pending = []
+            for f in self._pending:
+                if f.done():
+                    f.result()  # raises if the commit failed
+                else:
+                    still_pending.append(f)
+            self._pending = still_pending
+            self._pending.append(self._executor.submit(_finish))
+
+    def wait_all(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for f in pending:
+            f.result()
+
+
+_IO_STATE = CheckpointIOState()
+atexit.register(_IO_STATE.wait_all)
+
+
+def finalize_checkpoints() -> None:
+    """Block until every async save has committed (reference: atexit hook
+    trainer/checkpoint.py:733)."""
+    _IO_STATE.wait_all()
+
+
+# --- save/load ----------------------------------------------------------------
+
+
+def _orbax():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def _commit(
+    storage: BaseCheckpointStorage,
+    tag: str,
+    num_kept_ckpts: Optional[int],
+    current_tag: Optional[str] = None,
+) -> None:
+    storage.save_text("", os.path.join(tag, DONE_MARKER))
+    storage.save_text(tag, NEWEST_FILE)
+    if num_kept_ckpts is not None and num_kept_ckpts > 0:
+        victims = _determine_remove_tags(storage, num_kept_ckpts, current_tag)
+        for victim in victims:
+            logger.info("retention: removing checkpoint '%s'", victim)
+            storage.remove_checkpoint(victim)
+
+
+def _tag_step(tag: str) -> int:
+    """Trailing integer of a tag when present (step_100 → 100), else -1."""
+    digits = ""
+    for ch in reversed(tag):
+        if ch.isdigit():
+            digits = ch + digits
+        else:
+            break
+    return int(digits) if digits else -1
+
+
+def _tag_order_key(storage: BaseCheckpointStorage, tag: str):
+    """Retention/newest ordering: done-marker mtime (save-completion order),
+    with the trailing step number as tie-break. mtime makes non-numeric tags
+    (e.g. 'hf_import') order by recency instead of being evicted first."""
+    try:
+        mtime = storage.file_mtime(os.path.join(tag, DONE_MARKER))
+    except OSError:
+        mtime = 0.0
+    return (mtime, _tag_step(tag))
+
+
+def _determine_remove_tags(
+    storage: BaseCheckpointStorage,
+    num_kept_ckpts: int,
+    current_tag: Optional[str] = None,
+) -> List[str]:
+    """Reference _determine_remove_tags (trainer/checkpoint.py:65-97): tags
+    without a ``done`` marker are corrupted leftovers and removed outright —
+    EXCEPT tags whose save is still in flight (they have no marker yet by
+    construction). ``current_tag`` — the tag whose commit is running this
+    retention pass — is still registered in-flight but already has its done
+    marker, so it counts as completed (otherwise async retention would keep
+    one extra checkpoint). Completed tags beyond the newest ``num_kept_ckpts``
+    are removed oldest-first."""
+    in_flight = set(_IO_STATE.in_flight_tags()) - {current_tag}
+    tags = [t for t in storage.list_checkpoint_tags() if t not in in_flight]
+    done = [t for t in tags if storage.file_exists(os.path.join(t, DONE_MARKER))]
+    corrupted = [t for t in tags if t not in done]
+    done.sort(key=lambda t: _tag_order_key(storage, t))
+    excess = done[: max(0, len(done) - num_kept_ckpts)]
+    return corrupted + excess
+
+
+def save_checkpoint(
+    checkpoint_dir: str,
+    tag: str,
+    items: Mapping[str, Any],
+    user_content: Optional[Dict[str, Any]] = None,
+    num_kept_ckpts: Optional[int] = None,
+    async_save: bool = False,
+    storage: Optional[BaseCheckpointStorage] = None,
+) -> None:
+    """Save ``items`` (a dict of named pytrees, e.g. ``{"model": params,
+    "optimizer": opt_state}``) under ``checkpoint_dir/tag``.
+
+    Reference: save_checkpoint (trainer/checkpoint.py:653). Each item gets its
+    own subtree so model-only loads never touch optimizer bytes, matching the
+    reference's separate model/optimizer dirs.
+    """
+    ocp = _orbax()
+    storage = storage or create_checkpoint_storage(checkpoint_dir)
+    # Register before the tag dir exists so concurrent retention passes never
+    # see a half-written save as a corrupted tag.
+    _IO_STATE.register(tag)
+    try:
+        tag_dir = os.path.join(checkpoint_dir, tag)
+        os.makedirs(tag_dir, exist_ok=True)
+        # Re-saving an existing tag: drop the stale done marker FIRST so a
+        # crash mid-rewrite can never leave a half-written checkpoint that
+        # still passes the done check.
+        storage.remove_file(os.path.join(tag, DONE_MARKER))
+        if user_content is not None:
+            storage.save_text(json.dumps(user_content), os.path.join(tag, META_FILE))
+
+        target = os.path.abspath(os.path.join(tag_dir, _ITEMS_DIRNAME))
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        # One Composite save → one tensorstore transaction for all items.
+        args = ocp.args.Composite(
+            **{k: ocp.args.StandardSave(v) for k, v in items.items()}
+        )
+        if async_save:
+            checkpointer = ocp.AsyncCheckpointer(ocp.CompositeCheckpointHandler())
+            try:
+                checkpointer.save(target, args=args)
+                _IO_STATE.begin(checkpointer, storage, tag, num_kept_ckpts)
+            except Exception:
+                _IO_STATE.unregister(tag)
+                checkpointer.close()
+                raise
+            return  # _finish unregisters after commit
+        with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as checkpointer:
+            checkpointer.save(target, args=args)
+        _commit(storage, tag, num_kept_ckpts, current_tag=tag)
+    finally:
+        if not async_save:
+            _IO_STATE.unregister(tag)
+
+
+def latest_checkpoint_tag(checkpoint_dir: str) -> Optional[str]:
+    """Resolve the newest completed tag: the ``newest`` pointer when valid,
+    else the highest-step tag carrying a ``done`` marker."""
+    storage = create_checkpoint_storage(checkpoint_dir)
+    if storage.file_exists(NEWEST_FILE):
+        tag = storage.load_text(NEWEST_FILE).strip()
+        if storage.file_exists(os.path.join(tag, DONE_MARKER)):
+            return tag
+    done = [
+        t
+        for t in storage.list_checkpoint_tags()
+        if storage.file_exists(os.path.join(t, DONE_MARKER))
+    ]
+    if not done:
+        return None
+    return max(done, key=lambda t: _tag_order_key(storage, t))
+
+
+def load_checkpoint(
+    checkpoint_dir: str,
+    tag: Optional[str] = None,
+    items_target: Optional[Mapping[str, Any]] = None,
+) -> Tuple[Dict[str, Any], Optional[Dict[str, Any]], str]:
+    """Load ``(items, user_content, tag)`` from ``checkpoint_dir``.
+
+    ``tag=None`` resolves the newest completed checkpoint (reference
+    load_checkpoint trainer/checkpoint.py:837). ``items_target`` maps item
+    names to pytrees of arrays or ``jax.ShapeDtypeStruct`` with shardings —
+    supplying shardings from a *different* mesh layout reshards on read
+    (replacing the reference's offline zero-1/TP reshard converters for
+    on-line cases). Omitted items are restored as host numpy arrays.
+    """
+    ocp = _orbax()
+    storage = create_checkpoint_storage(checkpoint_dir)
+    if tag is None:
+        tag = latest_checkpoint_tag(checkpoint_dir)
+        if tag is None:
+            raise FileNotFoundError(f"no completed checkpoint under {checkpoint_dir}")
+    tag_dir = os.path.join(checkpoint_dir, tag)
+    if not storage.file_exists(os.path.join(tag, DONE_MARKER)):
+        raise FileNotFoundError(f"checkpoint '{tag}' has no done marker (corrupted?)")
+
+    target = os.path.abspath(os.path.join(tag_dir, _ITEMS_DIRNAME))
+    item_names = (
+        list(items_target.keys())
+        if items_target is not None
+        else [d for d in os.listdir(target) if os.path.isdir(os.path.join(target, d))]
+    )
+
+    def _restore_arg(name: str):
+        if items_target is not None and items_target.get(name) is not None:
+            tmpl = items_target[name]
+            abstract = jax.tree.map(
+                lambda x: x
+                if isinstance(x, jax.ShapeDtypeStruct)
+                else jax.ShapeDtypeStruct(
+                    jax.numpy.shape(x),
+                    x.dtype,
+                    sharding=getattr(x, "sharding", None),
+                ),
+                tmpl,
+            )
+            return ocp.args.StandardRestore(abstract)
+        return ocp.args.StandardRestore()
+
+    with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as checkpointer:
+        restored = checkpointer.restore(
+            target, args=ocp.args.Composite(**{n: _restore_arg(n) for n in item_names})
+        )
+    items = {n: restored[n] for n in item_names}
+
+    user_content = None
+    if storage.file_exists(os.path.join(tag, META_FILE)):
+        user_content = json.loads(storage.load_text(os.path.join(tag, META_FILE)))
+    return items, user_content, tag

@@ -1,0 +1,107 @@
+"""HF ↔ native converter: numerical parity against HF transformers Llama
+(reference: test/integration/convert_checkpoints/ equivalence checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+    hf_to_native,
+    native_to_hf,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _tiny_hf_model():
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    return transformers.LlamaForCausalLM(cfg).eval(), cfg
+
+
+def test_hf_native_logits_match():
+    hf_model, hf_cfg = _tiny_hf_model()
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=1)
+    cfg = LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=hf_cfg.num_key_value_heads,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rms_eps=hf_cfg.rms_norm_eps,
+        rope_theta=hf_cfg.rope_theta,
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    params = jax.tree.map(jnp.asarray, hf_to_native(state))
+
+    ids = np.array([[1, 5, 9, 2, 7, 3, 11, 4]], dtype=np.int32)
+    ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_roundtrip_identity():
+    hf_model, _ = _tiny_hf_model()
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    back = native_to_hf(hf_to_native(state))
+    for k, v in state.items():
+        if "rotary_emb" in k:
+            continue
+        np.testing.assert_array_equal(back[k], v)
+
+
+def test_tied_embeddings_roundtrip():
+    """Tied-embedding exports have no lm_head; import synthesizes it, export
+    with tie_word_embeddings=True omits it again."""
+    hf_model, _ = _tiny_hf_model()
+    state = {
+        k: v.detach().numpy()
+        for k, v in hf_model.state_dict().items()
+        if k != "lm_head.weight"
+    }
+    native = hf_to_native(state)
+    np.testing.assert_array_equal(
+        native["params"]["lm_head"]["kernel"],
+        native["params"]["model"]["embed"]["embedding"].T,
+    )
+    back = native_to_hf(native, tie_word_embeddings=True)
+    assert "lm_head.weight" not in back
+    assert set(back.keys()) == {k for k in state if "rotary_emb" not in k}
+
+
+def test_scan_layout_stack_unstack():
+    hf_model, _ = _tiny_hf_model()
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    stacked = hf_to_native(state, scan_layers=True)
+    layers = stacked["params"]["model"]["layers"]["layer"]
+    assert jax.tree.leaves(layers)[0].shape[0] == 2
+    back = native_to_hf(stacked)
+    for k, v in state.items():
+        if "rotary_emb" in k:
+            continue
+        np.testing.assert_array_equal(back[k], v)

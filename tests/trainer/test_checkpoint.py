@@ -1,0 +1,119 @@
+"""Checkpoint protocol tests (reference: test/unit_test/checkpoint/)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.trainer.checkpoint import (
+    DONE_MARKER,
+    finalize_checkpoints,
+    latest_checkpoint_tag,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def _tree(mesh):
+    sh = NamedSharding(mesh, P(mesh_lib.TP_AXIS, None))
+    w = jax.device_put(jnp.arange(64.0).reshape(8, 8), sh)
+    return {"w": w, "b": jnp.ones((3,), jnp.float32)}
+
+
+def test_save_load_roundtrip(tp4_mesh, tmp_path):
+    d = str(tmp_path)
+    tree = _tree(tp4_mesh)
+    save_checkpoint(d, "step_10", items={"model": tree}, user_content={"step": 10})
+    items, user, tag = load_checkpoint(d)
+    assert tag == "step_10"
+    assert user == {"step": 10}
+    np.testing.assert_array_equal(np.asarray(items["model"]["w"]), np.asarray(tree["w"]))
+    np.testing.assert_array_equal(np.asarray(items["model"]["b"]), np.asarray(tree["b"]))
+
+
+def test_resharded_load(tp4_mesh, tmp_path):
+    """Save under tp=4 sharding, restore under tp=8 sharding (layout change)."""
+    d = str(tmp_path)
+    tree = _tree(tp4_mesh)
+    save_checkpoint(d, "step_1", items={"model": tree})
+
+    mesh_lib.destroy_model_parallel()
+    state = mesh_lib.initialize_model_parallel(tensor_model_parallel_size=8)
+    tgt_sh = NamedSharding(state.mesh, P(mesh_lib.TP_AXIS, None))
+    target = {
+        "model": {
+            "w": jax.ShapeDtypeStruct((8, 8), jnp.float32, sharding=tgt_sh),
+            "b": jax.ShapeDtypeStruct((3,), jnp.float32),
+        }
+    }
+    items, _, _ = load_checkpoint(d, items_target=target)
+    w = items["model"]["w"]
+    assert w.sharding.spec == P(mesh_lib.TP_AXIS, None)
+    np.testing.assert_array_equal(np.asarray(w), np.arange(64.0).reshape(8, 8))
+
+
+def test_newest_and_retention(tp4_mesh, tmp_path):
+    d = str(tmp_path)
+    tree = _tree(tp4_mesh)
+    for step in (1, 2, 3):
+        save_checkpoint(d, f"step_{step}", items={"model": tree}, num_kept_ckpts=2)
+    assert latest_checkpoint_tag(d) == "step_3"
+    tags = sorted(t for t in os.listdir(d) if os.path.isdir(os.path.join(d, t)))
+    assert tags == ["step_2", "step_3"]
+
+
+def test_corrupted_tag_cleanup(tp4_mesh, tmp_path):
+    """A tag dir without a done marker is removed by the next retention pass
+    and never resolved as newest (reference _determine_remove_tags:65)."""
+    d = str(tmp_path)
+    tree = _tree(tp4_mesh)
+    save_checkpoint(d, "step_1", items={"model": tree})
+    os.makedirs(os.path.join(d, "step_2"))  # dead save: no done marker
+    assert latest_checkpoint_tag(d) == "step_1"
+    save_checkpoint(d, "step_3", items={"model": tree}, num_kept_ckpts=5)
+    assert not os.path.exists(os.path.join(d, "step_2"))
+    assert latest_checkpoint_tag(d) == "step_3"
+
+
+def test_async_save(tp4_mesh, tmp_path):
+    d = str(tmp_path)
+    tree = _tree(tp4_mesh)
+    save_checkpoint(d, "step_7", items={"model": tree}, async_save=True)
+    finalize_checkpoints()
+    assert os.path.exists(os.path.join(d, "step_7", DONE_MARKER))
+    items, _, _ = load_checkpoint(d)
+    np.testing.assert_array_equal(np.asarray(items["model"]["w"]), np.asarray(tree["w"]))
+
+
+def test_async_retention_exact(tp4_mesh, tmp_path):
+    """Async saves honour num_kept_ckpts exactly (not N+1)."""
+    d = str(tmp_path)
+    tree = _tree(tp4_mesh)
+    for step in (1, 2, 3):
+        save_checkpoint(
+            d, f"step_{step}", items={"model": tree},
+            num_kept_ckpts=2, async_save=True,
+        )
+    finalize_checkpoints()
+    tags = sorted(t for t in os.listdir(d) if os.path.isdir(os.path.join(d, t)))
+    assert tags == ["step_2", "step_3"]
+    assert latest_checkpoint_tag(d) == "step_3"
+
+
+def test_load_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path))
+
+
+def test_model_only_load_skips_optimizer(tp4_mesh, tmp_path):
+    d = str(tmp_path)
+    tree = _tree(tp4_mesh)
+    save_checkpoint(d, "s1", items={"model": tree, "optimizer": {"mu": tree["w"] * 0}})
+    items, _, _ = load_checkpoint(d, items_target={"model": None})
+    assert set(items.keys()) == {"model"}
