@@ -123,16 +123,15 @@ class PipelineEngine:
         def pipelined(layers_local, embed_params, batch):
             rank = lax.axis_index(mesh_lib.PP_AXIS)
             layers_local = jax.tree.map(lambda a: a[0], layers_local)  # drop stage dim
-            ids0 = jax.tree.map(lambda a: a[0], batch)
-            buf = jnp.zeros_like(self.embed_apply(embed_params, ids0))
+            # Embed all M microbatches once, OUTSIDE the tick loop: the loop
+            # otherwise pays M+S-1 embedding fwd (and bwd) passes per stage for
+            # the M that are used, and the differentiated scan grows with it.
+            embedded = jax.vmap(lambda mb: self.embed_apply(embed_params, mb))(batch)
+            buf = jnp.zeros_like(jax.tree.map(lambda a: a[0], embedded))
 
             def tick(buf, t):
                 mb_in = jnp.clip(t, 0, M - 1)
-                mb_batch = jax.tree.map(
-                    lambda a: lax.dynamic_index_in_dim(a, mb_in, 0, keepdims=False),
-                    batch,
-                )
-                x_in = self.embed_apply(embed_params, mb_batch)
+                x_in = lax.dynamic_index_in_dim(embedded, mb_in, 0, keepdims=False)
                 x = jnp.where(rank == 0, x_in, buf)
                 y = stage_fn(layers_local, x)
                 if S > 1:
