@@ -305,23 +305,27 @@ def _sharded_kernel_call(qt, kt, vt, causal, bq, bk, interpret):
         return _flash_attention_bhsd(qt, kt, vt, causal, bq, bk, interpret)
     mesh = mesh_lib.get_mesh()
     b, h = qt.shape[0], qt.shape[1]
-    dp = mesh.shape[mesh_lib.DP_AXIS]
+    dp = mesh.shape[mesh_lib.EDP_AXIS] * mesh.shape[mesh_lib.EP_AXIS]
     tp = mesh.shape[mesh_lib.TP_AXIS]
-    bspec = mesh_lib.DP_AXIS if (dp > 1 and b % dp == 0) else None
+    bspec = mesh_lib.DATA_AXES if (dp > 1 and b % dp == 0) else None
     hspec = mesh_lib.TP_AXIS if (tp > 1 and h % tp == 0) else None
     from jax.sharding import PartitionSpec as P
 
     spec = P(bspec, hspec, None, None)
     # when tracing inside another (partial-manual) shard_map — e.g. the
     # pipeline engine's pp region — the nested call must bind the context's
-    # AbstractMesh, not the concrete one
+    # AbstractMesh, not the concrete one. Mosaic custom calls require EVERY
+    # mesh axis to be manual at the call site, so axis_names claims all axes
+    # not already manual in the context.
     ctx_mesh = jax.sharding.get_abstract_mesh()
+    target = mesh if ctx_mesh.empty else ctx_mesh
+    already_manual = set() if ctx_mesh.empty else set(ctx_mesh.manual_axes)
     fn = jax.shard_map(
         lambda a, b_, c: _flash_attention_bhsd(a, b_, c, causal, bq, bk, interpret),
-        mesh=mesh if ctx_mesh.empty else ctx_mesh,
+        mesh=target,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names={mesh_lib.DP_AXIS, mesh_lib.CP_AXIS, mesh_lib.TP_AXIS},
+        axis_names=set(target.axis_names) - already_manual,
         check_vma=False,
     )
     return fn(qt, kt, vt)

@@ -1,0 +1,254 @@
+"""Expert MLP execution strategies (reference: ``modules/moe/expert_mlps.py``
+``ExpertMLPs:30`` with the strategy dispatch policy at ``forward:595``).
+
+Reference strategies → TPU-native formulations:
+
+* ``forward_all_experts`` (expert_mlps.py:179): every token through every
+  expert, mask-combine. Exact/dropless; FLOPs = dense. Kept as the golden path
+  and the EP-friendly dropless fallback (contraction over the sharded expert
+  dim becomes one psum under GSPMD).
+* ``forward_capacity_factor`` (expert_mlps.py:218): Megatron/GShard capacity-C
+  dispatch. The reference builds cumsum positions + permutes with fp64 one-hot
+  masks to keep XLA graphs static; here the same dispatch/combine masks are
+  fp32 einsums (exact for these 0/1 matmuls) — the classic TPU MoE
+  formulation, fully static, and the dispatch einsum is what XLA turns into
+  the EP all-to-all.
+* ``forward_blockwise`` (expert_mlps.py:346): dropless. The reference sorts
+  tokens into fixed-size blocks and calls an NKI grouped-matmul kernel
+  (blockwise.py:434); the TPU equivalent is ``jax.lax.ragged_dot`` — XLA's
+  native grouped matmul, lowered by Mosaic to MXU tiles — on expert-sorted
+  tokens. TP shards the intermediate dim inside an explicit ``shard_map``
+  (Mosaic grouped matmuls are not auto-partitioned over the ragged group dim).
+  Requires ep == 1 this round; with ep > 1 use capacity_factor (all-to-all) or
+  all_experts (exact).
+
+``forward_selective_loading`` (per-token decode loads, expert_mlps.py:319) is
+an inference-memory optimization deferred to the inference path.
+"""
+
+from __future__ import annotations
+
+from math import ceil
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.sharding import UNC, constrain
+
+Dtype = Any
+
+
+def _act(name: str):
+    return {"silu": jax.nn.silu, "gelu": jax.nn.gelu, "relu": jax.nn.relu}[name]
+
+
+class ExpertMLPs(nn.Module):
+    """3D-weight expert MLPs (weights ``(E, H, I)`` / ``(E, I, H)``, experts
+    sharded over ep, intermediate over tp — reference ``experts.py:22`` +
+    ``moe_parallel_layers.py`` fused layers).
+
+    ``capacity_factor=None`` → dropless (reference semantics); otherwise
+    Megatron-style capacity ``C = ceil(cf·T·k/E)`` with token dropping.
+    """
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    top_k: int = 2
+    hidden_act: str = "silu"
+    glu_mlp: bool = True
+    capacity_factor: Optional[float] = None
+    strategy: str = "auto"  # auto | all_experts | capacity_factor | blockwise
+    all_experts_threshold: int = 8
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    def _params(self):
+        from neuronx_distributed_tpu.modules.moe.moe_parallel_layers import (
+            COLUMN_KERNEL_PARTITION,
+            ROW_KERNEL_PARTITION,
+        )
+
+        E, H, I = self.num_experts, self.hidden_size, self.intermediate_size
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        up = self.param(
+            "up_proj",
+            nn.with_partitioning(init, COLUMN_KERNEL_PARTITION),
+            (E, H, I),
+            self.param_dtype,
+        )
+        gate = None
+        if self.glu_mlp:
+            gate = self.param(
+                "gate_proj",
+                nn.with_partitioning(init, COLUMN_KERNEL_PARTITION),
+                (E, H, I),
+                self.param_dtype,
+            )
+        down = self.param(
+            "down_proj",
+            nn.with_partitioning(init, ROW_KERNEL_PARTITION),
+            (E, I, H),
+            self.param_dtype,
+        )
+        return gate, up, down
+
+    def _resolve_strategy(self) -> str:
+        if self.strategy != "auto":
+            return self.strategy
+        if self.capacity_factor is not None:
+            return "capacity_factor"
+        ep = (
+            mesh_lib.get_expert_model_parallel_size()
+            if mesh_lib.model_parallel_is_initialized()
+            else 1
+        )
+        if ep > 1 or self.num_experts <= self.all_experts_threshold:
+            # dropless under EP: the all-experts contraction is the exact path
+            # (capacity dispatch would drop tokens the user asked to keep)
+            return "all_experts"
+        return "blockwise"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, top_e: jax.Array, top_w: jax.Array) -> jax.Array:
+        """``x (T, H)`` tokens, ``top_e (T, k)`` expert ids, ``top_w (T, k)``
+        affinities → ``(T, H)`` combined expert outputs."""
+        gate, up, down = self._params()
+        strategy = self._resolve_strategy()
+        x = x.astype(self.dtype)
+        gate = None if gate is None else gate.astype(self.dtype)
+        up, down = up.astype(self.dtype), down.astype(self.dtype)
+        if strategy == "all_experts":
+            return self._all_experts(x, top_e, top_w, gate, up, down)
+        if strategy == "capacity_factor":
+            return self._capacity_factor(x, top_e, top_w, gate, up, down)
+        if strategy == "blockwise":
+            return self._blockwise(x, top_e, top_w, gate, up, down)
+        raise ValueError(f"unknown expert strategy {strategy!r}")
+
+    # --- strategy: all experts (reference expert_mlps.py:179) -----------------
+
+    def _all_experts(self, x, top_e, top_w, gate, up, down):
+        E = self.num_experts
+        comb = (
+            jax.nn.one_hot(top_e, E, dtype=jnp.float32) * top_w[..., None]
+        ).sum(1)  # (T, E)
+        h = jnp.einsum("th,ehi->tei", x, up)
+        h = constrain(h, P(UNC, mesh_lib.EP_AXIS, mesh_lib.TP_AXIS))
+        if self.glu_mlp:
+            g = jnp.einsum("th,ehi->tei", x, gate)
+            h = _act(self.hidden_act)(g) * h
+        else:
+            h = _act(self.hidden_act)(h)
+        y = jnp.einsum("tei,eih->teh", h, down)
+        y = constrain(y, P(UNC, mesh_lib.EP_AXIS, None))
+        return jnp.einsum("teh,te->th", y, comb.astype(y.dtype))
+
+    # --- strategy: capacity factor (reference expert_mlps.py:218) -------------
+
+    def capacity(self, n_tokens: int) -> int:
+        cf = self.capacity_factor if self.capacity_factor is not None else 1.0
+        return min(
+            n_tokens, int(ceil(cf * n_tokens * self.top_k / self.num_experts))
+        )
+
+    def _capacity_factor(self, x, top_e, top_w, gate, up, down):
+        T, E, k = x.shape[0], self.num_experts, self.top_k
+        C = self.capacity(T)
+        flat_e = top_e.reshape(-1)  # (T·k,) token-major slot order = priority
+        oh = jax.nn.one_hot(flat_e, E, dtype=jnp.float32)  # (N, E)
+        # position of each slot within its expert's queue (the reference's
+        # cumsum-position trick, expert_mlps.py:218 — fp32 0/1 cumsums are
+        # exact on TPU, the reference needed fp64 for torch-XLA argmax quirks)
+        pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh  # nonzero only at own expert
+        pos = pos.sum(-1)  # (N,)
+        keep = (pos < C).astype(jnp.float32)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+        disp = jnp.einsum("ne,nc->nec", oh * keep[:, None], pos_oh)  # (N, E, C)
+        disp = disp.reshape(T, k, E, C)
+        dispatch = disp.sum(1)  # (T, E, C) 0/1
+        combine = (disp * top_w[:, :, None, None].astype(jnp.float32)).sum(1)
+        # dispatch einsum → (E, C, H): the expert dim goes ep-sharded here,
+        # which under GSPMD is exactly the enter-EP all-to-all
+        # (reference mappings.py:474 enter_expert_parallel_region)
+        xin = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
+        xin = constrain(xin, P(mesh_lib.EP_AXIS, None, None))
+        h = jnp.einsum("ech,ehi->eci", xin, up)
+        h = constrain(h, P(mesh_lib.EP_AXIS, None, mesh_lib.TP_AXIS))
+        if self.glu_mlp:
+            g = jnp.einsum("ech,ehi->eci", xin, gate)
+            h = _act(self.hidden_act)(g) * h
+        else:
+            h = _act(self.hidden_act)(h)
+        y = jnp.einsum("eci,eih->ech", h, down)
+        y = constrain(y, P(mesh_lib.EP_AXIS, None, None))
+        # combine einsum contracts (e, c) → the exit-EP all-to-all + weighting
+        return jnp.einsum("tec,ech->th", combine.astype(y.dtype), y)
+
+    # --- strategy: blockwise dropless (reference expert_mlps.py:346) ----------
+
+    def _blockwise(self, x, top_e, top_w, gate, up, down):
+        if (
+            mesh_lib.model_parallel_is_initialized()
+            and mesh_lib.get_expert_model_parallel_size() > 1
+        ):
+            raise ValueError(
+                "blockwise dropless path requires expert_parallel_size == 1 "
+                "this round; use capacity_factor (all-to-all) or all_experts "
+                "(exact) with ep > 1"
+            )
+        T, H = x.shape
+        k, E = self.top_k, self.num_experts
+        N = T * k
+        flat_e = top_e.reshape(-1)
+        order = jnp.argsort(flat_e, stable=True)  # expert-sorted slot ids
+        token_idx = order // k
+        xs = x[token_idx]  # (N, H) expert-contiguous token rows
+        group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+        ws = top_w.reshape(-1)[order].astype(x.dtype)
+
+        def grouped_mlp(xs_, gate_, up_, down_):
+            h = jax.lax.ragged_dot(xs_, up_, group_sizes)
+            if self.glu_mlp:
+                g = jax.lax.ragged_dot(xs_, gate_, group_sizes)
+                h = _act(self.hidden_act)(g) * h
+            else:
+                h = _act(self.hidden_act)(h)
+            return jax.lax.ragged_dot(h, down_, group_sizes)
+
+        tp = (
+            mesh_lib.get_tensor_model_parallel_size()
+            if mesh_lib.model_parallel_is_initialized()
+            else 1
+        )
+        if tp > 1:
+            # Grouped (ragged) matmuls cannot be auto-partitioned by GSPMD —
+            # same constraint as the Pallas flash kernel — so the tp sharding
+            # of the intermediate dim is an explicit shard_map: partial
+            # products from the down projection psum over tp.
+            mesh = mesh_lib.get_mesh()
+            ctx_mesh = jax.sharding.get_abstract_mesh()
+            wspec_col = P(None, None, mesh_lib.TP_AXIS)
+            wspec_row = P(None, mesh_lib.TP_AXIS, None)
+
+            def tp_mlp(xs_, gate_, up_, down_):
+                return jax.lax.psum(
+                    grouped_mlp(xs_, gate_, up_, down_), mesh_lib.TP_AXIS
+                )
+
+            ys = jax.shard_map(
+                tp_mlp,
+                mesh=mesh if ctx_mesh.empty else ctx_mesh,
+                in_specs=(P(), wspec_col, wspec_col, wspec_row),
+                out_specs=P(),
+                axis_names={mesh_lib.TP_AXIS},
+                check_vma=False,
+            )(xs, gate if gate is not None else up, up, down)
+        else:
+            ys = grouped_mlp(xs, gate, up, down)
+        out = jnp.zeros((T, H), ys.dtype).at[token_idx].add(ys * ws[:, None])
+        return out

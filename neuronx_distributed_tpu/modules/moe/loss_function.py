@@ -1,0 +1,37 @@
+"""MoE auxiliary losses (reference: ``modules/moe/loss_function.py``
+``load_balancing_loss_func:5`` — Switch-Transformer style).
+
+``loss = E · Σ_e f_e · P_e`` where ``f_e`` is the fraction of routed (token,
+slot) assignments that chose expert e and ``P_e`` the mean router probability
+of e. Minimized (→ 1.0) by a uniform assignment.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def load_balancing_loss_func(
+    router_probs: jax.Array,
+    top_e: jax.Array,
+    num_experts: int,
+    top_k: Optional[int] = None,
+) -> jax.Array:
+    """``router_probs (T, E)`` full router activations, ``top_e (T, k)``
+    selected expert ids → scalar aux loss."""
+    del top_k  # implied by top_e's shape
+    probs = router_probs.astype(jnp.float32)
+    mask = jax.nn.one_hot(top_e, num_experts, dtype=jnp.float32)  # (T, k, E)
+    tokens_per_expert = mask.mean(axis=(0, 1))  # f_e, sums to 1
+    prob_per_expert = probs.mean(axis=0)  # P_e
+    return num_experts * jnp.sum(tokens_per_expert * prob_per_expert)
+
+
+def router_z_loss_func(router_logits: jax.Array) -> jax.Array:
+    """ST-MoE z-loss: penalizes large router logits for stability (kept tiny;
+    companion to the balance loss in most MoE recipes)."""
+    z = jax.nn.logsumexp(router_logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(z**2)

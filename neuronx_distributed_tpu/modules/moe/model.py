@@ -1,0 +1,115 @@
+"""MoE orchestrator layer (reference: ``modules/moe/model.py`` ``MoE:10``,
+forward at :116-220).
+
+Reference flow: optional token shuffle over the shuffle group → (SP exit)
+all-gather sequence → router → ExpertMLPs → delayed reduce-scatter/all-reduce
+back into SP layout → unshuffle. Under GSPMD the SP enter/exit are sharding
+constraints and the delayed reduction is the combine einsum inside ExpertMLPs;
+the affinity grad copy-to-TP-region trick (model.py:176) is unnecessary —
+autodiff of the combine einsum produces exactly that gradient.
+
+Returns ``(output, aux)`` where ``aux`` carries the Switch balance loss and
+z-loss terms for the trainer to weight and add (the reference returns router
+logits for the same purpose).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.modules.moe.expert_mlps import ExpertMLPs
+from neuronx_distributed_tpu.modules.moe.loss_function import (
+    load_balancing_loss_func,
+    router_z_loss_func,
+)
+from neuronx_distributed_tpu.modules.moe.routing import make_router
+from neuronx_distributed_tpu.modules.moe.token_shuffling import (
+    shuffle_tokens,
+    unshuffle_tokens,
+)
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.sharding import UNC, constrain
+
+Dtype = Any
+
+
+class MoE(nn.Module):
+    """Router + experts, on ``(B, S, H)`` activations."""
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    top_k: int = 2
+    router_kind: str = "top_k"  # top_k | sinkhorn
+    router_act_fn: str = "softmax"
+    router_jitter_eps: float = 0.0
+    hidden_act: str = "silu"
+    glu_mlp: bool = True
+    capacity_factor: Optional[float] = None  # None → dropless
+    expert_strategy: str = "auto"
+    sequence_parallel_enabled: bool = False
+    token_shuffle: bool = False
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, deterministic: bool = True
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        B, S, H = x.shape
+        if self.sequence_parallel_enabled:
+            # exit SP: routing needs the full sequence per data shard
+            # (reference SP exit all-gather, model.py:116)
+            x = constrain(x, P(UNC, None, None))
+        tokens = x.reshape(B * S, H)
+
+        perm = None
+        if self.token_shuffle and not deterministic:
+            tokens, perm = shuffle_tokens(tokens, self.make_rng("token_shuffle"))
+
+        router = make_router(
+            self.router_kind,
+            hidden_size=self.hidden_size,
+            num_experts=self.num_experts,
+            top_k=self.top_k,
+            act_fn=self.router_act_fn,
+            jitter_eps=self.router_jitter_eps,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="router",
+        )
+        route = router(tokens, deterministic=deterministic)
+
+        out = ExpertMLPs(
+            num_experts=self.num_experts,
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            top_k=self.top_k,
+            hidden_act=self.hidden_act,
+            glu_mlp=self.glu_mlp,
+            capacity_factor=self.capacity_factor,
+            strategy=self.expert_strategy,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="experts",
+        )(tokens, route.top_e, route.top_w)
+
+        if perm is not None:
+            out = unshuffle_tokens(out, perm)
+        out = out.reshape(B, S, H).astype(x.dtype)
+        if self.sequence_parallel_enabled:
+            # re-enter SP layout (reference delayed reduce-scatter, model.py:200)
+            out = constrain(out, P(UNC, (mesh_lib.CP_AXIS, mesh_lib.TP_AXIS), None))
+
+        aux = {
+            "load_balancing_loss": load_balancing_loss_func(
+                route.probs, route.top_e, self.num_experts
+            ),
+            "router_z_loss": router_z_loss_func(route.logits),
+        }
+        return out, aux

@@ -1,0 +1,86 @@
+"""Expert-fused sharded linears (reference: ``modules/moe/moe_parallel_layers.py``
+``ExpertFusedColumnParallelLinear:166`` / ``ExpertFusedRowParallelLinear:256``).
+
+3D weights ``(E, in, out)`` with experts sharded over ep and the column/row dim
+over tp. The reference's custom autograd
+(``ExpertFusedLinearWithAsyncCommunication:17``) suppresses the output
+all-reduce so the MoE layer can delay it; under GSPMD the same effect comes
+from constraining the row-parallel output's last dim UNCONSTRAINED — the
+partitioner keeps partial sums local until a later constraint (or contraction)
+forces the reduction, which is the MoE combine einsum.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.sharding import UNC, constrain
+
+Dtype = Any
+
+# Canonical expert-weight partitioning for (E, in, out)-shaped 3D kernels.
+# ExpertMLPs declares its weights with these same tuples so the ep/tp policy
+# lives in exactly one place.
+COLUMN_KERNEL_PARTITION = (mesh_lib.EP_AXIS, None, mesh_lib.TP_AXIS)
+ROW_KERNEL_PARTITION = (mesh_lib.EP_AXIS, mesh_lib.TP_AXIS, None)
+
+
+class ExpertFusedColumnParallelLinear(nn.Module):
+    """Per-expert column-parallel matmul: ``(E, C, in) × (E, in, out) →
+    (E, C, out)`` with out sharded over tp, experts over ep."""
+
+    num_experts: int
+    input_size: int
+    output_size: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                COLUMN_KERNEL_PARTITION,
+            ),
+            (self.num_experts, self.input_size, self.output_size),
+            self.param_dtype,
+        )
+        y = jnp.einsum("ech,eho->eco", x.astype(self.dtype), kernel.astype(self.dtype))
+        return constrain(y, P(mesh_lib.EP_AXIS, UNC, mesh_lib.TP_AXIS))
+
+
+class ExpertFusedRowParallelLinear(nn.Module):
+    """Per-expert row-parallel matmul: ``(E, C, in) × (E, in, out) →
+    (E, C, out)``; in sharded over tp → partial sums. ``reduce_output=False``
+    leaves the reduction to the caller (the reference's delayed all-reduce)."""
+
+    num_experts: int
+    input_size: int
+    output_size: int
+    reduce_output: bool = True
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                ROW_KERNEL_PARTITION,
+            ),
+            (self.num_experts, self.input_size, self.output_size),
+            self.param_dtype,
+        )
+        x = constrain(x, P(mesh_lib.EP_AXIS, UNC, mesh_lib.TP_AXIS))
+        y = jnp.einsum("eci,eio->eco", x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.reduce_output:
+            y = constrain(y, P(mesh_lib.EP_AXIS, UNC, None))
+        return y

@@ -1,0 +1,145 @@
+"""Routers (reference: ``modules/moe/routing.py`` — ``RouterBase:12``,
+``RouterTopK:127``, ``RouterSinkhorn:169``).
+
+The reference computes router logits in fp64 for deterministic argmax/top-k
+under XLA; on TPU fp64 is emulated and slow, so logits are computed in fp32
+(exact for router-sized matmuls) — the same motivation, the TPU-appropriate
+precision. Selection uses ``jax.lax.top_k`` which is deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+class RouterOutput(NamedTuple):
+    logits: jax.Array  # (T, E) fp32 pre-activation
+    probs: jax.Array  # (T, E) fp32 activation output (aux-loss input)
+    top_e: jax.Array  # (T, k) int32 chosen expert ids
+    top_w: jax.Array  # (T, k) fp32 affinity weights
+
+
+class RouterBase(nn.Module):
+    """Linear router: hidden → per-expert logits.
+
+    ``act_fn`` ∈ {"softmax", "sigmoid"} (reference RouterBase applies the
+    activation in high precision, routing.py:12). ``jitter_eps`` multiplies the
+    input by U[1-eps, 1+eps] noise during training (reference input jitter).
+    Router weights are replicated — they are tiny and every rank needs full
+    logits.
+    """
+
+    hidden_size: int
+    num_experts: int
+    top_k: int = 2
+    act_fn: str = "softmax"
+    jitter_eps: float = 0.0
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    def _logits(self, x, deterministic: bool) -> jax.Array:
+        weight = self.param(
+            "weight",
+            nn.with_partitioning(nn.initializers.lecun_normal(), (None, None)),
+            (self.hidden_size, self.num_experts),
+            self.param_dtype,
+        )
+        if self.jitter_eps > 0.0 and not deterministic:
+            noise = jax.random.uniform(
+                self.make_rng("jitter"),
+                x.shape,
+                x.dtype,
+                1.0 - self.jitter_eps,
+                1.0 + self.jitter_eps,
+            )
+            x = x * noise
+        # fp32 logits regardless of activation dtype
+        return jnp.asarray(x, jnp.float32) @ jnp.asarray(weight, jnp.float32)
+
+    def _activate(self, logits: jax.Array) -> jax.Array:
+        if self.act_fn == "sigmoid":
+            return jax.nn.sigmoid(logits)
+        return jax.nn.softmax(logits, axis=-1)
+
+
+class RouterTopK(RouterBase):
+    """Top-k router (reference routing.py:127).
+
+    Returns ``(probs, top_e, top_w)``:
+      * ``probs (T, E)`` — full activation output (for the aux loss),
+      * ``top_e (T, k)`` int32 — chosen expert ids,
+      * ``top_w (T, k)`` fp32 — affinity weights, renormalized over the k
+        chosen experts when ``normalize_top_k_affinities`` (reference option;
+        Mixtral semantics).
+    """
+
+    normalize_top_k_affinities: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> RouterOutput:
+        logits = self._logits(x, deterministic)
+        probs = self._activate(logits)
+        top_w, top_e = jax.lax.top_k(probs, self.top_k)
+        if self.normalize_top_k_affinities and self.act_fn == "softmax":
+            top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)
+        return RouterOutput(logits, probs, top_e.astype(jnp.int32), top_w)
+
+
+class RouterSinkhorn(RouterBase):
+    """Sinkhorn-balanced router (reference routing.py:169, ``_sinkhorn:235``).
+
+    A FIXED number of Sinkhorn normalization iterations (static-shape friendly,
+    same reason the reference fixes the iteration count for its lazy graphs)
+    balances the token→expert assignment matrix; selection uses the balanced
+    matrix, affinity weights use the plain activation of the original logits
+    (Megatron sinkhorn-router semantics). At eval time routing falls back to
+    plain top-k of the logits — Sinkhorn balance only matters for training
+    load distribution.
+    """
+
+    sinkhorn_iterations: int = 4
+
+    def _sinkhorn(self, logits: jax.Array) -> jax.Array:
+        # Sinkhorn is invariant to a global scale of the cost matrix, so the
+        # max-subtraction is exact and keeps exp() finite in fp32 (the
+        # reference sidesteps overflow with fp64, slow on TPU).
+        cost = jnp.exp(logits - jax.lax.stop_gradient(logits.max()))
+        d0 = jnp.ones(cost.shape[0], jnp.float32)
+        d1 = jnp.ones(cost.shape[1], jnp.float32)
+        eps = 1e-8
+        for _ in range(self.sinkhorn_iterations):
+            d0 = 1.0 / (cost.shape[0] * ((cost * d1[None, :]).sum(1) + eps))
+            d1 = 1.0 / (cost.shape[1] * ((cost * d0[:, None]).sum(0) + eps))
+        return cost * d0[:, None] * d1[None, :]
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> RouterOutput:
+        logits = self._logits(x, deterministic)
+        probs = self._activate(logits)
+        if deterministic:
+            top_w, top_e = jax.lax.top_k(probs, self.top_k)
+        else:
+            balanced = self._sinkhorn(logits)
+            _, top_e = jax.lax.top_k(balanced, self.top_k)
+            top_w = jnp.take_along_axis(probs, top_e, axis=-1)
+        return RouterOutput(logits, probs, top_e.astype(jnp.int32), top_w)
+
+
+def make_router(
+    kind: str,
+    hidden_size: int,
+    num_experts: int,
+    top_k: int,
+    name: Optional[str] = None,
+    **kw,
+):
+    cls = {"top_k": RouterTopK, "sinkhorn": RouterSinkhorn}[kind]
+    return cls(
+        hidden_size=hidden_size, num_experts=num_experts, top_k=top_k, name=name, **kw
+    )

@@ -1,0 +1,29 @@
+"""Token shuffling for DP load balance (reference:
+``modules/moe/token_shuffling.py`` ``shuffle:64``, ``unshuffle:102``).
+
+The reference permutes tokens randomly and all-to-alls them over a dedicated
+token-shuffle process group (parallel_state.py:1180) so that bursty per-rank
+expert distributions even out across DP before routing. Under GSPMD a global
+permutation gather on the batch-sharded token dim IS that all-to-all — XLA
+lowers the cross-shard gather onto ICI; no dedicated group needed.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def shuffle_tokens(x: jax.Array, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Random permutation of dim 0. Returns ``(shuffled, perm)``; keep ``perm``
+    for :func:`unshuffle_tokens`."""
+    perm = jax.random.permutation(key, x.shape[0])
+    return jnp.take(x, perm, axis=0), perm
+
+
+def unshuffle_tokens(x: jax.Array, perm: jax.Array) -> jax.Array:
+    """Inverse of :func:`shuffle_tokens` (reference token_shuffling.py:102)."""
+    inv = jnp.argsort(perm)
+    return jnp.take(x, inv, axis=0)

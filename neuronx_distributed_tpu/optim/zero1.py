@@ -38,7 +38,15 @@ def zero1_partition_spec(
     parallel_state.py:1579). Falls back to the param spec when nothing fits."""
     mesh = mesh or mesh_lib.get_mesh()
     axes = axes or mesh_lib.zero1_sharding_axes()
-    axes = tuple(a for a in axes if a in mesh.shape)
+    # axes the param itself is already sharded over (e.g. expert weights on
+    # "ep") must not appear twice in the extended spec
+    used = set()
+    for entry in param_spec:
+        if isinstance(entry, str):
+            used.add(entry)
+        elif isinstance(entry, (tuple, list)):
+            used.update(entry)
+    axes = tuple(a for a in axes if a in mesh.shape and a not in used)
     n = int(np.prod([mesh.shape[a] for a in axes]))
     if n == 1 or not shape:
         return param_spec

@@ -7,10 +7,15 @@ reference builds torch.distributed process groups from a rank-array reshape
 ``[PP, DP, CP, TP]`` (worked examples at parallel_state.py:351-504) and a second
 expert view ``[PP, DPexp, EP, TP]`` (parallel_state.py:372-382). On TPU with
 single-controller JAX the same structure is ONE ``jax.sharding.Mesh`` with named
-axes ``("pp", "dp", "cp", "tp")`` plus an expert-view mesh over the same devices
-reshaped to ``("pp", "edp", "ep", "tp")`` — "groups" become mesh axes, group
-collectives become ``lax.psum/all_gather/psum_scatter/all_to_all/ppermute`` with
-an ``axis_name``, and XLA lowers them onto ICI.
+axes ``("pp", "edp", "ep", "cp", "tp")`` — the reference's data-parallel
+dimension is the combined ``("edp", "ep")`` pair (:data:`DATA_AXES`), and its
+expert-view reshape [PP, DPexp, EP, TP] is simply the same mesh addressed by the
+``ep`` axis. "Groups" become mesh axes, group collectives become
+``lax.psum/all_gather/psum_scatter/all_to_all/ppermute`` with an ``axis_name``,
+and XLA lowers them onto ICI. Keeping every strategy in one mesh (rather than a
+second reshaped Mesh object) is what lets expert weights shard over ``ep``
+inside the same jit as everything else — GSPMD requires a single mesh per
+program.
 
 What intentionally disappears relative to the reference:
   * process-group bootstrap / dummy warm-up all-reduce (parallel_state.py:597-607)
@@ -41,17 +46,20 @@ logger = get_logger(__name__)
 # Canonical mesh axis names. Order matters: minor-most (last) axis maps to the
 # closest ICI neighbours, so tensor parallelism — the most latency-sensitive
 # collective traffic — stays innermost, mirroring the reference's rank grid
-# [PP, DP, CP, TP] with TP fastest-varying (parallel_state.py:351-504).
+# [PP, DP, CP, TP] with TP fastest-varying (parallel_state.py:351-504). The
+# data-parallel dimension is split into (edp, ep) so expert weights can shard
+# over ep within the same mesh; non-expert code addresses "dp" as the combined
+# DATA_AXES tuple (PartitionSpec entries accept axis tuples).
 PP_AXIS = "pp"
-DP_AXIS = "dp"
-CP_AXIS = "cp"
-TP_AXIS = "tp"
-# Expert view axes (same devices, dp*cp reshaped into edp*ep).
 EDP_AXIS = "edp"
 EP_AXIS = "ep"
+CP_AXIS = "cp"
+TP_AXIS = "tp"
+# The reference's DP dimension, as a spec entry: P(DATA_AXES, ...) shards a dim
+# over edp×ep jointly.
+DATA_AXES = (EDP_AXIS, EP_AXIS)
 
-MESH_AXES = (PP_AXIS, DP_AXIS, CP_AXIS, TP_AXIS)
-EXPERT_MESH_AXES = (PP_AXIS, EDP_AXIS, EP_AXIS, TP_AXIS)
+MESH_AXES = (PP_AXIS, EDP_AXIS, EP_AXIS, CP_AXIS, TP_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,12 +97,18 @@ class MeshConfig:
 
 @dataclasses.dataclass
 class ParallelState:
-    """Holds the live meshes. Built by :func:`initialize_model_parallel`."""
+    """Holds the live mesh. Built by :func:`initialize_model_parallel`."""
 
     config: MeshConfig
-    mesh: Mesh          # axes (pp, dp, cp, tp)
-    expert_mesh: Mesh   # axes (pp, edp, ep, tp) over the same devices
+    mesh: Mesh  # axes (pp, edp, ep, cp, tp)
     aot_mode: bool = False
+
+    @property
+    def expert_mesh(self) -> Mesh:
+        """Same mesh — the expert view is the ep axis of the primary mesh (the
+        reference's second rank grid [PP, DPexp, EP, TP],
+        parallel_state.py:372-382, needs no second object here)."""
+        return self.mesh
 
     @property
     def world_size(self) -> int:
@@ -167,22 +181,19 @@ def initialize_model_parallel(
         cfg.tensor_parallel_size,
         cfg.expert_parallel_size,
     )
-    if (dp * cp) % ep != 0:
+    if dp % ep != 0:
         raise ValueError(
-            f"expert_parallel_size={ep} must divide dp*cp={dp * cp} "
-            "(the expert view reshapes the dp×cp block into edp×ep)"
+            f"expert_parallel_size={ep} must divide dp={dp} "
+            "(the dp dimension is split into edp×ep; the reference allows ep "
+            "over dp×cp — here cp stays a separate mesh axis, so use cp=1 "
+            "when ep should span it)"
         )
-    edp = dp * cp // ep
+    edp = dp // ep
 
-    grid = _build_device_grid((pp, dp, cp, tp), devices)
+    grid = _build_device_grid((pp, edp, ep, cp, tp), devices)
     mesh = Mesh(grid, MESH_AXES)
-    # Expert view: same device order, dp×cp block reshaped to edp×ep. This is
-    # exactly the reference's second rank-grid reshape [PP, DPexp, EP, TP]
-    # (parallel_state.py:372-382) — EP ranks are consecutive dp×cp neighbours.
-    expert_grid = grid.reshape(pp, edp, ep, tp)
-    expert_mesh = Mesh(expert_grid, EXPERT_MESH_AXES)
 
-    _STATE = ParallelState(config=cfg, mesh=mesh, expert_mesh=expert_mesh, aot_mode=aot_mode)
+    _STATE = ParallelState(config=cfg, mesh=mesh, aot_mode=aot_mode)
     logger.info(
         "initialized model parallel: pp=%d dp=%d cp=%d tp=%d ep=%d edp=%d over %d devices",
         pp, dp, cp, tp, ep, edp, len(devices),
@@ -230,7 +241,8 @@ def get_pipeline_model_parallel_size() -> int:
 
 
 def get_data_parallel_size() -> int:
-    return get_mesh().shape[DP_AXIS]
+    m = get_mesh()
+    return m.shape[EDP_AXIS] * m.shape[EP_AXIS]
 
 
 def get_context_parallel_size() -> int:
@@ -238,11 +250,14 @@ def get_context_parallel_size() -> int:
 
 
 def get_expert_model_parallel_size() -> int:
-    return get_expert_mesh().shape[EP_AXIS]
+    return get_mesh().shape[EP_AXIS]
 
 
 def get_expert_data_parallel_size() -> int:
-    return get_expert_mesh().shape[EDP_AXIS]
+    """Replication degree of each expert shard (reference edp = dp*cp/ep,
+    parallel_state.py:372-382; here = edp×cp since cp is a separate axis)."""
+    m = get_mesh()
+    return m.shape[EDP_AXIS] * m.shape[CP_AXIS]
 
 
 # --- rank getters (meaningful only inside shard_map'ed code) ------------------
@@ -263,7 +278,7 @@ def get_pipeline_model_parallel_rank():
 
 
 def get_data_parallel_rank():
-    return _axis_rank(DP_AXIS)
+    return _axis_rank(EDP_AXIS) * jax.lax.axis_size(EP_AXIS) + _axis_rank(EP_AXIS)
 
 
 def get_context_parallel_rank():
@@ -283,8 +298,9 @@ def named_sharding(*spec) -> NamedSharding:
 
 def zero1_sharding_axes() -> tuple:
     """Axes over which ZeRO-1 optimizer state is sharded: DP×CP, matching the
-    reference's zero-1 sharding groups (parallel_state.py:1579)."""
-    return (DP_AXIS, CP_AXIS)
+    reference's zero-1 sharding groups (parallel_state.py:1579). DP here is the
+    (edp, ep) pair."""
+    return (EDP_AXIS, EP_AXIS, CP_AXIS)
 
 
 def get_context_parallel_ring(forward: bool = True):

@@ -61,7 +61,7 @@ def shard_activation(x, *, sequence_parallel: bool = False, batch_dim: int = 0, 
     """Canonical activation sharding for (batch, seq, hidden...)-shaped tensors:
     batch over dp, sequence over cp (plus tp when Megatron-SP is active)."""
     spec = [UNC] * x.ndim
-    spec[batch_dim] = mesh_lib.DP_AXIS
+    spec[batch_dim] = mesh_lib.DATA_AXES
     if sequence_parallel:
         spec[seq_dim] = (mesh_lib.CP_AXIS, mesh_lib.TP_AXIS)
     else:

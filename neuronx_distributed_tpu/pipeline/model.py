@@ -170,7 +170,7 @@ def shard_microbatched_batch(batch):
     def put(x):
         spec = [None] * x.ndim
         if x.ndim >= 2:
-            spec[1] = mesh_lib.DP_AXIS
+            spec[1] = mesh_lib.DATA_AXES
         if x.ndim >= 3:
             spec[2] = mesh_lib.CP_AXIS
         return jax.device_put(x, NamedSharding(mesh, P(*spec)))

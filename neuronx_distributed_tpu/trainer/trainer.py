@@ -163,7 +163,7 @@ def shard_batch(batch: Mapping[str, jax.Array]):
     def put(x):
         spec = [None] * x.ndim
         if x.ndim >= 1:
-            spec[0] = mesh_lib.DP_AXIS
+            spec[0] = mesh_lib.DATA_AXES
         if x.ndim >= 2:
             spec[1] = mesh_lib.CP_AXIS
         return jax.device_put(x, NamedSharding(mesh, P(*spec)))

@@ -121,7 +121,7 @@ def test_expert_all_to_all_roundtrip():
     out = jax.jit(
         jax.shard_map(
             round_trip,
-            mesh=state.expert_mesh,
+            mesh=state.mesh,
             in_specs=P(("edp", "ep")),
             out_specs=P(("edp", "ep")),
             check_vma=False,

@@ -14,7 +14,7 @@ def test_initialize_basic():
     assert mesh_lib.get_pipeline_model_parallel_size() == 1
     assert mesh_lib.get_context_parallel_size() == 1
     assert mesh_lib.get_world_size() == 8
-    assert state.mesh.axis_names == ("pp", "dp", "cp", "tp")
+    assert state.mesh.axis_names == ("pp", "edp", "ep", "cp", "tp")
 
 
 def test_double_init_raises():
@@ -42,22 +42,24 @@ def test_pp_cp_tp():
     assert mesh_lib.get_data_parallel_size() == 1
     assert mesh_lib.get_context_parallel_size() == 2
     counts = mesh_lib.mesh_device_counts()
-    assert counts == {"pp": 2, "dp": 1, "cp": 2, "tp": 2}
+    assert counts == {"pp": 2, "edp": 1, "ep": 1, "cp": 2, "tp": 2}
 
 
-def test_expert_mesh_reshape():
-    """Expert view reshapes the dp×cp block into edp×ep over the SAME devices
-    in the same order (reference parallel_state.py:372-382)."""
+def test_expert_axes():
+    """The dp dimension splits into edp×ep on the one primary mesh (reference's
+    second rank grid [PP, DPexp, EP, TP], parallel_state.py:372-382)."""
     state = mesh_lib.initialize_model_parallel(
         tensor_model_parallel_size=2, expert_model_parallel_size=2
     )
     assert mesh_lib.get_expert_model_parallel_size() == 2
     assert mesh_lib.get_expert_data_parallel_size() == 2
-    base = state.mesh.devices
-    expert = state.expert_mesh.devices
-    assert expert.shape == (1, 2, 2, 2)
+    assert mesh_lib.get_data_parallel_size() == 4
+    assert state.mesh.devices.shape == (1, 2, 2, 1, 2)
+    # the expert view is the same mesh object
+    assert state.expert_mesh is state.mesh
     np.testing.assert_array_equal(
-        np.array([d.id for d in base.flat]), np.array([d.id for d in expert.flat])
+        np.array([d.id for d in state.mesh.devices.flat]),
+        np.arange(8),
     )
 
 
@@ -65,7 +67,7 @@ def test_expert_divisibility_error():
     with pytest.raises(ValueError):
         mesh_lib.initialize_model_parallel(
             tensor_model_parallel_size=4, expert_model_parallel_size=4
-        )  # ep=4 cannot divide dp*cp=2
+        )  # ep=4 cannot divide dp=2
 
 
 def test_cp_ring_pairs():
@@ -78,4 +80,4 @@ def test_cp_ring_pairs():
 
 def test_zero1_axes():
     mesh_lib.initialize_model_parallel(tensor_model_parallel_size=2)
-    assert mesh_lib.zero1_sharding_axes() == ("dp", "cp")
+    assert mesh_lib.zero1_sharding_axes() == ("edp", "ep", "cp")
