@@ -7,6 +7,13 @@ from neuronx_distributed_tpu.models.llama import (
     llama3_8b,
     tiny_llama,
 )
+from neuronx_distributed_tpu.models.mixtral import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    MixtralModel,
+    mixtral_8x7b,
+    tiny_mixtral,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -16,4 +23,9 @@ __all__ = [
     "llama2_70b",
     "llama3_8b",
     "tiny_llama",
+    "MixtralConfig",
+    "MixtralForCausalLM",
+    "MixtralModel",
+    "mixtral_8x7b",
+    "tiny_mixtral",
 ]

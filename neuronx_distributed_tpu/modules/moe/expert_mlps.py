@@ -168,10 +168,14 @@ class ExpertMLPs(nn.Module):
         pos = pos.sum(-1)  # (N,)
         keep = (pos < C).astype(jnp.float32)
         pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
-        disp = jnp.einsum("ne,nc->nec", oh * keep[:, None], pos_oh)  # (N, E, C)
-        disp = disp.reshape(T, k, E, C)
-        dispatch = disp.sum(1)  # (T, E, C) 0/1
-        combine = (disp * top_w[:, :, None, None].astype(jnp.float32)).sum(1)
+        # contract the k slot dim directly into the (T, E, C) masks — never
+        # materializing the k-times-larger (N, E, C) intermediate
+        oh3 = (oh * keep[:, None]).reshape(T, k, E)
+        pos3 = pos_oh.reshape(T, k, C)
+        dispatch = jnp.einsum("tke,tkc->tec", oh3, pos3)  # (T, E, C) 0/1
+        combine = jnp.einsum(
+            "tke,tkc,tk->tec", oh3, pos3, top_w.astype(jnp.float32)
+        )
         # dispatch einsum → (E, C, H): the expert dim goes ep-sharded here,
         # which under GSPMD is exactly the enter-EP all-to-all
         # (reference mappings.py:474 enter_expert_parallel_region)

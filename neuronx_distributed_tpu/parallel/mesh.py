@@ -121,7 +121,7 @@ _STATE: Optional[ParallelState] = None
 def _build_device_grid(
     shape: Sequence[int], devices: Optional[Sequence[jax.Device]]
 ) -> np.ndarray:
-    """Arrange devices into the (pp, dp, cp, tp) grid, topology-aware when possible.
+    """Arrange devices into the (pp, edp, ep, cp, tp) grid, topology-aware when possible.
 
     ``mesh_utils.create_device_mesh`` plays the role of the reference's LOGIC1/
     LOGIC2 ring orderings (parallel_state.py:102,173,293): it permutes devices so

@@ -29,7 +29,8 @@ def constrain(x, spec: P):
     over pp with tp/dp auto) the tracing context carries an AbstractMesh with
     Manual axis types, and a NamedSharding over the concrete mesh is rejected —
     there the bare PartitionSpec form binds to the context mesh instead. Manual
-    axes must simply not appear in ``spec`` (ours name only tp/cp/dp)."""
+    axes must simply not appear in ``spec`` (ours name only tp/cp/ep and the
+    (edp, ep) DATA_AXES pair — never pp, the pipeline's manual axis)."""
     if not mesh_lib.model_parallel_is_initialized():
         return x
     ctx_mesh = jax.sharding.get_abstract_mesh()

@@ -1,0 +1,129 @@
+"""Mixtral family tests (reference analogue: MoE integration tests with the
+mixtral_model.py fixture, test/unit_test/modules/moe/)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.models.mixtral import (
+    MixtralForCausalLM,
+    tiny_mixtral,
+)
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+B, S = 2, 16
+
+
+def _data(cfg):
+    key = jax.random.PRNGKey(0)
+    ids = jax.random.randint(key, (B, S), 0, cfg.vocab_size)
+    return ids, jnp.roll(ids, -1, axis=1)
+
+
+def test_forward_shapes_and_aux():
+    cfg = tiny_mixtral()
+    model = MixtralForCausalLM(cfg, attention_impl="xla")
+    ids, _ = _data(cfg)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    logits, aux = model.apply(params, ids)
+    assert logits.shape == (B, S, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+    # per-layer balance losses are ≥ 1 and summed over layers
+    assert float(aux["load_balancing_loss"]) >= cfg.num_layers * (1.0 - 1e-4)
+
+
+def test_tp_ep_matches_single_device_golden():
+    """TP=2/EP=2 sharded forward equals the unsharded golden (deterministic
+    dropless routing → exact)."""
+    cfg = tiny_mixtral()
+    model = MixtralForCausalLM(cfg, attention_impl="xla")
+    ids, _ = _data(cfg)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    ref, _ = model.apply(params, ids)
+    mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=2, expert_model_parallel_size=2
+    )
+    out, _ = jax.jit(lambda p, i: model.apply(p, i))(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-4
+    )
+
+
+def test_train_step_with_aux_loss():
+    from neuronx_distributed_tpu.trainer import (
+        OptimizerConfig,
+        build_train_step,
+        create_train_state,
+        make_optimizer,
+        shard_batch,
+    )
+
+    mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=2, expert_model_parallel_size=2
+    )
+    cfg = tiny_mixtral(capacity_factor=2.0)
+    model = MixtralForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, S), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+    optimizer = make_optimizer(OptimizerConfig(zero1=True))
+    state, p_sh, s_sh = create_train_state(
+        model, optimizer, jax.random.PRNGKey(0), ids, zero1=True
+    )
+
+    def loss_fn(params, batch):
+        return model.loss(params, batch["input_ids"], batch["labels"])
+
+    step = build_train_step(model, optimizer, p_sh, s_sh, loss_fn=loss_fn)
+    batch = shard_batch({"input_ids": ids, "labels": labels})
+    prev = None
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
+        assert np.isfinite(loss)
+        if prev is not None:
+            assert loss < prev + 1.0  # sanity: not exploding
+        prev = loss
+
+
+def test_scan_layers_variant_runs():
+    cfg = dataclasses.replace(tiny_mixtral(), scan_layers=True, num_layers=3)
+    model = MixtralForCausalLM(cfg, attention_impl="xla")
+    ids, _ = _data(cfg)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    logits, aux = jax.jit(lambda p, i: model.apply(p, i))(params, ids)
+    assert logits.shape == (B, S, cfg.vocab_size)
+    assert np.isfinite(float(aux["load_balancing_loss"]))
+
+
+def test_remat_with_training_mode_features():
+    """Regression: remat'd layers must not trace the deterministic flag
+    (router jitter / token shuffle / sinkhorn all branch on it)."""
+    cfg = tiny_mixtral(remat=True, router_jitter_eps=0.01, token_shuffle=True)
+    model = MixtralForCausalLM(cfg, attention_impl="xla")
+    ids, _ = _data(cfg)
+    rngs = {
+        "params": jax.random.PRNGKey(1),
+        "jitter": jax.random.PRNGKey(2),
+        "token_shuffle": jax.random.PRNGKey(3),
+    }
+    params = model.init(rngs, ids, deterministic=False)
+    logits, aux = model.apply(
+        params,
+        ids,
+        deterministic=False,
+        rngs={"jitter": jax.random.PRNGKey(4), "token_shuffle": jax.random.PRNGKey(5)},
+    )
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+    # scan variant too
+    cfg2 = tiny_mixtral(
+        remat=True, scan_layers=True, router_jitter_eps=0.01, num_layers=2
+    )
+    model2 = MixtralForCausalLM(cfg2, attention_impl="xla")
+    params2 = model2.init(rngs, ids, deterministic=False)
+    logits2, _ = model2.apply(
+        params2, ids, deterministic=False, rngs={"jitter": jax.random.PRNGKey(6)}
+    )
+    assert np.isfinite(np.asarray(logits2, np.float32)).all()
