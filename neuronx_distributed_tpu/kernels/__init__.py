@@ -1,3 +1,7 @@
 from neuronx_distributed_tpu.kernels.flash_attention import flash_attention
+from neuronx_distributed_tpu.kernels.ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "ring_attention", "ring_attention_sharded"]

@@ -1,0 +1,198 @@
+"""Ring attention over the context-parallel mesh axis (reference:
+``kernels/ring_attention_kernel.py`` ``nki_ring_attn_func:141``).
+
+The reference wraps a private NKI kernel that performs the ring exchange
+internally using rank/src-tgt pairs derived from the CP process groups
+(parallel_state.py:678-690). The idiomatic JAX formulation (SURVEY §7 hard
+parts; blockwise/ring attention per PAPERS.md) moves the ring OUTSIDE the
+kernel: the local K/V block is attended first, then ``cp - 1`` steps of
+``lax.ppermute`` rotate the other shards' K/V through, each combined with the
+online-softmax (running max / normalizer) recurrence. XLA overlaps the
+ppermute with the next block's matmuls (latency-hiding scheduler), which is
+exactly the overlap the NKI kernel hand-schedules.
+
+GQA K/V travel the ring at their native head count — the query-group broadcast
+happens inside the block einsum, so ring traffic is not inflated by the
+replication factor (the reference replicates KV across ranks instead,
+qkv_linear.py kv_size_multiplier).
+
+Causality is expressed with global position masks (each shard knows its block
+offset from ``lax.axis_index``), so every ring step runs the same static
+program — no data-dependent control flow. Fully-masked blocks contribute
+exp(-inf)=0 through the safe-max guards.
+
+The per-step function is ``jax.checkpoint``-ed: the backward pass re-runs the
+ring rather than storing every block's scores — the standard memory trade that
+makes ring attention long-context viable.
+
+Known perf gap (tracked): the per-block attention materializes the
+(S_local x S_local) score tile in fp32 XLA ops rather than calling the Pallas
+flash kernel per block; wiring position offsets through the flash kernel's
+causal mask is the planned fix.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_NEG_INF = -1e30
+
+
+def _block_attn(qt, kt, vt, q_pos, k_pos, causal):
+    """One blockwise attention partial: qt (B, Hkv, G, Sq, D) × kt/vt
+    (B, Hkv, Sk, D) → unnormalized (num, m, l) accumulator pieces."""
+    d = qt.shape[-1]
+    scores = jnp.einsum(
+        "bhgqd,bhkd->bhgqk", qt.astype(jnp.float32), kt.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    m = scores.max(-1)  # (B, Hkv, G, Sq)
+    safe_m = jnp.where(m > _NEG_INF / 2, m, 0.0)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(scores > _NEG_INF / 2, p, 0.0)
+    l = p.sum(-1)
+    num = jnp.einsum("bhgqk,bhkd->bhgqd", p, vt.astype(jnp.float32))
+    m = jnp.where(l > 0, safe_m, _NEG_INF)
+    return num, m, l
+
+
+def _combine(acc, m_run, l_run, num, m_blk, l_blk):
+    """Online-softmax merge of a new block into the running accumulator."""
+    m_new = jnp.maximum(m_run, m_blk)
+    safe_new = jnp.where(m_new > _NEG_INF / 2, m_new, 0.0)
+    scale_run = jnp.where(m_run > _NEG_INF / 2, jnp.exp(m_run - safe_new), 0.0)
+    scale_blk = jnp.where(m_blk > _NEG_INF / 2, jnp.exp(m_blk - safe_new), 0.0)
+    acc = acc * scale_run[..., None] + num * scale_blk[..., None]
+    l_new = l_run * scale_run + l_blk * scale_blk
+    return acc, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    axis_name: str = mesh_lib.CP_AXIS,
+) -> jax.Array:
+    """Ring attention on LOCAL sequence shards — call inside ``shard_map``
+    with the sequence dim sharded over ``axis_name``.
+
+    ``q``: (B, S_local, H, D); ``k, v``: (B, S_local, Hkv, D) with Hkv | H
+    (GQA broadcast happens per block). Returns (B, S_local, H, D).
+    """
+    cp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    # (B, S, H, D) → (B, Hkv, G, S, D); q head kv*G+g pairs with kv head kv
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, g, s_loc, d)
+    kt0 = jnp.swapaxes(k, 1, 2)  # (B, Hkv, S, D)
+    vt0 = jnp.swapaxes(v, 1, 2)
+    q_pos = rank * s_loc + jnp.arange(s_loc)
+    # receive the previous rank's K/V each step (reference ring direction:
+    # ascending ring over the CP src/tgt pairs, parallel_state.py:688)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def block(kt, vt, j):
+        k_pos = j * s_loc + jnp.arange(s_loc)
+        return _block_attn(qt, kt, vt, q_pos, k_pos, causal)
+
+    # step 0: the local block — no exchange needed
+    acc, m_run, l_run = block(kt0, vt0, rank)
+
+    @jax.checkpoint
+    def step(carry, step_idx):
+        kt, vt, acc, m_run, l_run = carry
+        # permute FIRST so exactly cp-1 exchanges happen (the last block's
+        # K/V are not rotated onward to be discarded)
+        kt = lax.ppermute(kt, axis_name, perm)
+        vt = lax.ppermute(vt, axis_name, perm)
+        j = (rank - step_idx) % cp  # whose K/V block we hold this step
+        num, m_blk, l_blk = block(kt, vt, j)
+        acc, m_run, l_run = _combine(acc, m_run, l_run, num, m_blk, l_blk)
+        return (kt, vt, acc, m_run, l_run), None
+
+    if cp > 1:
+        (_, _, acc, m_run, l_run), _ = lax.scan(
+            step, (kt0, vt0, acc, m_run, l_run), jnp.arange(1, cp)
+        )
+    out = acc / jnp.maximum(l_run, 1e-20)[..., None]
+    out = out.reshape(b, h, s_loc, d)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+) -> jax.Array:
+    """Ring attention on GLOBAL (B, S, H, D) arrays: wraps the shard_map with
+    sequence over cp, batch over the data axes, heads over tp (the layout the
+    reference's CP groups + flash-decoding KV groups imply)."""
+    if not mesh_lib.model_parallel_is_initialized():
+        # no mesh: single block, plain attention
+        return ring_attention_reference(q, k, v, causal)
+    mesh = mesh_lib.get_mesh()
+    b, s, h, _ = q.shape
+    hkv = k.shape[2]
+    dp = mesh.shape[mesh_lib.EDP_AXIS] * mesh.shape[mesh_lib.EP_AXIS]
+    tp = mesh.shape[mesh_lib.TP_AXIS]
+    cp = mesh.shape[mesh_lib.CP_AXIS]
+    if cp > 1 and s % cp != 0:
+        # a partial ring would mis-assign global positions → silently wrong
+        # attention; fall back to the exact single-block path
+        logger.warning(
+            "ring attention: seq len %d not divisible by cp=%d; "
+            "falling back to unsharded attention",
+            s,
+            cp,
+        )
+        return ring_attention_reference(q, k, v, causal)
+    bspec = mesh_lib.DATA_AXES if (dp > 1 and b % dp == 0) else None
+    # q and kv heads shard over tp only when BOTH divide: the per-block GQA
+    # grouping requires each shard's q-head slice to align with its kv slice
+    shard_heads = tp > 1 and h % tp == 0 and hkv % tp == 0
+    hspec = mesh_lib.TP_AXIS if shard_heads else None
+    sspec = mesh_lib.CP_AXIS if cp > 1 else None
+    qspec = P(bspec, sspec, hspec, None)
+    kvspec = P(bspec, sspec, hspec, None)
+    ctx_mesh = jax.sharding.get_abstract_mesh()
+    target = mesh if ctx_mesh.empty else ctx_mesh
+    already_manual = set() if ctx_mesh.empty else set(ctx_mesh.manual_axes)
+    fn = jax.shard_map(
+        partial(ring_attention, causal=causal, axis_name=mesh_lib.CP_AXIS),
+        mesh=target,
+        in_specs=(qspec, kvspec, kvspec),
+        out_specs=qspec,
+        axis_names=set(target.axis_names) - already_manual,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ring_attention_reference(q, k, v, causal=True):
+    """Single-device golden: same math, no ring (tests compare against it).
+    GQA handled by the same grouped einsum."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, h // hkv, s, d)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    pos = jnp.arange(s)
+    num, m, l = _block_attn(qt, kt, vt, pos, pos, causal)
+    out = num / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2).astype(q.dtype)

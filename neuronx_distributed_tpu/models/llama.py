@@ -134,11 +134,30 @@ def _flash_attention(q, k, v, causal: bool = True):
     return flash_attention(q, k, v, causal=causal)
 
 
+def _ring_attention(q, k, v, causal: bool = True):
+    from neuronx_distributed_tpu.kernels.ring_attention import ring_attention_sharded
+
+    return ring_attention_sharded(q, k, v, causal=causal)
+
+
 def attention_op(q, k, v, causal: bool = True, impl: str = "auto"):
     if impl == "auto":
-        impl = "flash" if jax.devices()[0].platform == "tpu" else "xla"
+        cp = (
+            mesh_lib.get_context_parallel_size()
+            if mesh_lib.model_parallel_is_initialized()
+            else 1
+        )
+        if cp > 1:
+            # sequence sharded over cp → ring attention (reference long-seq
+            # path: CP groups + NKI ring kernel, parallel_state.py:678,
+            # kernels/ring_attention_kernel.py)
+            impl = "ring"
+        else:
+            impl = "flash" if jax.devices()[0].platform == "tpu" else "xla"
     if impl == "flash":
         return _flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        return _ring_attention(q, k, v, causal=causal)
     return _xla_attention(q, k, v, causal=causal)
 
 
