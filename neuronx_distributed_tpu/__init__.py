@@ -3,8 +3,10 @@ and inference framework with the capabilities of AWS neuronx-distributed.
 
 Public surface mirrors the reference package root
 (/root/reference/src/neuronx_distributed/__init__.py): ``parallel`` (the
-reference's parallel_layers), ``pipeline``, ``trainer``, ``kernels``, ``utils``
-plus the trainer config/checkpoint entry points.
+reference's parallel_layers), ``pipeline``, ``trainer``, ``kernels``,
+``utils``, plus ``modules`` (MoE/GQA/norms), ``models``, ``operators``
+(distributed topk/argmax), and ``inference`` (the reference's ``trace`` AOT
+path) with the trainer config/checkpoint entry points.
 """
 
 from neuronx_distributed_tpu import parallel, utils
@@ -23,3 +25,24 @@ __all__ = [
     "destroy_model_parallel",
     "model_parallel_is_initialized",
 ]
+
+
+def __getattr__(name):
+    # heavyweight subpackages load lazily so `import neuronx_distributed_tpu`
+    # stays cheap (the reference package root imports everything eagerly;
+    # flax/optax imports are slower than torch's, so we don't)
+    import importlib
+
+    if name in (
+        "kernels",
+        "models",
+        "modules",
+        "operators",
+        "inference",
+        "optim",
+        "pipeline",
+        "trainer",
+        "scripts",
+    ):
+        return importlib.import_module(f"neuronx_distributed_tpu.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

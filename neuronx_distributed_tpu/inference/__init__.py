@@ -1,0 +1,13 @@
+"""Inference path (reference: ``src/neuronx_distributed/trace/`` §2.8).
+
+The reference's AOT machinery — per-rank process tracing, NEFF compilation,
+weight-layout HLO surgery, torchscript SPMD runtime — collapses on TPU into
+``jax.jit(...).lower().compile()`` plus ``jax.export`` serialization; the
+bucket router stays Python (:mod:`model_builder`). KV-cache generation lives
+in :mod:`generate`.
+"""
+
+from neuronx_distributed_tpu.inference.generate import GenerationConfig, generate
+from neuronx_distributed_tpu.inference.model_builder import ModelBuilder, NxDModel
+
+__all__ = ["GenerationConfig", "generate", "ModelBuilder", "NxDModel"]

@@ -1,0 +1,103 @@
+"""Autoregressive generation with a KV cache (reference: the
+``examples/inference/runner.py`` generate loop + ``trace/spmd.py``
+``StateInitializer:49`` KV-cache state).
+
+Flow: one prefill call writes the prompt K/V into the flax "cache" collection
+and yields the first sampled token; then a single jitted ``lax.scan`` runs all
+decode steps on device — cache, sampling keys, and the EOS done-mask stay in
+the carry, so there is no host round-trip per token (the reference's async
+SPMDModel forward serves the same purpose).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.utils.sampling import sample
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+
+
+def generate(
+    model,
+    params,
+    prompt_ids: jax.Array,
+    key: jax.Array,
+    config: GenerationConfig = GenerationConfig(),
+) -> jax.Array:
+    """Generate ``(B, max_new_tokens)`` token ids continuing ``prompt_ids``
+    (B, S). ``model`` is a mode-capable module (e.g. ``LlamaForCausalLM``);
+    clones with ``mode="prefill"`` / ``mode="decode"`` share its params."""
+    cfg = config
+    model_cfg = getattr(model, "config", None)
+    max_len = getattr(model_cfg, "max_seq_len", None)
+    if max_len is not None and prompt_ids.shape[1] + cfg.max_new_tokens > max_len:
+        # past max_seq_len the cache write index and RoPE positions would
+        # clamp and silently corrupt generation
+        raise ValueError(
+            f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
+            f"({cfg.max_new_tokens}) exceeds the model's max_seq_len ({max_len})"
+        )
+    prefill = model.clone(mode="prefill")
+    decode = model.clone(mode="decode")
+    b = prompt_ids.shape[0]
+
+    def _sample(logits, k):
+        return sample(
+            logits,
+            k,
+            temperature=cfg.temperature,
+            top_k=cfg.top_k,
+            top_p=cfg.top_p,
+        )
+
+    @jax.jit
+    def _prefill(params, ids, key):
+        logits, variables = prefill.apply(params, ids, mutable=["cache"])
+        tok = _sample(logits[:, -1], key)
+        return tok, variables["cache"]
+
+    @jax.jit
+    def _decode_all(params, cache, first_tok, key):
+        def step(carry, _):
+            cache, tok, key, done = carry
+            key, sub = jax.random.split(key)
+            logits, variables = decode.apply(
+                {**params, "cache": cache}, tok[:, None], mutable=["cache"]
+            )
+            nxt = _sample(logits[:, -1], sub)
+            if cfg.eos_token_id is not None:
+                nxt = jnp.where(done, cfg.eos_token_id, nxt)
+                done = done | (nxt == cfg.eos_token_id)
+            return (variables["cache"], nxt, key, done), nxt
+
+        done0 = (
+            first_tok == cfg.eos_token_id
+            if cfg.eos_token_id is not None
+            else jnp.zeros((b,), bool)
+        )
+        (_, _, _, _), toks = jax.lax.scan(
+            step,
+            (cache, first_tok, key, done0),
+            None,
+            length=cfg.max_new_tokens - 1,
+        )
+        return toks  # (steps, B)
+
+    key, k0 = jax.random.split(key)
+    first_tok, cache = _prefill(params, prompt_ids, k0)
+    if cfg.max_new_tokens == 1:
+        return first_tok[:, None]
+    toks = _decode_all(dict(params), cache, first_tok, key)
+    return jnp.concatenate([first_tok[:, None], toks.T], axis=1)

@@ -1,0 +1,165 @@
+"""AOT model builder + bucket-routing runtime (reference:
+``trace/model_builder.py`` ``ModelBuilder:106`` and ``trace/spmd.py``
+``NxDModel:71``).
+
+The reference traces one HLO per (model-key, bucket), compiles NEFFs on a
+thread pool, grafts compiler-chosen weight layouts across sibling HLOs, and
+assembles a torchscript router. On TPU every one of those stages is a JAX
+primitive: ``jax.jit(fn).lower(*args).compile()`` is the AOT compile (layout
+assignment included), ``jax.export`` provides portable serialized executables,
+and the shape router stays a small Python class. Sharded inference works by
+compiling with the params' NamedShardings baked in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class _Entry:
+    fn: Callable
+    bucket_args: List[Tuple[Any, ...]]  # example args, ascending bucket size
+    bucket_dim: int  # which dim of args[route_argnum] routes buckets
+    route_argnum: int
+
+
+class NxDModel:
+    """Shape-routed bundle of compiled executables (reference
+    ``trace/spmd.py:71`` torchscript module + its input router ``:144``)."""
+
+    def __init__(self):
+        self._compiled: Dict[str, List[Tuple[int, Callable]]] = {}
+        self._route: Dict[str, Tuple[int, int]] = {}
+
+    def add_compiled(self, key, bucket_size, call, bucket_dim, route_argnum):
+        self._compiled.setdefault(key, []).append((bucket_size, call))
+        self._compiled[key].sort(key=lambda t: t[0])
+        self._route[key] = (bucket_dim, route_argnum)
+
+    def buckets(self, key) -> List[int]:
+        return [b for b, _ in self._compiled[key]]
+
+    def __call__(self, key: str, *args):
+        """Route to the smallest bucket that fits, right-padding the routed
+        dim; outputs keep the bucket shape (callers slice as needed —
+        matching the reference's bucketed semantics)."""
+        bucket_dim, route_argnum = self._route[key]
+        size = args[route_argnum].shape[bucket_dim]
+        for bucket_size, call in self._compiled[key]:
+            if size <= bucket_size:
+                if size < bucket_size:
+                    args = list(args)
+                    a = args[route_argnum]
+                    pad = [(0, 0)] * a.ndim
+                    pad[bucket_dim] = (0, bucket_size - size)
+                    args[route_argnum] = jnp.pad(a, pad)
+                return call(*args)
+        raise ValueError(
+            f"input size {size} exceeds largest bucket "
+            f"{self._compiled[key][-1][0]} for model key {key!r}"
+        )
+
+
+class ModelBuilder:
+    """Collect named sub-models with bucketed example inputs, AOT-compile
+    them, and assemble the routed :class:`NxDModel` (reference
+    ``ModelBuilder.add:158`` / ``trace:189``)."""
+
+    def __init__(self):
+        self._entries: Dict[str, _Entry] = {}
+
+    def add(
+        self,
+        key: str,
+        fn: Callable,
+        bucket_args: Sequence[Tuple[Any, ...]],
+        bucket_dim: int = -1,
+        route_argnum: int = 0,
+    ) -> "ModelBuilder":
+        """Register ``fn`` with one example-args tuple per bucket (reference
+        add:158 — e.g. key "context_encode" with seq buckets 128/512/2048 and
+        key "token_gen" with a single decode bucket)."""
+        sizes = [a[route_argnum].shape[bucket_dim] for a in bucket_args]
+        order = sorted(range(len(sizes)), key=lambda i: sizes[i])
+        self._entries[key] = _Entry(
+            fn=fn,
+            bucket_args=[tuple(bucket_args[i]) for i in order],
+            bucket_dim=bucket_dim,
+            route_argnum=route_argnum,
+        )
+        return self
+
+    def trace(self, donate_argnums: Tuple[int, ...] = ()) -> NxDModel:
+        """AOT-compile every (key, bucket) (reference trace:189; the thread
+        pool + priority-NEFF layout grafting are unnecessary — XLA compiles
+        each executable with its own layout assignment)."""
+        model = NxDModel()
+        for key, entry in self._entries.items():
+            jitted = jax.jit(entry.fn, donate_argnums=donate_argnums)
+            for args in entry.bucket_args:
+                size = args[entry.route_argnum].shape[entry.bucket_dim]
+                compiled = jitted.lower(*args).compile()
+                logger.info("compiled %s bucket=%d", key, size)
+                model.add_compiled(
+                    key, size, compiled, entry.bucket_dim, entry.route_argnum
+                )
+        return model
+
+    # --- serialized executables (reference parallel_model_save/load,
+    # trace/trace.py:375,400) -------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Serialize every (key, bucket) via ``jax.export`` so serving hosts
+        skip retracing (reference saves per-rank torchscript+NEFF)."""
+        from jax import export as jax_export
+
+        os.makedirs(path, exist_ok=True)
+        manifest = {}
+        for key, entry in self._entries.items():
+            for args in entry.bucket_args:
+                size = args[entry.route_argnum].shape[entry.bucket_dim]
+                exp = jax_export.export(jax.jit(entry.fn))(*args)
+                fname = f"{key}.{size}.bin"
+                with open(os.path.join(path, fname), "wb") as f:
+                    f.write(exp.serialize())
+                manifest.setdefault(key, []).append(
+                    {
+                        "bucket": int(size),
+                        "file": fname,
+                        "bucket_dim": entry.bucket_dim,
+                        "route_argnum": entry.route_argnum,
+                    }
+                )
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+
+    @staticmethod
+    def load(path: str) -> NxDModel:
+        from jax import export as jax_export
+
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        model = NxDModel()
+        for key, buckets in manifest.items():
+            for info in buckets:
+                with open(os.path.join(path, info["file"]), "rb") as f:
+                    exp = jax_export.deserialize(f.read())
+                model.add_compiled(
+                    key,
+                    info["bucket"],
+                    exp.call,
+                    info["bucket_dim"],
+                    info["route_argnum"],
+                )
+        return model

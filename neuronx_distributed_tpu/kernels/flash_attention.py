@@ -312,21 +312,10 @@ def _sharded_kernel_call(qt, kt, vt, causal, bq, bk, interpret):
     from jax.sharding import PartitionSpec as P
 
     spec = P(bspec, hspec, None, None)
-    # when tracing inside another (partial-manual) shard_map — e.g. the
-    # pipeline engine's pp region — the nested call must bind the context's
-    # AbstractMesh, not the concrete one. Mosaic custom calls require EVERY
-    # mesh axis to be manual at the call site, so axis_names claims all axes
-    # not already manual in the context.
-    ctx_mesh = jax.sharding.get_abstract_mesh()
-    target = mesh if ctx_mesh.empty else ctx_mesh
-    already_manual = set() if ctx_mesh.empty else set(ctx_mesh.manual_axes)
-    fn = jax.shard_map(
+    fn = mesh_lib.manual_shard_map(
         lambda a, b_, c: _flash_attention_bhsd(a, b_, c, causal, bq, bk, interpret),
-        mesh=target,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names=set(target.axis_names) - already_manual,
-        check_vma=False,
     )
     return fn(qt, kt, vt)
 

@@ -170,16 +170,10 @@ def ring_attention_sharded(
     sspec = mesh_lib.CP_AXIS if cp > 1 else None
     qspec = P(bspec, sspec, hspec, None)
     kvspec = P(bspec, sspec, hspec, None)
-    ctx_mesh = jax.sharding.get_abstract_mesh()
-    target = mesh if ctx_mesh.empty else ctx_mesh
-    already_manual = set() if ctx_mesh.empty else set(ctx_mesh.manual_axes)
-    fn = jax.shard_map(
+    fn = mesh_lib.manual_shard_map(
         partial(ring_attention, causal=causal, axis_name=mesh_lib.CP_AXIS),
-        mesh=target,
         in_specs=(qspec, kvspec, kvspec),
         out_specs=qspec,
-        axis_names=set(target.axis_names) - already_manual,
-        check_vma=False,
     )
     return fn(q, k, v)
 

@@ -161,9 +161,39 @@ def attention_op(q, k, v, causal: bool = True, impl: str = "auto"):
     return _xla_attention(q, k, v, causal=causal)
 
 
+def _decode_attention(q, k_cache, v_cache, cur_pos):
+    """Single-step attention of q (B, 1, H, D) against the full cache
+    (B, L, Hkv, D), masking positions > cur_pos — the single-block special
+    case of the ring kernel's block primitive (one source of masked-softmax
+    numerics, kernels/ring_attention.py)."""
+    from neuronx_distributed_tpu.kernels.ring_attention import _block_attn
+
+    b, _, h, d = q.shape
+    hkv = k_cache.shape[2]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, h // hkv, 1, d)
+    kt = jnp.swapaxes(k_cache, 1, 2)
+    vt = jnp.swapaxes(v_cache, 1, 2)
+    q_pos = cur_pos[None] if cur_pos.ndim == 0 else cur_pos
+    k_pos = jnp.arange(k_cache.shape[1])
+    num, _, l = _block_attn(qt, kt, vt, q_pos, k_pos, causal=True)
+    out = num / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.swapaxes(out.reshape(b, h, 1, d), 1, 2).astype(q.dtype)
+
+
 class LlamaAttention(nn.Module):
+    """GQA attention. ``mode`` selects the KV-cache behaviour (reference
+    inference path: StateInitializer KV cache, trace/spmd.py:49):
+
+    * ``"train"`` — no cache, causal attention over the input.
+    * ``"prefill"`` — causal attention AND write K/V into the cache
+      collection, set the cache index to the prompt length.
+    * ``"decode"`` — single-token step: append K/V at the cache index,
+      attend against the whole cache, advance the index.
+    """
+
     config: LlamaConfig
     attention_impl: str = "auto"
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, x, freqs, positions=None):
@@ -188,9 +218,13 @@ class LlamaAttention(nn.Module):
         if self._kv_heads_shardable():
             k = constrain(k, P(UNC, UNC, mesh_lib.TP_AXIS, None))
             v = constrain(v, P(UNC, UNC, mesh_lib.TP_AXIS, None))
-        q = apply_rope(q, freqs, positions)
-        k = apply_rope(k, freqs, positions)
-        out = attention_op(q, k, v, causal=True, impl=self.attention_impl)
+
+        if self.mode == "train":
+            q = apply_rope(q, freqs, positions)
+            k = apply_rope(k, freqs, positions)
+            out = attention_op(q, k, v, causal=True, impl=self.attention_impl)
+        else:
+            out = self._cached_attention(q, k, v, freqs, positions)
         out = out.reshape(b, s, cfg.num_heads * d)
         return RowParallelLinear(
             cfg.num_heads * d,
@@ -201,6 +235,38 @@ class LlamaAttention(nn.Module):
             param_dtype=cfg.param_dtype,
             name="o_proj",
         )(out)
+
+    def _cached_attention(self, q, k, v, freqs, positions):
+        cfg = self.config
+        b, s = q.shape[0], q.shape[1]
+        hkv, d = cfg.num_kv_heads, cfg.head_dim_
+        cache_shape = (b, cfg.max_seq_len, hkv, d)
+        ck = self.variable("cache", "k", jnp.zeros, cache_shape, q.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, cache_shape, q.dtype)
+        cidx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        if s > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {s} exceeds max_seq_len={cfg.max_seq_len}"
+            )
+        if self.mode == "prefill":
+            q = apply_rope(q, freqs, positions)
+            k = apply_rope(k, freqs, positions)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
+            cidx.value = jnp.asarray(s, jnp.int32)
+            return attention_op(q, k, v, causal=True, impl=self.attention_impl)
+        if self.mode != "decode":
+            raise ValueError(f"unknown attention mode {self.mode!r}")
+        if s != 1:
+            raise ValueError(f"decode mode expects a single token, got seq {s}")
+        cur = cidx.value  # position of the incoming token
+        pos = jnp.full((b, 1), cur, jnp.int32)
+        q = apply_rope(q, freqs, pos)
+        k = apply_rope(k, freqs, pos)
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+        cidx.value = cur + 1
+        return _decode_attention(q, ck.value, cv.value, cur)
 
     def _kv_heads_shardable(self) -> bool:
         if not mesh_lib.model_parallel_is_initialized():
@@ -230,6 +296,7 @@ class LlamaMLP(nn.Module):
 class LlamaDecoderLayer(nn.Module):
     config: LlamaConfig
     attention_impl: str = "auto"
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, x, freqs, positions=None):
@@ -239,7 +306,9 @@ class LlamaDecoderLayer(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
         )
         h = RMSNorm(cfg.hidden_size, name="input_norm", **norm)(x)
-        x = x + LlamaAttention(cfg, self.attention_impl, name="attn")(h, freqs, positions)
+        x = x + LlamaAttention(cfg, self.attention_impl, self.mode, name="attn")(
+            h, freqs, positions
+        )
         h = RMSNorm(cfg.hidden_size, name="post_attn_norm", **norm)(x)
         x = x + LlamaMLP(cfg, name="mlp")(h)
         return x
@@ -250,11 +319,14 @@ class _ScanLayerAdapter(nn.Module):
 
     config: LlamaConfig
     attention_impl: str = "auto"
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, x, freqs, positions):
         layer_cls = nn.remat(LlamaDecoderLayer) if self.config.remat else LlamaDecoderLayer
-        x = layer_cls(self.config, self.attention_impl, name="layer")(x, freqs, positions)
+        x = layer_cls(self.config, self.attention_impl, self.mode, name="layer")(
+            x, freqs, positions
+        )
         return x, None
 
 
@@ -263,6 +335,7 @@ class LlamaModel(nn.Module):
 
     config: LlamaConfig
     attention_impl: str = "auto"
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, input_ids, positions=None):
@@ -280,17 +353,17 @@ class LlamaModel(nn.Module):
         if cfg.scan_layers:
             scanned = nn.scan(
                 _ScanLayerAdapter,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 in_axes=(nn.broadcast, nn.broadcast),
                 metadata_params={nn.PARTITION_NAME: None},
-            )(cfg, self.attention_impl, name="layers")
+            )(cfg, self.attention_impl, self.mode, name="layers")
             x, _ = scanned(x, freqs, positions)
         else:
             layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, self.attention_impl, name=f"layers_{i}")(
+                x = layer_cls(cfg, self.attention_impl, self.mode, name=f"layers_{i}")(
                     x, freqs, positions
                 )
         x = RMSNorm(
@@ -304,11 +377,14 @@ class LlamaModel(nn.Module):
 class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
     attention_impl: str = "auto"
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, input_ids, positions=None):
         cfg = self.config
-        x = LlamaModel(cfg, self.attention_impl, name="model")(input_ids, positions)
+        x = LlamaModel(cfg, self.attention_impl, self.mode, name="model")(
+            input_ids, positions
+        )
         if cfg.sequence_parallel and x.ndim >= 3:
             # leave SP for the logits: gather the sequence back
             x = constrain(x, P(UNC, None, None))

@@ -230,10 +230,12 @@ class ExpertMLPs(nn.Module):
             else 1
         )
         if tp > 1:
-            # Grouped (ragged) matmuls cannot be auto-partitioned by GSPMD —
-            # same constraint as the Pallas flash kernel — so the tp sharding
-            # of the intermediate dim is an explicit shard_map: partial
-            # products from the down projection psum over tp.
+            # Grouped (ragged) matmuls cannot be auto-partitioned by GSPMD, so
+            # the tp sharding of the intermediate dim is an explicit shard_map:
+            # partial products from the down projection psum over tp. NOTE this
+            # is deliberately PARTIAL manual ({tp} only, unlike
+            # mesh.manual_shard_map): the token rows stay sharded over the
+            # auto data axes instead of being all-gathered.
             mesh = mesh_lib.get_mesh()
             ctx_mesh = jax.sharding.get_abstract_mesh()
             wspec_col = P(None, None, mesh_lib.TP_AXIS)

@@ -1,0 +1,3 @@
+from neuronx_distributed_tpu.operators.topk import argmax, topk
+
+__all__ = ["topk", "argmax"]

@@ -316,3 +316,30 @@ def get_context_parallel_ring(forward: bool = True):
 def mesh_device_counts() -> dict:
     m = get_mesh()
     return {k: int(v) for k, v in m.shape.items()}
+
+
+def manual_shard_map(fn, in_specs, out_specs):
+    """``jax.shard_map`` over the global mesh claiming EVERY mesh axis not
+    already manual in the tracing context.
+
+    This is the one correct way to drop into explicit-SPMD from GSPMD code
+    here: Mosaic custom calls (Pallas kernels, grouped matmuls) require all
+    axes manual, and when tracing inside another partial-manual shard_map
+    (e.g. the pipeline engine's pp region) the nested call must bind the
+    context's AbstractMesh with only the remaining axes. Shared by the flash
+    and ring attention wrappers, blockwise MoE, and the distributed topk.
+    """
+    import jax as _jax
+
+    mesh = get_mesh()
+    ctx_mesh = _jax.sharding.get_abstract_mesh()
+    target = mesh if ctx_mesh.empty else ctx_mesh
+    already_manual = set() if ctx_mesh.empty else set(ctx_mesh.manual_axes)
+    return _jax.shard_map(
+        fn,
+        mesh=target,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        axis_names=set(target.axis_names) - already_manual,
+        check_vma=False,
+    )

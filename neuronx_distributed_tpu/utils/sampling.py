@@ -1,0 +1,55 @@
+"""On-device token sampling (reference: ``utils/sampling.py:77`` — avoids
+``torch.multinomial`` host syncs with an on-device sampler; here the Gumbel
+trick keeps everything inside the compiled program).
+
+All functions take logits ``(..., V)`` and return int32 token ids ``(...,)``.
+``top_k``/``top_p``/temperature compose in the standard order: temperature →
+top-k filter → top-p filter → sample.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _filter_top_k(logits: jax.Array, k: int) -> jax.Array:
+    vals, _ = jax.lax.top_k(logits, k)
+    thresh = vals[..., -1:]
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def _filter_top_p(logits: jax.Array, p: float) -> jax.Array:
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest prefix with cumulative prob ≥ p (always ≥ 1 token)
+    cutoff_mask = cum - probs < p
+    thresh = jnp.where(cutoff_mask, sorted_logits, jnp.inf).min(-1, keepdims=True)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def sample(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Temperature / top-k / top-p sampling via Gumbel-max — one fused XLA
+    program, no host round-trip."""
+    if temperature == 0.0:
+        return greedy(logits)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        logits = _filter_top_k(logits, top_k)
+    if top_p is not None and top_p < 1.0:
+        logits = _filter_top_p(logits, top_p)
+    gumbel = jax.random.gumbel(key, logits.shape, jnp.float32)
+    return jnp.argmax(logits + gumbel, axis=-1).astype(jnp.int32)

@@ -1,0 +1,72 @@
+"""ModelBuilder AOT tests (reference analogue:
+test/integration/inference/test_model_builder.py, on the CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import ModelBuilder
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+
+def _fn(w, ids):
+    # toy "model": embedding lookup + reduction, shape-polymorphic over seq
+    return jnp.take(w, ids, axis=0).sum(axis=1)
+
+
+def test_bucket_routing_and_padding():
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+    buckets = [
+        (w, jnp.zeros((2, 16), jnp.int32)),
+        (w, jnp.zeros((2, 64), jnp.int32)),
+    ]
+    model = ModelBuilder().add("encode", _fn, buckets, bucket_dim=-1, route_argnum=1).trace()
+    assert model.buckets("encode") == [16, 64]
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 32)
+    out = model("encode", w, ids)
+    # routed to bucket 16 with right-padding by id 0
+    padded = jnp.pad(ids, ((0, 0), (0, 6)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_fn(w, padded)), atol=1e-6)
+    # exact bucket hit
+    ids64 = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 0, 32)
+    np.testing.assert_allclose(
+        np.asarray(model("encode", w, ids64)), np.asarray(_fn(w, ids64)), atol=1e-6
+    )
+
+
+def test_oversize_input_raises():
+    w = jnp.zeros((8, 4))
+    model = ModelBuilder().add(
+        "m", _fn, [(w, jnp.zeros((1, 8), jnp.int32))], route_argnum=1
+    ).trace()
+    with pytest.raises(ValueError, match="exceeds largest bucket"):
+        model("m", w, jnp.zeros((1, 100), jnp.int32))
+
+
+def test_save_load_roundtrip(tmp_path):
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    builder = ModelBuilder().add(
+        "m", _fn, [(w, jnp.zeros((2, 8), jnp.int32))], route_argnum=1
+    )
+    live = builder.trace()
+    builder.save(str(tmp_path / "aot"))
+    loaded = ModelBuilder.load(str(tmp_path / "aot"))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 16)
+    np.testing.assert_allclose(
+        np.asarray(loaded("m", w, ids)), np.asarray(live("m", w, ids)), atol=1e-6
+    )
+
+
+def test_sharded_compile():
+    """AOT compile with a live mesh: the executable bakes in the shardings."""
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+
+    def fn(x, w):
+        return x @ w
+
+    x = jnp.ones((4, 16))
+    w = jnp.ones((16, 32))
+    model = ModelBuilder().add("mm", fn, [(x, w)], bucket_dim=0, route_argnum=0).trace()
+    out = model("mm", x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w), atol=1e-6)

@@ -1,0 +1,57 @@
+"""Distributed topk/argmax tests (reference analogue:
+test/integration/operators/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.operators import argmax, topk
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+B, V, K = 4, 64, 5
+
+
+def _logits():
+    return jax.random.normal(jax.random.PRNGKey(0), (B, V), jnp.float32)
+
+
+def test_topk_matches_plain_tp4():
+    x = _logits()
+    ref_v, ref_i = jax.lax.top_k(x, K)
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+    vals, idx = jax.jit(lambda t: topk(t, K))(x)
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_v), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_i))
+
+
+def test_topk_inner_dim():
+    x = jax.random.normal(jax.random.PRNGKey(1), (V, B))
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+    vals, idx = jax.jit(lambda t: topk(t, K, dim=0))(x)
+    ref_v, ref_i = jax.lax.top_k(x.T, K)
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_v.T), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_i.T))
+
+
+def test_argmax_matches_plain():
+    x = _logits()
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=8)
+    idx = jax.jit(lambda t: argmax(t))(x)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(jnp.argmax(x, -1)))
+
+
+def test_topk_non_divisible_falls_back():
+    x = jax.random.normal(jax.random.PRNGKey(2), (B, 63))
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+    vals, idx = jax.jit(lambda t: topk(t, K))(x)
+    ref_v, ref_i = jax.lax.top_k(x, K)
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_v), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_i))
+
+
+def test_topk_no_mesh():
+    x = _logits()
+    vals, idx = topk(x, K)
+    ref_v, ref_i = jax.lax.top_k(x, K)
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(ref_v), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_i))

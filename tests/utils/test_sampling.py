@@ -1,0 +1,52 @@
+"""On-device sampling tests (reference analogue: utils/sampling.py unit use)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.utils.sampling import greedy, sample
+
+B, V = 8, 32
+
+
+def _logits(seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (B, V), jnp.float32)
+
+
+def test_greedy_is_argmax():
+    x = _logits()
+    np.testing.assert_array_equal(np.asarray(greedy(x)), np.asarray(jnp.argmax(x, -1)))
+
+
+def test_temperature_zero_is_greedy():
+    x = _logits()
+    out = sample(x, jax.random.PRNGKey(1), temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy(x)))
+
+
+def test_top_k_restricts_support():
+    x = _logits()
+    topk_ids = np.asarray(jax.lax.top_k(x, 3)[1])
+    for seed in range(10):
+        out = np.asarray(sample(x, jax.random.PRNGKey(seed), top_k=3))
+        for b in range(B):
+            assert out[b] in topk_ids[b]
+
+
+def test_top_p_restricts_support():
+    # peaked distribution: top-1 has prob > 0.9 → top_p=0.5 must pick it
+    x = jnp.zeros((B, V)).at[:, 7].set(10.0)
+    for seed in range(5):
+        out = np.asarray(sample(x, jax.random.PRNGKey(seed), top_p=0.5))
+        assert (out == 7).all()
+
+
+def test_sampling_follows_distribution():
+    # two-token distribution with 3:1 odds; frequency must roughly match
+    x = jnp.log(jnp.array([[3.0, 1.0] + [1e-9] * (V - 2)]))
+    counts = np.zeros(V)
+    for seed in range(200):
+        tok = int(sample(x, jax.random.PRNGKey(seed))[0])
+        counts[tok] += 1
+    assert counts[0] > counts[1] > 0
+    assert counts[2:].sum() == 0
