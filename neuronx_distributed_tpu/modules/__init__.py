@@ -1,5 +1,5 @@
-from neuronx_distributed_tpu.modules import moe
+from neuronx_distributed_tpu.modules import lora, moe
 from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
 from neuronx_distributed_tpu.modules.rms_norm import RMSNorm
 
-__all__ = ["LayerNorm", "RMSNorm", "moe"]
+__all__ = ["LayerNorm", "RMSNorm", "moe", "lora"]

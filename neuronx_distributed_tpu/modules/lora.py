@@ -1,0 +1,179 @@
+"""LoRA adapters (reference: ``src/neuronx_distributed/modules/lora/`` —
+``LoraConfig`` config.py:6, ``LoraModel`` model.py:74 inject/merge,
+``LoraParallelLinear`` tp_layer.py:15).
+
+The reference injects adapter sub-modules into a live torch module tree and
+merges weights for serving. The functional JAX equivalent works on param
+pytrees, so it composes with EVERY model in this package without module
+swapping:
+
+* :func:`init_lora_params` — build a (tiny, trainable) adapter tree with A/B
+  factors for each selected kernel;
+* :func:`merge_lora_params` — ``W + (alpha/r)·A@B`` merged tree, used both
+  for the training forward (gradients flow only into A/B when only the
+  adapter tree is differentiated) and for serving merges (reference
+  ``merge_lora``);
+* :class:`LoraLinear` — the unmerged module form (adapter branch with
+  dropout) for custom architectures, matching reference ``LoraLinear``
+  (layer.py:15) semantics.
+
+Adapter checkpoints are just the adapter tree — save/load with the normal
+checkpoint system (reference save_lora/load_lora separate-adapter path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax.core import meta
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """Reference ``LoraConfig`` (lora/config.py:6), TPU-relevant fields."""
+
+    r: int = 8
+    lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
+    # substrings of param paths to adapt, e.g. ("attn", "qkv") or ("mlp",);
+    # every "kernel" leaf whose joined path contains any of them is adapted
+    target_modules: Sequence[str] = ("qkv", "o_proj")
+    init_std: float = 0.01
+
+    @property
+    def scaling(self) -> float:
+        return self.lora_alpha / self.r
+
+
+from neuronx_distributed_tpu.utils.tree import assert_dict_paths, path_keys as _path_keys
+
+
+def default_select(cfg: LoraConfig) -> Callable[[Tuple[str, ...], jax.Array], bool]:
+    def select(keys: Tuple[str, ...], leaf) -> bool:
+        if not keys or keys[-1] != "kernel" or leaf.ndim < 2:
+            return False
+        joined = "/".join(keys)
+        return any(t in joined for t in cfg.target_modules)
+
+    return select
+
+
+def init_lora_params(
+    params: Any,
+    cfg: LoraConfig,
+    rng: jax.Array,
+    select: Optional[Callable] = None,
+) -> Any:
+    """Adapter tree mirroring ``params``: selected kernels (..., in, out) get
+    ``{"lora_a": (..., in, r), "lora_b": (..., r, out)}``; A is gaussian, B
+    zero → the adapter starts as identity (reference LoraLayer init)."""
+    select = select or default_select(cfg)
+    params = meta.unbox(params)
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out: dict = {}
+    for path, leaf in flat:
+        keys = _path_keys(path)
+        if not select(keys, leaf):
+            continue
+        assert_dict_paths(path, "init_lora_params")
+        rng, sub = jax.random.split(rng)
+        *batch, fin, fout = leaf.shape
+        node = out
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = {
+            "lora_a": cfg.init_std
+            * jax.random.normal(sub, (*batch, fin, cfg.r), jnp.float32),
+            "lora_b": jnp.zeros((*batch, cfg.r, fout), jnp.float32),
+        }
+    return out
+
+
+def merge_lora_params(params: Any, lora_params: Any, cfg: LoraConfig) -> Any:
+    """``W + scaling · A@B`` for every adapted kernel; other leaves pass
+    through unchanged (reference merge path, lora/model.py merge_lora)."""
+    params = meta.unbox(params)
+
+    def walk(p_node, l_node):
+        if isinstance(l_node, dict) and "lora_a" in l_node:
+            a, b = l_node["lora_a"], l_node["lora_b"]
+            delta = cfg.scaling * jnp.matmul(a, b)
+            return (p_node.astype(jnp.float32) + delta).astype(p_node.dtype)
+        if isinstance(p_node, dict):
+            return {
+                k: walk(v, l_node.get(k)) if isinstance(l_node, dict) else v
+                for k, v in p_node.items()
+            }
+        return p_node
+
+    return walk(params, lora_params)
+
+
+def lora_train_loss_fn(params, cfg: LoraConfig, loss_fn):
+    """Wrap a ``loss_fn(params, batch)`` into ``loss(lora_params, batch)``.
+    The base params are frozen simply because they enter as a closure
+    constant — differentiating the wrapper w.r.t. ``lora_params`` yields
+    adapter-only gradients (the reference freezes base weights via
+    requires_grad)."""
+    frozen = meta.unbox(params)
+
+    def wrapped(lora_params, batch):
+        merged = merge_lora_params(frozen, lora_params, cfg)
+        return loss_fn(merged, batch)
+
+    return wrapped
+
+
+class LoraLinear(nn.Module):
+    """Unmerged adapter linear: ``x@W + scaling · drop(x)@A@B`` (reference
+    LoraLinear, lora/layer.py:15). For custom modules; the functional merge
+    path above is preferred for whole-model adaptation."""
+
+    input_size: int
+    output_size: int
+    config: LoraConfig = LoraConfig()
+    use_bias: bool = False
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.input_size, self.output_size),
+            self.param_dtype,
+        )
+        a = self.param(
+            "lora_a",
+            nn.initializers.normal(cfg.init_std),
+            (self.input_size, cfg.r),
+            self.param_dtype,
+        )
+        b = self.param(
+            "lora_b",
+            nn.initializers.zeros_init(),
+            (cfg.r, self.output_size),
+            self.param_dtype,
+        )
+        x = x.astype(self.dtype)
+        y = x @ kernel.astype(self.dtype)
+        h = x
+        if cfg.lora_dropout > 0.0 and not self.deterministic:
+            h = nn.Dropout(cfg.lora_dropout, deterministic=False)(h)
+        y = y + cfg.scaling * (h @ a.astype(self.dtype)) @ b.astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.output_size,),
+                self.param_dtype,
+            )
+            y = y + bias.astype(self.dtype)
+        return y

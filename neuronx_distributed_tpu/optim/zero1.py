@@ -65,15 +65,9 @@ def _flatten_with_path(tree):
 
 
 def _path_suffix_key(path) -> Tuple[str, ...]:
-    out = []
-    for p in path:
-        if hasattr(p, "key"):
-            out.append(str(p.key))
-        elif hasattr(p, "idx"):
-            out.append(str(p.idx))
-        elif hasattr(p, "name"):
-            out.append(str(p.name))
-    return tuple(out)
+    from neuronx_distributed_tpu.utils.tree import path_keys
+
+    return path_keys(path)
 
 
 def zero1_shardings_for_opt_state(

@@ -1,0 +1,33 @@
+"""Quantized sharded layers (reference: ``src/neuronx_distributed/quantization/``).
+
+``int8`` and ``fp8`` (e4m3) weight quantization with per-tensor or per-channel
+scales, dequant-then-matmul forward (reference quantization_layers.py:66
+``BaseQuantizeParallelLinear``), ``from_float`` converters, and a module-tree
+``convert`` pass (reference quantize.py:18).
+"""
+
+from neuronx_distributed_tpu.quantization.config import (
+    QuantizationConfig,
+    QuantizationType,
+    QuantizedDtype,
+)
+from neuronx_distributed_tpu.quantization.layers import (
+    QuantizedColumnParallel,
+    QuantizedRowParallel,
+)
+from neuronx_distributed_tpu.quantization.utils import (
+    dequantize,
+    direct_cast_quantize,
+    quantize_param_tree,
+)
+
+__all__ = [
+    "QuantizationConfig",
+    "QuantizationType",
+    "QuantizedDtype",
+    "QuantizedColumnParallel",
+    "QuantizedRowParallel",
+    "direct_cast_quantize",
+    "dequantize",
+    "quantize_param_tree",
+]

@@ -1,0 +1,42 @@
+"""Quantization config (reference: ``quantization/quantization_config.py``
+``QuantizationType``/``QuantizedDtype`` enums + qconfig dicts :39-101)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+
+
+class QuantizationType(str, enum.Enum):
+    PER_TENSOR_SYMMETRIC = "per_tensor_symmetric"
+    PER_CHANNEL_SYMMETRIC = "per_channel_symmetric"
+
+
+class QuantizedDtype(str, enum.Enum):
+    INT8 = "int8"
+    FP8E4M3 = "f8e4m3"
+
+    @property
+    def jnp_dtype(self):
+        return {
+            QuantizedDtype.INT8: jnp.int8,
+            QuantizedDtype.FP8E4M3: jnp.float8_e4m3fn,
+        }[self]
+
+    @property
+    def max_value(self) -> float:
+        # symmetric clamp bound (reference quantization_utils.py:130 fp8 clamp)
+        return {QuantizedDtype.INT8: 127.0, QuantizedDtype.FP8E4M3: 448.0}[self]
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizationConfig:
+    """Typed qconfig (reference dict-based get_default_*_config)."""
+
+    quantization_type: QuantizationType = QuantizationType.PER_CHANNEL_SYMMETRIC
+    quantized_dtype: QuantizedDtype = QuantizedDtype.INT8
+    # dim holding output channels in the kernel (column-parallel kernels are
+    # (in, out) → channel dim 1; per-channel scales live on that dim)
+    channel_dim: int = 1

@@ -1,0 +1,143 @@
+"""Quantized tensor-parallel linears (reference:
+``quantization/quantization_layers.py`` ``QuantizedColumnParallel:376`` /
+``QuantizedRowParallel:624``).
+
+Weights live in int8/fp8 with a float scale; forward dequantizes then matmuls
+in the activation dtype (the reference's dequant-then-matmul — XLA fuses the
+scale multiply into the matmul epilogue on TPU, so the MXU still sees a dense
+bf16 GEMM while HBM holds the 1-byte weights: the memory-bound decode case
+this exists for). Sharding matches the float layers: column kernels
+``(in, out)`` split on out over tp, row kernels on in; per-channel scales
+shard with their channel dim.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.sharding import UNC, constrain
+from neuronx_distributed_tpu.quantization.config import (
+    QuantizationConfig,
+    QuantizationType,
+)
+
+Dtype = Any
+
+
+def _scale_shape(cfg: QuantizationConfig, kernel_shape, channel_dim):
+    if cfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC:
+        return ()
+    shape = [1] * len(kernel_shape)
+    shape[channel_dim] = kernel_shape[channel_dim]
+    return tuple(shape)
+
+
+class QuantizedColumnParallel(nn.Module):
+    """Column-parallel linear with quantized weights (reference :376).
+    Initialized params are placeholders — real weights come from
+    ``quantize_param_tree`` on a trained float checkpoint (reference
+    ``from_float``)."""
+
+    input_size: int
+    output_size: int
+    quantization_config: QuantizationConfig = QuantizationConfig()
+    use_bias: bool = False
+    gather_output: bool = False
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    axis: str = mesh_lib.TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        qcfg = self.quantization_config
+        kshape = (self.input_size, self.output_size)
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(
+                lambda key, shape, dt: jnp.zeros(shape, dt), (None, self.axis)
+            ),
+            kshape,
+            qcfg.quantized_dtype.jnp_dtype,
+        )
+        # per-channel scales live on the output dim → shard with it
+        sshape = _scale_shape(qcfg, kshape, channel_dim=1)
+        spart = (None, self.axis) if len(sshape) == 2 else ()
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(nn.initializers.ones_init(), spart),
+            sshape,
+            jnp.float32,
+        )
+        w = (kernel.astype(jnp.float32) * scale).astype(self.dtype)
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), w, (((x.ndim - 1,), (0,)), ((), ()))
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_partitioning(nn.initializers.zeros_init(), (self.axis,)),
+                (self.output_size,),
+                self.param_dtype,
+            )
+            y = y + bias.astype(self.dtype)
+        if self.gather_output:
+            y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+        else:
+            y = constrain(y, P(*([UNC] * (y.ndim - 1)), self.axis))
+        return y
+
+
+class QuantizedRowParallel(nn.Module):
+    """Row-parallel linear with quantized weights (reference :624)."""
+
+    input_size: int
+    output_size: int
+    quantization_config: QuantizationConfig = QuantizationConfig()
+    use_bias: bool = False
+    input_is_parallel: bool = True
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    axis: str = mesh_lib.TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        qcfg = self.quantization_config
+        kshape = (self.input_size, self.output_size)
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(
+                lambda key, shape, dt: jnp.zeros(shape, dt), (self.axis, None)
+            ),
+            kshape,
+            qcfg.quantized_dtype.jnp_dtype,
+        )
+        # per-channel scales on the output dim are NOT sharded for row layers
+        sshape = _scale_shape(qcfg, kshape, channel_dim=1)
+        spart = (None, None) if len(sshape) == 2 else ()
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(nn.initializers.ones_init(), spart),
+            sshape,
+            jnp.float32,
+        )
+        w = (kernel.astype(jnp.float32) * scale).astype(self.dtype)
+        x = x.astype(self.dtype)
+        if self.input_is_parallel:
+            x = constrain(x, P(*([UNC] * (x.ndim - 1)), self.axis))
+        y = jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())))
+        y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_partitioning(nn.initializers.zeros_init(), (None,)),
+                (self.output_size,),
+                self.param_dtype,
+            )
+            y = y + bias.astype(self.dtype)
+        return y

@@ -1,0 +1,96 @@
+"""Quantize/dequantize primitives (reference: ``quantization/quantization_utils.py``
+per-tensor/per-channel fp8+int8 quantize :112-130 and ``quantize.py``
+``direct_cast_quantize:147``; scale computation is the abs-max observer,
+``observer.py:12``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.quantization.config import (
+    QuantizationConfig,
+    QuantizationType,
+)
+
+
+def absmax_scale(w: jax.Array, cfg: QuantizationConfig) -> jax.Array:
+    """Symmetric abs-max scale (reference PerChannelAbsMaxObserver,
+    observer.py:12): per-tensor scalar or per-channel vector on
+    ``cfg.channel_dim``."""
+    qmax = cfg.quantized_dtype.max_value
+    w = jnp.abs(w.astype(jnp.float32))
+    if cfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC:
+        amax = w.max()
+    else:
+        reduce_dims = tuple(
+            d for d in range(w.ndim) if d != cfg.channel_dim % w.ndim
+        )
+        amax = w.max(axis=reduce_dims, keepdims=True)
+    return jnp.maximum(amax, 1e-12) / qmax
+
+
+def direct_cast_quantize(
+    w: jax.Array, cfg: QuantizationConfig, scale: Optional[jax.Array] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Quantize a float weight to ``(q, scale)`` (reference
+    quantize.py:147). int8 rounds-to-nearest with symmetric clamp; fp8 casts
+    after scaling into the representable range."""
+    if scale is None:
+        scale = absmax_scale(w, cfg)
+    qmax = cfg.quantized_dtype.max_value
+    scaled = w.astype(jnp.float32) / scale
+    scaled = jnp.clip(scaled, -qmax, qmax)
+    dt = cfg.quantized_dtype.jnp_dtype
+    if dt == jnp.int8:
+        q = jnp.round(scaled).astype(jnp.int8)
+    else:
+        q = scaled.astype(dt)
+    return q, scale
+
+
+def dequantize(q: jax.Array, scale: jax.Array, dtype: Any = jnp.float32) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_param_tree(
+    params: Any,
+    cfg: QuantizationConfig,
+    select: Callable[[Tuple[str, ...], jax.Array], bool] = None,
+) -> Any:
+    """Convert a float param pytree into a quantized one: every kernel leaf
+    selected by ``select`` (default: name == "kernel" and ndim >= 2) becomes
+    ``{"kernel": q, "scale": s}`` (reference ``from_float`` converters +
+    state-dict adaptor, quantization_layers.py:286)."""
+    if select is None:
+        def select(path, leaf):
+            return path and path[-1] == "kernel" and leaf.ndim >= 2
+
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.utils.tree import assert_dict_paths, path_keys
+
+    params = meta.unbox(params)  # strip nn.Partitioned boxes from init trees
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+
+    rebuilt = {}
+    for path, leaf in flat:
+        assert_dict_paths(path, "quantize_param_tree")
+        keys = path_keys(path)
+        node = rebuilt
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        if select(keys, leaf):
+            if "scale" in node:
+                raise ValueError(
+                    f"param dict at {'/'.join(keys[:-1])} already has a "
+                    "'scale' entry; cannot attach the quantization scale"
+                )
+            q, s = direct_cast_quantize(leaf, cfg)
+            node[keys[-1]] = q
+            node["scale"] = s
+        else:
+            node[keys[-1]] = leaf
+    return rebuilt

@@ -1,0 +1,30 @@
+"""Pytree path utilities shared by ZeRO-1, LoRA, and quantization."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def path_keys(path) -> Tuple[str, ...]:
+    """Stringified key path from ``jax.tree_util.tree_flatten_with_path``
+    entries (DictKey/GetAttrKey/SequenceKey/FlattenedIndexKey)."""
+    out = []
+    for e in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(e, attr):
+                out.append(str(getattr(e, attr)))
+                break
+    return tuple(out)
+
+
+def assert_dict_paths(path, what: str) -> None:
+    """Raise if ``path`` traverses a non-dict container — tree-surgery passes
+    that rebuild string-keyed dicts would silently corrupt lists/tuples."""
+    import jax.tree_util as jtu
+
+    for e in path:
+        if not isinstance(e, (jtu.DictKey, jtu.GetAttrKey)):
+            raise TypeError(
+                f"{what} only supports dict-structured param trees; "
+                f"found container key {e!r}"
+            )

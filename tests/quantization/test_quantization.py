@@ -1,0 +1,97 @@
+"""Quantization tests (reference analogue: test/unit_test/quantization/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear
+from neuronx_distributed_tpu.quantization import (
+    QuantizationConfig,
+    QuantizationType,
+    QuantizedColumnParallel,
+    QuantizedDtype,
+    QuantizedRowParallel,
+    dequantize,
+    direct_cast_quantize,
+    quantize_param_tree,
+)
+
+IN, OUT, B = 32, 48, 4
+
+
+def _w(seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (IN, OUT)) * 0.2
+
+
+@pytest.mark.parametrize("qtype", list(QuantizationType))
+@pytest.mark.parametrize("qdtype", list(QuantizedDtype))
+def test_quantize_dequantize_roundtrip(qtype, qdtype):
+    cfg = QuantizationConfig(quantization_type=qtype, quantized_dtype=qdtype)
+    w = _w()
+    q, s = direct_cast_quantize(w, cfg)
+    assert q.dtype == qdtype.jnp_dtype
+    back = dequantize(q, s)
+    # int8: ≤ amax/127 per element; fp8 e4m3: 3 mantissa bits → ~6% relative
+    tol = 0.02 if qdtype == QuantizedDtype.INT8 else 0.07
+    err = np.abs(np.asarray(back) - np.asarray(w)).max()
+    assert err < tol, err
+
+
+def test_per_channel_beats_per_tensor():
+    # one giant outlier column ruins the per-tensor scale but not per-channel
+    w = _w().at[:, 0].mul(100.0)
+    pc = QuantizationConfig(quantization_type=QuantizationType.PER_CHANNEL_SYMMETRIC)
+    pt = QuantizationConfig(quantization_type=QuantizationType.PER_TENSOR_SYMMETRIC)
+    err_pc = np.abs(np.asarray(dequantize(*direct_cast_quantize(w, pc))) - np.asarray(w))
+    err_pt = np.abs(np.asarray(dequantize(*direct_cast_quantize(w, pt))) - np.asarray(w))
+    assert err_pc[:, 1:].max() < err_pt[:, 1:].max() / 10
+
+
+def test_quantized_column_matches_float():
+    """Quantized layer params built from a float layer's kernel reproduce the
+    float forward within quantization error (reference from_float path)."""
+    float_layer = ColumnParallelLinear(IN, OUT, use_bias=False, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, IN))
+    fparams = float_layer.init(jax.random.PRNGKey(2), x)
+    ref = float_layer.apply(fparams, x)
+
+    qcfg = QuantizationConfig()
+    qparams = quantize_param_tree(fparams["params"], qcfg)
+    qlayer = QuantizedColumnParallel(IN, OUT, quantization_config=qcfg, dtype=jnp.float32)
+    out = qlayer.apply({"params": qparams}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-2)
+    rel = np.abs(np.asarray(out) - np.asarray(ref)).mean() / np.abs(np.asarray(ref)).mean()
+    assert rel < 0.01
+
+
+def test_quantized_layers_sharded_match_unsharded():
+    qcfg = QuantizationConfig()
+    w = _w()
+    q, s = direct_cast_quantize(w, qcfg)
+    params = {"params": {"kernel": q, "scale": s}}
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, IN))
+    col = QuantizedColumnParallel(IN, OUT, quantization_config=qcfg, dtype=jnp.float32)
+    ref = col.apply(params, x)
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+    out = jax.jit(lambda p, xi: col.apply(p, xi))(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    row = QuantizedRowParallel(IN, OUT, quantization_config=qcfg, dtype=jnp.float32)
+    ref_r = row.apply(params, x)
+    out_r = jax.jit(lambda p, xi: row.apply(p, xi))(params, x)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(ref_r), atol=1e-5)
+
+
+def test_quantize_param_tree_structure():
+    tree = {
+        "layer1": {"kernel": _w(), "bias": jnp.zeros((OUT,))},
+        "norm": {"weight": jnp.ones((IN,))},
+    }
+    qcfg = QuantizationConfig()
+    out = quantize_param_tree(tree, qcfg)
+    assert out["layer1"]["kernel"].dtype == jnp.int8
+    assert "scale" in out["layer1"]
+    assert out["layer1"]["bias"].dtype == jnp.float32
+    assert out["norm"]["weight"].dtype == jnp.float32
