@@ -1,3 +1,28 @@
+from neuronx_distributed_tpu.models.bert import (
+    BertConfig,
+    BertForMaskedLM,
+    BertModel,
+    bert_large,
+    tiny_bert,
+)
+from neuronx_distributed_tpu.models.codegen import (
+    CodeGenConfig,
+    CodeGenForCausalLM,
+    codegen25_7b,
+    tiny_codegen,
+)
+from neuronx_distributed_tpu.models.dbrx import (
+    DbrxConfig,
+    DbrxForCausalLM,
+    dbrx_base,
+    tiny_dbrx,
+)
+from neuronx_distributed_tpu.models.gpt_neox import (
+    GPTNeoXConfig,
+    GPTNeoXForCausalLM,
+    gpt_neox_20b,
+    tiny_gpt_neox,
+)
 from neuronx_distributed_tpu.models.llama import (
     LlamaConfig,
     LlamaForCausalLM,
@@ -14,18 +39,21 @@ from neuronx_distributed_tpu.models.mixtral import (
     mixtral_8x7b,
     tiny_mixtral,
 )
+from neuronx_distributed_tpu.models.vit import (
+    ViTConfig,
+    ViTForImageClassification,
+    tiny_vit,
+    vit_base_patch16,
+)
 
 __all__ = [
-    "LlamaConfig",
-    "LlamaForCausalLM",
-    "LlamaModel",
-    "llama2_7b",
-    "llama2_70b",
-    "llama3_8b",
-    "tiny_llama",
-    "MixtralConfig",
-    "MixtralForCausalLM",
-    "MixtralModel",
-    "mixtral_8x7b",
-    "tiny_mixtral",
+    "LlamaConfig", "LlamaForCausalLM", "LlamaModel",
+    "llama2_7b", "llama2_70b", "llama3_8b", "tiny_llama",
+    "MixtralConfig", "MixtralForCausalLM", "MixtralModel",
+    "mixtral_8x7b", "tiny_mixtral",
+    "BertConfig", "BertForMaskedLM", "BertModel", "bert_large", "tiny_bert",
+    "GPTNeoXConfig", "GPTNeoXForCausalLM", "gpt_neox_20b", "tiny_gpt_neox",
+    "DbrxConfig", "DbrxForCausalLM", "dbrx_base", "tiny_dbrx",
+    "ViTConfig", "ViTForImageClassification", "vit_base_patch16", "tiny_vit",
+    "CodeGenConfig", "CodeGenForCausalLM", "codegen25_7b", "tiny_codegen",
 ]

@@ -1,0 +1,129 @@
+"""BERT family, TPU-native (reference analogue: ``examples/training/tp_dp_bert_hf_pretrain``
+— HF BERT wired through the sharded layer stack of §2.1).
+
+Post-LN encoder: token+position+type ParallelEmbeddings → N × (self-attn →
+add&norm → GELU MLP → add&norm) → MLM head (tied-free dense + vocab-parallel
+logits). Pretraining objective = masked-LM cross entropy (+ optional NSP
+omitted — modern recipes drop it; the reference example trains MLM+NSP via HF,
+the framework surface is the same)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from neuronx_distributed_tpu.modules.attention import ParallelMLP, ParallelSelfAttention
+from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+)
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    sequence_parallel: bool = False
+    remat: bool = False
+
+
+def bert_large(**over) -> BertConfig:
+    return BertConfig(**{**dict(
+        hidden_size=1024, intermediate_size=4096, num_layers=24, num_heads=16,
+    ), **over})
+
+
+def tiny_bert(**over) -> BertConfig:
+    return BertConfig(**{**dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=8, max_seq_len=64, dtype=jnp.float32,
+    ), **over})
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        common = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                      sequence_parallel_enabled=cfg.sequence_parallel)
+        attn = ParallelSelfAttention(
+            hidden_size=cfg.hidden_size, num_heads=cfg.num_heads, causal=False,
+            use_bias=True, name="attn", **common,
+        )(x, attention_mask=attention_mask)
+        x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="attn_norm")(x + attn)
+        mlp = ParallelMLP(
+            hidden_size=cfg.hidden_size, intermediate_size=cfg.intermediate_size,
+            activation="gelu", use_bias=True, name="mlp", **common,
+        )(x)
+        return LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="mlp_norm")(x + mlp)
+
+
+class BertModel(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        """``attention_mask`` (B, S): True at real tokens, False at padding —
+        excluded from every layer's attention (not just the loss)."""
+        cfg = self.config
+        b, s = input_ids.shape
+        emb = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        x = ParallelEmbedding(cfg.vocab_size, cfg.hidden_size, name="tok_embed", **emb)(input_ids)
+        pos = jnp.arange(s)[None, :].repeat(b, 0)
+        x = x + ParallelEmbedding(cfg.max_seq_len, cfg.hidden_size, name="pos_embed", **emb)(pos)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + ParallelEmbedding(cfg.type_vocab_size, cfg.hidden_size, name="type_embed", **emb)(token_type_ids)
+        x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="embed_norm")(x)
+        layer_cls = nn.remat(BertLayer) if cfg.remat else BertLayer
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layers_{i}")(x, attention_mask)
+        return x
+
+
+class BertForMaskedLM(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        cfg = self.config
+        x = BertModel(cfg, name="bert")(input_ids, token_type_ids, attention_mask)
+        x = ColumnParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, use_bias=True, gather_output=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="transform",
+        )(x)
+        x = jax.nn.gelu(x)
+        x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="transform_norm")(x)
+        return ColumnParallelLinear(
+            cfg.hidden_size, cfg.vocab_size, use_bias=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="decoder",
+        )(x)
+
+    def loss(self, params, input_ids, labels, label_mask: Optional[jax.Array] = None):
+        """MLM loss: cross entropy at masked positions (label_mask 1 where
+        the token was masked)."""
+        logits = self.apply(params, input_ids)
+        losses = parallel_cross_entropy(logits, labels)
+        if label_mask is not None:
+            return (losses * label_mask).sum() / jnp.maximum(label_mask.sum(), 1)
+        return losses.mean()

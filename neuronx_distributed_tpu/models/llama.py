@@ -87,78 +87,15 @@ def tiny_llama(**over) -> LlamaConfig:
     ), **over})
 
 
-# --- RoPE ---------------------------------------------------------------------
+# --- RoPE + attention dispatch live in modules/attention.py (shared across
+# all families); re-exported here for the historical import surface ----------
 
-def rope_frequencies(head_dim: int, max_seq_len: int, theta: float) -> jax.Array:
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    t = jnp.arange(max_seq_len, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv_freq)  # (S, D/2)
-    return freqs
-
-
-def apply_rope(x: jax.Array, freqs: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
-    """x: (B, S, H, D); freqs: (max_S, D/2); positions: (B, S) int or None."""
-    if positions is None:
-        f = freqs[: x.shape[1]][None, :, None, :]  # (1, S, 1, D/2)
-    else:
-        f = freqs[positions][:, :, None, :]  # (B, S, 1, D/2)
-    cos, sin = jnp.cos(f), jnp.sin(f)
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
-
-
-# --- attention ----------------------------------------------------------------
-
-def _xla_attention(q, k, v, causal: bool = True):
-    """Reference einsum attention (golden path; CPU meshes). q:(B,S,H,D),
-    k/v:(B,S,Hkv,D) with Hkv | H (GQA broadcast)."""
-    b, sq, h, d = q.shape
-    hkv = k.shape[2]
-    group = h // hkv
-    qg = q.reshape(b, sq, hkv, group, d)
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32))
-    scores = scores / jnp.sqrt(d).astype(jnp.float32)
-    if causal:
-        sk = k.shape[1]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-        scores = jnp.where(mask[None, None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
-    return out.reshape(b, sq, h, d).astype(q.dtype)
-
-
-def _flash_attention(q, k, v, causal: bool = True):
-    from neuronx_distributed_tpu.kernels.flash_attention import flash_attention
-
-    return flash_attention(q, k, v, causal=causal)
-
-
-def _ring_attention(q, k, v, causal: bool = True):
-    from neuronx_distributed_tpu.kernels.ring_attention import ring_attention_sharded
-
-    return ring_attention_sharded(q, k, v, causal=causal)
-
-
-def attention_op(q, k, v, causal: bool = True, impl: str = "auto"):
-    if impl == "auto":
-        cp = (
-            mesh_lib.get_context_parallel_size()
-            if mesh_lib.model_parallel_is_initialized()
-            else 1
-        )
-        if cp > 1:
-            # sequence sharded over cp → ring attention (reference long-seq
-            # path: CP groups + NKI ring kernel, parallel_state.py:678,
-            # kernels/ring_attention_kernel.py)
-            impl = "ring"
-        else:
-            impl = "flash" if jax.devices()[0].platform == "tpu" else "xla"
-    if impl == "flash":
-        return _flash_attention(q, k, v, causal=causal)
-    if impl == "ring":
-        return _ring_attention(q, k, v, causal=causal)
-    return _xla_attention(q, k, v, causal=causal)
+from neuronx_distributed_tpu.modules.attention import (  # noqa: E402
+    apply_rope,
+    attention_op,
+    rope_frequencies,
+    xla_attention as _xla_attention,
+)
 
 
 def _decode_attention(q, k_cache, v_cache, cur_pos):

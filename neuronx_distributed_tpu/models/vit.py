@@ -1,0 +1,125 @@
+"""ViT family, TPU-native (reference analogue: ``examples/training/vit`` —
+vision transformer through the sharded layer stack, patch embedding via the
+parallel Conv2d of parallel_layers/layers.py:1209).
+
+Pre-LN encoder: conv patch embed (output channels tp-sharded) → [CLS] +
+learned positions → N × (LN → MHA → LN → GELU MLP) → classifier head."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from neuronx_distributed_tpu.modules.attention import ParallelMLP, ParallelSelfAttention
+from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    OutputChannelParallelConv2d,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_classes: int = 1000
+    layer_norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def vit_base_patch16(**over) -> ViTConfig:
+    return ViTConfig(**over)
+
+
+def tiny_vit(**over) -> ViTConfig:
+    return ViTConfig(**{**dict(
+        image_size=32, patch_size=8, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=8, num_classes=10, dtype=jnp.float32,
+    ), **over})
+
+
+class ViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        norm = dict(eps=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        common = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        h = LayerNorm(cfg.hidden_size, name="norm_1", **norm)(x)
+        x = x + ParallelSelfAttention(
+            hidden_size=cfg.hidden_size, num_heads=cfg.num_heads, causal=False,
+            use_bias=True, attention_impl="xla", name="attn", **common,
+        )(h)
+        h = LayerNorm(cfg.hidden_size, name="norm_2", **norm)(x)
+        return x + ParallelMLP(
+            hidden_size=cfg.hidden_size, intermediate_size=cfg.intermediate_size,
+            activation="gelu", use_bias=True, name="mlp", **common,
+        )(h)
+
+
+class ViTForImageClassification(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, pixels):
+        """``pixels``: (B, H, W, C) NHWC."""
+        cfg = self.config
+        x = OutputChannelParallelConv2d(
+            in_channels=cfg.num_channels,
+            out_channels=cfg.hidden_size,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            gather_output=True,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="patch_embed",
+        )(pixels)
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.hidden_size)  # (B, P, H)
+        cls = self.param(
+            "cls_token",
+            nn.with_partitioning(nn.initializers.zeros_init(), (None, None, None)),
+            (1, 1, cfg.hidden_size),
+            cfg.param_dtype,
+        )
+        x = jnp.concatenate([jnp.tile(cls.astype(cfg.dtype), (b, 1, 1)), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.with_partitioning(
+                nn.initializers.normal(0.02), (None, None, None)
+            ),
+            (1, cfg.num_patches + 1, cfg.hidden_size),
+            cfg.param_dtype,
+        )
+        x = x + pos.astype(cfg.dtype)
+        block_cls = nn.remat(ViTBlock) if cfg.remat else ViTBlock
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"blocks_{i}")(x)
+        x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="final_norm")(x)
+        return ColumnParallelLinear(
+            cfg.hidden_size, cfg.num_classes, use_bias=True, gather_output=True,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="classifier",
+        )(x[:, 0])
+
+    def loss(self, params, pixels, labels):
+        logits = self.apply(params, pixels).astype(jnp.float32)
+        onehot = jax.nn.one_hot(labels, self.config.num_classes)
+        return -(onehot * jax.nn.log_softmax(logits)).sum(-1).mean()

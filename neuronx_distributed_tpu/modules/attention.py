@@ -1,0 +1,204 @@
+"""Shared attention infrastructure: RoPE, the attention-impl dispatcher, and
+the TP self-attention block used by the non-Llama model families (BERT/ViT
+bidirectional, GPT-NeoX/CodeGen causal with partial rotary).
+
+This module is the canonical home of the generic ops — ``rope_frequencies``,
+``apply_rope``, ``attention_op`` — which the flagship Llama path re-exports
+(models depend on modules, never the reverse).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.modules.qkv_linear import GQAQKVColumnParallelLinear
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.layers import RowParallelLinear
+from neuronx_distributed_tpu.parallel.sharding import UNC, constrain
+
+Dtype = Any
+
+
+# --- RoPE ---------------------------------------------------------------------
+
+def rope_frequencies(head_dim: int, max_seq_len: int, theta: float) -> jax.Array:
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    return jnp.outer(t, inv_freq)  # (S, D/2)
+
+
+def apply_rope(x: jax.Array, freqs: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+    """x: (B, S, H, D); freqs: (max_S, D/2); positions: (B, S) int or None."""
+    if positions is None:
+        f = freqs[: x.shape[1]][None, :, None, :]  # (1, S, 1, D/2)
+    else:
+        f = freqs[positions][:, :, None, :]  # (B, S, 1, D/2)
+    cos, sin = jnp.cos(f), jnp.sin(f)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# --- attention dispatch -------------------------------------------------------
+
+def xla_attention(q, k, v, causal: bool = True, mask: Optional[jax.Array] = None):
+    """Reference einsum attention (golden path; CPU meshes; masked inputs).
+    q:(B,S,H,D), k/v:(B,S,Hkv,D) with Hkv | H (GQA broadcast); ``mask``
+    (B, Sk) True at VALID key positions (padding mask)."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    qg = q.reshape(b, sq, hkv, group, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores / jnp.sqrt(d).astype(jnp.float32)
+    sk = k.shape[1]
+    if causal:
+        cmask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(cmask[None, None, None], scores, -1e30)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def attention_op(q, k, v, causal: bool = True, impl: str = "auto",
+                 mask: Optional[jax.Array] = None):
+    """Dispatch: ring when cp > 1, Pallas flash on TPU, XLA einsum golden
+    elsewhere. A padding ``mask`` forces the XLA path (the flash/ring kernels
+    take no arbitrary mask — pad-free batches are the fast path)."""
+    if mask is not None:
+        return xla_attention(q, k, v, causal=causal, mask=mask)
+    if impl == "auto":
+        cp = (
+            mesh_lib.get_context_parallel_size()
+            if mesh_lib.model_parallel_is_initialized()
+            else 1
+        )
+        if cp > 1:
+            # sequence sharded over cp → ring attention (reference long-seq
+            # path: CP groups + NKI ring kernel, parallel_state.py:678,
+            # kernels/ring_attention_kernel.py)
+            impl = "ring"
+        else:
+            impl = "flash" if jax.devices()[0].platform == "tpu" else "xla"
+    if impl == "flash":
+        from neuronx_distributed_tpu.kernels.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        from neuronx_distributed_tpu.kernels.ring_attention import ring_attention_sharded
+
+        return ring_attention_sharded(q, k, v, causal=causal)
+    return xla_attention(q, k, v, causal=causal)
+
+
+class ParallelSelfAttention(nn.Module):
+    """Multi-head self-attention with TP-sharded heads.
+
+    ``rotary_pct`` ∈ (0, 1] applies RoPE to the first ``rotary_pct`` fraction
+    of each head dim (GPT-NeoX partial rotary); 0 disables RoPE (BERT/ViT use
+    learned positions instead).
+    """
+
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: Optional[int] = None
+    causal: bool = False
+    use_bias: bool = True
+    rotary_pct: float = 0.0
+    rope_theta: float = 10000.0
+    max_seq_len: int = 2048
+    sequence_parallel_enabled: bool = False
+    attention_impl: str = "auto"
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, positions=None, attention_mask: Optional[jax.Array] = None):
+        """``attention_mask`` (B, S): True at valid (non-padding) positions;
+        forces the masked XLA attention path."""
+        h = self.num_heads
+        hkv = self.num_kv_heads or h
+        d = self.hidden_size // h
+        q, k, v = GQAQKVColumnParallelLinear(
+            hidden_size=self.hidden_size,
+            num_heads=h,
+            num_kv_heads=hkv,
+            head_dim=d,
+            use_bias=self.use_bias,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="qkv",
+        )(x)
+        b, s = q.shape[0], q.shape[1]
+        q = q.reshape(b, s, h, d)
+        k = k.reshape(b, s, hkv, d)
+        v = v.reshape(b, s, hkv, d)
+        q = constrain(q, P(UNC, UNC, mesh_lib.TP_AXIS, None))
+        if self.rotary_pct > 0.0:
+            rot = int(d * self.rotary_pct)
+            rot -= rot % 2
+            freqs = rope_frequencies(rot, self.max_seq_len, self.rope_theta)
+            q = jnp.concatenate(
+                [apply_rope(q[..., :rot], freqs, positions), q[..., rot:]], -1
+            )
+            k = jnp.concatenate(
+                [apply_rope(k[..., :rot], freqs, positions), k[..., rot:]], -1
+            )
+        out = attention_op(
+            q, k, v, causal=self.causal, impl=self.attention_impl,
+            mask=attention_mask,
+        )
+        out = out.reshape(b, s, h * d)
+        return RowParallelLinear(
+            h * d,
+            self.hidden_size,
+            use_bias=self.use_bias,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="o_proj",
+        )(out)
+
+
+class ParallelMLP(nn.Module):
+    """Plain 2-layer MLP: CPL → activation → RPL (BERT/NeoX/ViT FFN)."""
+
+    hidden_size: int
+    intermediate_size: int
+    activation: str = "gelu"
+    use_bias: bool = True
+    sequence_parallel_enabled: bool = False
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear
+
+        act = {
+            "gelu": lambda x: jax.nn.gelu(x, approximate=False),  # exact erf GELU
+            "gelu_new": jax.nn.gelu,  # tanh approximation
+            "relu": jax.nn.relu,
+            "silu": jax.nn.silu,
+        }[self.activation]
+        common = dict(
+            use_bias=self.use_bias,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        y = ColumnParallelLinear(
+            self.hidden_size, self.intermediate_size, name="up", **common
+        )(x)
+        y = act(y)
+        return RowParallelLinear(
+            self.intermediate_size, self.hidden_size, name="down", **common
+        )(y)

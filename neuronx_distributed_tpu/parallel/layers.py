@@ -154,6 +154,97 @@ class RowParallelLinear(nn.Module):
         return y
 
 
+class OutputChannelParallelConv2d(nn.Module):
+    """Conv2d with output channels sharded over tp (reference layers.py:1209).
+    NHWC layout; kernel (kh, kw, in, out) sharded on out."""
+
+    in_channels: int
+    out_channels: int
+    kernel_size: tuple
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+    gather_output: bool = False
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = default_kernel_init
+    axis: str = mesh_lib.TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (None, None, None, self.axis)),
+            (kh, kw, self.in_channels, self.out_channels),
+            self.param_dtype,
+        )
+        y = jax.lax.conv_general_dilated(
+            x.astype(self.dtype),
+            kernel.astype(self.dtype),
+            window_strides=self.strides,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_partitioning(nn.initializers.zeros_init(), (self.axis,)),
+                (self.out_channels,),
+                self.param_dtype,
+            )
+            y = y + bias.astype(self.dtype)
+        spec_tail = None if self.gather_output else self.axis
+        return constrain(y, P(*([UNC] * (y.ndim - 1)), spec_tail))
+
+
+class InputChannelParallelConv2d(nn.Module):
+    """Conv2d with input channels sharded over tp → partial sums all-reduced
+    (reference layers.py:1332)."""
+
+    in_channels: int
+    out_channels: int
+    kernel_size: tuple
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+    input_is_parallel: bool = True
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Initializer = default_kernel_init
+    axis: str = mesh_lib.TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.kernel_init, (None, None, self.axis, None)),
+            (kh, kw, self.in_channels, self.out_channels),
+            self.param_dtype,
+        )
+        x = x.astype(self.dtype)
+        if self.input_is_parallel:
+            x = constrain(x, P(*([UNC] * (x.ndim - 1)), self.axis))
+        y = jax.lax.conv_general_dilated(
+            x,
+            kernel.astype(self.dtype),
+            window_strides=self.strides,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_partitioning(nn.initializers.zeros_init(), (None,)),
+                (self.out_channels,),
+                self.param_dtype,
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 class ParallelEmbedding(nn.Module):
     """Embedding with the table sharded on the vocab dim (reference
     layers.py:154; the shard-on-embedding-dim variant maps to ``shard_dim=1``).

@@ -1,0 +1,136 @@
+"""Model-zoo tests: every family runs fwd+bwd and matches its unsharded
+golden on a tp mesh (reference analogue: the per-model example integration
+runs, shrunk onto the virtual CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from neuronx_distributed_tpu.models import (
+    BertForMaskedLM,
+    CodeGenForCausalLM,
+    DbrxForCausalLM,
+    GPTNeoXForCausalLM,
+    ViTForImageClassification,
+    tiny_bert,
+    tiny_codegen,
+    tiny_dbrx,
+    tiny_gpt_neox,
+    tiny_vit,
+)
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+B, S = 2, 16
+
+
+def _text_inputs(vocab):
+    ids = jax.random.randint(jax.random.PRNGKey(0), (B, S), 0, vocab)
+    return ids, jnp.roll(ids, -1, axis=1)
+
+
+FAMILIES = {
+    "bert": lambda: (BertForMaskedLM(tiny_bert()), _text_inputs(256)[0]),
+    "gpt_neox": lambda: (GPTNeoXForCausalLM(tiny_gpt_neox()), _text_inputs(256)[0]),
+    "dbrx": lambda: (DbrxForCausalLM(tiny_dbrx(), attention_impl="xla"), _text_inputs(256)[0]),
+    "codegen": lambda: (CodeGenForCausalLM(tiny_codegen()), _text_inputs(256)[0]),
+    "vit": lambda: (
+        ViTForImageClassification(tiny_vit()),
+        jax.random.normal(jax.random.PRNGKey(0), (B, 32, 32, 3)),
+    ),
+}
+
+
+def _logits_of(model, params, x):
+    out = model.apply(params, x)
+    return out[0] if isinstance(out, tuple) else out
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_forward_finite(family):
+    model, x = FAMILIES[family]()
+    params = model.init(jax.random.PRNGKey(1), x)
+    logits = _logits_of(model, params, x)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_tp2_matches_unsharded_golden(family):
+    model, x = FAMILIES[family]()
+    params = model.init(jax.random.PRNGKey(1), x)
+    ref = _logits_of(model, params, x)
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=2)
+    out = jax.jit(lambda p, xi: _logits_of(model, p, xi))(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=5e-4
+    )
+
+
+@pytest.mark.parametrize("family", ["bert", "gpt_neox", "dbrx", "codegen", "vit"])
+def test_train_loss_decreases(family):
+    model, x = FAMILIES[family]()
+    params = model.init(jax.random.PRNGKey(1), x)
+    if family == "vit":
+        labels = jax.random.randint(jax.random.PRNGKey(2), (B,), 0, 10)
+    else:
+        labels = jnp.roll(x, -1, axis=1)
+
+    def loss_fn(p):
+        return model.loss(p, x, labels)
+
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, s = opt.update(grads, s)
+        return optax.apply_updates(p, updates), s, loss
+
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+        assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_attention_mask_blocks_padding():
+    """Padding tokens must not influence real-token representations."""
+    model = BertForMaskedLM(tiny_bert())
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, 256)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    # pad to 12 with junk; mask marks the first 8 as real
+    junk = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 1, 256)
+    padded = jnp.concatenate([ids, junk], axis=1)
+    mask = jnp.arange(12)[None, :] < 8
+    out_masked = model.apply(params, padded, None, mask)
+    out_clean = model.apply(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_masked[:, :8], np.float32),
+        np.asarray(out_clean, np.float32),
+        atol=1e-4,
+    )
+    # and without the mask, junk DOES leak in (sanity that the test can fail)
+    out_unmasked = model.apply(params, padded)
+    assert not np.allclose(
+        np.asarray(out_unmasked[:, :8], np.float32),
+        np.asarray(out_clean, np.float32),
+        atol=1e-4,
+    )
+
+
+def test_input_channel_parallel_conv_matches_golden():
+    from neuronx_distributed_tpu.parallel.layers import InputChannelParallelConv2d
+
+    conv = InputChannelParallelConv2d(
+        in_channels=16, out_channels=8, kernel_size=(3, 3), dtype=jnp.float32
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 16))
+    params = conv.init(jax.random.PRNGKey(1), x)
+    ref = conv.apply(params, x)
+    assert ref.shape == (2, 8, 8, 8)
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+    out = jax.jit(lambda p, xi: conv.apply(p, xi))(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
