@@ -1,0 +1,132 @@
+"""Draft-model speculative decoding (reference: the speculative-draft process
+groups ``parallel_state.py:1428`` + ``examples/inference/run_llama_speculative.py``).
+
+Greedy speculation: each round the draft model proposes ``gamma`` tokens
+autoregressively through its own KV cache; the target model scores the whole
+window in ONE decode forward (the s>1 verify path of the cache) and accepts
+the longest prefix matching its own greedy choices, emitting one corrected
+or bonus token beyond it. Caches roll back by resetting their (traced) index
+variables — stale K/V past the index are masked out by position, so no
+recompute is needed.
+
+The round is one jitted function; only the accepted-count readback syncs the
+host per round (the reference syncs identically between draft and target
+NEFFs).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _set_cache_index(cache, value):
+    """Functionally set every per-layer 'index' leaf (cache rollback)."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {
+                k: jnp.asarray(value, jnp.int32) if k == "index" else walk(v)
+                for k, v in node.items()
+            }
+        return node
+
+    return walk(cache)
+
+
+def speculative_generate(
+    target_model,
+    target_params,
+    draft_model,
+    draft_params,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    gamma: int = 4,
+) -> Tuple[jax.Array, float]:
+    """Greedy speculative decoding. Returns ``(tokens (B, max_new_tokens),
+    mean_accepted_per_round)``. Batch size 1 recommended (acceptance lengths
+    diverge across a batch; per-row bookkeeping is future work — reference
+    speculative example is also B=1)."""
+    assert prompt_ids.shape[0] == 1, "speculative decoding supports B=1"
+    t_prefill = target_model.clone(mode="prefill")
+    t_decode = target_model.clone(mode="decode")
+    d_prefill = draft_model.clone(mode="prefill")
+    d_decode = draft_model.clone(mode="decode")
+
+    @jax.jit
+    def _prefills(tp, dp, ids):
+        t_logits, t_vars = t_prefill.apply(tp, ids, mutable=["cache"])
+        d_logits, d_vars = d_prefill.apply(dp, ids, mutable=["cache"])
+        first = jnp.argmax(t_logits[:, -1], -1).astype(jnp.int32)
+        return first, t_vars["cache"], d_vars["cache"]
+
+    @jax.jit
+    def _round(tp, dp, t_cache, d_cache, last_tok, base_pos):
+        # draft proposes gamma tokens from its own cache
+        d_cache = _set_cache_index(d_cache, base_pos)
+        draft_toks = []
+        tok = last_tok
+        for _ in range(gamma):
+            logits, d_vars = d_decode.apply(
+                {**dp, "cache": d_cache}, tok[:, None], mutable=["cache"]
+            )
+            d_cache = d_vars["cache"]
+            tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            draft_toks.append(tok)
+        draft = jnp.stack(draft_toks, 1)  # (1, gamma)
+
+        # target scores [last_tok, d_1..d_{gamma-1}] + bonus position in one
+        # s = gamma window; row j predicts the token after position base+j
+        t_cache = _set_cache_index(t_cache, base_pos)
+        window = jnp.concatenate([last_tok[:, None], draft[:, :-1]], axis=1)
+        t_logits, t_vars = t_decode.apply(
+            {**tp, "cache": t_cache}, window, mutable=["cache"]
+        )
+        t_cache = t_vars["cache"]
+        target_pred = jnp.argmax(t_logits, -1).astype(jnp.int32)  # (1, gamma)
+
+        # accept longest prefix where draft == target greedy
+        matches = draft == target_pred  # (1, gamma)
+        n_acc = jnp.argmin(
+            jnp.concatenate([matches, jnp.zeros((1, 1), bool)], 1), axis=1
+        )[0]  # first mismatch index == number accepted
+        # emitted tokens this round: accepted drafts + the target's token at
+        # the first mismatch (correction) — total n_acc + 1
+        out = jnp.where(
+            jnp.arange(gamma) < n_acc, draft[0], 0
+        )
+        corrected = target_pred[0, jnp.minimum(n_acc, gamma - 1)]
+        out = out.at[jnp.minimum(n_acc, gamma - 1)].set(
+            jnp.where(n_acc < gamma, corrected, draft[0, gamma - 1])
+        )
+        # when all gamma accepted, the gamma-th row's prediction is a bonus
+        # token — but its K/V write is position base+gamma-1's; emitting it
+        # requires no extra compute, the NEXT round re-feeds it as last_tok
+        next_tok = jnp.where(n_acc < gamma, corrected, target_pred[0, gamma - 1])
+        return t_cache, d_cache, out, n_acc, next_tok[None]
+
+    first, t_cache, d_cache = _prefills(
+        dict(target_params), dict(draft_params), prompt_ids
+    )
+    tokens = [int(first[0])]
+    base = prompt_ids.shape[1]
+    last = first
+    rounds, accepted_total = 0, 0
+    while len(tokens) < max_new_tokens:
+        t_cache, d_cache, out, n_acc, last = _round(
+            dict(target_params), dict(draft_params), t_cache, d_cache, last,
+            jnp.asarray(base, jnp.int32),
+        )
+        n = int(n_acc)
+        emitted = [int(v) for v in out[: min(n + 1, gamma)]]
+        tokens.extend(emitted)
+        # cache-valid entries this round: the window prefix whose inputs were
+        # correct — n+1 rows on a mismatch (incl. the correction's input),
+        # gamma rows on full acceptance (the bonus token was never fed)
+        base += min(n + 1, gamma)
+        rounds += 1
+        accepted_total += n
+    mean_accepted = accepted_total / max(rounds, 1)
+    return jnp.asarray(tokens[:max_new_tokens], jnp.int32)[None], mean_accepted

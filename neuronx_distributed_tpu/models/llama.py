@@ -98,23 +98,23 @@ from neuronx_distributed_tpu.modules.attention import (  # noqa: E402
 )
 
 
-def _decode_attention(q, k_cache, v_cache, cur_pos):
-    """Single-step attention of q (B, 1, H, D) against the full cache
-    (B, L, Hkv, D), masking positions > cur_pos — the single-block special
-    case of the ring kernel's block primitive (one source of masked-softmax
-    numerics, kernels/ring_attention.py)."""
+def _decode_attention(q, k_cache, v_cache, q_pos):
+    """Attention of q (B, S, H, D) rows at positions ``q_pos`` (S,) against
+    the full cache (B, L, Hkv, D), each row masked at its own position — the
+    single-block special case of the ring kernel's block primitive (one
+    source of masked-softmax numerics, kernels/ring_attention.py)."""
     from neuronx_distributed_tpu.kernels.ring_attention import _block_attn
 
-    b, _, h, d = q.shape
+    b, s, h, d = q.shape
     hkv = k_cache.shape[2]
-    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, h // hkv, 1, d)
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, h // hkv, s, d)
     kt = jnp.swapaxes(k_cache, 1, 2)
     vt = jnp.swapaxes(v_cache, 1, 2)
-    q_pos = cur_pos[None] if cur_pos.ndim == 0 else cur_pos
+    q_pos = q_pos[None] if q_pos.ndim == 0 else q_pos
     k_pos = jnp.arange(k_cache.shape[1])
     num, _, l = _block_attn(qt, kt, vt, q_pos, k_pos, causal=True)
     out = num / jnp.maximum(l, 1e-20)[..., None]
-    return jnp.swapaxes(out.reshape(b, h, 1, d), 1, 2).astype(q.dtype)
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2).astype(q.dtype)
 
 
 class LlamaAttention(nn.Module):
@@ -194,16 +194,16 @@ class LlamaAttention(nn.Module):
             return attention_op(q, k, v, causal=True, impl=self.attention_impl)
         if self.mode != "decode":
             raise ValueError(f"unknown attention mode {self.mode!r}")
-        if s != 1:
-            raise ValueError(f"decode mode expects a single token, got seq {s}")
-        cur = cidx.value  # position of the incoming token
-        pos = jnp.full((b, 1), cur, jnp.int32)
-        q = apply_rope(q, freqs, pos)
-        k = apply_rope(k, freqs, pos)
+        # decode accepts s >= 1: a 1-token step or an s-token speculative
+        # verify window (each row causally masked at its own position)
+        cur = cidx.value  # position of the first incoming token
+        pos = cur + jnp.arange(s, dtype=jnp.int32)
+        q = apply_rope(q, freqs, jnp.broadcast_to(pos[None], (b, s)))
+        k = apply_rope(k, freqs, jnp.broadcast_to(pos[None], (b, s)))
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
-        cidx.value = cur + 1
-        return _decode_attention(q, ck.value, cv.value, cur)
+        cidx.value = cur + s
+        return _decode_attention(q, ck.value, cv.value, pos)
 
     def _kv_heads_shardable(self) -> bool:
         if not mesh_lib.model_parallel_is_initialized():

@@ -1,0 +1,75 @@
+"""Attention-head padding for non-divisible TP (reference:
+``parallel_layers/pad.py`` ``pad_model:32`` — hook-based head padding so a
+model with e.g. 12 heads can run at tp=8).
+
+TPU formulation: padding is a config + param transformation, not module
+hooks. ``pad_heads_config`` rounds the head count up to a tp multiple;
+``pad_attention_params`` zero-pads the corresponding projection kernels so
+the padded heads compute zeros and the output projection ignores them —
+numerically identical to the unpadded model (same guarantee the reference's
+preshard hooks provide, layers.py:693,:916).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.utils.tree import path_keys
+
+
+def padded_head_count(num_heads: int, tp: int) -> int:
+    return -(-num_heads // tp) * tp
+
+
+def pad_heads_config(config: Any, tp: int) -> Any:
+    """Return a config with num_heads (and num_kv_heads if present) rounded up
+    to a multiple of tp (reference pad.py:14 get_number_of_extra_heads)."""
+    updates = {"num_heads": padded_head_count(config.num_heads, tp)}
+    if hasattr(config, "num_kv_heads"):
+        updates["num_kv_heads"] = padded_head_count(config.num_kv_heads, tp)
+    return dataclasses.replace(config, **updates)
+
+
+def pad_attention_params(
+    params: Any,
+    head_dim: int,
+    old_heads: int,
+    new_heads: int,
+    qkv_substr: str = "qkv",
+    out_substr: str = "o_proj",
+) -> Any:
+    """Zero-pad attention projection kernels from ``old_heads`` to
+    ``new_heads``:
+
+    * q/k/v kernels (in, old_heads·D) → (in, new_heads·D), zero columns —
+      padded heads emit zeros;
+    * output kernels (old_heads·D, out) → (new_heads·D, out), zero rows —
+      padded heads contribute nothing.
+    """
+    extra = (new_heads - old_heads) * head_dim
+    if extra == 0:
+        return params
+
+    def pad_leaf(path, leaf):
+        keys = "/".join(path_keys(path))
+        if (
+            qkv_substr in keys
+            and keys.endswith("bias")
+            and leaf.ndim == 1
+            and leaf.shape[0] == old_heads * head_dim
+        ):
+            return jnp.pad(leaf, ((0, extra),))
+        if leaf.ndim != 2:
+            return leaf
+        if qkv_substr in keys and keys.endswith("kernel") and leaf.shape[1] == old_heads * head_dim:
+            return jnp.pad(leaf, ((0, 0), (0, extra)))
+        if out_substr in keys and keys.endswith("kernel") and leaf.shape[0] == old_heads * head_dim:
+            return jnp.pad(leaf, ((0, extra), (0, 0)))
+        return leaf
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(treedef, [pad_leaf(p, l) for p, l in flat])

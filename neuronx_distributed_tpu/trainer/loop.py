@@ -1,0 +1,185 @@
+"""High-level training loop with callbacks (reference:
+``src/neuronx_distributed/lightning/`` — ``NeuronXLAStrategy``,
+``NeuronLTModule``, the rank-0 TensorBoard logger with step gating
+(logger.py:24), TQDM bar, and ``NeuronHooksCallback``; plus the examples'
+``Throughput`` moving-average meter, training_utils.py:338).
+
+Lightning's role in the reference — wiring parallel init, precision, the
+train loop, logging, and checkpoint IO — collapses here into one plain
+``Trainer`` class over the jitted train step. Callbacks get the same hook
+points the reference's Lightning plugins use."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, List, Optional
+
+import jax
+
+from neuronx_distributed_tpu.trainer.checkpoint import save_checkpoint
+from neuronx_distributed_tpu.trainer.trainer import (
+    OptimizerConfig,
+    build_train_step,
+    create_train_state,
+    make_optimizer,
+    shard_batch,
+)
+from neuronx_distributed_tpu.utils.logger import get_logger
+from neuronx_distributed_tpu.utils.timeline import Timeline
+
+logger = get_logger(__name__)
+
+
+class Callback:
+    """Hook points (reference: Lightning callback surface used by NxD)."""
+
+    def on_train_start(self, trainer: "Trainer") -> None: ...
+
+    def on_step_end(self, trainer: "Trainer", metrics: dict) -> None: ...
+
+    def on_train_end(self, trainer: "Trainer") -> None: ...
+
+
+class ThroughputMeter:
+    """Moving-average seqs/s over the last N steps (reference Throughput,
+    examples/training/llama/training_utils.py:338)."""
+
+    def __init__(self, batch_size: int, window: int = 10):
+        self.batch_size = batch_size
+        self.window = window
+        self._times: deque = deque(maxlen=window + 1)
+        self.throughput = 0.0
+
+    def update(self) -> float:
+        self._times.append(time.perf_counter())
+        if len(self._times) >= 2:
+            dt = self._times[-1] - self._times[0]
+            steps = len(self._times) - 1
+            self.throughput = self.batch_size * steps / max(dt, 1e-9)
+        return self.throughput
+
+
+class MetricsLogger(Callback):
+    """Rank-0 step-gated metric logging, optionally into TensorBoard
+    (reference lightning/logger.py:24 NeuronTensorBoardLogger)."""
+
+    def __init__(self, log_every: int = 10, tensorboard_dir: Optional[str] = None):
+        self.log_every = log_every
+        self._tb = None
+        if tensorboard_dir is not None:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception as e:  # tensorboard is optional
+                logger.warning("tensorboard unavailable (%s); file logging only", e)
+
+    def on_step_end(self, trainer, metrics):
+        if trainer.step % self.log_every != 0:
+            return
+        scalars = {k: float(v) for k, v in metrics.items()}
+        logger.info(
+            "step %d: %s", trainer.step,
+            " ".join(f"{k}={v:.4f}" for k, v in scalars.items()),
+        )
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, v, trainer.step)
+
+    def on_train_end(self, trainer):
+        if self._tb is not None:
+            self._tb.flush()
+
+
+class CheckpointCallback(Callback):
+    """Periodic async checkpoint with retention (reference
+    lightning/checkpoint_io.py + trainer/checkpoint.py save path)."""
+
+    def __init__(self, checkpoint_dir: str, every: int = 100,
+                 num_kept: Optional[int] = 3, async_save: bool = True):
+        self.checkpoint_dir = checkpoint_dir
+        self.every = every
+        self.num_kept = num_kept
+        self.async_save = async_save
+
+    def on_step_end(self, trainer, metrics):
+        if trainer.step % self.every != 0:
+            return
+        save_checkpoint(
+            self.checkpoint_dir,
+            tag=f"step_{trainer.step}",
+            items={"model": trainer.state.params, "optimizer": trainer.state.opt_state},
+            user_content={"step": trainer.step},
+            num_kept_ckpts=self.num_kept,
+            async_save=self.async_save,
+        )
+
+    def on_train_end(self, trainer):
+        from neuronx_distributed_tpu.trainer.checkpoint import finalize_checkpoints
+
+        finalize_checkpoints()
+
+
+@dataclasses.dataclass
+class Trainer:
+    """Plain training loop over the jitted SPMD step (the reference's
+    Lightning strategy+module+launcher collapse into this)."""
+
+    model: Any
+    optimizer_config: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    callbacks: List[Callback] = dataclasses.field(default_factory=list)
+    loss_fn: Optional[Callable] = None
+    timeline: Optional[Timeline] = None
+
+    step: int = 0
+    state: Any = None
+
+    def fit(
+        self,
+        data_iter: Iterable[dict],
+        rng_key: jax.Array,
+        max_steps: int,
+        sample_batch: Optional[dict] = None,
+    ) -> dict:
+        """Run ``max_steps`` over ``data_iter`` (an iterable of host batches
+        with at least ``input_ids``/``labels``). Returns the last metrics."""
+        from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+        if not mesh_lib.model_parallel_is_initialized():
+            # data-parallel-only default (reference neuronx_distributed_config
+            # initializes parallel state the same way when sizes are 1)
+            mesh_lib.initialize_model_parallel()
+        data_iter = iter(data_iter)
+        first = sample_batch if sample_batch is not None else next(data_iter)
+        optimizer = make_optimizer(self.optimizer_config)
+        self.state, p_sh, s_sh = create_train_state(
+            self.model, optimizer, rng_key, first["input_ids"],
+            zero1=self.optimizer_config.zero1,
+        )
+        train_step = build_train_step(
+            self.model, optimizer, p_sh, s_sh,
+            max_grad_norm=self.optimizer_config.max_grad_norm,
+            loss_fn=self.loss_fn,
+        )
+        meter = ThroughputMeter(batch_size=first["input_ids"].shape[0])
+        for cb in self.callbacks:
+            cb.on_train_start(self)
+        tl = self.timeline or Timeline(None)
+        metrics = {}
+        pending = first if sample_batch is None else None
+        while self.step < max_steps:
+            batch = pending if pending is not None else next(data_iter)
+            pending = None
+            with tl.event("train_step"):
+                self.state, metrics = train_step(self.state, shard_batch(batch))
+            self.step += 1
+            metrics = dict(metrics)
+            metrics["throughput_seq_s"] = meter.update()
+            for cb in self.callbacks:
+                cb.on_step_end(self, metrics)
+        for cb in self.callbacks:
+            cb.on_train_end(self)
+        tl.save()
+        return metrics

@@ -1,0 +1,110 @@
+"""Medusa multi-head speculative-decoding utilities (reference:
+``utils/medusa_utils.py`` — tree buffers, candidate generation, posterior
+acceptance; exercised by ``examples/inference/run_llama_medusa.py``).
+
+A Medusa tree is defined by ``choices``: each entry is a path of per-head
+top-k picks, e.g. ``(0,)`` = head-1's best, ``(0, 1)`` = head-2's 2nd-best
+following head-1's best. Buffers are static numpy arrays baked into the
+verify program (static shapes under jit):
+
+* ``attn_mask`` — tree attention: each node attends its ancestors + root;
+* ``tree_indices`` — gather map from the flattened [base, head1 top-k,
+  head2 top-k, ...] candidate pool into tree nodes;
+* ``position_ids`` — node depth (RoPE offsets relative to the current pos);
+* ``retrieve_indices`` — per-leaf root→leaf node chains for reading
+  candidate continuations back out.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def generate_medusa_buffers(
+    choices: Sequence[Tuple[int, ...]], top_k: int = 10
+) -> Dict[str, np.ndarray]:
+    """Build static tree buffers from the choice paths (reference
+    generate_medusa_buffers)."""
+    paths = sorted(set(tuple(c) for c in choices), key=lambda p: (len(p), p))
+    if not paths:
+        raise ValueError("medusa choices must be non-empty")
+    max_depth = max(len(p) for p in paths)
+    if any(pick >= top_k for p in paths for pick in p):
+        raise ValueError(f"choice index exceeds top_k={top_k}")
+    n = len(paths) + 1  # + root
+    node_of: Dict[Tuple[int, ...], int] = {(): 0}
+    for i, p in enumerate(paths):
+        if p[:-1] not in node_of:
+            raise ValueError(f"choice {p} missing its parent prefix {p[:-1]}")
+        node_of[p] = i + 1
+
+    attn_mask = np.zeros((n, n), dtype=bool)
+    position_ids = np.zeros((n,), dtype=np.int32)
+    tree_indices = np.zeros((n,), dtype=np.int32)
+    attn_mask[:, 0] = True  # everyone sees the root
+    for p, i in node_of.items():
+        attn_mask[i, i] = True
+        position_ids[i] = len(p)
+        if p:
+            # flattened pool: [base] + top_k picks per head, depth-major
+            tree_indices[i] = 1 + (len(p) - 1) * top_k + p[-1]
+            for d in range(1, len(p)):
+                attn_mask[i, node_of[p[:d]]] = True
+
+    leaves = [p for p in paths if not any(q[: len(p)] == p and q != p for q in paths)]
+    retrieve = np.full((len(leaves), max_depth + 1), -1, dtype=np.int32)
+    for li, leaf in enumerate(sorted(leaves)):
+        chain = [node_of[leaf[:d]] for d in range(len(leaf) + 1)]
+        retrieve[li, : len(chain)] = chain
+    return {
+        "attn_mask": attn_mask,
+        "tree_indices": tree_indices,
+        "position_ids": position_ids,
+        "retrieve_indices": retrieve,
+        "top_k": top_k,
+    }
+
+
+def generate_candidates(
+    base_token: jax.Array,
+    medusa_logits: jax.Array,
+    buffers: Dict[str, np.ndarray],
+) -> Tuple[jax.Array, jax.Array]:
+    """Flatten [base, per-head top-k] and gather tree + per-leaf candidate
+    sequences (reference generate_candidates).
+
+    ``base_token`` (B,) int32; ``medusa_logits`` (B, heads, V).
+    Returns ``tree_tokens (B, n_nodes)`` and ``candidates (B, leaves,
+    depth+1)`` (−1-padded positions carry the base token)."""
+    top_k = buffers["top_k"]
+    _, topk_ids = jax.lax.top_k(medusa_logits, top_k)  # (B, heads, k)
+    b = base_token.shape[0]
+    pool = jnp.concatenate(
+        [base_token[:, None], topk_ids.reshape(b, -1)], axis=1
+    )  # (B, 1 + heads·k)
+    tree_tokens = pool[:, jnp.asarray(buffers["tree_indices"])]
+    retrieve = jnp.asarray(buffers["retrieve_indices"])  # (L, D+1), -1 padded
+    cands = tree_tokens[:, jnp.clip(retrieve, 0)]
+    cands = jnp.where((retrieve >= 0)[None], cands, tree_tokens[:, :1, None])
+    return tree_tokens, cands
+
+
+def evaluate_posterior_greedy(
+    verify_logits: jax.Array,
+    candidates: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy acceptance (reference evaluate_posterior, threshold-free case):
+    ``verify_logits (B, leaves, depth+1, V)`` — target logits at each node of
+    each candidate chain; ``candidates (B, leaves, depth+1)``. Returns
+    ``(best_leaf (B,), accept_len (B,))``: the leaf with the longest accepted
+    prefix (candidate[d+1] == argmax(logits[d])) and that prefix's length."""
+    preds = jnp.argmax(verify_logits, -1)  # (B, L, D+1)
+    matches = candidates[..., 1:] == preds[..., :-1]  # (B, L, D)
+    cum = jnp.cumprod(matches.astype(jnp.int32), axis=-1)
+    lens = cum.sum(-1)  # (B, L)
+    best = jnp.argmax(lens, axis=-1)
+    return best.astype(jnp.int32), jnp.take_along_axis(lens, best[:, None], 1)[:, 0]

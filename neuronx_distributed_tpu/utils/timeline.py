@@ -1,0 +1,94 @@
+"""Chrome-trace event timeline (reference: ``utils/timeline.py:15`` base class
++ ``pipeline/timeline.py:10`` PP specialization).
+
+The reference marks host-side events per pipeline task and gathers them to
+rank 0 over a gloo group. Single-controller JAX has one host process per
+slice, so the gather disappears: events append locally and dump straight to
+the ``chrome://tracing`` / Perfetto JSON format. For device-side profiling use
+``jax.profiler`` (reference used the Neuron profiler); this timeline covers
+the host-side scheduling view the reference's tool provided.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    """Host-event timeline writing Chrome trace-event JSON."""
+
+    def __init__(self, trace_file_path: Optional[str] = None, rank: int = 0):
+        self.trace_file_path = trace_file_path
+        self.rank = rank
+        self._events: list = []
+        self._open: dict = {}
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter_ns()
+
+    @property
+    def enabled(self) -> bool:
+        return self.trace_file_path is not None
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def mark_event_start(self, name: str, category: str = "host") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._open[(name, category)] = self._now_us()
+
+    def mark_event_end(self, name: str, category: str = "host") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            start = self._open.pop((name, category), None)
+            if start is None:
+                return
+            self._events.append(
+                {
+                    "name": name,
+                    "cat": category,
+                    "ph": "X",
+                    "ts": start,
+                    "dur": self._now_us() - start,
+                    "pid": self.rank,
+                    "tid": threading.get_ident() % 10000,
+                }
+            )
+
+    def event(self, name: str, category: str = "host"):
+        """Context manager form."""
+        timeline = self
+
+        class _Ctx:
+            def __enter__(self):
+                timeline.mark_event_start(name, category)
+                return self
+
+            def __exit__(self, *exc):
+                timeline.mark_event_end(name, category)
+                return False
+
+        return _Ctx()
+
+    def instant(self, name: str, category: str = "host") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                {"name": name, "cat": category, "ph": "i", "ts": self._now_us(),
+                 "pid": self.rank, "s": "g"}
+            )
+
+    def save(self) -> None:
+        """Dump accumulated events (reference per-step JSON dump)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            payload = {"traceEvents": list(self._events)}
+        with open(self.trace_file_path, "w") as f:
+            json.dump(payload, f)

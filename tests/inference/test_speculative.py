@@ -1,0 +1,51 @@
+"""Speculative decoding tests: the invariant is output == the target model's
+own greedy decode, regardless of the draft (reference analogue:
+examples/inference/run_llama_speculative.py accuracy check)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.inference import GenerationConfig, generate
+from neuronx_distributed_tpu.inference.speculative import speculative_generate
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+
+NEW = 10
+
+
+def _setup():
+    cfg = tiny_llama()
+    target = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, cfg.vocab_size)
+    t_params = target.init(jax.random.PRNGKey(1), ids)
+    draft_cfg = tiny_llama(num_layers=2)
+    draft = LlamaForCausalLM(draft_cfg, attention_impl="xla")
+    d_params = draft.init(jax.random.PRNGKey(7), ids)
+    return target, t_params, draft, d_params, ids
+
+
+def test_speculative_matches_target_greedy():
+    target, t_params, draft, d_params, ids = _setup()
+    ref = generate(
+        target, t_params, ids, jax.random.PRNGKey(2),
+        GenerationConfig(max_new_tokens=NEW, temperature=0.0),
+    )
+    toks, mean_acc = speculative_generate(
+        target, t_params, draft, d_params, ids, max_new_tokens=NEW, gamma=3
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert 0.0 <= mean_acc <= 3.0
+
+
+def test_speculative_with_perfect_draft_accepts_everything():
+    """Draft == target → every round accepts all gamma tokens."""
+    target, t_params, _, _, ids = _setup()
+    ref = generate(
+        target, t_params, ids, jax.random.PRNGKey(2),
+        GenerationConfig(max_new_tokens=NEW, temperature=0.0),
+    )
+    toks, mean_acc = speculative_generate(
+        target, t_params, target, t_params, ids, max_new_tokens=NEW, gamma=4
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert mean_acc == 4.0

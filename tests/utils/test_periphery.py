@@ -1,0 +1,121 @@
+"""Timeline / pad / medusa utility tests (reference analogues:
+utils/timeline.py, parallel_layers/pad.py, utils/medusa_utils.py units)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.pad import (
+    pad_attention_params,
+    pad_heads_config,
+    padded_head_count,
+)
+from neuronx_distributed_tpu.utils.medusa import (
+    evaluate_posterior_greedy,
+    generate_candidates,
+    generate_medusa_buffers,
+)
+from neuronx_distributed_tpu.utils.timeline import Timeline
+
+
+def test_timeline_chrome_trace(tmp_path):
+    path = str(tmp_path / "trace.json")
+    tl = Timeline(path)
+    with tl.event("step"):
+        tl.instant("marker")
+        with tl.event("inner", category="comm"):
+            pass
+    tl.save()
+    data = json.load(open(path))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert set(names) == {"step", "marker", "inner"}
+    complete = [e for e in data["traceEvents"] if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 for e in complete)
+
+
+def test_timeline_disabled_is_noop():
+    tl = Timeline(None)
+    with tl.event("x"):
+        pass
+    tl.save()  # no file, no error
+    assert not tl.enabled
+
+
+def test_pad_heads_config_and_params():
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+
+    cfg = tiny_llama(num_heads=6, num_kv_heads=3)  # not divisible by tp=4
+    assert padded_head_count(6, 4) == 8
+    padded_cfg = pad_heads_config(cfg, 4)
+    assert padded_cfg.num_heads == 8 and padded_cfg.num_kv_heads == 4
+
+    d = cfg.head_dim_
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    ref = model.apply(params, ids)
+
+    from flax.core import meta
+
+    padded_params = pad_attention_params(
+        meta.unbox(params), head_dim=d, old_heads=cfg.num_heads,
+        new_heads=padded_cfg.num_heads,
+    )
+    padded_params = pad_attention_params(
+        padded_params, head_dim=d, old_heads=cfg.num_kv_heads,
+        new_heads=padded_cfg.num_kv_heads,
+    )
+    import dataclasses
+
+    pcfg = dataclasses.replace(padded_cfg, head_dim=d)
+    padded_model = LlamaForCausalLM(pcfg, attention_impl="xla")
+    out = padded_model.apply(padded_params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=1e-4
+    )
+
+
+def test_medusa_buffers_structure():
+    choices = [(0,), (1,), (0, 0), (0, 1), (1, 0), (0, 0, 0)]
+    buf = generate_medusa_buffers(choices, top_k=4)
+    n = len(choices) + 1
+    assert buf["attn_mask"].shape == (n, n)
+    # ancestor property: (0,0,0) attends root, (0,), (0,0), itself
+    node_depths = buf["position_ids"]
+    assert node_depths[0] == 0 and node_depths.max() == 3
+    deepest = int(np.argmax(node_depths))
+    assert buf["attn_mask"][deepest].sum() == 4
+    # tree indices: (1,) at depth 1 pick 1 → pool index 1 + 0*4 + 1 = 2
+    # leaves: (0,1),(1,0),(0,0,0) → 3 rows
+    assert buf["retrieve_indices"].shape[0] == 3
+
+
+def test_medusa_candidates_and_posterior():
+    choices = [(0,), (1,), (0, 0)]
+    buf = generate_medusa_buffers(choices, top_k=2)
+    base = jnp.array([7], jnp.int32)
+    logits = jnp.zeros((1, 2, 16))
+    # head-1 favors tokens 3 then 5; head-2 favors 11 then 2
+    logits = logits.at[0, 0, 3].set(9.0).at[0, 0, 5].set(8.0)
+    logits = logits.at[0, 1, 11].set(9.0).at[0, 1, 2].set(8.0)
+    tree_tokens, cands = generate_candidates(base, logits, buf)
+    assert tree_tokens.shape == (1, 4)  # root + 3 nodes
+    np.testing.assert_array_equal(np.asarray(tree_tokens[0]), [7, 3, 5, 11])
+    # leaves sorted: (0,0) → [7,3,11]; (1,) → [7,5,pad→7... base-padded]
+    assert cands.shape == (1, 2, 3)
+    # posterior: target agrees with candidate chain [7,3,11] fully
+    v = jnp.zeros((1, 2, 3, 16))
+    v = v.at[0, 0, 0, 3].set(5.0)   # after 7 → 3
+    v = v.at[0, 0, 1, 11].set(5.0)  # after 3 → 11
+    v = v.at[0, 0, 2, 1].set(5.0)
+    v = v.at[0, 1, 0, 9].set(5.0)   # disagree with other leaf immediately
+    best, acc = evaluate_posterior_greedy(v, cands)
+    assert int(best[0]) == 0
+    assert int(acc[0]) == 2
+
+
+def test_mesh_unused():
+    assert not mesh_lib.model_parallel_is_initialized()
