@@ -6,17 +6,30 @@ The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 computed against a hardware-grounded target: 40% MFU at the chip's peak bf16
 FLOPs (v5e ≈ 197 TFLOP/s) using the standard 6·N·tokens/step transformer FLOP
 count — i.e. vs_baseline = achieved_MFU / 0.40. >1.0 beats the target.
+
+Round-2 hardening (VERDICT.md "What's weak" #1): round 1 died with rc=1 in
+``jax.devices()`` — a TPU backend-init error with no fallback, wasting the
+round's only chip access.  The bench now runs as a parent harness that spawns
+the real measurement in a child process with a bounded timeout and retries
+(backend-init hangs/UNAVAILABLE errors are transient on the tunneled axon
+backend); if every attempt fails it emits a parseable JSON line with an
+``error`` field instead of a traceback.  The child forces
+``attention_impl="flash"`` on TPU so the Pallas kernel demonstrably compiles
+under Mosaic (round 1 never executed it on hardware).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+# Equal per-attempt budgets: a timed-out compile writes nothing to the cache,
+# so the retry needs as much time as the first try.
+ATTEMPT_TIMEOUTS = (600, 600)
 
 
-def peak_flops_per_chip() -> float:
-    dev = jax.devices()[0]
+def peak_flops_per_chip(dev) -> float:
     kind = getattr(dev, "device_kind", "").lower()
     if "v5 lite" in kind or "v5e" in kind:
         return 197e12
@@ -29,7 +42,67 @@ def peak_flops_per_chip() -> float:
     return 197e12  # conservative default
 
 
-def main():
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def child() -> None:
+    """The actual measurement. Prints the one JSON line on success; on
+    failure prints an error JSON (rc stays 0 — the parent decides whether to
+    retry based on the ``retryable`` flag)."""
+    import jax
+
+    # The axon sitecustomize force-selects the TPU platform regardless of the
+    # JAX_PLATFORMS env var; a post-import config update is the only override
+    # that sticks (same trick as tests/conftest.py). Used for CPU smoke tests.
+    forced = os.environ.get("BENCH_FORCE_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
+    # Persistent compilation cache: a retried attempt (or a rerun in the same
+    # round) skips the 20-40 s first compile.
+    try:
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    try:
+        devs = jax.devices()
+    except Exception as e:  # backend init failed — retryable
+        _emit(
+            {
+                "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
+                "value": 0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": f"backend init failed: {type(e).__name__}: {str(e)[:400]}",
+                "retryable": True,
+            }
+        )
+        return
+
+    try:
+        _measure(devs)
+    except Exception as e:
+        _emit(
+            {
+                "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
+                "value": 0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+                "retryable": False,
+                "extras": {"platform": devs[0].platform},
+            }
+        )
+
+
+def _measure(devs) -> None:
+    import jax
+    import jax.numpy as jnp
+
     from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from neuronx_distributed_tpu.parallel import mesh as mesh_lib
     from neuronx_distributed_tpu.trainer import (
@@ -40,7 +113,7 @@ def main():
         shard_batch,
     )
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    on_tpu = devs[0].platform == "tpu"
     mesh_lib.destroy_model_parallel()
     mesh_lib.initialize_model_parallel(tensor_model_parallel_size=1)
 
@@ -61,7 +134,10 @@ def main():
     )
     batch, seq = (1, 2048) if on_tpu else (1, 128)
 
-    model = LlamaForCausalLM(cfg)
+    # Force the Pallas flash kernel on TPU (compiled by Mosaic — no interpret
+    # fallback); XLA einsum path elsewhere.
+    attention_impl = "flash" if on_tpu else "xla"
+    model = LlamaForCausalLM(cfg, attention_impl=attention_impl)
     optimizer = make_optimizer(OptimizerConfig(zero1=False))
     key = jax.random.PRNGKey(0)
     ids = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
@@ -70,7 +146,6 @@ def main():
     step = build_train_step(model, optimizer, p_sh, s_sh)
     data = shard_batch({"input_ids": ids, "labels": jnp.roll(ids, -1, axis=1)})
 
-    # params for FLOP count
     n_params = sum(p.size for p in jax.tree.leaves(state.params))
 
     # warmup (compile). NOTE: on the axon TPU relay block_until_ready does not
@@ -100,26 +175,101 @@ def main():
     tokens = batch * seq
     tokens_per_sec = tokens / dt
     flops_per_step = 6.0 * n_params * tokens  # fwd+bwd transformer estimate
-    mfu = (flops_per_step / dt) / peak_flops_per_chip()
+    mfu = (flops_per_step / dt) / peak_flops_per_chip(devs[0])
     target_mfu = 0.40
-    print(
-        json.dumps(
+    _emit(
+        {
+            "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
+            "value": round(tokens_per_sec, 2),
+            "unit": "tokens/s",
+            "vs_baseline": round(mfu / target_mfu, 4),
+            "extras": {
+                "mfu": round(mfu, 4),
+                "n_params": int(n_params),
+                "step_time_s": round(dt, 4),
+                "layers": cfg.num_layers,
+                "platform": devs[0].platform,
+                "attention_impl": attention_impl,
+            },
+        }
+    )
+
+
+def _parse_result(stdout: str):
+    """Last stdout line that parses as a JSON object with a 'metric' key."""
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return None
+
+
+def main() -> None:
+    errors = []
+    # If the driver kills the harness mid-retry (its outer budget may be
+    # shorter than ours), still flush a parseable error JSON on the way out.
+    import signal
+
+    def _on_term(signum, frame):
+        _emit(
             {
                 "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 2),
+                "value": 0,
                 "unit": "tokens/s",
-                "vs_baseline": round(mfu / target_mfu, 4),
-                "extras": {
-                    "mfu": round(mfu, 4),
-                    "n_params": int(n_params),
-                    "step_time_s": round(dt, 4),
-                    "layers": cfg.num_layers,
-                    "platform": jax.devices()[0].platform,
-                },
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors + [f"killed by signal {signum} mid-attempt"]),
             }
         )
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    for attempt, timeout_s in enumerate(ATTEMPT_TIMEOUTS, 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt}: timed out after {timeout_s}s (backend hang)")
+            continue
+        result = _parse_result(proc.stdout)
+        if result is None:
+            tail = (proc.stderr or proc.stdout or "").strip()[-400:]
+            errors.append(f"attempt {attempt}: rc={proc.returncode}, no JSON: {tail}")
+            continue
+        if "error" in result and result.get("retryable") and attempt < len(ATTEMPT_TIMEOUTS):
+            errors.append(f"attempt {attempt}: {result['error']}")
+            continue
+        if "error" in result:
+            errors.append(f"attempt {attempt}: {result['error']}")
+            result["error"] = "; ".join(errors)
+            result.pop("retryable", None)
+        print(json.dumps(result), flush=True)
+        return
+    _emit(
+        {
+            "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
+            "value": 0,
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "error": "; ".join(errors) or "no attempt produced output",
+        }
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()
