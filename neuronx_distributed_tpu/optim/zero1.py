@@ -21,6 +21,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
+from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -28,6 +29,8 @@ from neuronx_distributed_tpu.parallel import mesh as mesh_lib
 from neuronx_distributed_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
+
+_is_spec = lambda s: isinstance(s, P)  # noqa: E731
 
 
 def zero1_partition_spec(
@@ -86,17 +89,6 @@ def zero1_shardings_for_opt_state(
     ``enabled=False`` moments get the plain param spec (non-ZeRO baseline).
     """
     mesh = mesh or mesh_lib.get_mesh()
-    if enabled and mesh.shape.get(mesh_lib.PP_AXIS, 1) > 1:
-        # Known XLA SPMD-partitioner CHECK crash (spmd_partitioner_util.cc:495,
-        # jaxlib 0.9) when optimizer moments carry pp+dp mixed shardings fed by
-        # grads from a partial-manual shard_map. Fall back to param-sharded
-        # optimizer state under pipeline parallelism until the explicit
-        # shard_map ZeRO-1 path lands.
-        logger.warning(
-            "zero1 optimizer-state sharding disabled under pipeline parallelism "
-            "(XLA partitioner limitation); optimizer state uses param shardings"
-        )
-        enabled = False
     param_leaves, _ = _flatten_with_path(params)
     spec_leaves, _ = _flatten_with_path(param_specs)
     by_suffix = {}
@@ -120,3 +112,120 @@ def zero1_shardings_for_opt_state(
 
     flat, treedef = _flatten_with_path(opt_state_shapes)
     return jax.tree_util.tree_unflatten(treedef, [resolve(p, l) for p, l in flat])
+
+
+# --- explicit ZeRO-1 update (the reference's shard-step-allgather loop) -------
+
+
+def opt_state_is_zero1_sharded(opt_state_shardings) -> bool:
+    """True when any optimizer-state leaf carries a zero-1-extended spec.
+
+    Param specs never use the edp or cp axes (parameters are replicated over
+    data/context parallelism — there is no FSDP param sharding here), so their
+    presence in an optimizer-state spec is exactly the zero-1 extension made
+    by :func:`zero1_partition_spec`."""
+    for sh in jax.tree.leaves(opt_state_shardings):
+        for entry in sh.spec:
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            if mesh_lib.EDP_AXIS in axes or mesh_lib.CP_AXIS in axes:
+                return True
+    return False
+
+
+def _zero1_added_dim(param_spec: P, z1_spec: P):
+    """(dim, axes) added by the zero-1 extension, or None when specs agree."""
+    pe = list(param_spec)
+    ze = list(z1_spec)
+    if pe == ze:
+        return None
+    pe = pe + [None] * (len(ze) - len(pe))
+    for i, (a, b) in enumerate(zip(pe, ze)):
+        if a != b:
+            return i, tuple(b) if isinstance(b, (tuple, list)) else (b,)
+    return None
+
+
+def build_explicit_zero1_update(optimizer, params_shardings, opt_state_shardings):
+    """Return ``update_fn(grads, opt_state, params) -> (new_params, new_opt_state)``
+    running the reference's explicit ZeRO-1 dataflow
+    (zero_redundancy_optimizer.py:29 — reduce-scatter grads over the sharding
+    groups, step a local shard, all-gather params) inside one fully-manual
+    ``shard_map``.
+
+    This exists because the GSPMD formulation (zero-1 specs as plain
+    out-shardings) hits an XLA SPMD-partitioner CHECK crash
+    (spmd_partitioner_util.cc:495, jaxlib 0.9) when the grads feeding the
+    update come out of a partial-manual shard_map (the pipeline engine).
+    Inside a fully-manual region the partitioner never sees the mixed
+    pp+dp shardings: grads arrive already DP-all-reduced (autodiff inserted
+    the psum), each device slices its zero-1 shard (XLA's
+    ReduceScatterCreator pass folds all-reduce + partition-id slice into a
+    reduce-scatter), steps optax on the shard, and all-gathers the updated
+    params.
+
+    CONSTRAINT: the optimizer's update must be elementwise per-tensor (adam/
+    adamw/sgd/lion...). Optimizers that reduce across a whole tensor or the
+    whole tree (adafactor's factored moments, a chained clip_by_global_norm)
+    would compute those reductions over the local zero-1 shard and silently
+    change the math — use the GSPMD path (pp=1) or zero1=False for those."""
+    logger.warning(
+        "explicit ZeRO-1 update engaged (pp>1): optimizer update must be "
+        "elementwise per-tensor (adam/adamw/sgd are; adafactor and "
+        "global-norm-chained transforms are NOT)"
+    )
+    mesh = mesh_lib.get_mesh()
+    param_specs = jax.tree.map(lambda s: s.spec, params_shardings)
+    opt_specs = jax.tree.map(lambda s: s.spec, opt_state_shardings)
+
+    def update_fn(grads, opt_state, params):
+        # Per-leaf zero-1 spec, recomputed with the same policy that built
+        # opt_state_shardings — shapes come from the (global) tracers, so this
+        # must run OUTSIDE the shard_map.
+        z1_specs = jax.tree.map(
+            lambda spec, g: zero1_partition_spec(spec, g.shape, mesh),
+            param_specs,
+            grads,
+            is_leaf=_is_spec,
+        )
+
+        def _slice(spec, z1, leaf):
+            info = _zero1_added_dim(spec, z1)
+            if info is None:
+                return leaf
+            dim, axes = info
+            n = int(np.prod([mesh.shape[a] for a in axes]))
+            idx = jax.numpy.zeros((), jax.numpy.int32)
+            for a in axes:  # row-major over axes, matching all_gather order
+                idx = idx * mesh.shape[a] + lax.axis_index(a)
+            size = leaf.shape[dim] // n
+            return lax.dynamic_slice_in_dim(leaf, idx * size, size, dim)
+
+        def _gather(spec, z1, leaf):
+            info = _zero1_added_dim(spec, z1)
+            if info is None:
+                return leaf
+            dim, axes = info
+            return lax.all_gather(leaf, axes, axis=dim, tiled=True)
+
+        def inner(g, o, p):
+            g_shard = jax.tree.map(_slice, param_specs, z1_specs, g, is_leaf=_is_spec)
+            p_shard = jax.tree.map(_slice, param_specs, z1_specs, p, is_leaf=_is_spec)
+            import optax
+
+            updates, new_o = optimizer.update(g_shard, o, p_shard)
+            new_p_shard = optax.apply_updates(p_shard, updates)
+            new_p = jax.tree.map(
+                _gather, param_specs, z1_specs, new_p_shard, is_leaf=_is_spec
+            )
+            return new_p, new_o
+
+        fn = jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(param_specs, opt_specs, param_specs),
+            out_specs=(param_specs, opt_specs),
+            check_vma=False,
+        )
+        return fn(grads, opt_state, params)
+
+    return update_fn

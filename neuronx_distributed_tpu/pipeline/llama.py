@@ -22,13 +22,20 @@ from neuronx_distributed_tpu.parallel.layers import (
     ParallelEmbedding,
 )
 from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
-from neuronx_distributed_tpu.pipeline.model import PipelineEngine
+from neuronx_distributed_tpu.pipeline.model import OneFOneBEngine, PipelineEngine
 
 
 def llama_pipeline_engine(
-    config: LlamaConfig, num_microbatches: int, attention_impl: str = "auto"
+    config: LlamaConfig,
+    num_microbatches: int,
+    attention_impl: str = "auto",
+    schedule: str = "gpipe",
 ) -> PipelineEngine:
-    """Build a PipelineEngine for a scan-form Llama (config.scan_layers=True)."""
+    """Build a pipeline engine for a scan-form Llama (config.scan_layers=True).
+
+    ``schedule``: "gpipe" (scan engine, backward by autodiff — time-optimal,
+    activation memory O(M)) or "1f1b" (OneFOneBEngine — explicit synchronous
+    1F1B, activation memory O(S); see pipeline/model.py)."""
     embed = ParallelEmbedding(
         num_embeddings=config.vocab_size,
         features=config.hidden_size,
@@ -68,7 +75,10 @@ def llama_pipeline_engine(
             mask = jnp.ones_like(losses)
         return (losses * mask).sum(), mask.sum().astype(jnp.float32)
 
-    return PipelineEngine(
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    engine_cls = PipelineEngine if schedule == "gpipe" else OneFOneBEngine
+    return engine_cls(
         embed_apply=embed_apply,
         layer_apply=layer_apply,
         head_apply=head_apply,

@@ -58,6 +58,18 @@ class PipelineEngine:
     num_layers: int
     num_microbatches: int
     remat_layers: bool = True
+    # Per-microbatch loss weight (must equal head_apply's weight_sum for the
+    # same microbatch — used by OneFOneBEngine to seed head cotangents before
+    # any head has run). Default: loss_mask.sum(), else the label count.
+    weight_fn: Optional[Callable] = None
+
+    def _microbatch_weight(self, mb_batch):
+        if self.weight_fn is not None:
+            return self.weight_fn(mb_batch)
+        mask = mb_batch.get("loss_mask")
+        if mask is not None:
+            return mask.sum().astype(jnp.float32)
+        return jnp.asarray(float(mb_batch["labels"].size), jnp.float32)
 
     def _stages(self) -> int:
         return mesh_lib.get_pipeline_model_parallel_size()
@@ -160,6 +172,229 @@ class PipelineEngine:
         final = ys[S - 1, S - 1 :]  # (M, mb, ...)
         lsum, wsum = self.head_apply(params["head"], final, batch)
         return lsum / jnp.maximum(wsum, 1.0)
+
+
+@dataclasses.dataclass
+class OneFOneBEngine(PipelineEngine):
+    """Explicitly-scheduled synchronous 1F1B runtime (VERDICT.md missing #2;
+    reference ``pipeline/model.py:1737`` ``_exec_schedule`` over
+    ``Train1F1BSchedule``).
+
+    Unlike :class:`PipelineEngine` (scan-GPipe: one forward scan, backward by
+    ``jax.grad`` reversing it, activation memory O(M) stage-inputs under
+    remat), this engine *is* the scheduler: grads are computed inside the
+    cycle loop, never by differentiating it. Each cycle rank r
+
+      * forwards microbatch  ``c - r``            (recv → stage → send via
+        ``ppermute``, storing only the stage INPUT in a depth-``2S-1``
+        circular buffer),
+      * backwards microbatch ``c - 2(S-1) + r``   (pop the saved input,
+        ``jax.vjp`` recomputes the stage forward and pulls the cotangent
+        back, accumulate param grads, send the input-cotangent upstream),
+
+    which is ``SyncTrain1F1BSchedule`` — 1F1B's dependency structure in SPMD
+    lockstep (see its docstring for the warmup/bubble accounting). Activation
+    memory is O(S) stage-inputs, independent of M: the scan-GPipe engine
+    stores M+S-1 stage inputs, this one ``min(2S-1, M)``. Compute per
+    microbatch is identical (both pay the remat 4/3: fwd + vjp-recompute-fwd
+    + bwd).
+
+    The loss head runs inside the loop on every rank (only the last rank's
+    result is kept — rank-divergent module calls cannot be expressed in one
+    SPMD program without doubling the traced graph under ``lax.cond``); the
+    embedding fwd/bwd runs outside in plain GSPMD, connected through an
+    explicit (M, ...) cotangent buffer.
+    """
+
+    def _cycle_tables(self):
+        """Per-rank (fwd_mb, bwd_mb) per cycle, derived from the task stream
+        of SyncTrain1F1BSchedule — the scheduler is the source of truth; the
+        closed forms inside the scan body are asserted against it here."""
+        from neuronx_distributed_tpu.pipeline.scheduler import (
+            BackwardTask,
+            ForwardTask,
+            SyncTrain1F1BSchedule,
+            validate_schedule,
+        )
+
+        S, M = self._stages(), self.num_microbatches
+        cycles = M + 2 * (S - 1)
+        for r in range(S):
+            sched = SyncTrain1F1BSchedule(M, S, r)
+            validate_schedule(sched)
+            fwd = [t.mb for t in sched.steps() if isinstance(t, ForwardTask)]
+            bwd = [t.mb for t in sched.steps() if isinstance(t, BackwardTask)]
+            want_fwd = [c - r for c in range(cycles) if 0 <= c - r < M]
+            want_bwd = [
+                c - 2 * (S - 1) + r
+                for c in range(cycles)
+                if 0 <= c - 2 * (S - 1) + r < M
+            ]
+            if fwd != want_fwd or bwd != want_bwd:
+                raise AssertionError(
+                    f"1F1B cycle tables diverge from SyncTrain1F1BSchedule at rank {r}"
+                )
+        return cycles
+
+    def value_and_grad(self, params, batch):
+        """(loss, grads) with grads computed by the explicit 1F1B schedule.
+        Same params/batch layout as :meth:`PipelineEngine.loss_fn`."""
+        mesh = mesh_lib.get_mesh()
+        S = self._stages()
+        M = self.num_microbatches
+        cycles = self._cycle_tables()
+        D = min(2 * S - 1, M)  # circular-buffer depth: peak in-flight inputs
+
+        # total loss weight, known before the loop so every head vjp can be
+        # seeded with d(mean_loss)/d(loss_sum_mb) = 1/w_total
+        w_total = jax.vmap(self._microbatch_weight)(batch).sum()
+        inv_w = 1.0 / jnp.maximum(w_total, 1.0)
+
+        # embedding fwd outside the pp region (plain GSPMD), vjp'd at the end
+        embedded, embed_vjp = jax.vjp(
+            lambda ep: jax.vmap(lambda mb: self.embed_apply(ep, mb))(batch),
+            params["embed"],
+        )
+
+        def pipelined(layers_local, head_params, embedded, batch):
+            rank = lax.axis_index(mesh_lib.PP_AXIS)
+            layers_local = jax.tree.map(lambda a: a[0], layers_local)
+            is_last = rank == S - 1
+            is_first = rank == 0
+
+            x0 = jnp.zeros_like(jax.tree.map(lambda a: a[0], embedded))
+
+            def head_loss(hp, y, mb_batch):
+                lsum, _ = self.head_apply(hp, y, mb_batch)
+                return lsum * inv_w
+
+            # remat each layer so the backward slot's vjp stores only per-layer
+            # inputs (the scan carries), not every internal residual — same
+            # policy as the parent engine's loss_fn
+            layer_apply = (
+                jax.checkpoint(self.layer_apply)
+                if self.remat_layers
+                else self.layer_apply
+            )
+
+            def stage_fn(lp, x):
+                def body(h, one_layer):
+                    return layer_apply(one_layer, h), None
+
+                out, _ = lax.scan(body, x, lp)
+                return out
+
+            def cycle(carry, c):
+                y_in, cot_in, x_buf, g_layers, g_head, d_emb, loss_sum = carry
+
+                # ---- forward slot: mb = c - rank ----
+                mf = c - rank
+                fwd_valid = (mf >= 0) & (mf < M)
+                mf_c = jnp.clip(mf, 0, M - 1)
+                mb_batch = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, mf_c, 0, keepdims=False),
+                    batch,
+                )
+                x_in = jnp.where(
+                    is_first,
+                    lax.dynamic_index_in_dim(embedded, mf_c, 0, keepdims=False),
+                    y_in,
+                )
+                y = stage_fn(layers_local, x_in)
+                loss_mb, head_vjp = jax.vjp(
+                    lambda hp, yy: head_loss(hp, yy, mb_batch), head_params, y
+                )
+                d_head, cot_seed = head_vjp(jnp.ones((), loss_mb.dtype))
+
+                slot = jnp.remainder(mf_c, D)
+                keep = jnp.where(
+                    fwd_valid,
+                    x_in,
+                    lax.dynamic_index_in_dim(x_buf, slot, 0, keepdims=False),
+                )
+                x_buf = lax.dynamic_update_index_in_dim(x_buf, keep, slot, 0)
+
+                # ---- backward slot: mb = c - 2(S-1) + rank ----
+                mb_i = c - 2 * (S - 1) + rank
+                bwd_valid = (mb_i >= 0) & (mb_i < M)
+                mb_c = jnp.clip(mb_i, 0, M - 1)
+                x_saved = lax.dynamic_index_in_dim(
+                    x_buf, jnp.remainder(mb_c, D), 0, keepdims=False
+                )
+                _, stage_vjp = jax.vjp(stage_fn, layers_local, x_saved)
+                cot_y = jnp.where(is_last, cot_seed, cot_in)
+                d_layers, dx = stage_vjp(cot_y)
+
+                mask_b = bwd_valid.astype(jnp.float32)
+                g_layers = jax.tree.map(
+                    lambda acc, g: acc + g * mask_b.astype(g.dtype), g_layers, d_layers
+                )
+                mask_h = (fwd_valid & is_last).astype(jnp.float32)
+                g_head = jax.tree.map(
+                    lambda acc, g: acc + g * mask_h.astype(g.dtype), g_head, d_head
+                )
+                loss_sum = loss_sum + loss_mb * mask_h.astype(loss_mb.dtype)
+
+                d_emb_slot = jnp.where(
+                    bwd_valid & is_first,
+                    dx,
+                    lax.dynamic_index_in_dim(d_emb, mb_c, 0, keepdims=False),
+                )
+                d_emb = lax.dynamic_update_index_in_dim(d_emb, d_emb_slot, mb_c, 0)
+
+                if S > 1:
+                    y_next = lax.ppermute(
+                        y, mesh_lib.PP_AXIS, [(i, i + 1) for i in range(S - 1)]
+                    )
+                    cot_next = lax.ppermute(
+                        dx, mesh_lib.PP_AXIS, [(i, i - 1) for i in range(1, S)]
+                    )
+                else:
+                    y_next, cot_next = y, dx
+                return (y_next, cot_next, x_buf, g_layers, g_head, d_emb, loss_sum), None
+
+            zeros_like_tree = lambda t: jax.tree.map(jnp.zeros_like, t)  # noqa: E731
+            init = (
+                x0,
+                jnp.zeros_like(x0),
+                jnp.zeros((D,) + x0.shape, x0.dtype),
+                zeros_like_tree(layers_local),
+                zeros_like_tree(head_params),
+                jnp.zeros_like(embedded),
+                jnp.zeros((), jnp.float32),
+            )
+            (_, _, _, g_layers, g_head, d_emb, loss_sum), _ = lax.scan(
+                cycle, init, jnp.arange(cycles)
+            )
+            # restore the stage dim on layer grads; reduce the rank-local
+            # contributions of shared (non-pp) outputs over pp
+            g_layers = jax.tree.map(lambda a: a[None], g_layers)
+            g_head = jax.tree.map(
+                lambda a: lax.psum(a, mesh_lib.PP_AXIS), g_head
+            )
+            d_emb = lax.psum(d_emb, mesh_lib.PP_AXIS)
+            loss_sum = lax.psum(loss_sum, mesh_lib.PP_AXIS)
+            return g_layers, g_head, d_emb, loss_sum
+
+        fn = jax.shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=(P(mesh_lib.PP_AXIS), P(), P(), P()),
+            out_specs=(P(mesh_lib.PP_AXIS), P(), P(), P()),
+            check_vma=False,
+            axis_names={mesh_lib.PP_AXIS},
+        )
+        g_layers, g_head, d_emb, loss = fn(
+            params["layers"], params["head"], embedded, batch
+        )
+        (g_embed,) = embed_vjp(d_emb)
+        grads = {"embed": g_embed, "layers": g_layers, "head": g_head}
+        return loss, grads
+
+    def loss_fn(self, params, batch):
+        """Forward-only loss via the parent scan engine (identical math); the
+        1F1B machinery matters only for the backward."""
+        return PipelineEngine.loss_fn(self, params, batch)
 
 
 def shard_microbatched_batch(batch):

@@ -141,6 +141,51 @@ class Train1F1BSchedule(PipelineSchedule):
         yield ReduceGradsTask(mb=-1)
 
 
+class SyncTrain1F1BSchedule(PipelineSchedule):
+    """1F1B realized in synchronous SPMD lockstep (the OneFOneBEngine runtime,
+    pipeline/model.py).
+
+    A single-controller XLA program cannot phase-shift ranks by half a tick
+    (every device executes the same per-cycle program), so each cycle carries
+    one forward slot AND one backward slot; rank r forwards microbatch
+    ``c - r`` and backwards microbatch ``c - 2(S-1) + r`` in cycle ``c``.
+    Relative to the async reference 1F1B (``Train1F1BSchedule``,
+    reference scheduler.py:157) the warmup doubles — ``min(M, 2(S-1-r))``
+    instead of ``min(M, S-1-r)`` — buying the same O(S) activation bound
+    (peak in-flight microbatches = warmup+1) at a bubble of 2(S-1) cycles
+    instead of (S-1). The task stream still satisfies every
+    ``validate_schedule`` invariant; the runtime derives its cycle tables
+    from exactly this stream (tested equal in tests/pipeline/test_scheduler.py).
+    """
+
+    @property
+    def num_warmup(self) -> int:
+        return min(self.num_microbatches, 2 * (self.num_stages - self.stage_rank - 1))
+
+    @property
+    def num_cycles(self) -> int:
+        return self.num_microbatches + 2 * (self.num_stages - 1)
+
+    def tasks(self) -> Iterator[Task]:
+        M, S, r = self.num_microbatches, self.num_stages, self.stage_rank
+        for c in range(self.num_cycles):
+            mf = c - r
+            if 0 <= mf < M:
+                if not self.is_first:
+                    yield RecvForwardTask(mf)
+                yield ForwardTask(mf)
+                if not self.is_last:
+                    yield SendForwardTask(mf)
+            mb = c - 2 * (S - 1) + r
+            if 0 <= mb < M:
+                if not self.is_last:
+                    yield RecvBackwardTask(mb)
+                yield BackwardTask(mb)
+                if not self.is_first:
+                    yield SendBackwardTask(mb)
+        yield ReduceGradsTask(mb=-1)
+
+
 class TrainInterleavedSchedule(PipelineSchedule):
     """Megatron interleaved / virtual-pipeline schedule (reference :256).
 

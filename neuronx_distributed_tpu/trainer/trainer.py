@@ -187,24 +187,49 @@ def build_train_step(
     opt_state_shardings,
     max_grad_norm: float = 1.0,
     loss_fn: Optional[Callable] = None,
+    value_and_grad_fn: Optional[Callable] = None,
 ):
     """One jitted SPMD train step: fwd → bwd → clip → update
     (reference: the whole NxDOptimizer.step pipeline, trainer/optimizer.py:122).
     State is donated; shardings are pinned so ZeRO-1 layout persists across
     steps instead of being renegotiated by the partitioner.
     """
-    loss_fn = loss_fn or partial(default_loss_fn, model)
+    from neuronx_distributed_tpu.optim.zero1 import (
+        build_explicit_zero1_update,
+        opt_state_is_zero1_sharded,
+    )
+
+    if value_and_grad_fn is None:
+        loss_fn = loss_fn or partial(default_loss_fn, model)
+        value_and_grad_fn = jax.value_and_grad(loss_fn)
     mesh = mesh_lib.get_mesh()
     repl = NamedSharding(mesh, P())
     state_shardings = TrainState(
         step=repl, params=params_shardings, opt_state=opt_state_shardings
     )
+    # Under pipeline parallelism the GSPMD zero-1 formulation crashes the XLA
+    # partitioner (see build_explicit_zero1_update); route the update through
+    # the explicit shard-step-allgather path instead.
+    explicit_z1 = (
+        mesh.shape.get(mesh_lib.PP_AXIS, 1) > 1
+        and opt_state_is_zero1_sharded(opt_state_shardings)
+    )
+    z1_update = (
+        build_explicit_zero1_update(optimizer, params_shardings, opt_state_shardings)
+        if explicit_z1
+        else None
+    )
 
     def step_fn(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        loss, grads = value_and_grad_fn(state.params, batch)
         grads, grad_norm = clip_grad_norm(grads, max_grad_norm)
-        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if z1_update is not None:
+            new_params, new_opt_state = z1_update(grads, state.opt_state, state.params)
+        else:
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
             step=state.step + 1, params=new_params, opt_state=new_opt_state
         )

@@ -11,6 +11,7 @@ from neuronx_distributed_tpu.pipeline.scheduler import (
     RecvForwardTask,
     ReduceGradsTask,
     SendForwardTask,
+    SyncTrain1F1BSchedule,
     Train1F1BSchedule,
     TrainInterleavedSchedule,
     validate_schedule,
@@ -78,6 +79,66 @@ def test_interleaved_chunk_coverage():
     s = TrainInterleavedSchedule(4, 2, 0, num_chunks=2)
     fwd = [t for t in s.steps() if isinstance(t, ForwardTask)]
     assert {(t.mb, t.chunk) for t in fwd} == {(m, c) for m in range(4) for c in range(2)}
+
+
+@pytest.mark.parametrize("pp,mb", [(2, 4), (4, 8), (4, 2), (8, 16)])
+def test_sync_1f1b_valid_all_ranks(pp, mb):
+    for r in range(pp):
+        validate_schedule(SyncTrain1F1BSchedule(mb, pp, r))
+
+
+@pytest.mark.parametrize("pp,mb", [(2, 4), (4, 8)])
+def test_sync_1f1b_matches_cycle_tables(pp, mb):
+    """The stream IS the runtime: reconstructing per-cycle (fwd, bwd) indices
+    from the closed forms used by OneFOneBEngine must reproduce the task
+    stream exactly."""
+    from neuronx_distributed_tpu.pipeline.scheduler import (
+        BackwardTask,
+        ForwardTask,
+        RecvBackwardTask,
+        RecvForwardTask,
+        ReduceGradsTask,
+        SendBackwardTask,
+        SendForwardTask,
+    )
+
+    for r in range(pp):
+        sched = SyncTrain1F1BSchedule(mb, pp, r)
+        want = []
+        for c in range(sched.num_cycles):
+            mf = c - r
+            if 0 <= mf < mb:
+                if r != 0:
+                    want.append(RecvForwardTask(mf))
+                want.append(ForwardTask(mf))
+                if r != pp - 1:
+                    want.append(SendForwardTask(mf))
+            mbk = c - 2 * (pp - 1) + r
+            if 0 <= mbk < mb:
+                if r != pp - 1:
+                    want.append(RecvBackwardTask(mbk))
+                want.append(BackwardTask(mbk))
+                if r != 0:
+                    want.append(SendBackwardTask(mbk))
+        want.append(ReduceGradsTask(mb=-1))
+        assert sched.steps() == want
+
+
+def test_sync_1f1b_peak_in_flight():
+    """Peak outstanding (forwarded, not yet backwarded) microbatches per rank
+    must be min(M, 2(S-1-r)) + 1 — the O(S) bound independent of M."""
+    from neuronx_distributed_tpu.pipeline.scheduler import BackwardTask, ForwardTask
+
+    S, M = 4, 16
+    for r in range(S):
+        out = peak = 0
+        for t in SyncTrain1F1BSchedule(M, S, r).steps():
+            if isinstance(t, ForwardTask):
+                out += 1
+                peak = max(peak, out)
+            elif isinstance(t, BackwardTask):
+                out -= 1
+        assert peak == min(M, 2 * (S - 1 - r)) + 1, (r, peak)
 
 
 def test_bad_args():
