@@ -23,12 +23,20 @@ import jax.numpy as jnp
 
 
 def _set_cache_index(cache, value):
-    """Functionally set every per-layer 'index' leaf (cache rollback)."""
+    """Functionally set every per-layer 'index' leaf (cache rollback).
+
+    Preserves each leaf's shape: under ``scan_layers`` models the index leaf
+    is stacked to (num_layers,) by ``nn.scan(variable_axes={'cache': 0})``,
+    and a scalar replacement would make the scan unable to split it."""
 
     def walk(node):
         if isinstance(node, dict):
             return {
-                k: jnp.asarray(value, jnp.int32) if k == "index" else walk(v)
+                k: (
+                    jnp.broadcast_to(jnp.asarray(value, jnp.int32), jnp.shape(v))
+                    if k == "index"
+                    else walk(v)
+                )
                 for k, v in node.items()
             }
         return node
@@ -50,6 +58,20 @@ def speculative_generate(
     diverge across a batch; per-row bookkeeping is future work — reference
     speculative example is also B=1)."""
     assert prompt_ids.shape[0] == 1, "speculative decoding supports B=1"
+    # Past max_seq_len the cache write index and RoPE position gather clamp
+    # silently, corrupting output — same guard as generate.py. The last round
+    # may score a gamma-token window starting at most max_new_tokens-1 past
+    # the prompt.
+    for m in (target_model, draft_model):
+        max_len = getattr(m.config, "max_seq_len", None)
+        if max_len is not None and (
+            prompt_ids.shape[1] + max_new_tokens + gamma - 1 > max_len
+        ):
+            raise ValueError(
+                f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) + gamma-1 ({gamma - 1}) exceeds the "
+                f"model's max_seq_len ({max_len})"
+            )
     t_prefill = target_model.clone(mode="prefill")
     t_decode = target_model.clone(mode="decode")
     d_prefill = draft_model.clone(mode="prefill")

@@ -19,11 +19,14 @@ Reference strategies → TPU-native formulations:
   native grouped matmul, lowered by Mosaic to MXU tiles — on expert-sorted
   tokens. TP shards the intermediate dim inside an explicit ``shard_map``
   (Mosaic grouped matmuls are not auto-partitioned over the ragged group dim).
-  Requires ep == 1 this round; with ep > 1 use capacity_factor (all-to-all) or
-  all_experts (exact).
-
-``forward_selective_loading`` (per-token decode loads, expert_mlps.py:319) is
-an inference-memory optimization deferred to the inference path.
+  With ep > 1 each ep rank rolls the expert-sorted rows to its own experts'
+  segment, runs the grouped matmul on its E/ep local experts, and the
+  combine is a psum over ep (the reference's blockwise NKI path composes
+  with EP the same way, blockwise.py:434).
+* ``forward_selective_loading`` (expert_mlps.py:319): decode path — for a
+  handful of tokens, gather just the k expert weight slices each token
+  routed to and run per-token matmuls; FLOPs = k/E of dense and no
+  dispatch machinery. Auto-selected when T <= selective_threshold.
 """
 
 from __future__ import annotations
@@ -62,8 +65,15 @@ class ExpertMLPs(nn.Module):
     hidden_act: str = "silu"
     glu_mlp: bool = True
     capacity_factor: Optional[float] = None
-    strategy: str = "auto"  # auto | all_experts | capacity_factor | blockwise
-    all_experts_threshold: int = 8
+    # auto | all_experts | capacity_factor | blockwise | selective
+    strategy: str = "auto"
+    # dense all-experts pays E/k times the routed FLOPs — only worth it when
+    # the dispatch overhead dominates, i.e. very few experts (ADVICE round 1:
+    # the old threshold of 8 made the flagship top-2-of-8 Mixtral dense)
+    all_experts_threshold: int = 4
+    # token count at or below which the per-token gathered-weights decode path
+    # is used (reference forward_selective_loading, expert_mlps.py:319)
+    selective_threshold: int = 8
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -97,19 +107,16 @@ class ExpertMLPs(nn.Module):
         )
         return gate, up, down
 
-    def _resolve_strategy(self) -> str:
+    def _resolve_strategy(self, n_tokens: Optional[int] = None) -> str:
         if self.strategy != "auto":
             return self.strategy
+        if n_tokens is not None and n_tokens <= self.selective_threshold:
+            return "selective"
         if self.capacity_factor is not None:
             return "capacity_factor"
-        ep = (
-            mesh_lib.get_expert_model_parallel_size()
-            if mesh_lib.model_parallel_is_initialized()
-            else 1
-        )
-        if ep > 1 or self.num_experts <= self.all_experts_threshold:
-            # dropless under EP: the all-experts contraction is the exact path
-            # (capacity dispatch would drop tokens the user asked to keep)
+        # dropless: blockwise (ragged grouped matmul, routed FLOPs only) is
+        # the default; dense all-experts only for a handful of experts
+        if self.num_experts <= self.all_experts_threshold:
             return "all_experts"
         return "blockwise"
 
@@ -118,7 +125,18 @@ class ExpertMLPs(nn.Module):
         """``x (T, H)`` tokens, ``top_e (T, k)`` expert ids, ``top_w (T, k)``
         affinities → ``(T, H)`` combined expert outputs."""
         gate, up, down = self._params()
-        strategy = self._resolve_strategy()
+        strategy = self._resolve_strategy(n_tokens=x.shape[0])
+        if self.strategy == "auto" and not self.is_initializing():
+            from neuronx_distributed_tpu.utils.logger import get_logger
+
+            flops_mult = (
+                self.num_experts / self.top_k if strategy == "all_experts" else 1.0
+            )
+            get_logger(__name__).debug(
+                "MoE auto strategy: %s (T=%d, E=%d, k=%d, FLOPs multiplier vs "
+                "routed: %.1fx)",
+                strategy, x.shape[0], self.num_experts, self.top_k, flops_mult,
+            )
         x = x.astype(self.dtype)
         gate = None if gate is None else gate.astype(self.dtype)
         up, down = up.astype(self.dtype), down.astype(self.dtype)
@@ -128,7 +146,25 @@ class ExpertMLPs(nn.Module):
             return self._capacity_factor(x, top_e, top_w, gate, up, down)
         if strategy == "blockwise":
             return self._blockwise(x, top_e, top_w, gate, up, down)
+        if strategy == "selective":
+            return self._selective(x, top_e, top_w, gate, up, down)
         raise ValueError(f"unknown expert strategy {strategy!r}")
+
+    # --- strategy: selective loading (reference expert_mlps.py:319) -----------
+
+    def _selective(self, x, top_e, top_w, gate, up, down):
+        """Per-token gathered expert weights — the decode path. For T tokens,
+        gathers (T, k, H, I) weight slices and runs per-token einsums; memory
+        is bounded by T·k weight slices, so this is gated on small T."""
+        up_g = jnp.take(up, top_e, axis=0)  # (T, k, H, I)
+        h = jnp.einsum("th,tkhi->tki", x, up_g)
+        if self.glu_mlp:
+            g = jnp.einsum("th,tkhi->tki", x, jnp.take(gate, top_e, axis=0))
+            h = _act(self.hidden_act)(g) * h
+        else:
+            h = _act(self.hidden_act)(h)
+        y = jnp.einsum("tki,tkih->tkh", h, jnp.take(down, top_e, axis=0))
+        return jnp.einsum("tkh,tk->th", y, top_w.astype(y.dtype))
 
     # --- strategy: all experts (reference expert_mlps.py:179) -----------------
 
@@ -196,15 +232,6 @@ class ExpertMLPs(nn.Module):
     # --- strategy: blockwise dropless (reference expert_mlps.py:346) ----------
 
     def _blockwise(self, x, top_e, top_w, gate, up, down):
-        if (
-            mesh_lib.model_parallel_is_initialized()
-            and mesh_lib.get_expert_model_parallel_size() > 1
-        ):
-            raise ValueError(
-                "blockwise dropless path requires expert_parallel_size == 1 "
-                "this round; use capacity_factor (all-to-all) or all_experts "
-                "(exact) with ep > 1"
-            )
         T, H = x.shape
         k, E = self.top_k, self.num_experts
         N = T * k
@@ -215,46 +242,81 @@ class ExpertMLPs(nn.Module):
         group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
         ws = top_w.reshape(-1)[order].astype(x.dtype)
 
-        def grouped_mlp(xs_, gate_, up_, down_):
-            h = jax.lax.ragged_dot(xs_, up_, group_sizes)
+        initialized = mesh_lib.model_parallel_is_initialized()
+        tp = mesh_lib.get_tensor_model_parallel_size() if initialized else 1
+        ep = mesh_lib.get_expert_model_parallel_size() if initialized else 1
+
+        def grouped_mlp(xs_, gate_, up_, down_, sizes):
+            h = jax.lax.ragged_dot(xs_, up_, sizes)
             if self.glu_mlp:
-                g = jax.lax.ragged_dot(xs_, gate_, group_sizes)
+                g = jax.lax.ragged_dot(xs_, gate_, sizes)
                 h = _act(self.hidden_act)(g) * h
             else:
                 h = _act(self.hidden_act)(h)
-            return jax.lax.ragged_dot(h, down_, group_sizes)
+            return jax.lax.ragged_dot(h, down_, sizes)
 
-        tp = (
-            mesh_lib.get_tensor_model_parallel_size()
-            if mesh_lib.model_parallel_is_initialized()
-            else 1
-        )
-        if tp > 1:
+        if tp > 1 or ep > 1:
             # Grouped (ragged) matmuls cannot be auto-partitioned by GSPMD, so
-            # the tp sharding of the intermediate dim is an explicit shard_map:
-            # partial products from the down projection psum over tp. NOTE this
-            # is deliberately PARTIAL manual ({tp} only, unlike
+            # tp/ep sharding is an explicit shard_map. NOTE this is
+            # deliberately PARTIAL manual ({tp, ep} only, unlike
             # mesh.manual_shard_map): the token rows stay sharded over the
             # auto data axes instead of being all-gathered.
+            #
+            # ep: each rank holds E/ep experts' weights. The expert-sorted row
+            # buffer is rolled so the local experts' segment starts at row 0
+            # (a dynamic-slice — the segment offset is data-dependent), the
+            # grouped matmul runs on the E/ep local group sizes, and rows are
+            # rolled back; every row belongs to exactly one rank's segment, so
+            # the ep-psum of the masked results is the dropless combine
+            # (reference: the blockwise NKI path composes with EP the same
+            # way, blockwise.py:434).
+            if E % max(ep, 1) != 0:
+                raise ValueError(f"num_experts {E} not divisible by ep {ep}")
             mesh = mesh_lib.get_mesh()
             ctx_mesh = jax.sharding.get_abstract_mesh()
-            wspec_col = P(None, None, mesh_lib.TP_AXIS)
-            wspec_row = P(None, mesh_lib.TP_AXIS, None)
+            E_l = E // max(ep, 1)
+            # only claim axes of size > 1: a claimed-but-unreduced axis breaks
+            # the psum transpose rule in the backward
+            ep_ax = mesh_lib.EP_AXIS if ep > 1 else None
+            tp_ax = mesh_lib.TP_AXIS if tp > 1 else None
+            axes = tuple(a for a in (ep_ax, tp_ax) if a)
+            wspec_col = P(ep_ax, None, tp_ax)
+            wspec_row = P(ep_ax, tp_ax, None)
 
-            def tp_mlp(xs_, gate_, up_, down_):
-                return jax.lax.psum(
-                    grouped_mlp(xs_, gate_, up_, down_), mesh_lib.TP_AXIS
+            def sharded_mlp(xs_, sizes, gate_, up_, down_):
+                ep_rank = (
+                    jax.lax.axis_index(mesh_lib.EP_AXIS) if ep > 1 else 0
                 )
+                local_sizes = jax.lax.dynamic_slice_in_dim(
+                    sizes, ep_rank * E_l, E_l
+                )
+                offsets = jnp.concatenate(
+                    [jnp.zeros((1,), sizes.dtype), jnp.cumsum(sizes)]
+                )
+                start = offsets[ep_rank * E_l]
+                n_local = local_sizes.sum()
+                xs_rolled = jnp.roll(xs_, -start, axis=0)
+                y = grouped_mlp(xs_rolled, gate_, up_, down_, local_sizes)
+                # rows past the local segment are garbage — zero them before
+                # rolling back; the combine over ep (and the tp partial-sum
+                # reduction) happens OUTSIDE the shard_map as a plain sum over
+                # the stacked rank dims: transposing an in-region psum through
+                # a partial-manual shard_map is not supported, a stacked
+                # output transposes cleanly
+                valid = (jnp.arange(N) < n_local)[:, None]
+                y = jnp.roll(jnp.where(valid, y, 0), start, axis=0)
+                return y[None, None]
 
             ys = jax.shard_map(
-                tp_mlp,
+                sharded_mlp,
                 mesh=mesh if ctx_mesh.empty else ctx_mesh,
-                in_specs=(P(), wspec_col, wspec_col, wspec_row),
-                out_specs=P(),
-                axis_names={mesh_lib.TP_AXIS},
+                in_specs=(P(), P(), wspec_col, wspec_col, wspec_row),
+                out_specs=P(ep_ax, tp_ax, None, None),
+                axis_names=set(axes),
                 check_vma=False,
-            )(xs, gate if gate is not None else up, up, down)
+            )(xs, group_sizes, gate if gate is not None else up, up, down)
+            ys = ys.sum(axis=(0, 1))
         else:
-            ys = grouped_mlp(xs, gate, up, down)
+            ys = grouped_mlp(xs, gate, up, down, group_sizes)
         out = jnp.zeros((T, H), ys.dtype).at[token_idx].add(ys * ws[:, None])
         return out

@@ -5,6 +5,7 @@ examples/inference/run_llama_speculative.py accuracy check)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from neuronx_distributed_tpu.inference import GenerationConfig, generate
 from neuronx_distributed_tpu.inference.speculative import speculative_generate
@@ -13,8 +14,8 @@ from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
 NEW = 10
 
 
-def _setup():
-    cfg = tiny_llama()
+def _setup(**cfg_kwargs):
+    cfg = tiny_llama(**cfg_kwargs)
     target = LlamaForCausalLM(cfg, attention_impl="xla")
     ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, cfg.vocab_size)
     t_params = target.init(jax.random.PRNGKey(1), ids)
@@ -49,3 +50,30 @@ def test_speculative_with_perfect_draft_accepts_everything():
     )
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
     assert mean_acc == 4.0
+
+
+def test_speculative_with_scan_layers():
+    """The default LlamaConfig uses scan_layers=True, where cache index leaves
+    are stacked to (num_layers,); rollback must preserve that shape
+    (ADVICE round 1, speculative.py:25)."""
+    target, t_params, draft, d_params, ids = _setup(scan_layers=True)
+    ref = generate(
+        target, t_params, ids, jax.random.PRNGKey(2),
+        GenerationConfig(max_new_tokens=NEW, temperature=0.0),
+    )
+    toks, _ = speculative_generate(
+        target, t_params, draft, d_params, ids, max_new_tokens=NEW, gamma=3
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
+def test_speculative_max_seq_len_guard():
+    """Requests that would write past max_seq_len must raise up front instead
+    of silently clamping (ADVICE round 1, speculative.py:39)."""
+    target, t_params, draft, d_params, ids = _setup()
+    too_many = target.config.max_seq_len - ids.shape[1] + 1
+    with pytest.raises(ValueError, match="max_seq_len"):
+        speculative_generate(
+            target, t_params, draft, d_params, ids,
+            max_new_tokens=too_many, gamma=3,
+        )
