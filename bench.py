@@ -4,18 +4,32 @@ Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 computed against a hardware-grounded target: 40% MFU at the chip's peak bf16
-FLOPs (v5e ≈ 197 TFLOP/s) using the standard 6·N·tokens/step transformer FLOP
-count — i.e. vs_baseline = achieved_MFU / 0.40. >1.0 beats the target.
+FLOPs (v5e ≈ 197 TFLOP/s) — i.e. vs_baseline = achieved_MFU / 0.40. >1.0
+beats the target.
 
-Round-2 hardening (VERDICT.md "What's weak" #1): round 1 died with rc=1 in
-``jax.devices()`` — a TPU backend-init error with no fallback, wasting the
-round's only chip access.  The bench now runs as a parent harness that spawns
-the real measurement in a child process with a bounded timeout and retries
-(backend-init hangs/UNAVAILABLE errors are transient on the tunneled axon
-backend); if every attempt fails it emits a parseable JSON line with an
-``error`` field instead of a traceback.  The child forces
-``attention_impl="flash"`` on TPU so the Pallas kernel demonstrably compiles
-under Mosaic (round 1 never executed it on hardware).
+FLOP accounting (round-3 correction, VERDICT.md weak #2): the headline MFU is
+the *corrected* one —
+
+    flops = 6 · (N − N_embed_table) · tokens   (input embedding is a lookup,
+                                                not a matmul; lm_head counts)
+          + 6 · L · B · S² · H                 (causal QKᵀ+AV fwd+bwd: the
+                                                flash kernel computes only the
+                                                lower triangle, so half of the
+                                                full 12·L·B·S²·H)
+
+both the raw 6·N number and every component are in ``extras`` so the MFU can
+be recomputed from the artifact alone.
+
+A second, parallelism-exercising measurement runs on an 8-device virtual CPU
+mesh (pp=2×tp=2×dp=2): per-step wall time of the explicit-1F1B engine vs the
+GPipe scan engine plus their XLA temp-allocation sizes, logged under
+``extras.parallel_proxy`` (VERDICT.md weak #3 — the single-chip number alone
+cannot regress if sharded paths get slow).
+
+Round-2 hardening (kept): the measurement runs in child processes with
+bounded timeouts and retries; backend-init failures emit a parseable JSON
+error line instead of a traceback. The child forces ``attention_impl="flash"``
+on TPU so the Pallas kernel demonstrably compiles under Mosaic.
 """
 
 import json
@@ -147,6 +161,9 @@ def _measure(devs) -> None:
     data = shard_batch({"input_ids": ids, "labels": jnp.roll(ids, -1, axis=1)})
 
     n_params = sum(p.size for p in jax.tree.leaves(state.params))
+    # input embedding table does a lookup, not a matmul — exclude from the
+    # 6·N count (the lm_head, a real matmul, stays); VERDICT.md round-2 weak #2
+    embed_params = cfg.vocab_size * cfg.hidden_size
 
     # warmup (compile). NOTE: on the axon TPU relay block_until_ready does not
     # actually wait for device completion — a host readback (float()) is the
@@ -174,8 +191,15 @@ def _measure(devs) -> None:
 
     tokens = batch * seq
     tokens_per_sec = tokens / dt
-    flops_per_step = 6.0 * n_params * tokens  # fwd+bwd transformer estimate
-    mfu = (flops_per_step / dt) / peak_flops_per_chip(devs[0])
+    peak = peak_flops_per_chip(devs[0])
+    flops_raw = 6.0 * n_params * tokens
+    flops_matmul = 6.0 * (n_params - embed_params) * tokens
+    # causal attention (QK^T + AV), fwd+bwd = 3× fwd; the flash kernel only
+    # computes the lower triangle, so the honest hardware count is half of
+    # the full 12·L·B·S²·H
+    flops_attn = 6.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
+    mfu_raw = (flops_raw / dt) / peak
+    mfu = ((flops_matmul + flops_attn) / dt) / peak
     target_mfu = 0.40
     _emit(
         {
@@ -185,12 +209,106 @@ def _measure(devs) -> None:
             "vs_baseline": round(mfu / target_mfu, 4),
             "extras": {
                 "mfu": round(mfu, 4),
+                "mfu_raw_6n": round(mfu_raw, 4),
+                "flops_matmul_per_step": flops_matmul,
+                "flops_attn_per_step": flops_attn,
+                "embed_params_excluded": int(embed_params),
+                "peak_flops": peak,
                 "n_params": int(n_params),
                 "step_time_s": round(dt, 4),
+                "batch": batch,
+                "seq": seq,
                 "layers": cfg.num_layers,
                 "platform": devs[0].platform,
                 "attention_impl": attention_impl,
             },
+        }
+    )
+
+
+def child_parallel() -> None:
+    """Parallelism proxy on an 8-device virtual CPU mesh: step time + XLA
+    temp-allocation of the explicit-1F1B engine vs the GPipe scan engine at
+    pp=2×tp=2×dp=2 with ZeRO-1 + SP. Emits one JSON line merged by the parent
+    into ``extras.parallel_proxy``."""
+    from neuronx_distributed_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(8)
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+    from neuronx_distributed_tpu.pipeline.llama import LlamaPipelineAdapter
+    from neuronx_distributed_tpu.pipeline.model import (
+        microbatch,
+        shard_microbatched_batch,
+    )
+    from neuronx_distributed_tpu.trainer import OptimizerConfig, make_optimizer
+
+    cfg = LlamaConfig(
+        vocab_size=512,
+        hidden_size=256,
+        intermediate_size=704,
+        num_layers=4,
+        num_heads=8,
+        num_kv_heads=4,
+        max_seq_len=128,
+        # fp32: the CPU backend's AllReducePromotion pass CHECK-crashes on
+        # bf16 all-reduces ("Invalid binary instruction opcode copy"); the
+        # proxy measures relative engine cost, dtype is immaterial
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+        scan_layers=True,
+        sequence_parallel=True,
+    )
+    M = 8
+    mesh_lib.destroy_model_parallel()
+    mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=2, pipeline_model_parallel_size=2
+    )
+    dp = mesh_lib.get_data_parallel_size()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    key = jax.random.PRNGKey(0)
+    ids = jax.random.randint(key, (M * dp, 64), 0, cfg.vocab_size)
+    batch = shard_microbatched_batch(
+        microbatch({"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}, M)
+    )
+
+    out = {}
+    for sched in ("1f1b", "gpipe"):
+        adapter = LlamaPipelineAdapter(
+            config=cfg, num_microbatches=M, attention_impl="xla", schedule=sched
+        )
+        state, step, _engine = adapter.build_state_and_step(
+            model, make_optimizer(OptimizerConfig()), key, ids
+        )
+        # temp-allocation evidence via compiled memory analysis
+        lowered = step.lower(state, batch)
+        compiled = lowered.compile()
+        try:
+            temp_bytes = int(compiled.memory_analysis().temp_size_in_bytes)
+        except Exception:
+            temp_bytes = -1
+        state, metrics = step(state, batch)
+        _ = float(metrics["loss"])
+        t0 = time.perf_counter()
+        iters = 3
+        for _ in range(iters):
+            state, metrics = step(state, batch)
+        _ = float(metrics["loss"])
+        out[sched] = {
+            "step_time_s": round((time.perf_counter() - t0) / iters, 4),
+            "temp_alloc_bytes": temp_bytes,
+            "loss": round(float(metrics["loss"]), 4),
+        }
+    _emit(
+        {
+            "metric": "parallel_proxy",
+            "mesh": "cpu pp=2 tp=2 dp=2 sp=on zero1=on",
+            "microbatches": M,
+            "schedules": out,
         }
     )
 
@@ -210,22 +328,57 @@ def _parse_result(stdout: str):
     return None
 
 
+def _run_parallel_proxy():
+    """Run the CPU-mesh 1F1B-vs-GPipe proxy child; returns the proxy dict, or
+    a dict with an 'error' key on failure (the proxy augments the headline
+    metric, it must never sink it)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child-parallel"],
+            capture_output=True,
+            text=True,
+            timeout=420,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "parallel proxy timed out"}
+    result = _parse_result(proc.stdout)
+    if result is None or result.get("metric") != "parallel_proxy":
+        tail = (proc.stderr or proc.stdout or "").strip()[-300:]
+        return {"error": f"parallel proxy failed: {tail}"}
+    result.pop("metric", None)
+    return result
+
+
 def main() -> None:
     errors = []
+    # A successful headline result is stashed here so that a driver SIGTERM
+    # during the (optional, slow) parallel proxy still emits the real TPU
+    # measurement instead of discarding it.
+    headline = {}
     # If the driver kills the harness mid-retry (its outer budget may be
     # shorter than ours), still flush a parseable error JSON on the way out.
     import signal
 
     def _on_term(signum, frame):
-        _emit(
-            {
-                "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
-                "value": 0,
-                "unit": "tokens/s",
-                "vs_baseline": 0.0,
-                "error": "; ".join(errors + [f"killed by signal {signum} mid-attempt"]),
+        if headline:
+            result = dict(headline)
+            result.setdefault("extras", {})["parallel_proxy"] = {
+                "error": f"killed by signal {signum} during proxy"
             }
-        )
+            _emit(result)
+        else:
+            _emit(
+                {
+                    "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
+                    "value": 0,
+                    "unit": "tokens/s",
+                    "vs_baseline": 0.0,
+                    "error": "; ".join(
+                        errors + [f"killed by signal {signum} mid-attempt"]
+                    ),
+                }
+            )
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _on_term)
@@ -255,6 +408,11 @@ def main() -> None:
             errors.append(f"attempt {attempt}: {result['error']}")
             result["error"] = "; ".join(errors)
             result.pop("retryable", None)
+        headline.update(result)
+        if "error" not in result:
+            # only augment a successful headline — a dead bench should not
+            # spend minutes compiling the CPU proxy before reporting
+            result.setdefault("extras", {})["parallel_proxy"] = _run_parallel_proxy()
         print(json.dumps(result), flush=True)
         return
     _emit(
@@ -269,7 +427,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
+    if "--child-parallel" in sys.argv:
+        child_parallel()
+    elif "--child" in sys.argv:
         child()
     else:
         main()

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Llama inference example — trace / generate / benchmark harness (reference:
+``examples/inference/runner.py:475-765`` — ``trace``, ``serve``, and
+``benchmark_sampling`` with p50/p99 latency reporting).
+
+Modes:
+
+  generate   — KV-cache autoregressive generation from a prompt
+  benchmark  — repeat generation ``--iters`` times, report p50/p99 e2e
+               latency, per-token decode latency, and tokens/s
+  trace      — AOT-compile prefill buckets + decode step via ModelBuilder
+               and (optionally) serialize the executables with --save-dir
+  speculative— draft-model speculative decoding (tiny draft of the same
+               family), reports mean accepted tokens/round
+
+Examples (development host, virtual CPU devices):
+
+  python examples/run_inference.py --model tiny --mode generate \
+      --prompt-len 16 --max-new-tokens 32 --force-cpu-devices 8 --tp 2
+  python examples/run_inference.py --model tiny --mode benchmark --iters 10
+  python examples/run_inference.py --model tiny --mode trace \
+      --buckets 64,128 --save-dir /tmp/traced
+
+On TPU (BASELINE config 5 shape): --model 7b --tp 8 --prompt-len 1024.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import statistics
+import sys
+import time
+
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="tiny", choices=["tiny", "7b", "llama3-8b"])
+    p.add_argument("--mode", default="generate",
+                   choices=["generate", "benchmark", "trace", "speculative"])
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--greedy", action="store_true", help="temperature-0 argmax")
+    p.add_argument("--iters", type=int, default=10, help="benchmark iterations")
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--gamma", type=int, default=4, help="speculative window")
+    p.add_argument("--buckets", default="64,256",
+                   help="comma-separated prompt buckets for trace mode")
+    p.add_argument("--save-dir", default=None,
+                   help="serialize traced executables here (trace mode)")
+    p.add_argument("--attention", default="auto", choices=["auto", "flash", "xla"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--force-cpu-devices", type=int, default=None)
+    return p.parse_args(argv)
+
+
+def build_model(args):
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.models import llama as llama_lib
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+    preset = {
+        "tiny": llama_lib.tiny_llama,
+        "7b": llama_lib.llama2_7b,
+        "llama3-8b": llama_lib.llama3_8b,
+    }[args.model]
+    need = args.prompt_len + args.max_new_tokens + args.gamma
+    cfg = preset()
+    if cfg.max_seq_len < need:
+        cfg = dataclasses.replace(cfg, max_seq_len=need)
+    if args.model == "tiny":
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    return LlamaForCausalLM(cfg, attention_impl=args.attention), cfg
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.force_cpu_devices:
+        from neuronx_distributed_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(args.force_cpu_devices)
+
+    import jax
+    import jax.numpy as jnp
+
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.inference.generate import (
+        GenerationConfig,
+        generate,
+    )
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+    from neuronx_distributed_tpu.utils.logger import get_logger
+
+    logger = get_logger("examples.run_inference")
+    if mesh_lib.model_parallel_is_initialized():
+        mesh_lib.destroy_model_parallel()
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=args.tp)
+
+    model, cfg = build_model(args)
+    key = jax.random.PRNGKey(args.seed)
+    prompt = jax.random.randint(
+        key, (args.batch, args.prompt_len), 0, cfg.vocab_size, jnp.int32
+    )
+    logger.info("initializing %s (tp=%d, %d layers)", args.model, args.tp,
+                cfg.num_layers)
+    params = meta.unbox(jax.jit(model.init)(key, prompt))
+
+    gen_cfg = GenerationConfig(
+        max_new_tokens=args.max_new_tokens,
+        temperature=0.0 if args.greedy else args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+    )
+
+    if args.mode == "generate":
+        toks = generate(model, params, prompt, key, gen_cfg)
+        toks = jax.device_get(toks)
+        print(f"prompt ids[0]: {jax.device_get(prompt)[0].tolist()}")
+        print(f"generated ids[0]: {toks[0].tolist()}")
+        return {"tokens": toks}
+
+    if args.mode == "benchmark":
+        # reference benchmark_sampling (runner.py:521): warmup, then N e2e
+        # timed runs → p50/p99 latency + throughput
+        lat = []
+        for i in range(args.warmup + args.iters):
+            t0 = time.perf_counter()
+            toks = generate(model, params, prompt, key, gen_cfg)
+            jax.block_until_ready(toks)
+            dt = time.perf_counter() - t0
+            if i >= args.warmup:
+                lat.append(dt)
+        lat.sort()
+        p50 = statistics.median(lat)
+        p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+        new_tokens = args.batch * args.max_new_tokens
+        report = {
+            "e2e_p50_s": round(p50, 4),
+            "e2e_p99_s": round(p99, 4),
+            "per_token_p50_ms": round(1e3 * p50 / args.max_new_tokens, 3),
+            "tokens_per_s_p50": round(new_tokens / p50, 1),
+            "iters": args.iters,
+            "batch": args.batch,
+            "prompt_len": args.prompt_len,
+            "max_new_tokens": args.max_new_tokens,
+        }
+        print(report)
+        return report
+
+    if args.mode == "trace":
+        # reference ModelBuilder.trace path: prefill per bucket + decode step
+        from neuronx_distributed_tpu.inference.model_builder import ModelBuilder
+
+        buckets = sorted(int(b) for b in args.buckets.split(","))
+        prefill = model.clone(mode="prefill")
+        decode = model.clone(mode="decode")
+
+        def prefill_fn(ids, params):
+            logits, variables = prefill.apply(params, ids, mutable=["cache"])
+            return logits[:, -1], variables["cache"]
+
+        def decode_fn(tok, params, cache):
+            logits, variables = decode.apply(
+                {**params, "cache": cache}, tok, mutable=["cache"]
+            )
+            return logits[:, -1], variables["cache"]
+
+        builder = ModelBuilder()
+        bucket_args = []
+        for b in buckets:
+            ids = jnp.zeros((args.batch, b), jnp.int32)
+            bucket_args.append((ids, params))
+        builder.add("context_encode", prefill_fn, bucket_args, bucket_dim=1,
+                    route_argnum=0)
+        _, cache0 = jax.jit(prefill_fn)(
+            jnp.zeros((args.batch, buckets[0]), jnp.int32), params
+        )
+        builder.add(
+            "token_gen",
+            decode_fn,
+            [(jnp.zeros((args.batch, 1), jnp.int32), params, cache0)],
+            bucket_dim=1,
+            route_argnum=0,
+        )
+        t0 = time.perf_counter()
+        nxd_model = builder.trace()
+        print(f"traced {len(buckets)} prefill buckets + decode in "
+              f"{time.perf_counter() - t0:.1f}s")
+        logits, cache = nxd_model("context_encode", prompt, params)
+        print(f"context_encode(prompt {prompt.shape}) -> logits {logits.shape}")
+        if args.save_dir:
+            builder.save(args.save_dir)
+            print(f"serialized executables -> {args.save_dir}")
+        return {"buckets": buckets}
+
+    if args.mode == "speculative":
+        from neuronx_distributed_tpu.inference.speculative import (
+            speculative_generate,
+        )
+        from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+        draft_cfg = dataclasses.replace(
+            cfg,
+            num_layers=max(1, cfg.num_layers // 4),
+            scan_layers=False,
+        )
+        draft = LlamaForCausalLM(draft_cfg, attention_impl=args.attention)
+        draft_params = meta.unbox(jax.jit(draft.init)(key, prompt))
+        t0 = time.perf_counter()
+        toks, accepted = speculative_generate(
+            model, params, draft, draft_params, prompt,
+            max_new_tokens=args.max_new_tokens, gamma=args.gamma,
+        )
+        dt = time.perf_counter() - t0
+        print(f"speculative: {args.max_new_tokens} tokens in {dt:.2f}s, "
+              f"mean accepted/round {float(accepted):.2f}")
+        print(f"generated ids[0]: {jax.device_get(toks)[0].tolist()}")
+        return {"accepted_per_round": float(accepted)}
+
+    raise ValueError(f"unknown mode {args.mode!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() is not None else 1)

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Llama pretraining example — the runnable E2E harness (reference:
+``examples/training/llama/tp_zero1_llama_hf_pretrain/run_llama_nxd.py`` and
+the tp_pp variant: args → parallel init → dataloader → train loop →
+throughput/TensorBoard logging → checkpointing).
+
+Covers the BASELINE.md milestone configs:
+
+  config 2 (7B TP8):         --model 7b  --tp 8
+  config 3 (7B TP8+SP+Z1):   --model 7b  --tp 8 --sp            (zero1 default)
+  config 4 (70B TP8 PP4):    --model 70b --tp 8 --pp 4 --schedule 1f1b
+
+On a development host without TPUs, run the same configs on a virtual CPU
+mesh (the test trick from SURVEY §4):
+
+  python examples/train_llama.py --model tiny --tp 2 --sp --steps 4 \
+      --force-cpu-devices 8
+  python examples/train_llama.py --model tiny --tp 2 --pp 2 --microbatches 4 \
+      --schedule 1f1b --steps 4 --force-cpu-devices 8
+
+Data: ``--data synthetic`` (default, seeded random tokens), or
+``--data npy:<path>`` — a memory-mapped ``.npy``/``.npz`` of token ids shaped
+``(num_tokens,)`` or ``(num_seqs, seq_len)`` (produce one with any HF
+tokenizer offline; this container has no network egress).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+# allow running straight from a source checkout: examples/ sits next to the package
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _repo_root not in sys.path:
+    sys.path.insert(0, _repo_root)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    m = p.add_argument_group("model")
+    m.add_argument("--model", default="tiny",
+                   choices=["tiny", "7b", "70b", "llama3-8b"],
+                   help="model preset (tiny = 4-layer test config)")
+    m.add_argument("--layers", type=int, default=None,
+                   help="override layer count (e.g. 4-layer 70B shape, the "
+                        "reference integration trick)")
+    m.add_argument("--seq-len", type=int, default=None, help="sequence length")
+    m.add_argument("--attention", default="auto",
+                   choices=["auto", "flash", "xla"], help="attention kernel")
+
+    par = p.add_argument_group("parallelism")
+    par.add_argument("--tp", type=int, default=1, help="tensor parallel size")
+    par.add_argument("--pp", type=int, default=1, help="pipeline parallel size")
+    par.add_argument("--cp", type=int, default=1, help="context parallel size")
+    par.add_argument("--sp", action="store_true", help="Megatron sequence parallel")
+    par.add_argument("--schedule", default="1f1b", choices=["gpipe", "1f1b"],
+                     help="pipeline schedule (pp > 1)")
+    par.add_argument("--microbatches", type=int, default=4,
+                     help="pipeline microbatches (pp > 1)")
+
+    t = p.add_argument_group("training")
+    t.add_argument("--batch-size", type=int, default=None,
+                   help="global batch size (default: dp, or microbatches·dp under pp)")
+    t.add_argument("--steps", type=int, default=10)
+    t.add_argument("--lr", type=float, default=3e-4)
+    t.add_argument("--warmup-steps", type=int, default=0)
+    t.add_argument("--lr-schedule", default="constant", choices=["constant", "cosine"])
+    t.add_argument("--no-zero1", action="store_true", help="disable ZeRO-1")
+    t.add_argument("--max-grad-norm", type=float, default=1.0)
+    t.add_argument("--seed", type=int, default=0)
+
+    d = p.add_argument_group("data")
+    d.add_argument("--data", default="synthetic",
+                   help="'synthetic' or 'npy:<path>' token-id array")
+
+    io = p.add_argument_group("io")
+    io.add_argument("--ckpt-dir", default=None, help="checkpoint directory (local or gs://)")
+    io.add_argument("--ckpt-every", type=int, default=100)
+    io.add_argument("--ckpt-keep", type=int, default=3)
+    io.add_argument("--resume", action="store_true",
+                    help="resume from the newest checkpoint in --ckpt-dir")
+    io.add_argument("--tensorboard-dir", default=None)
+    io.add_argument("--log-every", type=int, default=1)
+    io.add_argument("--timeline", default=None,
+                    help="write a chrome-trace timeline JSON here")
+
+    e = p.add_argument_group("environment")
+    e.add_argument("--force-cpu-devices", type=int, default=None,
+                   help="run on N virtual CPU devices (development mode)")
+    return p.parse_args(argv)
+
+
+def build_config(args):
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.models import llama as llama_lib
+
+    preset = {
+        "tiny": llama_lib.tiny_llama,
+        "7b": llama_lib.llama2_7b,
+        "70b": llama_lib.llama2_70b,
+        "llama3-8b": llama_lib.llama3_8b,
+    }[args.model]
+    over = {}
+    if args.layers is not None:
+        over["num_layers"] = args.layers
+    if args.seq_len is not None:
+        over["max_seq_len"] = args.seq_len
+    over["sequence_parallel"] = args.sp
+    if args.pp > 1:
+        over["scan_layers"] = True  # pipeline layout needs stacked layer params
+    cfg = preset(**over)
+    if args.model == "tiny" and args.attention == "auto":
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    return cfg
+
+
+def make_data_iter(args, cfg, batch_size: int, seq_len: int):
+    """Yield host batches {input_ids, labels} forever (reference: the HF
+    dataloader in run_llama_nxd.py; synthetic keeps the harness hermetic)."""
+    import numpy as np
+
+    if args.data == "synthetic":
+        rng = np.random.default_rng(args.seed)
+        while True:
+            ids = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1),
+                               dtype=np.int32)
+            yield {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    elif args.data.startswith("npy:"):
+        path = args.data[4:]
+        tokens = np.load(path, mmap_mode="r")
+        if hasattr(tokens, "files"):  # .npz archive: use its first array
+            tokens = tokens[tokens.files[0]]
+        if tokens.ndim == 2:
+            tokens = tokens.reshape(-1)  # view on the memmap, stays lazy
+        n = (len(tokens) - 1) // (batch_size * seq_len)
+        if n == 0:
+            raise ValueError(f"{path}: too few tokens for one batch")
+        while True:
+            for i in range(n):
+                lo = i * batch_size * seq_len
+                chunk = np.asarray(
+                    tokens[lo : lo + batch_size * seq_len + 1], dtype=np.int32
+                )
+                ids = chunk[:-1].reshape(batch_size, seq_len)
+                lbl = chunk[1:].reshape(batch_size, seq_len)
+                yield {"input_ids": ids, "labels": lbl}
+    else:
+        raise ValueError(f"unknown --data {args.data!r}")
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.force_cpu_devices:
+        from neuronx_distributed_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(args.force_cpu_devices)
+
+    import jax
+
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+    from neuronx_distributed_tpu.trainer import OptimizerConfig
+    from neuronx_distributed_tpu.trainer.loop import (
+        CheckpointCallback,
+        MetricsLogger,
+        Trainer,
+    )
+    from neuronx_distributed_tpu.utils.logger import get_logger
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    logger = get_logger("examples.train_llama")
+
+    if mesh_lib.model_parallel_is_initialized():
+        mesh_lib.destroy_model_parallel()
+    mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=args.tp,
+        pipeline_model_parallel_size=args.pp,
+        context_parallel_size=args.cp,
+    )
+    dp = mesh_lib.get_data_parallel_size()
+    cfg = build_config(args)
+    seq_len = min(cfg.max_seq_len, args.seq_len or cfg.max_seq_len)
+
+    if args.batch_size is None:
+        batch_size = dp * (args.microbatches if args.pp > 1 else 1)
+    else:
+        batch_size = args.batch_size
+
+    opt_cfg = OptimizerConfig(
+        learning_rate=args.lr,
+        warmup_steps=args.warmup_steps,
+        lr_schedule=args.lr_schedule,
+        total_steps=args.steps,
+        zero1=not args.no_zero1,
+        max_grad_norm=args.max_grad_norm,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl=args.attention)
+    pipeline = None
+    if args.pp > 1:
+        from neuronx_distributed_tpu.pipeline.llama import LlamaPipelineAdapter
+
+        pipeline = LlamaPipelineAdapter(
+            config=cfg,
+            num_microbatches=args.microbatches,
+            attention_impl=args.attention,
+            schedule=args.schedule,
+        )
+
+    callbacks = [MetricsLogger(log_every=args.log_every,
+                               tensorboard_dir=args.tensorboard_dir)]
+    if args.ckpt_dir:
+        callbacks.append(
+            CheckpointCallback(args.ckpt_dir, every=args.ckpt_every,
+                               num_kept=args.ckpt_keep)
+        )
+
+    trainer = Trainer(
+        model=model,
+        optimizer_config=opt_cfg,
+        callbacks=callbacks,
+        pipeline=pipeline,
+        timeline=Timeline(args.timeline) if args.timeline else None,
+    )
+    data = make_data_iter(args, cfg, batch_size, seq_len)
+
+    logger.info(
+        "training %s: %d layers, tp=%d pp=%d cp=%d dp=%d sp=%s zero1=%s "
+        "batch=%d seq=%d steps=%d",
+        args.model, cfg.num_layers, args.tp, args.pp, args.cp, dp, args.sp,
+        not args.no_zero1, batch_size, seq_len, args.steps,
+    )
+    t0 = time.perf_counter()
+    metrics = trainer.fit(
+        data,
+        jax.random.PRNGKey(args.seed),
+        args.steps,
+        resume_from=args.ckpt_dir if args.resume else None,
+    )
+    wall = time.perf_counter() - t0
+    if "loss" not in metrics:
+        # resumed at/after --steps: nothing left to train
+        print(f"nothing to do: resumed at step {trainer.step} >= --steps {args.steps}")
+        return metrics
+    # steps actually executed this run (resume starts past step 0)
+    steps_run = trainer.steps_run
+    tokens_per_step = batch_size * seq_len
+    print(
+        f"done: {steps_run} steps in {wall:.1f}s — "
+        f"final loss {float(metrics['loss']):.4f}, "
+        f"avg throughput {steps_run * tokens_per_step / wall:.0f} tokens/s "
+        f"({metrics.get('throughput_seq_s', 0.0):.2f} seqs/s moving avg)"
+    )
+    return metrics
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() is not None else 1)

@@ -31,6 +31,7 @@ Reference strategies → TPU-native formulations:
 
 from __future__ import annotations
 
+import functools
 from math import ceil
 from typing import Any, Optional
 
@@ -47,6 +48,61 @@ Dtype = Any
 
 def _act(name: str):
     return {"silu": jax.nn.silu, "gelu": jax.nn.gelu, "relu": jax.nn.relu}[name]
+
+
+def _grouped_mlp(xs_, gate_, up_, down_, sizes, *, glu: bool, act: str):
+    h = jax.lax.ragged_dot(xs_, up_, sizes)
+    if glu:
+        g = jax.lax.ragged_dot(xs_, gate_, sizes)
+        h = _act(act)(g) * h
+    else:
+        h = _act(act)(h)
+    return jax.lax.ragged_dot(h, down_, sizes)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_blockwise_mlp(mesh, ep_ax, tp_ax, E_l: int, ep: int, glu: bool,
+                           act: str):
+    """Cached jitted shard_map for the ep/tp-sharded blockwise grouped matmul
+    (jit keys on callable identity — rebuilding per call would recompile every
+    eager invocation). The jit wrapper exists because the eager shard_map impl
+    cannot execute partial-manual specs (its internal unmatch step builds a
+    full-mesh out_spec); under an outer jit it inlines."""
+    axes = tuple(a for a in (ep_ax, tp_ax) if a)
+    wspec_col = P(ep_ax, None, tp_ax)
+    wspec_row = P(ep_ax, tp_ax, None)
+
+    def sharded_mlp(xs_, sizes, gate_, up_, down_):
+        N = xs_.shape[0]
+        ep_rank = jax.lax.axis_index(ep_ax) if ep > 1 else 0
+        local_sizes = jax.lax.dynamic_slice_in_dim(sizes, ep_rank * E_l, E_l)
+        offsets = jnp.concatenate(
+            [jnp.zeros((1,), sizes.dtype), jnp.cumsum(sizes)]
+        )
+        start = offsets[ep_rank * E_l]
+        n_local = local_sizes.sum()
+        xs_rolled = jnp.roll(xs_, -start, axis=0)
+        y = _grouped_mlp(xs_rolled, gate_, up_, down_, local_sizes,
+                         glu=glu, act=act)
+        # rows past the local segment are garbage — zero them before rolling
+        # back; the combine over ep (and the tp partial-sum reduction) happens
+        # OUTSIDE the shard_map as a plain sum over the stacked rank dims:
+        # transposing an in-region psum through a partial-manual shard_map is
+        # not supported, a stacked output transposes cleanly
+        valid = (jnp.arange(N) < n_local)[:, None]
+        y = jnp.roll(jnp.where(valid, y, 0), start, axis=0)
+        return y[None, None]
+
+    return jax.jit(
+        jax.shard_map(
+            sharded_mlp,
+            mesh=mesh,
+            in_specs=(P(), P(), wspec_col, wspec_col, wspec_row),
+            out_specs=P(ep_ax, tp_ax, None, None),
+            axis_names=set(axes),
+            check_vma=False,
+        )
+    )
 
 
 class ExpertMLPs(nn.Module):
@@ -234,7 +290,6 @@ class ExpertMLPs(nn.Module):
     def _blockwise(self, x, top_e, top_w, gate, up, down):
         T, H = x.shape
         k, E = self.top_k, self.num_experts
-        N = T * k
         flat_e = top_e.reshape(-1)
         order = jnp.argsort(flat_e, stable=True)  # expert-sorted slot ids
         token_idx = order // k
@@ -245,15 +300,6 @@ class ExpertMLPs(nn.Module):
         initialized = mesh_lib.model_parallel_is_initialized()
         tp = mesh_lib.get_tensor_model_parallel_size() if initialized else 1
         ep = mesh_lib.get_expert_model_parallel_size() if initialized else 1
-
-        def grouped_mlp(xs_, gate_, up_, down_, sizes):
-            h = jax.lax.ragged_dot(xs_, up_, sizes)
-            if self.glu_mlp:
-                g = jax.lax.ragged_dot(xs_, gate_, sizes)
-                h = _act(self.hidden_act)(g) * h
-            else:
-                h = _act(self.hidden_act)(h)
-            return jax.lax.ragged_dot(h, down_, sizes)
 
         if tp > 1 or ep > 1:
             # Grouped (ragged) matmuls cannot be auto-partitioned by GSPMD, so
@@ -274,49 +320,23 @@ class ExpertMLPs(nn.Module):
                 raise ValueError(f"num_experts {E} not divisible by ep {ep}")
             mesh = mesh_lib.get_mesh()
             ctx_mesh = jax.sharding.get_abstract_mesh()
-            E_l = E // max(ep, 1)
             # only claim axes of size > 1: a claimed-but-unreduced axis breaks
             # the psum transpose rule in the backward
-            ep_ax = mesh_lib.EP_AXIS if ep > 1 else None
-            tp_ax = mesh_lib.TP_AXIS if tp > 1 else None
-            axes = tuple(a for a in (ep_ax, tp_ax) if a)
-            wspec_col = P(ep_ax, None, tp_ax)
-            wspec_row = P(ep_ax, tp_ax, None)
-
-            def sharded_mlp(xs_, sizes, gate_, up_, down_):
-                ep_rank = (
-                    jax.lax.axis_index(mesh_lib.EP_AXIS) if ep > 1 else 0
-                )
-                local_sizes = jax.lax.dynamic_slice_in_dim(
-                    sizes, ep_rank * E_l, E_l
-                )
-                offsets = jnp.concatenate(
-                    [jnp.zeros((1,), sizes.dtype), jnp.cumsum(sizes)]
-                )
-                start = offsets[ep_rank * E_l]
-                n_local = local_sizes.sum()
-                xs_rolled = jnp.roll(xs_, -start, axis=0)
-                y = grouped_mlp(xs_rolled, gate_, up_, down_, local_sizes)
-                # rows past the local segment are garbage — zero them before
-                # rolling back; the combine over ep (and the tp partial-sum
-                # reduction) happens OUTSIDE the shard_map as a plain sum over
-                # the stacked rank dims: transposing an in-region psum through
-                # a partial-manual shard_map is not supported, a stacked
-                # output transposes cleanly
-                valid = (jnp.arange(N) < n_local)[:, None]
-                y = jnp.roll(jnp.where(valid, y, 0), start, axis=0)
-                return y[None, None]
-
-            ys = jax.shard_map(
-                sharded_mlp,
-                mesh=mesh if ctx_mesh.empty else ctx_mesh,
-                in_specs=(P(), P(), wspec_col, wspec_col, wspec_row),
-                out_specs=P(ep_ax, tp_ax, None, None),
-                axis_names=set(axes),
-                check_vma=False,
-            )(xs, group_sizes, gate if gate is not None else up, up, down)
+            smapped = _sharded_blockwise_mlp(
+                mesh if ctx_mesh.empty else ctx_mesh,
+                mesh_lib.EP_AXIS if ep > 1 else None,
+                mesh_lib.TP_AXIS if tp > 1 else None,
+                E // max(ep, 1),
+                ep,
+                self.glu_mlp,
+                self.hidden_act,
+            )
+            ys = smapped(
+                xs, group_sizes, gate if gate is not None else up, up, down
+            )
             ys = ys.sum(axis=(0, 1))
         else:
-            ys = grouped_mlp(xs, gate, up, down, group_sizes)
+            ys = _grouped_mlp(xs, gate, up, down, group_sizes,
+                              glu=self.glu_mlp, act=self.hidden_act)
         out = jnp.zeros((T, H), ys.dtype).at[token_idx].add(ys * ws[:, None])
         return out

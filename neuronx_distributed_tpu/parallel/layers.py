@@ -28,6 +28,7 @@ init path (jax.eval_shape + jit init subsume it).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -245,6 +246,39 @@ class InputChannelParallelConv2d(nn.Module):
         return y
 
 
+@functools.lru_cache(maxsize=None)
+def _vocab_parallel_lookup(mesh, axis: str, upcast: bool):
+    """Cached jitted shard_map for the vocab-parallel lookup — jit keys on
+    callable identity, so rebuilding the wrapper per call would recompile on
+    every eager lookup. The jit wrapper exists because the eager shard_map
+    impl rejects partial-manual specs (see modules/moe/expert_mlps.py); it
+    inlines under an outer jit."""
+
+    def local_lookup(table_l, ids_):
+        per = table_l.shape[0]
+        lo = jax.lax.axis_index(axis) * per
+        local_ids = ids_ - lo
+        ok = (local_ids >= 0) & (local_ids < per)
+        rows = jnp.take(table_l, jnp.clip(local_ids, 0, per - 1), axis=0)
+        rows = jnp.where(ok[..., None], rows, 0)
+        if upcast:
+            return jax.lax.psum(rows.astype(jnp.float32), axis).astype(
+                table_l.dtype
+            )
+        return jax.lax.psum(rows, axis)
+
+    return jax.jit(
+        jax.shard_map(
+            local_lookup,
+            mesh=mesh,
+            in_specs=(P(axis, None), P()),
+            out_specs=P(),
+            axis_names={axis},
+            check_vma=False,
+        )
+    )
+
+
 class ParallelEmbedding(nn.Module):
     """Embedding with the table sharded on the vocab dim (reference
     layers.py:154; the shard-on-embedding-dim variant maps to ``shard_dim=1``).
@@ -268,7 +302,7 @@ class ParallelEmbedding(nn.Module):
             (self.num_embeddings, self.features),
             self.param_dtype,
         )
-        y = jnp.take(table.astype(self.dtype), ids, axis=0)
+        y = self._lookup(table.astype(self.dtype), ids)
         if self.sequence_parallel_enabled and y.ndim >= 3:
             # hand off straight into SP layout: seq sharded over tp
             y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis, None))
@@ -277,3 +311,28 @@ class ParallelEmbedding(nn.Module):
         else:
             y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
         return y
+
+    def _lookup(self, table, ids):
+        """Vocab-sharded lookup as an explicit masked local gather + psum
+        (the reference's input-masking formulation, layers.py:154,:290),
+        inside a partial-manual shard_map over tp. Besides matching reference
+        semantics, this sidesteps an XLA SPMD-partitioner CHECK crash
+        (spmd_partitioner_util.cc:495, jaxlib 0.9) that the auto-partitioned
+        vocab-sharded gather triggers on meshes with pp > 1."""
+        tp = (
+            mesh_lib.get_tensor_model_parallel_size()
+            if mesh_lib.model_parallel_is_initialized()
+            else 1
+        )
+        if self.shard_dim != 0 or tp <= 1 or self.num_embeddings % tp != 0:
+            return jnp.take(table, ids, axis=0)
+        mesh = mesh_lib.get_mesh()
+        ctx_mesh = jax.sharding.get_abstract_mesh()
+        return _vocab_parallel_lookup(
+            mesh if ctx_mesh.empty else ctx_mesh,
+            self.axis,
+            # CPU backend: AllReducePromotion CHECK-crashes on bf16 all-reduces
+            # ("Invalid binary instruction opcode copy"), so psum in fp32
+            # there; on TPU the psum stays in the compute dtype (bandwidth)
+            jax.devices()[0].platform == "cpu",
+        )(table, ids)

@@ -30,7 +30,7 @@ def parallel_cross_entropy(
     m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
     shifted = logits - m
     lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
-    label_logit = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    label_logit = _select_label_logit(logits, labels)
     loss = lse - label_logit
     if label_smoothing > 0.0:
         # smoothed target: (1-eps) one-hot + eps/V uniform
@@ -39,6 +39,28 @@ def parallel_cross_entropy(
         mean_logit = jnp.mean(logits, axis=-1)
         loss = (1.0 - eps) * loss + eps * (lse - mean_logit)
     return loss
+
+
+def _select_label_logit(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """``logits[..., labels]`` as a masked reduction instead of a gather: each
+    vocab shard compares its global indices against the label and reduces —
+    the formulation the reference's masked-target trick uses
+    (loss_functions.py:60-77), which XLA partitions into a local reduce +
+    all-reduce (a gather over the sharded dim trips an SPMD-partitioner CHECK
+    on pp>1 meshes, spmd_partitioner_util.cc:495)."""
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    if (
+        not mesh_lib.model_parallel_is_initialized()
+        or mesh_lib.get_tensor_model_parallel_size() <= 1
+    ):
+        # unsharded vocab: the plain gather is cheapest on a single chip
+        return jnp.take_along_axis(
+            logits, labels[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+    idx = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    mask = idx == labels[..., None].astype(jnp.int32)
+    return jnp.sum(jnp.where(mask, logits, 0.0), axis=-1)
 
 
 def parallel_log_softmax(logits: jax.Array) -> jax.Array:
@@ -58,6 +80,4 @@ def from_parallel_logits_to_logprobs(
     scores targets[t+1] (reference loss_functions.py:206 shifts the same way).
     ``logits``: (B, S, V), ``targets``: (B, S) → returns (B, S-1)."""
     logp = parallel_log_softmax(logits[:, :-1, :])
-    return jnp.take_along_axis(
-        logp, targets[:, 1:, None].astype(jnp.int32), axis=-1
-    )[..., 0]
+    return _select_label_logit(logp, targets[:, 1:])

@@ -6,6 +6,7 @@ equivalent needed)."""
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Dict
 
 import jax
@@ -140,3 +141,71 @@ def pipeline_params_to_llama(pp_params: Dict[str, Any], engine: PipelineEngine):
             "lm_head": pp_params["head"]["lm_head"],
         }
     }
+
+
+@dataclasses.dataclass
+class LlamaPipelineAdapter:
+    """Plugs a scan-form Llama into the Trainer's pipeline path
+    (trainer/loop.py): builds the engine, converts params to the pipeline
+    layout, and produces the jitted train step. The reference analogue is
+    ``initialize_parallel_model``'s NxDPPModel wrap (trainer/trainer.py:147)
+    followed by ``NxDPPModel.run_train``."""
+
+    config: LlamaConfig
+    num_microbatches: int
+    attention_impl: str = "auto"
+    schedule: str = "1f1b"
+
+    def build_state_and_step(self, model, optimizer, rng_key, sample_ids,
+                             zero1: bool = True, max_grad_norm: float = 1.0):
+        import jax.numpy as jnp
+        from flax.core import meta
+
+        from neuronx_distributed_tpu.optim.zero1 import zero1_shardings_for_opt_state
+        from neuronx_distributed_tpu.trainer.trainer import (
+            TrainState,
+            build_train_step,
+        )
+
+        engine = llama_pipeline_engine(
+            self.config,
+            num_microbatches=self.num_microbatches,
+            attention_impl=self.attention_impl,
+            schedule=self.schedule,
+        )
+        boxed = jax.jit(model.init)(rng_key, sample_ids)
+        pp_sh = llama_pipeline_shardings(boxed, engine)
+        params = jax.device_put(
+            llama_params_to_pipeline({"params": meta.unbox(boxed)["params"]}, engine),
+            pp_sh,
+        )
+        specs = jax.tree.map(lambda s: s.spec, pp_sh)
+        opt_sh = zero1_shardings_for_opt_state(
+            jax.eval_shape(optimizer.init, params), params, specs, enabled=zero1
+        )
+        opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)(params)
+        step_kw = (
+            {"value_and_grad_fn": engine.value_and_grad}
+            if self.schedule == "1f1b"
+            else {"loss_fn": engine.loss_fn}
+        )
+        step = build_train_step(
+            model=None,
+            optimizer=optimizer,
+            params_shardings=pp_sh,
+            opt_state_shardings=opt_sh,
+            max_grad_norm=max_grad_norm,
+            **step_kw,
+        )
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+        )
+        return state, step, engine
+
+    def prepare_batch(self, batch):
+        from neuronx_distributed_tpu.pipeline.model import (
+            microbatch,
+            shard_microbatched_batch,
+        )
+
+        return shard_microbatched_batch(microbatch(batch, self.num_microbatches))

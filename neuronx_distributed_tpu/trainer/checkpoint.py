@@ -84,6 +84,20 @@ class BaseCheckpointStorage:
     def remove_checkpoint(self, tag: str) -> None:
         raise NotImplementedError
 
+    # --- tensor-plane path handling (orbax/tensorstore target) ---------------
+
+    def items_url(self, tag: str) -> str:
+        """Path/URI handed to orbax for the tensor payload of ``tag``."""
+        raise NotImplementedError
+
+    def prepare_tag(self, tag: str) -> None:
+        """Make the tag container writable and clear stale tensor payloads."""
+        raise NotImplementedError
+
+    def list_items(self, tag: str) -> List[str]:
+        """Names of saved item subtrees under ``tag`` (model, optimizer, ...)."""
+        raise NotImplementedError
+
 
 class FilesystemCheckpointStorage(BaseCheckpointStorage):
     """Local/NFS directory storage (reference checkpoint_storage.py:138)."""
@@ -123,18 +137,174 @@ class FilesystemCheckpointStorage(BaseCheckpointStorage):
     def remove_checkpoint(self, tag: str) -> None:
         shutil.rmtree(os.path.join(self._dirname, tag), ignore_errors=True)
 
+    def items_url(self, tag: str) -> str:
+        return os.path.abspath(os.path.join(self._dirname, tag, _ITEMS_DIRNAME))
+
+    def prepare_tag(self, tag: str) -> None:
+        tag_dir = os.path.join(self._dirname, tag)
+        os.makedirs(tag_dir, exist_ok=True)
+        items = os.path.join(tag_dir, _ITEMS_DIRNAME)
+        if os.path.exists(items):
+            shutil.rmtree(items)
+
+    def list_items(self, tag: str) -> List[str]:
+        target = self.items_url(tag)
+        return [
+            d for d in os.listdir(target) if os.path.isdir(os.path.join(target, d))
+        ]
+
+
+# Transient error classes worth retrying on object stores (reference:
+# tenacity retry on ClientError/slow-down, checkpoint_storage.py:236-330).
+_TRANSIENT_ERRORS: Tuple[type, ...] = (OSError, IOError, TimeoutError)
+
+
+def _with_retries(fn, what: str, max_attempts: int = 5,
+                  first_wait: float = 4.0, min_wait: float = 0.5):
+    """Reference ``wait_decrementing_with_jitter`` (checkpoint_storage.py:236):
+    retry on transient object-store errors with a DEcreasing jittered wait —
+    the first wait is longest (ride out a throttle burst), later waits shrink.
+    """
+    import random
+    import time as _time
+
+    last: Optional[BaseException] = None
+    for attempt in range(max_attempts):
+        try:
+            return fn()
+        except FileNotFoundError:
+            raise  # a missing object is a result, not a transient fault
+        except _TRANSIENT_ERRORS as e:  # noqa: PERF203
+            last = e
+            if attempt == max_attempts - 1:
+                break
+            wait = max(min_wait, first_wait / (attempt + 1))
+            wait *= 0.5 + random.random()  # jitter in [0.5, 1.5)·wait
+            logger.warning(
+                "%s failed (%s: %s) — retry %d/%d in %.1fs",
+                what, type(e).__name__, e, attempt + 1, max_attempts - 1, wait,
+            )
+            _time.sleep(wait)
+    raise last  # type: ignore[misc]
+
+
+class FsspecCheckpointStorage(BaseCheckpointStorage):
+    """Object-store / URI storage over fsspec (reference: the S3 storage with
+    CRT + tenacity retries, checkpoint_storage.py:236-330). Handles any
+    registered protocol — ``gs://`` (gcsfs), ``s3://`` (s3fs), ``file://``,
+    ``memory://`` — with the same tag/done/newest/retention semantics as the
+    filesystem backend and decrementing-jitter retries on transient errors.
+
+    The tensor payload goes to the SAME URI through orbax/tensorstore, which
+    speak ``gs://`` natively on TPU VMs; ``file://`` URIs are translated to
+    plain paths for orbax (tensorstore treats bare paths as local files).
+    """
+
+    def __init__(self, url: str):
+        import fsspec
+
+        super().__init__(url.rstrip("/"))
+        self._fs, self._root = fsspec.core.url_to_fs(self._dirname)
+        self._protocol = self._dirname.split("://", 1)[0]
+
+    def _path(self, filename: str) -> str:
+        return f"{self._root}/{filename}"
+
+    def file_exists(self, filename: str) -> bool:
+        return _with_retries(
+            lambda: self._fs.exists(self._path(filename)),
+            f"exists({filename})",
+        )
+
+    def file_mtime(self, filename: str) -> float:
+        def get():
+            info = self._fs.info(self._path(filename))
+            m = info.get("mtime") or info.get("LastModified") or 0.0
+            return m.timestamp() if hasattr(m, "timestamp") else float(m)
+
+        return _with_retries(get, f"mtime({filename})")
+
+    def remove_file(self, filename: str) -> None:
+        def rm():
+            p = self._path(filename)
+            if self._fs.exists(p):
+                self._fs.rm_file(p)
+
+        _with_retries(rm, f"remove({filename})")
+
+    def save_text(self, text: str, filename: str) -> None:
+        def put():
+            with self._fs.open(self._path(filename), "w") as f:
+                f.write(text)
+
+        _with_retries(put, f"save_text({filename})")
+
+    def load_text(self, filename: str) -> str:
+        def get():
+            with self._fs.open(self._path(filename), "r") as f:
+                return f.read()
+
+        return _with_retries(get, f"load_text({filename})")
+
+    def list_checkpoint_tags(self) -> List[str]:
+        def ls():
+            if not self._fs.exists(self._root):
+                return []
+            out = []
+            for info in self._fs.ls(self._root, detail=True):
+                if info.get("type") == "directory":
+                    out.append(info["name"].rstrip("/").rsplit("/", 1)[-1])
+            return sorted(out)
+
+        return _with_retries(ls, "list_tags")
+
+    def remove_checkpoint(self, tag: str) -> None:
+        def rm():
+            p = self._path(tag)
+            if self._fs.exists(p):
+                self._fs.rm(p, recursive=True)
+
+        _with_retries(rm, f"remove_checkpoint({tag})")
+
+    def items_url(self, tag: str) -> str:
+        url = f"{self._dirname}/{tag}/{_ITEMS_DIRNAME}"
+        if self._protocol == "file":
+            # orbax/tensorstore want a plain path for local files
+            return url[len("file://"):]
+        return url
+
+    def prepare_tag(self, tag: str) -> None:
+        def prep():
+            items = self._path(f"{tag}/{_ITEMS_DIRNAME}")
+            if self._fs.exists(items):
+                self._fs.rm(items, recursive=True)
+            # object stores have no real directories; makedirs where supported
+            try:
+                self._fs.makedirs(self._path(tag), exist_ok=True)
+            except Exception:
+                pass
+
+        _with_retries(prep, f"prepare_tag({tag})")
+
+    def list_items(self, tag: str) -> List[str]:
+        def ls():
+            target = self._path(f"{tag}/{_ITEMS_DIRNAME}")
+            return sorted(
+                info["name"].rstrip("/").rsplit("/", 1)[-1]
+                for info in self._fs.ls(target, detail=True)
+                if info.get("type") == "directory"
+            )
+
+        return _with_retries(ls, f"list_items({tag})")
+
 
 def create_checkpoint_storage(dirname: str) -> BaseCheckpointStorage:
-    """Reference: create_checkpoint_storage (checkpoint_storage.py) — S3 paths
-    would return an S3 storage; object stores are reached on TPU through
-    tensorstore/gcsfs URIs instead, so only the filesystem backend is native
-    here."""
-    if dirname.startswith("s3://") or dirname.startswith("gs://"):
-        raise NotImplementedError(
-            "object-store checkpointing: point orbax/tensorstore at the bucket "
-            "URI directly (gs:// works out of the box on TPU VMs); the tag/"
-            "done/retention layer currently supports filesystem paths"
-        )
+    """Reference: create_checkpoint_storage (checkpoint_storage.py:46) — local
+    paths get the filesystem backend; any ``scheme://`` URI (``gs://``,
+    ``s3://``, ``file://``, ``memory://``) goes through fsspec with
+    retry/backoff. TPU pods checkpoint to GCS: pass ``gs://bucket/run1``."""
+    if "://" in dirname:
+        return FsspecCheckpointStorage(dirname)
     return FilesystemCheckpointStorage(dirname)
 
 
@@ -314,18 +484,15 @@ def save_checkpoint(
     # see a half-written save as a corrupted tag.
     _IO_STATE.register(tag)
     try:
-        tag_dir = os.path.join(checkpoint_dir, tag)
-        os.makedirs(tag_dir, exist_ok=True)
         # Re-saving an existing tag: drop the stale done marker FIRST so a
         # crash mid-rewrite can never leave a half-written checkpoint that
         # still passes the done check.
         storage.remove_file(os.path.join(tag, DONE_MARKER))
+        storage.prepare_tag(tag)
         if user_content is not None:
             storage.save_text(json.dumps(user_content), os.path.join(tag, META_FILE))
 
-        target = os.path.abspath(os.path.join(tag_dir, _ITEMS_DIRNAME))
-        if os.path.exists(target):
-            shutil.rmtree(target)
+        target = storage.items_url(tag)
         # One Composite save → one tensorstore transaction for all items.
         args = ocp.args.Composite(
             **{k: ocp.args.StandardSave(v) for k, v in items.items()}
@@ -386,15 +553,14 @@ def load_checkpoint(
         tag = latest_checkpoint_tag(checkpoint_dir)
         if tag is None:
             raise FileNotFoundError(f"no completed checkpoint under {checkpoint_dir}")
-    tag_dir = os.path.join(checkpoint_dir, tag)
     if not storage.file_exists(os.path.join(tag, DONE_MARKER)):
         raise FileNotFoundError(f"checkpoint '{tag}' has no done marker (corrupted?)")
 
-    target = os.path.abspath(os.path.join(tag_dir, _ITEMS_DIRNAME))
+    target = storage.items_url(tag)
     item_names = (
         list(items_target.keys())
         if items_target is not None
-        else [d for d in os.listdir(target) if os.path.isdir(os.path.join(target, d))]
+        else storage.list_items(tag)
     )
 
     def _restore_arg(name: str):

@@ -132,9 +132,16 @@ class Trainer:
     callbacks: List[Callback] = dataclasses.field(default_factory=list)
     loss_fn: Optional[Callable] = None
     timeline: Optional[Timeline] = None
+    # Pipeline-parallel adapter (e.g. pipeline.llama.LlamaPipelineAdapter).
+    # When set, fit() builds the pipelined train step (GPipe scan or explicit
+    # 1F1B per the adapter's schedule) instead of the monolithic one — the
+    # reference's NxDPPModel wrap inside initialize_parallel_model
+    # (trainer/trainer.py:147).
+    pipeline: Optional[Any] = None
 
     step: int = 0
     state: Any = None
+    steps_run: int = 0  # steps executed by the last fit() (excludes resumed ones)
 
     def fit(
         self,
@@ -142,6 +149,7 @@ class Trainer:
         rng_key: jax.Array,
         max_steps: int,
         sample_batch: Optional[dict] = None,
+        resume_from: Optional[str] = None,
     ) -> dict:
         """Run ``max_steps`` over ``data_iter`` (an iterable of host batches
         with at least ``input_ids``/``labels``). Returns the last metrics."""
@@ -154,15 +162,43 @@ class Trainer:
         data_iter = iter(data_iter)
         first = sample_batch if sample_batch is not None else next(data_iter)
         optimizer = make_optimizer(self.optimizer_config)
-        self.state, p_sh, s_sh = create_train_state(
-            self.model, optimizer, rng_key, first["input_ids"],
-            zero1=self.optimizer_config.zero1,
-        )
-        train_step = build_train_step(
-            self.model, optimizer, p_sh, s_sh,
-            max_grad_norm=self.optimizer_config.max_grad_norm,
-            loss_fn=self.loss_fn,
-        )
+        if self.pipeline is not None:
+            self.state, train_step, _engine = self.pipeline.build_state_and_step(
+                self.model, optimizer, rng_key, first["input_ids"],
+                zero1=self.optimizer_config.zero1,
+                max_grad_norm=self.optimizer_config.max_grad_norm,
+            )
+            prepare = self.pipeline.prepare_batch
+        else:
+            self.state, p_sh, s_sh = create_train_state(
+                self.model, optimizer, rng_key, first["input_ids"],
+                zero1=self.optimizer_config.zero1,
+            )
+            train_step = build_train_step(
+                self.model, optimizer, p_sh, s_sh,
+                max_grad_norm=self.optimizer_config.max_grad_norm,
+                loss_fn=self.loss_fn,
+            )
+            prepare = shard_batch
+        if resume_from is not None:
+            from neuronx_distributed_tpu.trainer.checkpoint import (
+                latest_checkpoint_tag,
+                load_checkpoint,
+            )
+
+            if latest_checkpoint_tag(resume_from) is not None:
+                items, user_content, tag = load_checkpoint(
+                    resume_from,
+                    items_target={
+                        "model": self.state.params,
+                        "optimizer": self.state.opt_state,
+                    },
+                )
+                self.state = self.state.replace(
+                    params=items["model"], opt_state=items["optimizer"]
+                )
+                self.step = int((user_content or {}).get("step", 0))
+                logger.info("resumed from '%s' at step %d", tag, self.step)
         meter = ThroughputMeter(batch_size=first["input_ids"].shape[0])
         for cb in self.callbacks:
             cb.on_train_start(self)
@@ -173,8 +209,9 @@ class Trainer:
             batch = pending if pending is not None else next(data_iter)
             pending = None
             with tl.event("train_step"):
-                self.state, metrics = train_step(self.state, shard_batch(batch))
+                self.state, metrics = train_step(self.state, prepare(batch))
             self.step += 1
+            self.steps_run += 1
             metrics = dict(metrics)
             metrics["throughput_seq_s"] = meter.update()
             for cb in self.callbacks:

@@ -1,0 +1,29 @@
+"""Platform helpers (the TPU-stack analogue of the reference's
+``NXD_CPU_MODE`` switch, utils/__init__.py:6): force a virtual multi-device
+CPU backend for development/test runs on hosts without a TPU slice."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n_devices: int) -> None:
+    """Force JAX onto >= ``n_devices`` virtual CPU devices.
+
+    Must be called before the JAX backend initializes. Sets the
+    ``--xla_force_host_platform_device_count`` XLA flag (only effective
+    pre-init) and overrides the platform to CPU via ``jax.config`` — the env
+    var alone does not stick when a sitecustomize force-registers another
+    platform (the axon TPU relay does).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; caller sees whatever platform is up

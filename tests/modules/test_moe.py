@@ -150,8 +150,9 @@ def test_capacity_ep_sharded_matches_unsharded(routed):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_blockwise_ep_sharded_matches_golden(routed):
-    """blockwise on an ep=2(+tp=2) mesh — each rank grouped-matmuls its E/ep
+@pytest.mark.parametrize("ep,tp", [(2, 1), (2, 2), (4, 1)])
+def test_blockwise_ep_sharded_matches_golden(routed, ep, tp):
+    """blockwise on an ep(+tp) mesh — each rank grouped-matmuls its E/ep
     local experts over the rolled row segment, psum combine — == no-mesh
     golden (reference: blockwise NKI composes with EP, blockwise.py:434;
     round-1 raised ValueError here — VERDICT missing #4)."""
@@ -160,7 +161,7 @@ def test_blockwise_ep_sharded_matches_golden(routed):
     params = golden.init(jax.random.PRNGKey(7), x, top_e, top_w)
     ref = golden.apply(params, x, top_e, top_w)
     mesh_lib.initialize_model_parallel(
-        tensor_model_parallel_size=2, expert_model_parallel_size=2
+        tensor_model_parallel_size=tp, expert_model_parallel_size=ep
     )
     out = jax.jit(lambda p, xin: _mlps("blockwise").apply(p, xin, top_e, top_w))(
         params, x
@@ -168,12 +169,19 @@ def test_blockwise_ep_sharded_matches_golden(routed):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_blockwise_ep_grads_flow(routed):
-    """Grads must flow through the ep-sharded roll/psum combine."""
+@pytest.mark.parametrize("ep,tp", [(2, 1), (2, 2), (4, 1)])
+def test_blockwise_ep_grads_flow(routed, ep, tp):
+    """Grads must flow through the ep-sharded roll/psum combine — including
+    eager ``init`` under the mesh (round-2 red test: the eager shard_map impl
+    rejects partial-manual specs; the engine now jits the sharded matmul)."""
     x, top_e, top_w = routed
-    mesh_lib.initialize_model_parallel(expert_model_parallel_size=2)
+    mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=tp, expert_model_parallel_size=ep
+    )
     m = _mlps("blockwise")
     params = m.init(jax.random.PRNGKey(0), x, top_e, top_w)
+
+    golden = _mlps("blockwise")
 
     def loss(p, xin):
         return m.apply(p, xin, top_e, top_w).sum()
@@ -182,6 +190,14 @@ def test_blockwise_ep_grads_flow(routed):
     for leaf in jax.tree.leaves((gp, gx)):
         assert np.isfinite(np.asarray(leaf)).all()
         assert np.abs(np.asarray(leaf)).sum() > 0
+
+    # grads must match the no-mesh golden, not merely be finite
+    mesh_lib.destroy_model_parallel()
+    gp_ref, gx_ref = jax.grad(
+        lambda p, xin: golden.apply(p, xin, top_e, top_w).sum(), argnums=(0, 1)
+    )(params, x)
+    for a, b in zip(jax.tree.leaves((gp, gx)), jax.tree.leaves((gp_ref, gx_ref))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
 def test_selective_matches_all_experts(routed):
