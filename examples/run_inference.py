@@ -47,7 +47,9 @@ def parse_args(argv=None):
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--prompt-len", type=int, default=16)
     p.add_argument("--max-new-tokens", type=int, default=32)
-    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--temperature", type=float, default=None,
+                   help="sampling temperature (generate default 1.0; "
+                        "speculative default 0.0 = greedy)")
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--greedy", action="store_true", help="temperature-0 argmax")
@@ -117,9 +119,10 @@ def main(argv=None):
                 cfg.num_layers)
     params = meta.unbox(jax.jit(model.init)(key, prompt))
 
+    gen_temp = 1.0 if args.temperature is None else args.temperature
     gen_cfg = GenerationConfig(
         max_new_tokens=args.max_new_tokens,
-        temperature=0.0 if args.greedy else args.temperature,
+        temperature=0.0 if args.greedy else gen_temp,
         top_k=args.top_k,
         top_p=args.top_p,
     )
@@ -218,10 +221,12 @@ def main(argv=None):
         )
         draft = LlamaForCausalLM(draft_cfg, attention_impl=args.attention)
         draft_params = meta.unbox(jax.jit(draft.init)(key, prompt))
+        temp = 0.0 if (args.greedy or args.temperature is None) else args.temperature
         t0 = time.perf_counter()
         toks, accepted = speculative_generate(
             model, params, draft, draft_params, prompt,
             max_new_tokens=args.max_new_tokens, gamma=args.gamma,
+            temperature=temp, key=key if temp > 0 else None,
         )
         dt = time.perf_counter() - t0
         print(f"speculative: {args.max_new_tokens} tokens in {dt:.2f}s, "

@@ -56,8 +56,11 @@ def parse_args(argv=None):
     par.add_argument("--pp", type=int, default=1, help="pipeline parallel size")
     par.add_argument("--cp", type=int, default=1, help="context parallel size")
     par.add_argument("--sp", action="store_true", help="Megatron sequence parallel")
-    par.add_argument("--schedule", default="1f1b", choices=["gpipe", "1f1b"],
+    par.add_argument("--schedule", default="1f1b",
+                     choices=["gpipe", "1f1b", "interleaved"],
                      help="pipeline schedule (pp > 1)")
+    par.add_argument("--chunks", type=int, default=2,
+                     help="virtual chunks per rank (interleaved schedule)")
     par.add_argument("--microbatches", type=int, default=4,
                      help="pipeline microbatches (pp > 1)")
 
@@ -208,6 +211,7 @@ def main(argv=None):
             num_microbatches=args.microbatches,
             attention_impl=args.attention,
             schedule=args.schedule,
+            num_chunks=args.chunks if args.schedule == "interleaved" else 1,
         )
 
     callbacks = [MetricsLogger(log_every=args.log_every,

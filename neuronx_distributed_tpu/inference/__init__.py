@@ -8,6 +8,15 @@ in :mod:`generate`.
 """
 
 from neuronx_distributed_tpu.inference.generate import GenerationConfig, generate
+from neuronx_distributed_tpu.inference.medusa import medusa_generate
 from neuronx_distributed_tpu.inference.model_builder import ModelBuilder, NxDModel
+from neuronx_distributed_tpu.inference.speculative import speculative_generate
 
-__all__ = ["GenerationConfig", "generate", "ModelBuilder", "NxDModel"]
+__all__ = [
+    "GenerationConfig",
+    "generate",
+    "medusa_generate",
+    "ModelBuilder",
+    "NxDModel",
+    "speculative_generate",
+]

@@ -62,10 +62,14 @@ def generate(
             top_p=cfg.top_p,
         )
 
+    def _logits(out):
+        # MoE families return (logits, aux_losses); dense families bare logits
+        return out[0] if isinstance(out, tuple) else out
+
     @jax.jit
     def _prefill(params, ids, key):
-        logits, variables = prefill.apply(params, ids, mutable=["cache"])
-        tok = _sample(logits[:, -1], key)
+        out, variables = prefill.apply(params, ids, mutable=["cache"])
+        tok = _sample(_logits(out)[:, -1], key)
         return tok, variables["cache"]
 
     @jax.jit
@@ -73,10 +77,10 @@ def generate(
         def step(carry, _):
             cache, tok, key, done = carry
             key, sub = jax.random.split(key)
-            logits, variables = decode.apply(
+            out, variables = decode.apply(
                 {**params, "cache": cache}, tok[:, None], mutable=["cache"]
             )
-            nxt = _sample(logits[:, -1], sub)
+            nxt = _sample(_logits(out)[:, -1], sub)
             if cfg.eos_token_id is not None:
                 nxt = jnp.where(done, cfg.eos_token_id, nxt)
                 done = done | (nxt == cfg.eos_token_id)

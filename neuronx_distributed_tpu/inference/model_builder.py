@@ -32,6 +32,7 @@ class _Entry:
     bucket_args: List[Tuple[Any, ...]]  # example args, ascending bucket size
     bucket_dim: int  # which dim of args[route_argnum] routes buckets
     route_argnum: int
+    unpad: Optional[Callable] = None
 
 
 class NxDModel:
@@ -41,19 +42,26 @@ class NxDModel:
     def __init__(self):
         self._compiled: Dict[str, List[Tuple[int, Callable]]] = {}
         self._route: Dict[str, Tuple[int, int]] = {}
+        self._unpad: Dict[str, Optional[Callable]] = {}
 
-    def add_compiled(self, key, bucket_size, call, bucket_dim, route_argnum):
+    def add_compiled(self, key, bucket_size, call, bucket_dim, route_argnum,
+                     unpad: Optional[Callable] = None):
         self._compiled.setdefault(key, []).append((bucket_size, call))
         self._compiled[key].sort(key=lambda t: t[0])
         self._route[key] = (bucket_dim, route_argnum)
+        self._unpad[key] = unpad
 
     def buckets(self, key) -> List[int]:
         return [b for b, _ in self._compiled[key]]
 
     def __call__(self, key: str, *args):
         """Route to the smallest bucket that fits, right-padding the routed
-        dim; outputs keep the bucket shape (callers slice as needed —
-        matching the reference's bucketed semantics)."""
+        dim. With an ``unpad`` callback registered for the key (ModelBuilder
+        ``add(..., unpad=...)``), outputs are mapped back to the caller's
+        original size: ``unpad(outputs, original_size)``; without one,
+        outputs keep the bucket shape (the reference's raw bucketed
+        semantics — round-2 weak #8 flagged this as a sharp edge, hence the
+        explicit opt-in contract)."""
         bucket_dim, route_argnum = self._route[key]
         size = args[route_argnum].shape[bucket_dim]
         for bucket_size, call in self._compiled[key]:
@@ -64,7 +72,11 @@ class NxDModel:
                     pad = [(0, 0)] * a.ndim
                     pad[bucket_dim] = (0, bucket_size - size)
                     args[route_argnum] = jnp.pad(a, pad)
-                return call(*args)
+                out = call(*args)
+                unpad = self._unpad.get(key)
+                if unpad is not None and size < bucket_size:
+                    out = unpad(out, size)
+                return out
         raise ValueError(
             f"input size {size} exceeds largest bucket "
             f"{self._compiled[key][-1][0]} for model key {key!r}"
@@ -86,10 +98,13 @@ class ModelBuilder:
         bucket_args: Sequence[Tuple[Any, ...]],
         bucket_dim: int = -1,
         route_argnum: int = 0,
+        unpad: Optional[Callable] = None,
     ) -> "ModelBuilder":
         """Register ``fn`` with one example-args tuple per bucket (reference
         add:158 — e.g. key "context_encode" with seq buckets 128/512/2048 and
-        key "token_gen" with a single decode bucket)."""
+        key "token_gen" with a single decode bucket). ``unpad(outputs,
+        original_size)`` maps bucket-shaped outputs back to the caller's
+        size (e.g. ``lambda out, n: out[:, :n]`` for per-position logits)."""
         sizes = [a[route_argnum].shape[bucket_dim] for a in bucket_args]
         order = sorted(range(len(sizes)), key=lambda i: sizes[i])
         self._entries[key] = _Entry(
@@ -97,6 +112,7 @@ class ModelBuilder:
             bucket_args=[tuple(bucket_args[i]) for i in order],
             bucket_dim=bucket_dim,
             route_argnum=route_argnum,
+            unpad=unpad,
         )
         return self
 
@@ -112,7 +128,8 @@ class ModelBuilder:
                 compiled = jitted.lower(*args).compile()
                 logger.info("compiled %s bucket=%d", key, size)
                 model.add_compiled(
-                    key, size, compiled, entry.bucket_dim, entry.route_argnum
+                    key, size, compiled, entry.bucket_dim, entry.route_argnum,
+                    unpad=entry.unpad,
                 )
         return model
 

@@ -52,12 +52,20 @@ def speculative_generate(
     prompt_ids: jax.Array,
     max_new_tokens: int,
     gamma: int = 4,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
 ) -> Tuple[jax.Array, float]:
-    """Greedy speculative decoding. Returns ``(tokens (B, max_new_tokens),
-    mean_accepted_per_round)``. Batch size 1 recommended (acceptance lengths
-    diverge across a batch; per-row bookkeeping is future work — reference
-    speculative example is also B=1)."""
+    """Speculative decoding. ``temperature=0`` is greedy; ``temperature>0``
+    runs the exact speculative-SAMPLING acceptance rule (accept draft token x
+    with prob ``min(1, p_target(x)/p_draft(x))``, resample rejections from
+    ``norm(max(0, p_t − p_d))`` — the output distribution equals sampling the
+    target directly; round-2 weak #6 flagged the greedy-only gap). Returns
+    ``(tokens (B, max_new_tokens), mean_accepted_per_round)``. Batch size 1
+    (acceptance lengths diverge across a batch — reference speculative
+    example is also B=1)."""
     assert prompt_ids.shape[0] == 1, "speculative decoding supports B=1"
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampled speculative decoding needs a PRNG key")
     # Past max_seq_len the cache write index and RoPE position gather clamp
     # silently, corrupting output — same guard as generate.py. The last round
     # may score a gamma-token window starting at most max_new_tokens-1 past
@@ -77,26 +85,46 @@ def speculative_generate(
     d_prefill = draft_model.clone(mode="prefill")
     d_decode = draft_model.clone(mode="decode")
 
+    def _logits(out):
+        # MoE families return (logits, aux_losses); dense families bare logits
+        return out[0] if isinstance(out, tuple) else out
+
+    sampled = temperature > 0.0
+
     @jax.jit
-    def _prefills(tp, dp, ids):
+    def _prefills(tp, dp, ids, k):
         t_logits, t_vars = t_prefill.apply(tp, ids, mutable=["cache"])
         d_logits, d_vars = d_prefill.apply(dp, ids, mutable=["cache"])
-        first = jnp.argmax(t_logits[:, -1], -1).astype(jnp.int32)
+        t_logits = _logits(t_logits)
+        if sampled:
+            first = jax.random.categorical(
+                k, t_logits[:, -1] / temperature, axis=-1
+            ).astype(jnp.int32)
+        else:
+            first = jnp.argmax(t_logits[:, -1], -1).astype(jnp.int32)
         return first, t_vars["cache"], d_vars["cache"]
 
     @jax.jit
-    def _round(tp, dp, t_cache, d_cache, last_tok, base_pos):
+    def _round(tp, dp, t_cache, d_cache, last_tok, base_pos, k):
         # draft proposes gamma tokens from its own cache
         d_cache = _set_cache_index(d_cache, base_pos)
         draft_toks = []
+        d_logit_rows = []
         tok = last_tok
-        for _ in range(gamma):
+        for i in range(gamma):
             logits, d_vars = d_decode.apply(
                 {**dp, "cache": d_cache}, tok[:, None], mutable=["cache"]
             )
+            logits = _logits(logits)
             d_cache = d_vars["cache"]
-            tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+            if sampled:
+                tok = jax.random.categorical(
+                    jax.random.fold_in(k, i), logits[:, -1] / temperature, -1
+                ).astype(jnp.int32)
+            else:
+                tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
             draft_toks.append(tok)
+            d_logit_rows.append(logits[0, -1])
         draft = jnp.stack(draft_toks, 1)  # (1, gamma)
 
         # target scores [last_tok, d_1..d_{gamma-1}] + bonus position in one
@@ -106,40 +134,65 @@ def speculative_generate(
         t_logits, t_vars = t_decode.apply(
             {**tp, "cache": t_cache}, window, mutable=["cache"]
         )
+        t_logits = _logits(t_logits)
         t_cache = t_vars["cache"]
-        target_pred = jnp.argmax(t_logits, -1).astype(jnp.int32)  # (1, gamma)
 
-        # accept longest prefix where draft == target greedy
-        matches = draft == target_pred  # (1, gamma)
-        n_acc = jnp.argmin(
-            jnp.concatenate([matches, jnp.zeros((1, 1), bool)], 1), axis=1
-        )[0]  # first mismatch index == number accepted
-        # emitted tokens this round: accepted drafts + the target's token at
-        # the first mismatch (correction) — total n_acc + 1
-        out = jnp.where(
-            jnp.arange(gamma) < n_acc, draft[0], 0
-        )
-        corrected = target_pred[0, jnp.minimum(n_acc, gamma - 1)]
+        idx = jnp.arange(gamma)
+        if sampled:
+            # exact speculative sampling (Leviathan et al.): accept d_i with
+            # prob min(1, p_t/p_d); first rejection resamples from the
+            # normalized positive residual
+            t_probs = jax.nn.softmax(t_logits[0] / temperature, -1)  # (g, V)
+            d_probs = jax.nn.softmax(
+                jnp.stack(d_logit_rows) / temperature, -1
+            )  # (g, V)
+            p_t = t_probs[idx, draft[0]]
+            p_d = d_probs[idx, draft[0]]
+            u = jax.random.uniform(jax.random.fold_in(k, 1000), (gamma,))
+            accepted = u < jnp.minimum(1.0, p_t / jnp.maximum(p_d, 1e-20))
+            n_acc = jnp.argmin(
+                jnp.concatenate([accepted, jnp.zeros((1,), bool)])
+            ).astype(jnp.int32)
+            rej = jnp.minimum(n_acc, gamma - 1)
+            residual = jnp.maximum(t_probs[rej] - d_probs[rej], 0.0)
+            residual = jnp.where(
+                residual.sum() > 0, residual, t_probs[rej]
+            )
+            corrected = jax.random.categorical(
+                jax.random.fold_in(k, 2000), jnp.log(residual + 1e-30)
+            ).astype(jnp.int32)
+        else:
+            target_pred = jnp.argmax(t_logits, -1).astype(jnp.int32)  # (1, g)
+            matches = draft == target_pred
+            n_acc = jnp.argmin(
+                jnp.concatenate([matches, jnp.zeros((1, 1), bool)], 1), axis=1
+            )[0]  # first mismatch index == number accepted
+            corrected = target_pred[0, jnp.minimum(n_acc, gamma - 1)]
+
+        # emitted tokens this round: accepted drafts + the correction at the
+        # first rejection — total n_acc + 1 (full acceptance: the gamma
+        # drafts, with the NEXT round re-feeding the last one)
+        out = jnp.where(idx < n_acc, draft[0], 0)
         out = out.at[jnp.minimum(n_acc, gamma - 1)].set(
             jnp.where(n_acc < gamma, corrected, draft[0, gamma - 1])
         )
-        # when all gamma accepted, the gamma-th row's prediction is a bonus
-        # token — but its K/V write is position base+gamma-1's; emitting it
-        # requires no extra compute, the NEXT round re-feeds it as last_tok
-        next_tok = jnp.where(n_acc < gamma, corrected, target_pred[0, gamma - 1])
+        next_tok = jnp.where(n_acc < gamma, corrected, draft[0, gamma - 1])
         return t_cache, d_cache, out, n_acc, next_tok[None]
 
+    key = key if key is not None else jax.random.PRNGKey(0)
+    key, k0 = jax.random.split(key)
     first, t_cache, d_cache = _prefills(
-        dict(target_params), dict(draft_params), prompt_ids
+        dict(target_params), dict(draft_params), prompt_ids, k0
     )
     tokens = [int(first[0])]
     base = prompt_ids.shape[1]
     last = first
     rounds, accepted_total = 0, 0
     while len(tokens) < max_new_tokens:
+        key, kr = jax.random.split(key)
         t_cache, d_cache, out, n_acc, last = _round(
             dict(target_params), dict(draft_params), t_cache, d_cache, last,
-            jnp.asarray(base, jnp.int32),
+            jnp.asarray(base, jnp.int32), kr,
         )
         n = int(n_acc)
         emitted = [int(v) for v in out[: min(n + 1, gamma)]]

@@ -41,7 +41,7 @@ def _pick_block(s: int, preferred: int = 512) -> int:
 
 # --- forward ------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, causal, scale, block_q, block_k, num_k_blocks):
+def _fwd_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, causal, scale, block_q, block_k, num_k_blocks, dyn_offsets):
     i = pl.program_id(2)  # q block
     j = pl.program_id(3)  # k block
 
@@ -51,8 +51,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: skip K blocks entirely above the diagonal
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    # causal: skip K blocks entirely above the diagonal. With dynamic global
+    # offsets (ring attention: this shard's rows start at q_off, the visiting
+    # K/V shard's at k_off) the skip test moves to runtime — a fully-future
+    # K shard skips every block, leaving l = 0 → lse ≈ -inf, which the ring
+    # merge treats as a zero contribution.
+    q_off = q_off_ref[0] if dyn_offsets else 0
+    k_off = k_off_ref[0] if dyn_offsets else 0
+    run = (
+        (k_off + j * block_k <= q_off + i * block_q + block_q - 1)
+        if causal
+        else True
+    )
 
     @pl.when(run)
     def _body():
@@ -63,8 +73,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                      # (BQ, BK)
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q + q_off
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k + k_off
             s = jnp.where(rows >= cols, s, NEG_INF)
         m_prev = m_scr[:]                              # (BQ, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -83,19 +93,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, c
         lse_ref[0, 0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool):
+def _off_arr(off) -> jax.Array:
+    return jnp.asarray(off, jnp.int32).reshape((1,))
+
+
+_SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool,
+               q_off=None, k_off=None):
+    """Forward kernel call. ``q_off``/``k_off`` are dynamic global position
+    offsets for the causal mask (ring attention); None compiles the static
+    zero-offset fast path."""
     b, h, s, d = q.shape
     sk = k.shape[2]
     nq, nk = s // block_q, sk // block_k
     scale = 1.0 / (d ** 0.5)
+    dyn = q_off is not None or k_off is not None
     kernel = functools.partial(
         _fwd_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk, dyn_offsets=dyn,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
         in_specs=[
+            _SMEM_SPEC,
+            _SMEM_SPEC,
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
@@ -117,14 +141,18 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int, interpret: boo
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(
+        _off_arr(q_off if q_off is not None else 0),
+        _off_arr(k_off if k_off is not None else 0),
+        q, k, v,
+    )
     return out, lse
 
 
 # --- backward -----------------------------------------------------------------
 
-def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                 dk_scr, dv_scr, *, causal, scale, block_q, block_k, num_q_blocks):
+def _dkdv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                 dk_scr, dv_scr, *, causal, scale, block_q, block_k, num_q_blocks, dyn_offsets):
     j = pl.program_id(2)  # k block
     i = pl.program_id(3)  # q block (sequential)
 
@@ -133,7 +161,13 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+    q_off = q_off_ref[0] if dyn_offsets else 0
+    k_off = k_off_ref[0] if dyn_offsets else 0
+    run = (
+        (q_off + i * block_q + block_q - 1 >= k_off + j * block_k)
+        if causal
+        else True
+    )
 
     @pl.when(run)
     def _body():
@@ -147,8 +181,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                                       # (BQ, BK)
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q + q_off
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k + k_off
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                            # (BQ, BK)
         dv_scr[:] += jax.lax.dot_general(
@@ -168,8 +202,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-               *, causal, scale, block_q, block_k, num_k_blocks):
+def _dq_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+               *, causal, scale, block_q, block_k, num_k_blocks, dyn_offsets):
     i = pl.program_id(2)  # q block
     j = pl.program_id(3)  # k block (sequential)
 
@@ -177,7 +211,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    q_off = q_off_ref[0] if dyn_offsets else 0
+    k_off = k_off_ref[0] if dyn_offsets else 0
+    run = (
+        (k_off + j * block_k <= q_off + i * block_q + block_q - 1)
+        if causal
+        else True
+    )
 
     @pl.when(run)
     def _body():
@@ -191,8 +231,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + i * block_q + q_off
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + j * block_k + k_off
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
@@ -208,27 +248,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(res, g, causal: bool, block_q: int, block_k: int, interpret: bool):
-    q, k, v, o, lse = res
+def _flash_dkdv(q, k, v, g, lse, delta, causal, block_q, block_k, interpret,
+                q_off=None, k_off=None):
     b, h, s, d = q.shape
     sk = k.shape[2]
     nq, nk = s // block_q, sk // block_k
     scale = 1.0 / (d ** 0.5)
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,S,1)
-
-    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, x, y: (b_, h_, x, 0))
-    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, x, y: (b_, h_, y, 0))
-    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, x, y: (b_, h_, x, 0))
-
+    dyn = q_off is not None or k_off is not None
     # dK/dV: grid over k blocks, q sequential — q-indexed inputs use the LAST
     # grid dim, k-indexed the third.
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkdv_kernel, causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k, num_q_blocks=nq,
+            block_q=block_q, block_k=block_k, num_q_blocks=nq, dyn_offsets=dyn,
         ),
         grid=(b, h, nk, nq),
         in_specs=[
+            _SMEM_SPEC,
+            _SMEM_SPEC,
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0)),  # q
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),  # k
             pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0)),  # v
@@ -252,15 +289,31 @@ def _flash_bwd(res, g, causal: bool, block_q: int, block_k: int, interpret: bool
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(
+        _off_arr(q_off if q_off is not None else 0),
+        _off_arr(k_off if k_off is not None else 0),
+        q, k, v, g, lse, delta,
+    )
+    return dk, dv
 
+
+def _flash_dq(q, k, v, g, lse, delta, causal, block_q, block_k, interpret,
+              q_off=None, k_off=None):
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    nq, nk = s // block_q, sk // block_k
+    scale = 1.0 / (d ** 0.5)
+    dyn = q_off is not None or k_off is not None
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, x, y: (b_, h_, x, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, x, y: (b_, h_, y, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, x, y: (b_, h_, x, 0))
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k, num_k_blocks=nk,
+            block_q=block_q, block_k=block_k, num_k_blocks=nk, dyn_offsets=dyn,
         ),
         grid=(b, h, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=[_SMEM_SPEC, _SMEM_SPEC, qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -268,7 +321,19 @@ def _flash_bwd(res, g, causal: bool, block_q: int, block_k: int, interpret: bool
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(
+        _off_arr(q_off if q_off is not None else 0),
+        _off_arr(k_off if k_off is not None else 0),
+        q, k, v, g, lse, delta,
+    )
+    return dq
+
+
+def _flash_bwd(res, g, causal: bool, block_q: int, block_k: int, interpret: bool):
+    q, k, v, o, lse = res
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,S,1)
+    dk, dv = _flash_dkdv(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
+    dq = _flash_dq(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
     return dq, dk, dv
 
 

@@ -25,10 +25,17 @@ The per-step function is ``jax.checkpoint``-ed: the backward pass re-runs the
 ring rather than storing every block's scores — the standard memory trade that
 makes ring attention long-context viable.
 
-Known perf gap (tracked): the per-block attention materializes the
-(S_local x S_local) score tile in fp32 XLA ops rather than calling the Pallas
-flash kernel per block; wiring position offsets through the flash kernel's
-causal mask is the planned fix.
+Two per-block engines:
+
+* ``impl="xla"`` — fp32 einsum blocks (the numerics golden, and the CPU path);
+* ``impl="flash"`` — the Pallas flash kernel per ring step, with this shard's
+  global row offset and the visiting shard's column offset fed into the
+  kernel's causal mask (reference intent: the NKI ring kernel fuses flash
+  tiles with the ring, ring_attention_kernel.py:141). The merge across steps
+  uses the (out, lse) pairs; the backward re-runs the ring with the kernel's
+  dK/dV + dQ tiles, rotating the dK/dV accumulators home with the K/V shards.
+
+``impl="auto"`` picks flash on TPU, xla elsewhere.
 """
 
 from __future__ import annotations
@@ -48,14 +55,17 @@ logger = get_logger(__name__)
 _NEG_INF = -1e30
 
 
-def _block_attn(qt, kt, vt, q_pos, k_pos, causal):
+def _block_attn(qt, kt, vt, q_pos, k_pos, causal, mask=None):
     """One blockwise attention partial: qt (B, Hkv, G, Sq, D) × kt/vt
-    (B, Hkv, Sk, D) → unnormalized (num, m, l) accumulator pieces."""
+    (B, Hkv, Sk, D) → unnormalized (num, m, l) accumulator pieces.
+    ``mask`` (Sq, Sk) overrides the positional causal mask (tree attention)."""
     d = qt.shape[-1]
     scores = jnp.einsum(
         "bhgqd,bhkd->bhgqk", qt.astype(jnp.float32), kt.astype(jnp.float32)
     ) / jnp.sqrt(jnp.float32(d))
-    if causal:
+    if mask is not None:
+        scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    elif causal:
         mask = q_pos[:, None] >= k_pos[None, :]
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     m = scores.max(-1)  # (B, Hkv, G, Sq)
@@ -77,6 +87,167 @@ def _combine(acc, m_run, l_run, num, m_blk, l_blk):
     acc = acc * scale_run[..., None] + num * scale_blk[..., None]
     l_new = l_run * scale_run + l_blk * scale_blk
     return acc, m_new, l_new
+
+
+# --- flash-kernel ring engine -------------------------------------------------
+
+
+def _merge_lse(out, lse, o_j, lse_j):
+    """Merge two (out, lse) flash partials: out_i are each normalized by their
+    own softmax sum, so the exact combine is exp-weighted by lse. Fully-masked
+    partials carry lse ≈ -inf and contribute zero."""
+    m = jnp.maximum(lse, lse_j)
+    safe = jnp.where(m > _NEG_INF / 2, m, 0.0)
+    w1 = jnp.where(lse > _NEG_INF / 2, jnp.exp(lse - safe), 0.0)
+    w2 = jnp.where(lse_j > _NEG_INF / 2, jnp.exp(lse_j - safe), 0.0)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    out_new = (out * w1 + o_j.astype(out.dtype) * w2) / denom
+    lse_new = safe + jnp.log(denom)
+    lse_new = jnp.where(m > _NEG_INF / 2, lse_new, _NEG_INF)
+    return out_new, lse_new
+
+
+def _ring_flash_fwd_pass(q, k, v, axis_name, bq, bk, interpret):
+    """Forward ring with the Pallas kernel per step. q (B, S, H, D) local,
+    k/v (B, S, Hkv, D) local. Returns (out (B,S,H,D), lse (B,H,S,1))."""
+    from neuronx_distributed_tpu.kernels.flash_attention import _flash_fwd
+
+    cp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    hkv = k.shape[2]
+    rep = h // hkv
+    qt = jnp.swapaxes(q, 1, 2)  # (B, H, S, D)
+
+    def kv_t(x):
+        # repeat ON ARRIVAL so ring traffic stays at Hkv heads
+        return jnp.swapaxes(jnp.repeat(x, rep, axis=2), 1, 2)
+
+    q_off = rank * s_loc
+    out, lse = _flash_fwd(
+        qt, kv_t(k), kv_t(v), True, bq, bk, interpret,
+        q_off=q_off, k_off=q_off,
+    )
+    out = out.astype(jnp.float32)
+    if cp > 1:
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+        def step(carry, t):
+            k_c, v_c, out, lse = carry
+            k_c = lax.ppermute(k_c, axis_name, perm)
+            v_c = lax.ppermute(v_c, axis_name, perm)
+            j = (rank - t) % cp
+            o_j, lse_j = _flash_fwd(
+                qt, kv_t(k_c), kv_t(v_c), True, bq, bk, interpret,
+                q_off=q_off, k_off=j * s_loc,
+            )
+            out, lse = _merge_lse(out, lse, o_j, lse_j)
+            return (k_c, v_c, out, lse), None
+
+        (_, _, out, lse), _ = lax.scan(
+            step, (k, v, out, lse), jnp.arange(1, cp)
+        )
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, bq, bk, interpret):
+    out, _ = _ring_flash_fwd_pass(q, k, v, axis_name, bq, bk, interpret)
+    return out
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, bq, bk, interpret):
+    out, lse = _ring_flash_fwd_pass(q, k, v, axis_name, bq, bk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, bq, bk, interpret, res, g):
+    """Backward ring: dQ accumulates locally; dK/dV tiles are computed for the
+    visiting shard and travel onward WITH it — after the full rotation each
+    accumulator arrives back at its owner."""
+    from neuronx_distributed_tpu.kernels.flash_attention import (
+        _flash_dkdv,
+        _flash_dq,
+    )
+
+    q, k, v, out, lse = res
+    cp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    hkv = k.shape[2]
+    rep = h // hkv
+    qt = jnp.swapaxes(q, 1, 2)
+    gt = jnp.swapaxes(g, 1, 2)
+    ot = jnp.swapaxes(out, 1, 2)
+    delta = jnp.sum(
+        gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    q_off = rank * s_loc
+
+    def kv_t(x):
+        return jnp.swapaxes(jnp.repeat(x, rep, axis=2), 1, 2)
+
+    def fold_kv(dx):
+        # (B, H, S, D) repeated-head grads → (B, S, Hkv, D)
+        dx = dx.reshape(b, hkv, rep, s_loc, d).sum(2)
+        return jnp.swapaxes(dx, 1, 2)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, t):
+        k_c, v_c, dk_c, dv_c, dq = carry
+        j = (rank - t) % cp
+        k_rep, v_rep = kv_t(k_c), kv_t(v_c)
+        dq_j = _flash_dq(
+            qt, k_rep, v_rep, gt, lse, delta, True, bq, bk, interpret,
+            q_off=q_off, k_off=j * s_loc,
+        )
+        dk_j, dv_j = _flash_dkdv(
+            qt, k_rep, v_rep, gt, lse, delta, True, bq, bk, interpret,
+            q_off=q_off, k_off=j * s_loc,
+        )
+        dq = dq + dq_j.astype(jnp.float32)
+        dk_c = dk_c + fold_kv(dk_j.astype(jnp.float32))
+        dv_c = dv_c + fold_kv(dv_j.astype(jnp.float32))
+        if cp > 1:
+            k_c = lax.ppermute(k_c, axis_name, perm)
+            v_c = lax.ppermute(v_c, axis_name, perm)
+            dk_c = lax.ppermute(dk_c, axis_name, perm)
+            dv_c = lax.ppermute(dv_c, axis_name, perm)
+        return (k_c, v_c, dk_c, dv_c, dq), None
+
+    init = (
+        k,
+        v,
+        jnp.zeros(k.shape, jnp.float32),
+        jnp.zeros(v.shape, jnp.float32),
+        jnp.zeros(qt.shape, jnp.float32),
+    )
+    (_, _, dk, dv, dq), _ = lax.scan(step, init, jnp.arange(cp))
+    dq = jnp.swapaxes(dq, 1, 2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = mesh_lib.CP_AXIS,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Causal ring attention with the Pallas flash kernel per ring step —
+    call inside ``shard_map`` with seq sharded over ``axis_name``
+    (the kernel path of :func:`ring_attention_sharded`)."""
+    from neuronx_distributed_tpu.kernels.flash_attention import _pick_block
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    s_loc = q.shape[1]
+    bq = bk = _pick_block(s_loc, 256)
+    return _ring_flash(q, k, v, axis_name, bq, bk, interpret)
 
 
 def ring_attention(
@@ -139,10 +310,18 @@ def ring_attention_sharded(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
+    impl: str = "auto",
 ) -> jax.Array:
     """Ring attention on GLOBAL (B, S, H, D) arrays: wraps the shard_map with
     sequence over cp, batch over the data axes, heads over tp (the layout the
-    reference's CP groups + flash-decoding KV groups imply)."""
+    reference's CP groups + flash-decoding KV groups imply).
+
+    ``impl``: "flash" (Pallas kernel per ring step), "xla" (fp32 einsum
+    blocks), or "auto" (flash on TPU). Causal sequences not divisible by cp
+    are right-PADDED to the next multiple — padded keys sit at positions
+    after every real query, so the causal mask already excludes them (the
+    round-2 fallback replicated the whole sequence instead, an OOM at the
+    context lengths cp exists for)."""
     if not mesh_lib.model_parallel_is_initialized():
         # no mesh: single block, plain attention
         return ring_attention_reference(q, k, v, causal)
@@ -152,16 +331,22 @@ def ring_attention_sharded(
     dp = mesh.shape[mesh_lib.EDP_AXIS] * mesh.shape[mesh_lib.EP_AXIS]
     tp = mesh.shape[mesh_lib.TP_AXIS]
     cp = mesh.shape[mesh_lib.CP_AXIS]
-    if cp > 1 and s % cp != 0:
-        # a partial ring would mis-assign global positions → silently wrong
-        # attention; fall back to the exact single-block path
+    if impl == "auto":
+        impl = "flash" if jax.devices()[0].platform == "tpu" else "xla"
+    if impl == "flash" and not causal:
+        impl = "xla"  # the kernel ring is causal-only; xla blocks are exact
+    pad = (-s) % cp if cp > 1 else 0
+    if pad and not causal:
+        # padded keys would receive non-causal attention weight → the exact
+        # unsharded path is the only correct fallback here
         logger.warning(
-            "ring attention: seq len %d not divisible by cp=%d; "
-            "falling back to unsharded attention",
-            s,
-            cp,
+            "ring attention: non-causal seq len %d not divisible by cp=%d; "
+            "falling back to unsharded attention", s, cp,
         )
         return ring_attention_reference(q, k, v, causal)
+    if pad:
+        cfg = [(0, 0), (0, pad), (0, 0), (0, 0)]
+        q, k, v = jnp.pad(q, cfg), jnp.pad(k, cfg), jnp.pad(v, cfg)
     bspec = mesh_lib.DATA_AXES if (dp > 1 and b % dp == 0) else None
     # q and kv heads shard over tp only when BOTH divide: the per-block GQA
     # grouping requires each shard's q-head slice to align with its kv slice
@@ -170,12 +355,17 @@ def ring_attention_sharded(
     sspec = mesh_lib.CP_AXIS if cp > 1 else None
     qspec = P(bspec, sspec, hspec, None)
     kvspec = P(bspec, sspec, hspec, None)
+    if impl == "flash":
+        local_fn = partial(ring_flash_attention, axis_name=mesh_lib.CP_AXIS)
+    else:
+        local_fn = partial(ring_attention, causal=causal, axis_name=mesh_lib.CP_AXIS)
     fn = mesh_lib.manual_shard_map(
-        partial(ring_attention, causal=causal, axis_name=mesh_lib.CP_AXIS),
+        local_fn,
         in_specs=(qspec, kvspec, kvspec),
         out_specs=qspec,
     )
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out[:, :s] if pad else out
 
 
 def ring_attention_reference(q, k, v, causal=True):

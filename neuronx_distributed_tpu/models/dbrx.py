@@ -77,15 +77,16 @@ class DbrxBlock(nn.Module):
     config: DbrxConfig
     attention_impl: str = "auto"
     deterministic: bool = True
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, x, freqs, positions=None):
         cfg = self.config
         norm = dict(eps=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         h = LayerNorm(cfg.hidden_size, name="norm_1", **norm)(x)
-        x = x + LlamaAttention(cfg.as_llama(), self.attention_impl, name="attn")(
-            h, freqs, positions
-        )
+        x = x + LlamaAttention(
+            cfg.as_llama(), self.attention_impl, self.mode, name="attn"
+        )(h, freqs, positions)
         h = LayerNorm(cfg.hidden_size, name="norm_2", **norm)(x)
         moe_out, aux = MoE(
             num_experts=cfg.num_experts,
@@ -105,6 +106,7 @@ class DbrxBlock(nn.Module):
 class DbrxForCausalLM(nn.Module):
     config: DbrxConfig
     attention_impl: str = "auto"
+    mode: str = "train"
 
     @nn.compact
     def __call__(
@@ -120,7 +122,8 @@ class DbrxForCausalLM(nn.Module):
         block_cls = nn.remat(DbrxBlock) if cfg.remat else DbrxBlock
         for i in range(cfg.num_layers):
             x, aux = block_cls(
-                cfg, self.attention_impl, deterministic, name=f"blocks_{i}"
+                cfg, self.attention_impl, deterministic, self.mode,
+                name=f"blocks_{i}",
             )(x, freqs, positions)
             aux_sum = aux_sum + aux
         x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,

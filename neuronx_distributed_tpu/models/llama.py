@@ -98,11 +98,12 @@ from neuronx_distributed_tpu.modules.attention import (  # noqa: E402
 )
 
 
-def _decode_attention(q, k_cache, v_cache, q_pos):
+def _decode_attention(q, k_cache, v_cache, q_pos, mask=None):
     """Attention of q (B, S, H, D) rows at positions ``q_pos`` (S,) against
     the full cache (B, L, Hkv, D), each row masked at its own position — the
     single-block special case of the ring kernel's block primitive (one
-    source of masked-softmax numerics, kernels/ring_attention.py)."""
+    source of masked-softmax numerics, kernels/ring_attention.py).
+    ``mask`` (S, L) overrides the positional mask (Medusa tree attention)."""
     from neuronx_distributed_tpu.kernels.ring_attention import _block_attn
 
     b, s, h, d = q.shape
@@ -112,7 +113,7 @@ def _decode_attention(q, k_cache, v_cache, q_pos):
     vt = jnp.swapaxes(v_cache, 1, 2)
     q_pos = q_pos[None] if q_pos.ndim == 0 else q_pos
     k_pos = jnp.arange(k_cache.shape[1])
-    num, _, l = _block_attn(qt, kt, vt, q_pos, k_pos, causal=True)
+    num, _, l = _block_attn(qt, kt, vt, q_pos, k_pos, causal=True, mask=mask)
     out = num / jnp.maximum(l, 1e-20)[..., None]
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2).astype(q.dtype)
 
@@ -133,7 +134,7 @@ class LlamaAttention(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions=None):
+    def __call__(self, x, freqs, positions=None, attn_mask=None):
         cfg = self.config
         d = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -161,7 +162,7 @@ class LlamaAttention(nn.Module):
             k = apply_rope(k, freqs, positions)
             out = attention_op(q, k, v, causal=True, impl=self.attention_impl)
         else:
-            out = self._cached_attention(q, k, v, freqs, positions)
+            out = self._cached_attention(q, k, v, freqs, positions, attn_mask)
         out = out.reshape(b, s, cfg.num_heads * d)
         return RowParallelLinear(
             cfg.num_heads * d,
@@ -173,7 +174,7 @@ class LlamaAttention(nn.Module):
             name="o_proj",
         )(out)
 
-    def _cached_attention(self, q, k, v, freqs, positions):
+    def _cached_attention(self, q, k, v, freqs, positions, attn_mask=None):
         cfg = self.config
         b, s = q.shape[0], q.shape[1]
         hkv, d = cfg.num_kv_heads, cfg.head_dim_
@@ -194,16 +195,22 @@ class LlamaAttention(nn.Module):
             return attention_op(q, k, v, causal=True, impl=self.attention_impl)
         if self.mode != "decode":
             raise ValueError(f"unknown attention mode {self.mode!r}")
-        # decode accepts s >= 1: a 1-token step or an s-token speculative
-        # verify window (each row causally masked at its own position)
+        # decode accepts s >= 1: a 1-token step, an s-token speculative verify
+        # window (each row causally masked at its own position), or a Medusa
+        # TREE step — explicit per-node ``positions`` (depth offsets) plus an
+        # ``attn_mask`` (S, cache_len) replacing the positional mask so each
+        # node attends the prefix + its ancestors only
         cur = cidx.value  # position of the first incoming token
-        pos = cur + jnp.arange(s, dtype=jnp.int32)
+        if positions is not None:
+            pos = positions.astype(jnp.int32)  # (s,) absolute
+        else:
+            pos = cur + jnp.arange(s, dtype=jnp.int32)
         q = apply_rope(q, freqs, jnp.broadcast_to(pos[None], (b, s)))
         k = apply_rope(k, freqs, jnp.broadcast_to(pos[None], (b, s)))
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
         cidx.value = cur + s
-        return _decode_attention(q, ck.value, cv.value, pos)
+        return _decode_attention(q, ck.value, cv.value, pos, mask=attn_mask)
 
     def _kv_heads_shardable(self) -> bool:
         if not mesh_lib.model_parallel_is_initialized():
@@ -236,7 +243,7 @@ class LlamaDecoderLayer(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions=None):
+    def __call__(self, x, freqs, positions=None, attn_mask=None):
         cfg = self.config
         norm = dict(
             eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -244,7 +251,7 @@ class LlamaDecoderLayer(nn.Module):
         )
         h = RMSNorm(cfg.hidden_size, name="input_norm", **norm)(x)
         x = x + LlamaAttention(cfg, self.attention_impl, self.mode, name="attn")(
-            h, freqs, positions
+            h, freqs, positions, attn_mask
         )
         h = RMSNorm(cfg.hidden_size, name="post_attn_norm", **norm)(x)
         x = x + LlamaMLP(cfg, name="mlp")(h)
@@ -259,10 +266,10 @@ class _ScanLayerAdapter(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions):
+    def __call__(self, x, freqs, positions, attn_mask):
         layer_cls = nn.remat(LlamaDecoderLayer) if self.config.remat else LlamaDecoderLayer
         x = layer_cls(self.config, self.attention_impl, self.mode, name="layer")(
-            x, freqs, positions
+            x, freqs, positions, attn_mask
         )
         return x, None
 
@@ -275,7 +282,7 @@ class LlamaModel(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, attn_mask=None):
         cfg = self.config
         x = ParallelEmbedding(
             num_embeddings=cfg.vocab_size,
@@ -293,15 +300,15 @@ class LlamaModel(nn.Module):
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
-                in_axes=(nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, self.attention_impl, self.mode, name="layers")
-            x, _ = scanned(x, freqs, positions)
+            x, _ = scanned(x, freqs, positions, attn_mask)
         else:
             layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
             for i in range(cfg.num_layers):
                 x = layer_cls(cfg, self.attention_impl, self.mode, name=f"layers_{i}")(
-                    x, freqs, positions
+                    x, freqs, positions, attn_mask
                 )
         x = RMSNorm(
             cfg.hidden_size, eps=cfg.rms_eps, dtype=cfg.dtype,
@@ -317,10 +324,10 @@ class LlamaForCausalLM(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, attn_mask=None):
         cfg = self.config
         x = LlamaModel(cfg, self.attention_impl, self.mode, name="model")(
-            input_ids, positions
+            input_ids, positions, attn_mask
         )
         if cfg.sequence_parallel and x.ndim >= 3:
             # leave SP for the logits: gather the sequence back

@@ -55,8 +55,15 @@ from neuronx_distributed_tpu.utils.tree import assert_dict_paths, path_keys as _
 
 
 def default_select(cfg: LoraConfig) -> Callable[[Tuple[str, ...], jax.Array], bool]:
+    """Adaptable leaves: any matmul ``kernel`` (linear/conv/expert-fused/GQA
+    q-k-v — each is its own kernel leaf under the module path, so
+    target_modules like ("qkv",) adapt Q, K and V individually, the
+    reference's LoraGQAQKVParallelLinear case, tp_layer.py:62) and
+    ``embedding`` tables (reference LoraEmbedding, layer.py:214 — the A@B
+    low-rank delta applies to a lookup table exactly as to a kernel)."""
+
     def select(keys: Tuple[str, ...], leaf) -> bool:
-        if not keys or keys[-1] != "kernel" or leaf.ndim < 2:
+        if not keys or keys[-1] not in ("kernel", "embedding") or leaf.ndim < 2:
             return False
         joined = "/".join(keys)
         return any(t in joined for t in cfg.target_modules)
@@ -128,6 +135,65 @@ def lora_train_loss_fn(params, cfg: LoraConfig, loss_fn):
         return loss_fn(merged, batch)
 
     return wrapped
+
+
+# --- adapter checkpoint flows (reference lora/model.py save_lora/load_lora:
+# the separate-adapter checkpoint vs the merged-for-serving checkpoint) -------
+
+
+def save_lora_checkpoint(
+    checkpoint_dir: str,
+    tag: str,
+    lora_params: Any,
+    cfg: LoraConfig,
+    **save_kwargs,
+) -> None:
+    """Separate-adapter checkpoint: only the (tiny) adapter tree + its config
+    (reference save_lora with save_lora_base=False)."""
+    from neuronx_distributed_tpu.trainer.checkpoint import save_checkpoint
+
+    save_checkpoint(
+        checkpoint_dir,
+        tag,
+        items={"lora": lora_params},
+        user_content={"lora_config": dataclasses.asdict(cfg)},
+        **save_kwargs,
+    )
+
+
+def load_lora_checkpoint(
+    checkpoint_dir: str, tag: Optional[str] = None
+) -> Tuple[Any, LoraConfig]:
+    """Load ``(lora_params, LoraConfig)`` saved by :func:`save_lora_checkpoint`."""
+    from neuronx_distributed_tpu.trainer.checkpoint import load_checkpoint
+
+    items, user_content, _tag = load_checkpoint(checkpoint_dir, tag=tag)
+    raw = (user_content or {}).get("lora_config", {})
+    raw["target_modules"] = tuple(raw.get("target_modules", ()))
+    return items["lora"], LoraConfig(**raw)
+
+
+def save_merged_checkpoint(
+    checkpoint_dir: str,
+    tag: str,
+    params: Any,
+    lora_params: Any,
+    cfg: LoraConfig,
+    **save_kwargs,
+) -> None:
+    """Merged-for-serving checkpoint: ``W + scaling·A@B`` baked into the base
+    tree so serving needs no adapter support (reference save_lora merged
+    flow / merge_lora)."""
+    from neuronx_distributed_tpu.trainer.checkpoint import save_checkpoint
+
+    merged = merge_lora_params(params, lora_params, cfg)
+    save_checkpoint(
+        checkpoint_dir,
+        tag,
+        items={"model": merged},
+        user_content={"lora_merged": True},
+        **save_kwargs,
+    )
 
 
 class LoraLinear(nn.Module):

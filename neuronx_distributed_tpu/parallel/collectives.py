@@ -27,6 +27,19 @@ def all_reduce(x, axis_name: AxisName):
     return lax.psum(x, axis_name)
 
 
+def psum_cpu_safe(x, axis_name: AxisName):
+    """``lax.psum`` that upcasts bf16 to fp32 on the CPU backend: jaxlib
+    0.9's CPU AllReducePromotion pass CHECK-crashes on bf16 all-reduces
+    ("Invalid binary instruction opcode copy"). On TPU the bf16 psum stays
+    (ICI bandwidth). Use for any psum whose operand may be bf16 on the
+    virtual CPU test mesh."""
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform == "cpu" and x.dtype == jnp.bfloat16:
+        return lax.psum(x.astype(jnp.float32), axis_name).astype(jnp.bfloat16)
+    return lax.psum(x, axis_name)
+
+
 def all_reduce_max(x, axis_name: AxisName):
     return lax.pmax(x, axis_name)
 

@@ -247,12 +247,13 @@ class InputChannelParallelConv2d(nn.Module):
 
 
 @functools.lru_cache(maxsize=None)
-def _vocab_parallel_lookup(mesh, axis: str, upcast: bool):
+def _vocab_parallel_lookup(mesh, axis: str):
     """Cached jitted shard_map for the vocab-parallel lookup — jit keys on
     callable identity, so rebuilding the wrapper per call would recompile on
     every eager lookup. The jit wrapper exists because the eager shard_map
     impl rejects partial-manual specs (see modules/moe/expert_mlps.py); it
     inlines under an outer jit."""
+    from neuronx_distributed_tpu.parallel.collectives import psum_cpu_safe
 
     def local_lookup(table_l, ids_):
         per = table_l.shape[0]
@@ -261,11 +262,7 @@ def _vocab_parallel_lookup(mesh, axis: str, upcast: bool):
         ok = (local_ids >= 0) & (local_ids < per)
         rows = jnp.take(table_l, jnp.clip(local_ids, 0, per - 1), axis=0)
         rows = jnp.where(ok[..., None], rows, 0)
-        if upcast:
-            return jax.lax.psum(rows.astype(jnp.float32), axis).astype(
-                table_l.dtype
-            )
-        return jax.lax.psum(rows, axis)
+        return psum_cpu_safe(rows, axis)
 
     return jax.jit(
         jax.shard_map(
@@ -329,10 +326,5 @@ class ParallelEmbedding(nn.Module):
         mesh = mesh_lib.get_mesh()
         ctx_mesh = jax.sharding.get_abstract_mesh()
         return _vocab_parallel_lookup(
-            mesh if ctx_mesh.empty else ctx_mesh,
-            self.axis,
-            # CPU backend: AllReducePromotion CHECK-crashes on bf16 all-reduces
-            # ("Invalid binary instruction opcode copy"), so psum in fp32
-            # there; on TPU the psum stays in the compute dtype (bandwidth)
-            jax.devices()[0].platform == "cpu",
+            mesh if ctx_mesh.empty else ctx_mesh, self.axis
         )(table, ids)

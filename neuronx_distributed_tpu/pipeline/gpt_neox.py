@@ -1,0 +1,137 @@
+"""GPT-NeoX ↔ PipelineEngine adapter (reference: manual pipe stages for
+arbitrary models, ``pipeline/manual_pipe_stage.py`` — round-2 coverage #15
+flagged Llama as the sole adapter).
+
+NeoX uses the unrolled ``layers_{i}`` layout; the adapter stacks the
+per-layer subtrees into the engine's (L, ...) layout and back."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXLayer
+from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+)
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+from neuronx_distributed_tpu.pipeline.model import OneFOneBEngine, PipelineEngine
+
+
+def gpt_neox_pipeline_engine(
+    config: GPTNeoXConfig,
+    num_microbatches: int,
+    schedule: str = "1f1b",
+    num_chunks: int = 1,
+) -> PipelineEngine:
+    embed = ParallelEmbedding(
+        config.vocab_size, config.hidden_size, dtype=config.dtype,
+        param_dtype=config.param_dtype,
+    )
+    layer = GPTNeoXLayer(config)
+    final_norm = LayerNorm(
+        config.hidden_size, eps=config.layer_norm_eps, dtype=config.dtype,
+        param_dtype=config.param_dtype,
+    )
+    lm_head = ColumnParallelLinear(
+        config.hidden_size, config.vocab_size, use_bias=False,
+        dtype=config.dtype, param_dtype=config.param_dtype,
+    )
+
+    def embed_apply(ep, mb_batch):
+        return embed.apply({"params": ep}, mb_batch["input_ids"])
+
+    def layer_apply(lp, x):
+        return layer.apply({"params": lp}, x, None)
+
+    def head_apply(hp, x, mb_batch):
+        h = final_norm.apply({"params": hp["final_norm"]}, x)
+        logits = lm_head.apply({"params": hp["lm_head"]}, h)
+        losses = parallel_cross_entropy(logits, mb_batch["labels"])
+        mask = mb_batch.get("loss_mask")
+        if mask is None:
+            mask = jnp.ones_like(losses)
+        return (losses * mask).sum(), mask.sum().astype(jnp.float32)
+
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    kwargs = dict(
+        embed_apply=embed_apply,
+        layer_apply=layer_apply,
+        head_apply=head_apply,
+        num_layers=config.num_layers,
+        num_microbatches=num_microbatches,
+        remat_layers=config.remat,
+    )
+    if schedule == "gpipe":
+        return PipelineEngine(**kwargs)
+    if schedule == "interleaved" and num_chunks < 2:
+        num_chunks = 2
+    return OneFOneBEngine(
+        **kwargs, num_chunks=num_chunks if schedule == "interleaved" else 1
+    )
+
+
+def _stack_unrolled(params: Dict[str, Any], n: int):
+    per_layer = [params[f"layers_{i}"] for i in range(n)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_layer)
+
+
+def gpt_neox_params_to_pipeline(params: Dict[str, Any], engine: PipelineEngine):
+    p = params["params"]
+    return {
+        "embed": p["embed"],
+        "layers": engine.reshape_layer_params(
+            _stack_unrolled(p, engine.num_layers)
+        ),
+        "head": {"final_norm": p["final_norm"], "lm_head": p["lm_head"]},
+    }
+
+
+def pipeline_params_to_gpt_neox(pp_params: Dict[str, Any], engine: PipelineEngine):
+    stacked = engine.unshape_layer_params(pp_params["layers"])
+    n = engine.num_layers
+    out: Dict[str, Any] = {
+        "embed": pp_params["embed"],
+        "final_norm": pp_params["head"]["final_norm"],
+        "lm_head": pp_params["head"]["lm_head"],
+    }
+    for i in range(n):
+        out[f"layers_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    return {"params": out}
+
+
+def gpt_neox_pipeline_shardings(boxed_variables, engine: PipelineEngine):
+    """NamedShardings for the pipeline layout from flax metadata (the
+    unrolled layers share one structure — layer 0's specs gain the stacked
+    layer dim, then the engine's stage layout)."""
+    from flax import linen as nn
+    from jax.sharding import NamedSharding
+
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.get_mesh()
+    specs = nn.get_partition_spec(boxed_variables)["params"]
+    layer_specs = jax.tree.map(
+        lambda s: P(None, *s) if isinstance(s, P) else P(None),
+        specs["layers_0"],
+        is_leaf=lambda s: isinstance(s, P),
+    )
+    pp_specs = {
+        "embed": specs["embed"],
+        "layers": engine.stack_layer_specs(layer_specs),
+        "head": {
+            "final_norm": specs["final_norm"],
+            "lm_head": specs["lm_head"],
+        },
+    }
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        pp_specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )

@@ -31,12 +31,15 @@ def llama_pipeline_engine(
     num_microbatches: int,
     attention_impl: str = "auto",
     schedule: str = "gpipe",
+    num_chunks: int = 1,
 ) -> PipelineEngine:
     """Build a pipeline engine for a scan-form Llama (config.scan_layers=True).
 
     ``schedule``: "gpipe" (scan engine, backward by autodiff — time-optimal,
-    activation memory O(M)) or "1f1b" (OneFOneBEngine — explicit synchronous
-    1F1B, activation memory O(S); see pipeline/model.py)."""
+    activation memory O(M)), "1f1b" (OneFOneBEngine — explicit synchronous
+    1F1B, activation memory O(S)), or "interleaved" (OneFOneBEngine with
+    ``num_chunks`` virtual chunks per rank — the bubble-shrinking schedule;
+    see pipeline/model.py)."""
     embed = ParallelEmbedding(
         num_embeddings=config.vocab_size,
         features=config.hidden_size,
@@ -76,16 +79,22 @@ def llama_pipeline_engine(
             mask = jnp.ones_like(losses)
         return (losses * mask).sum(), mask.sum().astype(jnp.float32)
 
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    engine_cls = PipelineEngine if schedule == "gpipe" else OneFOneBEngine
-    return engine_cls(
+    if schedule == "interleaved" and num_chunks < 2:
+        num_chunks = 2
+    kwargs = dict(
         embed_apply=embed_apply,
         layer_apply=layer_apply,
         head_apply=head_apply,
         num_layers=config.num_layers,
         num_microbatches=num_microbatches,
         remat_layers=config.remat,
+    )
+    if schedule == "gpipe":
+        return PipelineEngine(**kwargs)
+    return OneFOneBEngine(
+        **kwargs, num_chunks=num_chunks if schedule == "interleaved" else 1
     )
 
 
@@ -155,6 +164,7 @@ class LlamaPipelineAdapter:
     num_microbatches: int
     attention_impl: str = "auto"
     schedule: str = "1f1b"
+    num_chunks: int = 1
 
     def build_state_and_step(self, model, optimizer, rng_key, sample_ids,
                              zero1: bool = True, max_grad_norm: float = 1.0):
@@ -172,6 +182,7 @@ class LlamaPipelineAdapter:
             num_microbatches=self.num_microbatches,
             attention_impl=self.attention_impl,
             schedule=self.schedule,
+            num_chunks=self.num_chunks,
         )
         boxed = jax.jit(model.init)(rng_key, sample_ids)
         pp_sh = llama_pipeline_shardings(boxed, engine)
@@ -186,7 +197,7 @@ class LlamaPipelineAdapter:
         opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)(params)
         step_kw = (
             {"value_and_grad_fn": engine.value_and_grad}
-            if self.schedule == "1f1b"
+            if self.schedule in ("1f1b", "interleaved")
             else {"loss_fn": engine.loss_fn}
         )
         step = build_train_step(

@@ -1,0 +1,147 @@
+"""Mixtral (MoE) ↔ PipelineEngine adapter (round-2 coverage #15: only Llama
+could pipeline; reference: NxDPPModel wraps arbitrary models incl. the
+Mixtral example, pipeline/model.py:80).
+
+MoE specifics: each decoder layer returns ``(x, aux_vec)`` router aux terms —
+the engines' ``layer_aux`` channel sums them (pre-weighted by the config's
+coefficients) and adds mean-over-microbatches to the loss, with the constant
+1/M cotangent seeding the router grads in the explicit 1F1B backward.
+
+Note: aux losses are computed per microbatch under PP (they are nonlinear in
+the batch split, so a full-batch monolith differs slightly — inherent to
+microbatching; set the coefficients to 0 for exact-parity checks)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.models.llama import rope_frequencies
+from neuronx_distributed_tpu.models.mixtral import (
+    MixtralConfig,
+    MixtralDecoderLayer,
+)
+from neuronx_distributed_tpu.modules.rms_norm import RMSNorm
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+)
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+from neuronx_distributed_tpu.pipeline.model import OneFOneBEngine, PipelineEngine
+
+
+def mixtral_pipeline_engine(
+    config: MixtralConfig,
+    num_microbatches: int,
+    attention_impl: str = "auto",
+    schedule: str = "1f1b",
+    num_chunks: int = 1,
+) -> PipelineEngine:
+    embed = ParallelEmbedding(
+        config.vocab_size, config.hidden_size, dtype=config.dtype,
+        param_dtype=config.param_dtype,
+        sequence_parallel_enabled=config.sequence_parallel,
+    )
+    layer = MixtralDecoderLayer(config, attention_impl)
+    final_norm = RMSNorm(
+        config.hidden_size, eps=config.rms_eps, dtype=config.dtype,
+        param_dtype=config.param_dtype,
+        sequence_parallel_enabled=config.sequence_parallel,
+    )
+    lm_head = ColumnParallelLinear(
+        config.hidden_size, config.vocab_size, use_bias=False,
+        dtype=config.dtype, param_dtype=config.param_dtype,
+    )
+    freqs = rope_frequencies(config.head_dim_, config.max_seq_len, config.rope_theta)
+
+    def embed_apply(ep, mb_batch):
+        return embed.apply({"params": ep}, mb_batch["input_ids"])
+
+    def layer_apply(lp, x):
+        x, aux_vec = layer.apply({"params": lp}, x, freqs, None)
+        aux = (
+            config.router_aux_loss_coef * aux_vec[0]
+            + config.router_z_loss_coef * aux_vec[1]
+        )
+        return x, aux
+
+    def head_apply(hp, x, mb_batch):
+        h = final_norm.apply({"params": hp["final_norm"]}, x)
+        logits = lm_head.apply({"params": hp["lm_head"]}, h)
+        losses = parallel_cross_entropy(logits, mb_batch["labels"])
+        mask = mb_batch.get("loss_mask")
+        if mask is None:
+            mask = jnp.ones_like(losses)
+        return (losses * mask).sum(), mask.sum().astype(jnp.float32)
+
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "interleaved" and num_chunks < 2:
+        num_chunks = 2
+    kwargs = dict(
+        embed_apply=embed_apply,
+        layer_apply=layer_apply,
+        head_apply=head_apply,
+        num_layers=config.num_layers,
+        num_microbatches=num_microbatches,
+        remat_layers=config.remat,
+        layer_aux=True,
+    )
+    if schedule == "gpipe":
+        return PipelineEngine(**kwargs)
+    return OneFOneBEngine(
+        **kwargs, num_chunks=num_chunks if schedule == "interleaved" else 1
+    )
+
+
+def mixtral_params_to_pipeline(params: Dict[str, Any], engine: PipelineEngine):
+    """Scan-form MixtralForCausalLM params → engine layout (the scan adapter
+    nests each layer under 'layer', models/mixtral.py)."""
+    p = params["params"]
+    return {
+        "embed": p["model"]["embed"],
+        "layers": engine.reshape_layer_params(p["model"]["layers"]["layer"]),
+        "head": {
+            "final_norm": p["model"]["final_norm"],
+            "lm_head": p["lm_head"],
+        },
+    }
+
+
+def pipeline_params_to_mixtral(pp_params: Dict[str, Any], engine: PipelineEngine):
+    return {
+        "params": {
+            "model": {
+                "embed": pp_params["embed"],
+                "layers": {"layer": engine.unshape_layer_params(pp_params["layers"])},
+                "final_norm": pp_params["head"]["final_norm"],
+            },
+            "lm_head": pp_params["head"]["lm_head"],
+        }
+    }
+
+
+def mixtral_pipeline_shardings(boxed_variables, engine: PipelineEngine):
+    from flax import linen as nn
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.get_mesh()
+    specs = nn.get_partition_spec(boxed_variables)["params"]
+    pp_specs = {
+        "embed": specs["model"]["embed"],
+        "layers": engine.stack_layer_specs(specs["model"]["layers"]["layer"]),
+        "head": {
+            "final_norm": specs["model"]["final_norm"],
+            "lm_head": specs["lm_head"],
+        },
+    }
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        pp_specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )

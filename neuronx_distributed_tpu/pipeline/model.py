@@ -62,6 +62,12 @@ class PipelineEngine:
     # same microbatch — used by OneFOneBEngine to seed head cotangents before
     # any head has run). Default: loss_mask.sum(), else the label count.
     weight_fn: Optional[Callable] = None
+    # MoE-style per-layer auxiliary losses: when True, ``layer_apply`` returns
+    # ``(x, aux_scalar)`` (pre-weighted by the adapter's coefficients) and the
+    # engines add ``mean-over-microbatches`` of the summed aux to the loss —
+    # the per-microbatch formulation the reference's MoE aux wiring implies
+    # (modules/moe/loss_function.py via returned router logits).
+    layer_aux: bool = False
 
     def _microbatch_weight(self, mb_batch):
         if self.weight_fn is not None:
@@ -124,13 +130,7 @@ class PipelineEngine:
         layer_apply = (
             jax.checkpoint(self.layer_apply) if self.remat_layers else self.layer_apply
         )
-
-        def stage_fn(layers_local, x):
-            def body(h, one_layer):
-                return layer_apply(one_layer, h), None
-
-            out, _ = lax.scan(body, x, layers_local)
-            return out
+        stage_fn = self._make_stage_fn(layer_apply)
 
         def pipelined(layers_local, embed_params, batch):
             rank = lax.axis_index(mesh_lib.PP_AXIS)
@@ -141,109 +141,203 @@ class PipelineEngine:
             embedded = jax.vmap(lambda mb: self.embed_apply(embed_params, mb))(batch)
             buf = jnp.zeros_like(jax.tree.map(lambda a: a[0], embedded))
 
-            def tick(buf, t):
+            def tick(carry, t):
+                buf, aux_acc = carry
                 mb_in = jnp.clip(t, 0, M - 1)
                 x_in = lax.dynamic_index_in_dim(embedded, mb_in, 0, keepdims=False)
                 x = jnp.where(rank == 0, x_in, buf)
-                y = stage_fn(layers_local, x)
+                y, aux = stage_fn(layers_local, x)
+                # aux only counts for ticks carrying a REAL microbatch on
+                # this rank (tick t processes mb = t - rank)
+                mb = t - rank
+                valid = ((mb >= 0) & (mb < M)).astype(aux.dtype)
+                aux_acc = aux_acc + aux * valid
                 if S > 1:
                     buf_next = lax.ppermute(
                         y, mesh_lib.PP_AXIS, [(i, i + 1) for i in range(S - 1)]
                     )
                 else:
                     buf_next = y
-                return buf_next, y
+                return (buf_next, aux_acc), y
 
-            _, ys = lax.scan(tick, buf, jnp.arange(M + S - 1))
-            return ys  # (M+S-1, mb, ...): this rank's stage outputs per tick
+            (_, aux_acc), ys = lax.scan(
+                tick, (buf, jnp.zeros((), jnp.float32)), jnp.arange(M + S - 1)
+            )
+            # this rank's stage outputs per tick + its layers' aux total
+            return ys, aux_acc[None]
 
         fn = jax.shard_map(
             pipelined,
             mesh=mesh,
             in_specs=(P(mesh_lib.PP_AXIS), P(), P()),
-            out_specs=P(mesh_lib.PP_AXIS),
+            out_specs=(P(mesh_lib.PP_AXIS), P(mesh_lib.PP_AXIS)),
             check_vma=False,
             axis_names={mesh_lib.PP_AXIS},
         )
-        ys = fn(params["layers"], params["embed"], batch)
+        ys, aux_stacked = fn(params["layers"], params["embed"], batch)
         # (S·(M+S-1), mb, ...) → last stage's valid window = microbatch outputs
         ticks = M + S - 1
         ys = ys.reshape((S, ticks) + ys.shape[1:])
         final = ys[S - 1, S - 1 :]  # (M, mb, ...)
         lsum, wsum = self.head_apply(params["head"], final, batch)
-        return lsum / jnp.maximum(wsum, 1.0)
+        loss = lsum / jnp.maximum(wsum, 1.0)
+        if self.layer_aux:
+            loss = loss + aux_stacked.sum() / M
+        return loss
+
+    def _make_stage_fn(self, layer_apply):
+        """Scan the local layers; with ``layer_aux`` the carry also sums the
+        per-layer (pre-weighted) aux scalars."""
+        if self.layer_aux:
+
+            def stage_fn(lp, x):
+                def body(carry, one_layer):
+                    h, acc = carry
+                    h, aux = layer_apply(one_layer, h)
+                    return (h, acc + aux.astype(jnp.float32)), None
+
+                (out, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), lp)
+                return out, aux
+
+            return stage_fn
+
+        def stage_fn(lp, x):
+            def body(h, one_layer):
+                return layer_apply(one_layer, h), None
+
+            out, _ = lax.scan(body, x, lp)
+            return out, jnp.zeros((), jnp.float32)
+
+        return stage_fn
 
 
 @dataclasses.dataclass
 class OneFOneBEngine(PipelineEngine):
-    """Explicitly-scheduled synchronous 1F1B runtime (VERDICT.md missing #2;
+    """Explicitly-scheduled synchronous 1F1B runtime, with interleaved
+    (virtual-pipeline) chunks at ``num_chunks > 1`` (VERDICT.md missing #2/#6;
     reference ``pipeline/model.py:1737`` ``_exec_schedule`` over
-    ``Train1F1BSchedule``).
+    ``Train1F1BSchedule`` / ``TrainInterleavedSchedule``, virtual chunks via
+    ``get_current_stage`` model.py:1053).
 
     Unlike :class:`PipelineEngine` (scan-GPipe: one forward scan, backward by
     ``jax.grad`` reversing it, activation memory O(M) stage-inputs under
     remat), this engine *is* the scheduler: grads are computed inside the
-    cycle loop, never by differentiating it. Each cycle rank r
+    cycle loop, never by differentiating it. With S stages, C chunks and the
+    mixed-radix decomposition ``u = g·S·C + k·S + i`` each cycle rank r
 
-      * forwards microbatch  ``c - r``            (recv → stage → send via
-        ``ppermute``, storing only the stage INPUT in a depth-``2S-1``
-        circular buffer),
-      * backwards microbatch ``c - 2(S-1) + r``   (pop the saved input,
-        ``jax.vjp`` recomputes the stage forward and pulls the cotangent
-        back, accumulate param grads, send the input-cotangent upstream),
+      * forwards ``u = c - r``: microbatch ``g·S + i`` through its chunk-k
+        layers (recv → stage → send via a full-rotation ``ppermute`` — rank
+        S-1's chunk-k output wraps to rank 0's chunk-k+1 input), storing only
+        the stage INPUT in a depth-``min(2SC-1, MC)`` circular buffer,
+      * backwards ``u' = c - (SC-1) - (S-1-r)`` mirrored (chunk ``C-1-k'``):
+        pop the saved input, ``jax.vjp`` recomputes the stage forward and
+        pulls the cotangent back, accumulate param grads into the chunk-k
+        slot, send the input-cotangent down-rotation,
 
-    which is ``SyncTrain1F1BSchedule`` — 1F1B's dependency structure in SPMD
-    lockstep (see its docstring for the warmup/bubble accounting). Activation
-    memory is O(S) stage-inputs, independent of M: the scan-GPipe engine
-    stores M+S-1 stage inputs, this one ``min(2S-1, M)``. Compute per
-    microbatch is identical (both pay the remat 4/3: fwd + vjp-recompute-fwd
-    + bwd).
+    which is ``SyncTrainInterleavedSchedule`` (≡ ``SyncTrain1F1BSchedule`` at
+    C=1) — see its docstring for the bubble accounting: interleaving shrinks
+    the sync-lockstep bubble from ``2(S-1)`` toward ``S`` stage-units.
+    Activation memory is O(S·C) stage-inputs, independent of M. Compute per
+    microbatch is identical to GPipe (both pay the remat 4/3).
 
-    The loss head runs inside the loop on every rank (only the last rank's
-    result is kept — rank-divergent module calls cannot be expressed in one
-    SPMD program without doubling the traced graph under ``lax.cond``); the
-    embedding fwd/bwd runs outside in plain GSPMD, connected through an
+    The loss head (last rank, chunk C-1) is gated behind a rank-dependent
+    ``lax.cond`` so other ranks skip its vocab-sized matmul+CE at runtime;
+    the embedding fwd/bwd runs outside in plain GSPMD, connected through an
     explicit (M, ...) cotangent buffer.
     """
 
+    num_chunks: int = 1
+
     def _cycle_tables(self):
-        """Per-rank (fwd_mb, bwd_mb) per cycle, derived from the task stream
-        of SyncTrain1F1BSchedule — the scheduler is the source of truth; the
-        closed forms inside the scan body are asserted against it here."""
+        """Per-rank (fwd mb/chunk, bwd mb/chunk) per cycle, derived from the
+        task stream of SyncTrainInterleavedSchedule — the scheduler is the
+        source of truth; the closed forms inside the scan body are asserted
+        against it here."""
         from neuronx_distributed_tpu.pipeline.scheduler import (
             BackwardTask,
             ForwardTask,
-            SyncTrain1F1BSchedule,
+            SyncTrainInterleavedSchedule,
             validate_schedule,
         )
 
-        S, M = self._stages(), self.num_microbatches
-        cycles = M + 2 * (S - 1)
+        S, M, C = self._stages(), self.num_microbatches, self.num_chunks
+        cycles = M * C + S * C + S - 2
         for r in range(S):
-            sched = SyncTrain1F1BSchedule(M, S, r)
+            sched = SyncTrainInterleavedSchedule(M, S, r, num_chunks=C)
             validate_schedule(sched)
-            fwd = [t.mb for t in sched.steps() if isinstance(t, ForwardTask)]
-            bwd = [t.mb for t in sched.steps() if isinstance(t, BackwardTask)]
-            want_fwd = [c - r for c in range(cycles) if 0 <= c - r < M]
-            want_bwd = [
-                c - 2 * (S - 1) + r
-                for c in range(cycles)
-                if 0 <= c - 2 * (S - 1) + r < M
-            ]
+            assert cycles == sched.num_cycles
+            fwd = [(t.mb, t.chunk) for t in sched.steps() if isinstance(t, ForwardTask)]
+            bwd = [(t.mb, t.chunk) for t in sched.steps() if isinstance(t, BackwardTask)]
+            want_fwd, want_bwd = [], []
+            for c in range(cycles):
+                u = c - r
+                if 0 <= u < M * C:
+                    g, rem = divmod(u, S * C)
+                    k, i = divmod(rem, S)
+                    want_fwd.append((g * S + i, k))
+                ub = c - (S * C - 1) - (S - 1 - r)
+                if 0 <= ub < M * C:
+                    g, rem = divmod(ub, S * C)
+                    kp, i = divmod(rem, S)
+                    want_bwd.append((g * S + i, C - 1 - kp))
             if fwd != want_fwd or bwd != want_bwd:
                 raise AssertionError(
-                    f"1F1B cycle tables diverge from SyncTrain1F1BSchedule at rank {r}"
+                    f"cycle tables diverge from SyncTrainInterleavedSchedule at rank {r}"
                 )
         return cycles
 
+    # --- interleaved param layout: (L,...) → (C, S, L/(S·C), ...) -------------
+    # Virtual stage v = k·S + r covers layers [v·Lc, (v+1)·Lc), so a plain
+    # reshape to (C, S, Lc) puts chunk k of rank r at [k, r] exactly.
+
+    def stack_layer_specs(self, layer_specs):
+        if self.num_chunks == 1:
+            return super().stack_layer_specs(layer_specs)
+
+        def fix(spec):
+            entries = list(spec)
+            rest = entries[1:] if entries else []
+            return P(None, mesh_lib.PP_AXIS, None, *rest)
+
+        return jax.tree.map(fix, layer_specs, is_leaf=lambda s: isinstance(s, P))
+
+    def reshape_layer_params(self, layer_params):
+        if self.num_chunks == 1:
+            return super().reshape_layer_params(layer_params)
+        S, C, L = self._stages(), self.num_chunks, self.num_layers
+        if L % (S * C) != 0:
+            raise ValueError(
+                f"num_layers {L} not divisible by stages×chunks {S}×{C}"
+            )
+        return jax.tree.map(
+            lambda a: a.reshape((C, S, L // (S * C)) + a.shape[1:]), layer_params
+        )
+
+    def unshape_layer_params(self, layer_params):
+        if self.num_chunks == 1:
+            return super().unshape_layer_params(layer_params)
+        return jax.tree.map(
+            lambda a: a.reshape((self.num_layers,) + a.shape[3:]), layer_params
+        )
+
     def value_and_grad(self, params, batch):
-        """(loss, grads) with grads computed by the explicit 1F1B schedule.
-        Same params/batch layout as :meth:`PipelineEngine.loss_fn`."""
+        """(loss, grads) with grads computed by the explicit sync-1F1B /
+        interleaved schedule. Same params/batch layout as
+        :meth:`PipelineEngine.loss_fn` (layers gain a leading chunk dim when
+        ``num_chunks > 1``)."""
         mesh = mesh_lib.get_mesh()
         S = self._stages()
         M = self.num_microbatches
+        C = self.num_chunks
+        if C > 1 and M % S != 0:
+            raise ValueError(
+                f"interleaved pipeline needs microbatches divisible by stages "
+                f"(got M={M}, S={S})"
+            )
         cycles = self._cycle_tables()
-        D = min(2 * S - 1, M)  # circular-buffer depth: peak in-flight inputs
+        MC = M * C
+        SC = S * C
+        D = min(2 * SC - 1, MC)  # circular-buffer depth: peak in-flight inputs
 
         # total loss weight, known before the loop so every head vjp can be
         # seeded with d(mean_loss)/d(loss_sum_mb) = 1/w_total
@@ -256,9 +350,17 @@ class OneFOneBEngine(PipelineEngine):
             params["embed"],
         )
 
+        # internal layout is always (C, S, Lc, ...); expand the public C=1
+        # layout (S, Lc, ...) outside the shard_map (a free reshape)
+        layers_in = (
+            jax.tree.map(lambda a: a[None], params["layers"])
+            if C == 1
+            else params["layers"]
+        )
+
         def pipelined(layers_local, head_params, embedded, batch):
             rank = lax.axis_index(mesh_lib.PP_AXIS)
-            layers_local = jax.tree.map(lambda a: a[0], layers_local)
+            layers_local = jax.tree.map(lambda a: a[:, 0], layers_local)  # (C, Lc, ...)
             is_last = rank == S - 1
             is_first = rank == 0
 
@@ -276,37 +378,66 @@ class OneFOneBEngine(PipelineEngine):
                 if self.remat_layers
                 else self.layer_apply
             )
+            stage_fn = self._make_stage_fn(layer_apply)
 
-            def stage_fn(lp, x):
-                def body(h, one_layer):
-                    return layer_apply(one_layer, h), None
-
-                out, _ = lax.scan(body, x, lp)
-                return out
+            def chunk_of(tree, k):
+                return jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False),
+                    tree,
+                )
 
             def cycle(carry, c):
                 y_in, cot_in, x_buf, g_layers, g_head, d_emb, loss_sum = carry
 
-                # ---- forward slot: mb = c - rank ----
-                mf = c - rank
-                fwd_valid = (mf >= 0) & (mf < M)
-                mf_c = jnp.clip(mf, 0, M - 1)
+                # ---- forward slot: u = c - rank = g·SC + k·S + i ----
+                u = c - rank
+                fwd_valid = (u >= 0) & (u < MC)
+                u_c = jnp.clip(u, 0, MC - 1)
+                k_f = (u_c % SC) // S
+                mb_f = (u_c // SC) * S + (u_c % S)
                 mb_batch = jax.tree.map(
-                    lambda a: lax.dynamic_index_in_dim(a, mf_c, 0, keepdims=False),
+                    lambda a: lax.dynamic_index_in_dim(a, mb_f, 0, keepdims=False),
                     batch,
                 )
                 x_in = jnp.where(
-                    is_first,
-                    lax.dynamic_index_in_dim(embedded, mf_c, 0, keepdims=False),
+                    is_first & (k_f == 0),
+                    lax.dynamic_index_in_dim(embedded, mb_f, 0, keepdims=False),
                     y_in,
                 )
-                y = stage_fn(layers_local, x_in)
-                loss_mb, head_vjp = jax.vjp(
-                    lambda hp, yy: head_loss(hp, yy, mb_batch), head_params, y
-                )
-                d_head, cot_seed = head_vjp(jnp.ones((), loss_mb.dtype))
+                y, aux_f = stage_fn(chunk_of(layers_local, k_f), x_in)
 
-                slot = jnp.remainder(mf_c, D)
+                # Head (lm_head matmul + CE over the vocab) only contributes
+                # on the LAST rank's chunk C-1 forward; running it on every
+                # rank every cycle is an (S-1)/S FLOP tax at 70B/128k-vocab
+                # scale (round-2 weak #4). lax.cond executes one branch at
+                # runtime: other ranks/cycles skip the head entirely. This is
+                # rank-divergent control flow, but every tp/dp peer of a given
+                # pp rank takes the same branch, so the head's internal
+                # collectives stay aligned.
+                def run_head(operands):
+                    hp, yy = operands
+                    loss_mb, head_vjp = jax.vjp(
+                        lambda h, v: head_loss(h, v, mb_batch), hp, yy
+                    )
+                    d_head, cot_seed = head_vjp(jnp.ones((), loss_mb.dtype))
+                    return loss_mb, d_head, cot_seed
+
+                def skip_head(operands):
+                    hp, yy = operands
+                    return (
+                        jnp.zeros((), jnp.float32),
+                        jax.tree.map(jnp.zeros_like, hp),
+                        jnp.zeros_like(yy),
+                    )
+
+                loss_mb, d_head, cot_seed = lax.cond(
+                    fwd_valid & is_last & (k_f == C - 1),
+                    run_head,
+                    skip_head,
+                    (head_params, y),
+                )
+
+                slot = jnp.remainder(u_c, D)
                 keep = jnp.where(
                     fwd_valid,
                     x_in,
@@ -314,40 +445,58 @@ class OneFOneBEngine(PipelineEngine):
                 )
                 x_buf = lax.dynamic_update_index_in_dim(x_buf, keep, slot, 0)
 
-                # ---- backward slot: mb = c - 2(S-1) + rank ----
-                mb_i = c - 2 * (S - 1) + rank
-                bwd_valid = (mb_i >= 0) & (mb_i < M)
-                mb_c = jnp.clip(mb_i, 0, M - 1)
+                # ---- backward slot: u' = c - (SC-1) - (S-1-rank), mirrored ----
+                ub = c - (SC - 1) - (S - 1 - rank)
+                bwd_valid = (ub >= 0) & (ub < MC)
+                ub_c = jnp.clip(ub, 0, MC - 1)
+                k_b = C - 1 - (ub_c % SC) // S
+                i_b = ub_c % S
+                mb_b = (ub_c // SC) * S + i_b
+                u_saved = (ub_c // SC) * SC + k_b * S + i_b
                 x_saved = lax.dynamic_index_in_dim(
-                    x_buf, jnp.remainder(mb_c, D), 0, keepdims=False
+                    x_buf, jnp.remainder(u_saved, D), 0, keepdims=False
                 )
-                _, stage_vjp = jax.vjp(stage_fn, layers_local, x_saved)
-                cot_y = jnp.where(is_last, cot_seed, cot_in)
-                d_layers, dx = stage_vjp(cot_y)
+                _, stage_vjp = jax.vjp(
+                    stage_fn, chunk_of(layers_local, k_b), x_saved
+                )
+                cot_y = jnp.where(is_last & (k_b == C - 1), cot_seed, cot_in)
+                # aux cotangent: d(loss)/d(aux_slot) = 1/M (the aux term is
+                # mean-over-microbatches of pre-weighted scalars)
+                aux_cot = jnp.asarray(
+                    (1.0 / M) if self.layer_aux else 0.0, jnp.float32
+                )
+                d_layers_k, dx = stage_vjp((cot_y, aux_cot))
 
                 mask_b = bwd_valid.astype(jnp.float32)
                 g_layers = jax.tree.map(
-                    lambda acc, g: acc + g * mask_b.astype(g.dtype), g_layers, d_layers
+                    lambda acc, g: acc.at[k_b].add(g * mask_b.astype(g.dtype)),
+                    g_layers,
+                    d_layers_k,
                 )
-                mask_h = (fwd_valid & is_last).astype(jnp.float32)
-                g_head = jax.tree.map(
-                    lambda acc, g: acc + g * mask_h.astype(g.dtype), g_head, d_head
-                )
-                loss_sum = loss_sum + loss_mb * mask_h.astype(loss_mb.dtype)
+                # head grads/loss already zeroed by the cond gate
+                g_head = jax.tree.map(lambda acc, g: acc + g, g_head, d_head)
+                loss_sum = loss_sum + loss_mb
+                if self.layer_aux:
+                    loss_sum = loss_sum + (
+                        aux_f * fwd_valid.astype(jnp.float32) / M
+                    )
 
                 d_emb_slot = jnp.where(
-                    bwd_valid & is_first,
+                    bwd_valid & is_first & (k_b == 0),
                     dx,
-                    lax.dynamic_index_in_dim(d_emb, mb_c, 0, keepdims=False),
+                    lax.dynamic_index_in_dim(d_emb, mb_b, 0, keepdims=False),
                 )
-                d_emb = lax.dynamic_update_index_in_dim(d_emb, d_emb_slot, mb_c, 0)
+                d_emb = lax.dynamic_update_index_in_dim(d_emb, d_emb_slot, mb_b, 0)
 
                 if S > 1:
+                    # full rotation: rank S-1's chunk-k output wraps to rank
+                    # 0's chunk-k+1 input (overridden by the embedding when
+                    # the receiving slot is chunk 0)
                     y_next = lax.ppermute(
-                        y, mesh_lib.PP_AXIS, [(i, i + 1) for i in range(S - 1)]
+                        y, mesh_lib.PP_AXIS, [(i, (i + 1) % S) for i in range(S)]
                     )
                     cot_next = lax.ppermute(
-                        dx, mesh_lib.PP_AXIS, [(i, i - 1) for i in range(1, S)]
+                        dx, mesh_lib.PP_AXIS, [(i, (i - 1) % S) for i in range(S)]
                     )
                 else:
                     y_next, cot_next = y, dx
@@ -368,32 +517,41 @@ class OneFOneBEngine(PipelineEngine):
             )
             # restore the stage dim on layer grads; reduce the rank-local
             # contributions of shared (non-pp) outputs over pp
-            g_layers = jax.tree.map(lambda a: a[None], g_layers)
+            g_layers = jax.tree.map(lambda a: a[:, None], g_layers)
             g_head = jax.tree.map(
                 lambda a: lax.psum(a, mesh_lib.PP_AXIS), g_head
             )
-            d_emb = lax.psum(d_emb, mesh_lib.PP_AXIS)
+            from neuronx_distributed_tpu.parallel.collectives import psum_cpu_safe
+
+            d_emb = psum_cpu_safe(d_emb, mesh_lib.PP_AXIS)
             loss_sum = lax.psum(loss_sum, mesh_lib.PP_AXIS)
             return g_layers, g_head, d_emb, loss_sum
 
         fn = jax.shard_map(
             pipelined,
             mesh=mesh,
-            in_specs=(P(mesh_lib.PP_AXIS), P(), P(), P()),
-            out_specs=(P(mesh_lib.PP_AXIS), P(), P(), P()),
+            in_specs=(P(None, mesh_lib.PP_AXIS), P(), P(), P()),
+            out_specs=(P(None, mesh_lib.PP_AXIS), P(), P(), P()),
             check_vma=False,
             axis_names={mesh_lib.PP_AXIS},
         )
         g_layers, g_head, d_emb, loss = fn(
-            params["layers"], params["head"], embedded, batch
+            layers_in, params["head"], embedded, batch
         )
+        if C == 1:
+            g_layers = jax.tree.map(lambda a: a[0], g_layers)
         (g_embed,) = embed_vjp(d_emb)
         grads = {"embed": g_embed, "layers": g_layers, "head": g_head}
         return loss, grads
 
     def loss_fn(self, params, batch):
         """Forward-only loss via the parent scan engine (identical math); the
-        1F1B machinery matters only for the backward."""
+        1F1B machinery matters only for the backward. At num_chunks > 1 the
+        parent's linear-pipeline scan does not apply, so the loss comes from
+        the full schedule (grads discarded — use value_and_grad directly in
+        training loops)."""
+        if self.num_chunks > 1:
+            return self.value_and_grad(params, batch)[0]
         return PipelineEngine.loss_fn(self, params, batch)
 
 

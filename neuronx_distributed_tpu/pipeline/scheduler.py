@@ -186,6 +186,73 @@ class SyncTrain1F1BSchedule(PipelineSchedule):
         yield ReduceGradsTask(mb=-1)
 
 
+class SyncTrainInterleavedSchedule(PipelineSchedule):
+    """Interleaved (virtual-pipeline) schedule realized in synchronous SPMD
+    lockstep — the ``num_chunks > 1`` generalization of
+    :class:`SyncTrain1F1BSchedule` (which it equals at ``num_chunks=1``),
+    consumed by the OneFOneBEngine runtime (pipeline/model.py).
+
+    Rank r owns chunk k's layers for virtual stages ``v = k·S + r``. Forward
+    slots follow one closed form: with ``u = cycle - r`` decomposed in mixed
+    radix as ``u = g·S·C + k·S + i`` (g = microbatch group, k = chunk,
+    i = member), rank r forwards microbatch ``g·S + i`` through chunk ``k``.
+    Activation transfers are then a single full-rotation ppermute per cycle:
+    rank S-1's chunk-k output wraps to rank 0's chunk-k+1 input one cycle
+    later. Backward mirrors with ``u' = cycle - (S·C-1) - (S-1-r)`` and
+    chunk ``C-1-k'``.
+
+    Bubble accounting: total cycles ``M·C + S·C + S - 2`` of 1/C-sized stage
+    work each → bubble time ≈ ``(S·C + S - 2)/C`` stage-units vs ``2(S-1)``
+    for sync 1F1B — interleaving shrinks the sync-lockstep bubble toward S
+    (reference interleaved: pipeline/scheduler.py:256, the schedule that
+    shrinks the bubble at large pp; NxD's async variant reaches (S-1)/C).
+    Requires ``M % S == 0`` when C > 1 (the reference has the same
+    constraint, scheduler.py:268).
+    """
+
+    def __init__(self, num_microbatches: int, num_stages: int, stage_rank: int,
+                 num_chunks: int = 1):
+        super().__init__(num_microbatches, num_stages, stage_rank)
+        if num_chunks > 1 and num_microbatches % num_stages != 0:
+            raise ValueError(
+                "interleaved schedule requires num_microbatches divisible by "
+                f"num_stages (got {num_microbatches} % {num_stages})"
+            )
+        self.num_chunks = num_chunks
+
+    @property
+    def num_cycles(self) -> int:
+        M, S, C = self.num_microbatches, self.num_stages, self.num_chunks
+        return M * C + S * C + S - 2
+
+    def tasks(self) -> Iterator[Task]:
+        M, S, C = self.num_microbatches, self.num_stages, self.num_chunks
+        r = self.stage_rank
+        for c in range(self.num_cycles):
+            u = c - r
+            if 0 <= u < M * C:
+                g, rem = divmod(u, S * C)
+                k, i = divmod(rem, S)
+                mb = g * S + i
+                if not (self.is_first and k == 0):
+                    yield RecvForwardTask(mb, k)
+                yield ForwardTask(mb, k)
+                if not (self.is_last and k == C - 1):
+                    yield SendForwardTask(mb, k)
+            ub = c - (S * C - 1) - (S - 1 - r)
+            if 0 <= ub < M * C:
+                g, rem = divmod(ub, S * C)
+                kp, i = divmod(rem, S)
+                k = C - 1 - kp
+                mb = g * S + i
+                if not (self.is_last and k == C - 1):
+                    yield RecvBackwardTask(mb, k)
+                yield BackwardTask(mb, k)
+                if not (self.is_first and k == 0):
+                    yield SendBackwardTask(mb, k)
+        yield ReduceGradsTask(mb=-1)
+
+
 class TrainInterleavedSchedule(PipelineSchedule):
     """Megatron interleaved / virtual-pipeline schedule (reference :256).
 

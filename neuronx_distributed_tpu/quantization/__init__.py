@@ -13,6 +13,8 @@ from neuronx_distributed_tpu.quantization.config import (
 )
 from neuronx_distributed_tpu.quantization.layers import (
     QuantizedColumnParallel,
+    QuantizedExpertFusedColumnParallel,
+    QuantizedExpertFusedRowParallel,
     QuantizedRowParallel,
 )
 from neuronx_distributed_tpu.quantization.utils import (
@@ -26,6 +28,8 @@ __all__ = [
     "QuantizationType",
     "QuantizedDtype",
     "QuantizedColumnParallel",
+    "QuantizedExpertFusedColumnParallel",
+    "QuantizedExpertFusedRowParallel",
     "QuantizedRowParallel",
     "direct_cast_quantize",
     "dequantize",

@@ -40,3 +40,7 @@ class QuantizationConfig:
     # dim holding output channels in the kernel (column-parallel kernels are
     # (in, out) → channel dim 1; per-channel scales live on that dim)
     channel_dim: int = 1
+    # batch dim kept out of the scale reduction — set 0 for expert-fused 3D
+    # kernels (E, in, out) so every expert gets its own scales (reference
+    # quantizes each expert's matrix independently, quantization_layers.py:867)
+    batch_dim: int | None = None

@@ -35,6 +35,10 @@ def _scale_shape(cfg: QuantizationConfig, kernel_shape, channel_dim):
         return ()
     shape = [1] * len(kernel_shape)
     shape[channel_dim] = kernel_shape[channel_dim]
+    if cfg.batch_dim is not None:
+        shape[cfg.batch_dim % len(kernel_shape)] = kernel_shape[
+            cfg.batch_dim % len(kernel_shape)
+        ]
     return tuple(shape)
 
 
@@ -90,6 +94,110 @@ class QuantizedColumnParallel(nn.Module):
             y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
         else:
             y = constrain(y, P(*([UNC] * (y.ndim - 1)), self.axis))
+        return y
+
+
+class QuantizedExpertFusedColumnParallel(nn.Module):
+    """Per-expert column-parallel matmul with quantized 3D weights
+    ``(E, in, out)`` (reference ``QuantizedExpertFusedColumnParallel``,
+    quantization_layers.py:867): experts sharded over ep, out over tp,
+    dequant-then-einsum so HBM holds 1-byte expert weights — the quantized-MoE
+    serving case. Per-channel scales live on the out dim and shard with it."""
+
+    num_experts: int
+    input_size: int
+    output_size: int
+    quantization_config: QuantizationConfig = QuantizationConfig()
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from neuronx_distributed_tpu.modules.moe.moe_parallel_layers import (
+            COLUMN_KERNEL_PARTITION,
+        )
+
+        qcfg = self.quantization_config
+        kshape = (self.num_experts, self.input_size, self.output_size)
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(
+                lambda key, shape, dt: jnp.zeros(shape, dt),
+                COLUMN_KERNEL_PARTITION,
+            ),
+            kshape,
+            qcfg.quantized_dtype.jnp_dtype,
+        )
+        sshape = _scale_shape(qcfg, kshape, channel_dim=2)
+        spart = (
+            (mesh_lib.EP_AXIS if len(sshape) == 3 and sshape[0] > 1 else None,
+             None, mesh_lib.TP_AXIS)
+            if len(sshape) == 3
+            else ()
+        )
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(nn.initializers.ones_init(), spart),
+            sshape,
+            jnp.float32,
+        )
+        w = (kernel.astype(jnp.float32) * scale).astype(self.dtype)
+        y = jnp.einsum("ech,eho->eco", x.astype(self.dtype), w)
+        return constrain(y, P(mesh_lib.EP_AXIS, UNC, mesh_lib.TP_AXIS))
+
+
+class QuantizedExpertFusedRowParallel(nn.Module):
+    """Per-expert row-parallel matmul with quantized 3D weights
+    ``(E, in, out)`` (reference quantization_layers.py:979): in sharded over
+    tp → partial sums; ``reduce_output=False`` delays the reduction to the
+    MoE combine exactly like the float layer."""
+
+    num_experts: int
+    input_size: int
+    output_size: int
+    quantization_config: QuantizationConfig = QuantizationConfig()
+    reduce_output: bool = True
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from neuronx_distributed_tpu.modules.moe.moe_parallel_layers import (
+            ROW_KERNEL_PARTITION,
+        )
+
+        qcfg = self.quantization_config
+        kshape = (self.num_experts, self.input_size, self.output_size)
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(
+                lambda key, shape, dt: jnp.zeros(shape, dt),
+                ROW_KERNEL_PARTITION,
+            ),
+            kshape,
+            qcfg.quantized_dtype.jnp_dtype,
+        )
+        # per-channel scales on the (unsharded) out dim
+        sshape = _scale_shape(qcfg, kshape, channel_dim=2)
+        spart = (
+            (mesh_lib.EP_AXIS if len(sshape) == 3 and sshape[0] > 1 else None,
+             None, None)
+            if len(sshape) == 3
+            else ()
+        )
+        scale = self.param(
+            "scale",
+            nn.with_partitioning(nn.initializers.ones_init(), spart),
+            sshape,
+            jnp.float32,
+        )
+        w = (kernel.astype(jnp.float32) * scale).astype(self.dtype)
+        x = constrain(
+            x.astype(self.dtype), P(mesh_lib.EP_AXIS, UNC, mesh_lib.TP_AXIS)
+        )
+        y = jnp.einsum("eci,eio->eco", x, w)
+        if self.reduce_output:
+            y = constrain(y, P(mesh_lib.EP_AXIS, UNC, None))
         return y
 
 

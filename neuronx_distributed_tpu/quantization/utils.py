@@ -25,9 +25,10 @@ def absmax_scale(w: jax.Array, cfg: QuantizationConfig) -> jax.Array:
     if cfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC:
         amax = w.max()
     else:
-        reduce_dims = tuple(
-            d for d in range(w.ndim) if d != cfg.channel_dim % w.ndim
-        )
+        keep = {cfg.channel_dim % w.ndim}
+        if cfg.batch_dim is not None:
+            keep.add(cfg.batch_dim % w.ndim)
+        reduce_dims = tuple(d for d in range(w.ndim) if d not in keep)
         amax = w.max(axis=reduce_dims, keepdims=True)
     return jnp.maximum(amax, 1e-12) / qmax
 

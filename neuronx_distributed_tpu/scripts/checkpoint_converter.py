@@ -148,6 +148,215 @@ def _unstack_layers(model: Dict[str, Any]) -> Dict[str, Any]:
     return model
 
 
+# --- Mixtral family (reference checkpoint_converter.py multi-family support;
+# experts stack across HF per-expert tensors into the 3D (E, in, out) native
+# layout) ----------------------------------------------------------------------
+
+_MIXTRAL_ATTN_MAP = {
+    "self_attn.q_proj.weight": ("attn/qkv/q_proj/kernel", True),
+    "self_attn.k_proj.weight": ("attn/qkv/k_proj/kernel", True),
+    "self_attn.v_proj.weight": ("attn/qkv/v_proj/kernel", True),
+    "self_attn.o_proj.weight": ("attn/o_proj/kernel", True),
+    "input_layernorm.weight": ("input_norm/weight", False),
+    "post_attention_layernorm.weight": ("post_attn_norm/weight", False),
+    "block_sparse_moe.gate.weight": ("moe/router/weight", True),
+}
+# HF per-expert names → native 3D stacks (w1=gate, w3=up, w2=down)
+_MIXTRAL_EXPERT_MAP = {"w1": "gate_proj", "w3": "up_proj", "w2": "down_proj"}
+
+
+def hf_to_native_mixtral(
+    hf_state: Mapping[str, np.ndarray], scan_layers: bool = False
+) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    experts: Dict[tuple, Dict[int, np.ndarray]] = {}
+    num_layers = 0
+    for name, tensor in hf_state.items():
+        tensor = np.asarray(tensor)
+        if name in _TOP_MAP:
+            path, transpose = _TOP_MAP[name]
+            _set(params, path, tensor.T if transpose else tensor)
+            continue
+        if name.startswith("model.layers."):
+            rest = name[len("model.layers.") :]
+            idx_str, suffix = rest.split(".", 1)
+            idx = int(idx_str)
+            num_layers = max(num_layers, idx + 1)
+            if suffix in _MIXTRAL_ATTN_MAP:
+                path, transpose = _MIXTRAL_ATTN_MAP[suffix]
+                _set(params, f"model/layers_{idx}/{path}",
+                     tensor.T if transpose else tensor)
+                continue
+            if suffix.startswith("block_sparse_moe.experts."):
+                erest = suffix[len("block_sparse_moe.experts.") :]
+                e_str, wname = erest.split(".", 1)
+                wname = wname.removesuffix(".weight")
+                if wname not in _MIXTRAL_EXPERT_MAP:
+                    raise KeyError(f"unmapped Mixtral expert tensor: {name}")
+                # HF expert linears are (out, in); native 3D is (E, in, out)
+                experts.setdefault((idx, _MIXTRAL_EXPERT_MAP[wname]), {})[
+                    int(e_str)
+                ] = tensor.T
+                continue
+            raise KeyError(f"unmapped HF layer tensor: {name}")
+        if name.endswith("rotary_emb.inv_freq"):
+            continue
+        raise KeyError(f"unmapped HF tensor: {name}")
+    for (idx, native_name), by_e in experts.items():
+        stacked = np.stack([by_e[e] for e in range(len(by_e))], axis=0)
+        _set(params, f"model/layers_{idx}/moe/experts/{native_name}", stacked)
+    if "lm_head" not in params:
+        _set(params, "lm_head/kernel", _get(params, "model/embed/embedding").T)
+    if scan_layers:
+        params["model"] = _stack_layers(params["model"], num_layers)
+    return {"params": params}
+
+
+def native_to_hf_mixtral(params: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    tree = dict(params.get("params", params))
+    model = dict(tree["model"])
+    if "layers" in model:
+        model = _unstack_layers(model)
+    tree = dict(tree)
+    tree["model"] = model
+    out: Dict[str, np.ndarray] = {}
+    for hf_name, (path, transpose) in _TOP_MAP.items():
+        t = np.asarray(_get(tree, path))
+        out[hf_name] = t.T if transpose else t
+    idx = 0
+    while f"layers_{idx}" in model:
+        layer = model[f"layers_{idx}"]
+        for hf_suffix, (path, transpose) in _MIXTRAL_ATTN_MAP.items():
+            t = np.asarray(_get(layer, path))
+            out[f"model.layers.{idx}.{hf_suffix}"] = t.T if transpose else t
+        for wname, native_name in _MIXTRAL_EXPERT_MAP.items():
+            stacked = np.asarray(_get(layer, f"moe/experts/{native_name}"))
+            for e in range(stacked.shape[0]):
+                out[
+                    f"model.layers.{idx}.block_sparse_moe.experts.{e}.{wname}.weight"
+                ] = stacked[e].T
+        idx += 1
+    return out
+
+
+# --- GPT-NeoX family: fused query_key_value with PER-HEAD interleaving — the
+# reference's fused/split-QKV transform with the kv-multiplier inverse
+# (checkpoint_converter.py:21-252); NeoX's multiplier is 1 but the per-head
+# [q_i; k_i; v_i] interleave is the same split/fuse machinery ------------------
+
+_NEOX_TOP_MAP = {
+    "gpt_neox.embed_in.weight": ("embed/embedding", False),
+    "gpt_neox.final_layer_norm.weight": ("final_norm/ln/scale", False),
+    "gpt_neox.final_layer_norm.bias": ("final_norm/ln/bias", False),
+    "embed_out.weight": ("lm_head/kernel", True),
+}
+
+_NEOX_LAYER_MAP = {
+    "attention.dense.weight": ("attn/o_proj/kernel", True),
+    "attention.dense.bias": ("attn/o_proj/bias", False),
+    "mlp.dense_h_to_4h.weight": ("mlp/up/kernel", True),
+    "mlp.dense_h_to_4h.bias": ("mlp/up/bias", False),
+    "mlp.dense_4h_to_h.weight": ("mlp/down/kernel", True),
+    "mlp.dense_4h_to_h.bias": ("mlp/down/bias", False),
+    "input_layernorm.weight": ("input_norm/ln/scale", False),
+    "input_layernorm.bias": ("input_norm/ln/bias", False),
+    "post_attention_layernorm.weight": ("post_attn_norm/ln/scale", False),
+    "post_attention_layernorm.bias": ("post_attn_norm/ln/bias", False),
+}
+
+_NEOX_SKIP = (
+    "attention.bias",
+    "attention.masked_bias",
+    "attention.rotary_emb.inv_freq",
+)
+
+
+def _split_neox_qkv(fused_w: np.ndarray, fused_b: np.ndarray, num_heads: int):
+    """HF NeoX fuses per head: rows are [q_0 k_0 v_0 q_1 k_1 v_1 ...]."""
+    hidden = fused_w.shape[1]
+    d = fused_w.shape[0] // (3 * num_heads)
+    w = fused_w.reshape(num_heads, 3, d, hidden)
+    b = fused_b.reshape(num_heads, 3, d)
+    out = {}
+    for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+        out[f"{proj}/kernel"] = w[:, j].reshape(num_heads * d, hidden).T
+        out[f"{proj}/bias"] = b[:, j].reshape(num_heads * d)
+    return out
+
+
+def _fuse_neox_qkv(layer: Mapping[str, Any], num_heads: int):
+    ws, bs = [], []
+    for proj in ("q_proj", "k_proj", "v_proj"):
+        ws.append(np.asarray(_get(layer, f"attn/qkv/{proj}/kernel")).T)
+        bs.append(np.asarray(_get(layer, f"attn/qkv/{proj}/bias")))
+    hidden = ws[0].shape[1]
+    d = ws[0].shape[0] // num_heads
+    w = np.stack([wi.reshape(num_heads, d, hidden) for wi in ws], axis=1)
+    b = np.stack([bi.reshape(num_heads, d) for bi in bs], axis=1)
+    return w.reshape(3 * num_heads * d, hidden), b.reshape(3 * num_heads * d)
+
+
+def hf_to_native_gpt_neox(
+    hf_state: Mapping[str, np.ndarray], num_heads: int, scan_layers: bool = False
+) -> Dict[str, Any]:
+    if scan_layers:
+        raise ValueError("native GPT-NeoX uses the unrolled layer layout")
+    params: Dict[str, Any] = {}
+    fused: Dict[int, Dict[str, np.ndarray]] = {}
+    for name, tensor in hf_state.items():
+        tensor = np.asarray(tensor)
+        if name in _NEOX_TOP_MAP:
+            path, transpose = _NEOX_TOP_MAP[name]
+            _set(params, path, tensor.T if transpose else tensor)
+            continue
+        if name.startswith("gpt_neox.layers."):
+            rest = name[len("gpt_neox.layers.") :]
+            idx_str, suffix = rest.split(".", 1)
+            idx = int(idx_str)
+            if suffix in _NEOX_SKIP:
+                continue
+            if suffix in ("attention.query_key_value.weight",
+                          "attention.query_key_value.bias"):
+                fused.setdefault(idx, {})[suffix.rsplit(".", 1)[-1]] = tensor
+                continue
+            if suffix in _NEOX_LAYER_MAP:
+                path, transpose = _NEOX_LAYER_MAP[suffix]
+                _set(params, f"layers_{idx}/{path}",
+                     tensor.T if transpose else tensor)
+                continue
+            raise KeyError(f"unmapped HF layer tensor: {name}")
+        raise KeyError(f"unmapped HF tensor: {name}")
+    for idx, wb in fused.items():
+        split = _split_neox_qkv(wb["weight"], wb["bias"], num_heads)
+        for sub, tensor in split.items():
+            _set(params, f"layers_{idx}/attn/qkv/{sub}", tensor)
+    return {"params": params}
+
+
+def native_to_hf_gpt_neox(
+    params: Mapping[str, Any], num_heads: int
+) -> Dict[str, np.ndarray]:
+    tree = dict(params.get("params", params))
+    out: Dict[str, np.ndarray] = {}
+    for hf_name, (path, transpose) in _NEOX_TOP_MAP.items():
+        t = np.asarray(_get(tree, path))
+        out[hf_name] = t.T if transpose else t
+    idx = 0
+    while f"layers_{idx}" in tree:
+        layer = tree[f"layers_{idx}"]
+        for hf_suffix, (path, transpose) in _NEOX_LAYER_MAP.items():
+            t = np.asarray(_get(layer, path))
+            out[f"gpt_neox.layers.{idx}.{hf_suffix}"] = t.T if transpose else t
+        w, b = _fuse_neox_qkv(layer, num_heads)
+        out[f"gpt_neox.layers.{idx}.attention.query_key_value.weight"] = w
+        out[f"gpt_neox.layers.{idx}.attention.query_key_value.bias"] = b
+        idx += 1
+    return out
+
+
+FAMILIES = ("llama", "mixtral", "gpt_neox")
+
+
 def _load_hf_dir(hf_dir: str) -> Dict[str, np.ndarray]:
     from safetensors import safe_open
 
@@ -163,11 +372,26 @@ def _load_hf_dir(hf_dir: str) -> Dict[str, np.ndarray]:
 
 
 def convert_hf_to_native(
-    hf_dir: str, output_dir: str, tag: str = "hf_import", scan_layers: bool = False
+    hf_dir: str,
+    output_dir: str,
+    tag: str = "hf_import",
+    scan_layers: bool = False,
+    family: str = "llama",
+    num_heads: int = 0,
 ) -> None:
     from neuronx_distributed_tpu.trainer.checkpoint import save_checkpoint
 
-    params = hf_to_native(_load_hf_dir(hf_dir), scan_layers=scan_layers)
+    state = _load_hf_dir(hf_dir)
+    if family == "llama":
+        params = hf_to_native(state, scan_layers=scan_layers)
+    elif family == "mixtral":
+        params = hf_to_native_mixtral(state, scan_layers=scan_layers)
+    elif family == "gpt_neox":
+        if num_heads <= 0:
+            raise ValueError("gpt_neox conversion needs --num-heads (fused QKV split)")
+        params = hf_to_native_gpt_neox(state, num_heads=num_heads)
+    else:
+        raise ValueError(f"unknown family {family!r} (choose from {FAMILIES})")
     save_checkpoint(output_dir, tag, items={"model": params})
 
 
@@ -176,35 +400,52 @@ def convert_native_to_hf(
     output_dir: str,
     tag: str = None,
     tie_word_embeddings: bool = False,
+    family: str = "llama",
+    num_heads: int = 0,
 ) -> None:
     from safetensors.numpy import save_file
 
     from neuronx_distributed_tpu.trainer.checkpoint import load_checkpoint
 
     items, _, tag = load_checkpoint(checkpoint_dir, tag, items_target={"model": None})
-    hf_state = native_to_hf(items["model"], tie_word_embeddings=tie_word_embeddings)
+    if family == "llama":
+        hf_state = native_to_hf(items["model"], tie_word_embeddings=tie_word_embeddings)
+    elif family == "mixtral":
+        hf_state = native_to_hf_mixtral(items["model"])
+    elif family == "gpt_neox":
+        if num_heads <= 0:
+            raise ValueError("gpt_neox conversion needs --num-heads (QKV fuse)")
+        hf_state = native_to_hf_gpt_neox(items["model"], num_heads=num_heads)
+    else:
+        raise ValueError(f"unknown family {family!r} (choose from {FAMILIES})")
     os.makedirs(output_dir, exist_ok=True)
     save_file(hf_state, os.path.join(output_dir, "model.safetensors"))
     with open(os.path.join(output_dir, "conversion_info.json"), "w") as f:
-        json.dump({"source": checkpoint_dir, "tag": tag}, f)
+        json.dump({"source": checkpoint_dir, "tag": tag, "family": family}, f)
 
 
 def main() -> None:
-    p = argparse.ArgumentParser(description="HF ↔ native Llama checkpoint converter")
+    p = argparse.ArgumentParser(description="HF ↔ native checkpoint converter")
     p.add_argument("--direction", choices=["hf2native", "native2hf"], required=True)
+    p.add_argument("--family", choices=list(FAMILIES), default="llama")
     p.add_argument("--input", required=True)
     p.add_argument("--output", required=True)
     p.add_argument("--tag", default=None)
     p.add_argument("--scan-layers", action="store_true")
     p.add_argument("--tie-embeddings", action="store_true")
+    p.add_argument("--num-heads", type=int, default=0,
+                   help="attention heads (gpt_neox fused-QKV split/fuse)")
     args = p.parse_args()
     if args.direction == "hf2native":
         convert_hf_to_native(
-            args.input, args.output, args.tag or "hf_import", args.scan_layers
+            args.input, args.output, args.tag or "hf_import", args.scan_layers,
+            family=args.family, num_heads=args.num_heads,
         )
     else:
         convert_native_to_hf(
-            args.input, args.output, args.tag, tie_word_embeddings=args.tie_embeddings
+            args.input, args.output, args.tag,
+            tie_word_embeddings=args.tie_embeddings,
+            family=args.family, num_heads=args.num_heads,
         )
 
 

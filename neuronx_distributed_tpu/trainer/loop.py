@@ -93,6 +93,63 @@ class MetricsLogger(Callback):
             self._tb.flush()
 
 
+class ProgressBar(Callback):
+    """TQDM-style progress (reference: the Lightning TQDM bar plugin). Falls
+    back to plain line logging when tqdm is unavailable."""
+
+    def __init__(self, total_steps: Optional[int] = None):
+        self.total_steps = total_steps
+        self._bar = None
+
+    def on_train_start(self, trainer):
+        try:
+            from tqdm import tqdm
+
+            self._bar = tqdm(total=self.total_steps, desc="train", unit="step")
+        except Exception:
+            self._bar = None
+
+    def on_step_end(self, trainer, metrics):
+        if self._bar is not None:
+            self._bar.update(1)
+            self._bar.set_postfix(
+                {k: f"{float(v):.4f}" for k, v in metrics.items() if k == "loss"}
+            )
+
+    def on_train_end(self, trainer):
+        if self._bar is not None:
+            self._bar.close()
+
+
+class HooksCallback(Callback):
+    """Per-parameter-group gradient/param-norm dumps (reference
+    ``lightning/neuron_hooks_callback.py:8`` NeuronHooksCallback — activation
+    and grad-norm debugging). Computes top-level-group param norms from the
+    train state every ``every`` steps and hands them to ``sink`` (default:
+    the module logger)."""
+
+    def __init__(self, every: int = 50, sink: Optional[Callable] = None):
+        self.every = every
+        self.sink = sink or (lambda d: logger.info("param norms: %s", d))
+
+    def on_step_end(self, trainer, metrics):
+        if trainer.step % self.every != 0:
+            return
+        params = trainer.state.params
+        groups = params.items() if isinstance(params, dict) else [("params", params)]
+        norms = {}
+        for name, tree in groups:
+            leaves = jax.tree.leaves(tree)
+            if leaves:
+                norms[name] = float(
+                    jax.numpy.sqrt(
+                        sum(jax.numpy.sum(l.astype(jax.numpy.float32) ** 2)
+                            for l in leaves)
+                    )
+                )
+        self.sink(norms)
+
+
 class CheckpointCallback(Callback):
     """Periodic async checkpoint with retention (reference
     lightning/checkpoint_io.py + trainer/checkpoint.py save path)."""
@@ -138,6 +195,10 @@ class Trainer:
     # reference's NxDPPModel wrap inside initialize_parallel_model
     # (trainer/trainer.py:147).
     pipeline: Optional[Any] = None
+    # jax.profiler trace directory (reference aux: the neuron-profiler hooks,
+    # SURVEY §5 tracing/profiling — device-level truth to pair with the
+    # schedule-derived pipeline timeline). Profiles steps [2, 5) of fit().
+    profile_dir: Optional[str] = None
 
     step: int = 0
     state: Any = None
@@ -160,6 +221,7 @@ class Trainer:
             # initializes parallel state the same way when sizes are 1)
             mesh_lib.initialize_model_parallel()
         data_iter = iter(data_iter)
+        self.steps_run = 0  # per-fit counter (profiler window + throughput)
         first = sample_batch if sample_batch is not None else next(data_iter)
         optimizer = make_optimizer(self.optimizer_config)
         if self.pipeline is not None:
@@ -205,9 +267,17 @@ class Trainer:
         tl = self.timeline or Timeline(None)
         metrics = {}
         pending = first if sample_batch is None else None
+        profiling = False
         while self.step < max_steps:
             batch = pending if pending is not None else next(data_iter)
             pending = None
+            if self.profile_dir is not None:
+                if self.steps_run == 2 and not profiling:
+                    jax.profiler.start_trace(self.profile_dir)
+                    profiling = True
+                elif self.steps_run == 5 and profiling:
+                    jax.profiler.stop_trace()
+                    profiling = False
             with tl.event("train_step"):
                 self.state, metrics = train_step(self.state, prepare(batch))
             self.step += 1
@@ -216,6 +286,8 @@ class Trainer:
             metrics["throughput_seq_s"] = meter.update()
             for cb in self.callbacks:
                 cb.on_step_end(self, metrics)
+        if profiling:
+            jax.profiler.stop_trace()
         for cb in self.callbacks:
             cb.on_train_end(self)
         tl.save()

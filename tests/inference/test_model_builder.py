@@ -70,3 +70,27 @@ def test_sharded_compile():
     model = ModelBuilder().add("mm", fn, [(x, w)], bucket_dim=0, route_argnum=0).trace()
     out = model("mm", x, w)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w), atol=1e-6)
+
+
+def test_unpad_callback_restores_caller_shape():
+    """add(..., unpad=...) maps bucket-shaped outputs back to the input size
+    (round-2 weak #8: pads-but-never-unpads was a sharp public contract)."""
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.inference.model_builder import ModelBuilder
+
+    def fn(x):
+        return x * 2.0
+
+    builder = ModelBuilder()
+    builder.add(
+        "double", fn, [(jnp.zeros((2, 8)),), (jnp.zeros((2, 16)),)],
+        bucket_dim=1, unpad=lambda out, n: out[:, :n],
+    )
+    model = builder.trace()
+    x = jnp.ones((2, 5))
+    out = model("double", x)
+    assert out.shape == (2, 5)
+    import numpy as np
+
+    np.testing.assert_array_equal(np.asarray(out), 2.0 * np.ones((2, 5)))

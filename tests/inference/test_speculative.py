@@ -77,3 +77,39 @@ def test_speculative_max_seq_len_guard():
             target, t_params, draft, d_params, ids,
             max_new_tokens=too_many, gamma=3,
         )
+
+
+def test_sampled_speculative_perfect_draft_accepts_all():
+    """temperature>0: with draft == target, p_t == p_d so the acceptance
+    probability min(1, p_t/p_d) is 1 — every round accepts all gamma drafts
+    (the exact-sampling rule's sanity anchor)."""
+    target, t_params, _draft, _d_params, ids = _setup()
+    toks, acc = speculative_generate(
+        target, t_params, target, t_params, ids, max_new_tokens=NEW, gamma=3,
+        temperature=0.8, key=jax.random.PRNGKey(7),
+    )
+    assert toks.shape == (1, NEW)
+    v = target.config.vocab_size
+    assert np.asarray(toks).min() >= 0 and np.asarray(toks).max() < v
+    np.testing.assert_allclose(acc, 3.0)
+
+
+def test_sampled_speculative_runs_with_weak_draft():
+    """Sampled path with a different draft: still emits valid tokens and a
+    plausible acceptance rate."""
+    target, t_params, draft, d_params, ids = _setup()
+    toks, acc = speculative_generate(
+        target, t_params, draft, d_params, ids, max_new_tokens=NEW, gamma=3,
+        temperature=1.0, key=jax.random.PRNGKey(3),
+    )
+    assert toks.shape == (1, NEW)
+    assert 0.0 <= acc <= 3.0
+
+
+def test_sampled_speculative_requires_key():
+    target, t_params, draft, d_params, ids = _setup()
+    with pytest.raises(ValueError, match="PRNG key"):
+        speculative_generate(
+            target, t_params, draft, d_params, ids, max_new_tokens=4,
+            temperature=0.5,
+        )

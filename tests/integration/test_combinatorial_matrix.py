@@ -1,0 +1,200 @@
+"""Combinatorial parallelism matrix (reference:
+``test/integration/combinatorial_tests/`` — the config-driven
+TP×SP×PP×ZeRO1 sweep over a tiny-depth Llama, extended here with the CP, EP
+and interleaved-PP axes the TPU stack adds).
+
+The invariant swept is stronger than "it runs": with identical params and
+data, the FIRST train-step loss must equal the unsharded baseline's for every
+layout — parallelism is a layout change, never a math change. (The round-2
+blockwise-EP regression at ep=2/tp=1 would have failed exactly this.)
+
+Wall-time budget: one tiny model + one step per combo; the whole matrix must
+stay under ~5 min on the 8-device CPU mesh (VERDICT round-2 item #10).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.models.mixtral import (
+    MixtralForCausalLM,
+    tiny_mixtral,
+)
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+from neuronx_distributed_tpu.trainer import (
+    OptimizerConfig,
+    build_train_step,
+    create_train_state,
+    make_optimizer,
+    shard_batch,
+)
+
+B, S = 8, 32
+
+
+def _llama_cfg(**over):
+    return tiny_llama(max_seq_len=S, **over)
+
+
+@pytest.fixture(scope="module")
+def llama_data():
+    ids = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, 256)
+    return {"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}
+
+
+@pytest.fixture(scope="module")
+def llama_baseline(llama_data):
+    """Unsharded golden: params + first-step loss (computed once per module)."""
+    mesh_lib.destroy_model_parallel()
+    cfg = _llama_cfg(scan_layers=True)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    params = meta.unbox(jax.jit(model.init)(jax.random.PRNGKey(0),
+                                            llama_data["input_ids"]))
+
+    def loss_fn(p):
+        logits = model.apply(p, llama_data["input_ids"])
+        return parallel_cross_entropy(logits, llama_data["labels"]).mean()
+
+    loss = float(jax.jit(loss_fn)(params))
+    # host copy: device_put aliases matching-sharding buffers, and the donated
+    # train step would delete them out from under the next combo
+    return jax.device_get(params), loss
+
+
+# (tp, sp, pp, zero1, cp, schedule)
+LLAMA_MATRIX = [
+    (2, False, 1, False, 1, None),
+    (2, True, 1, True, 1, None),
+    (4, True, 1, False, 1, None),
+    (4, False, 1, True, 1, None),
+    (1, False, 2, False, 1, "gpipe"),
+    (2, True, 2, True, 1, "1f1b"),
+    (2, False, 2, True, 1, "interleaved"),
+    (1, False, 4, True, 1, "1f1b"),
+    (2, False, 1, True, 2, None),  # cp: ring-attention training path
+]
+
+
+@pytest.mark.parametrize("tp,sp,pp,zero1,cp,schedule", LLAMA_MATRIX)
+def test_llama_matrix(llama_data, llama_baseline, tp, sp, pp, zero1, cp, schedule):
+    base_params, base_loss = llama_baseline
+    mesh_lib.destroy_model_parallel()
+    mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        context_parallel_size=cp,
+    )
+    cfg = _llama_cfg(scan_layers=True, sequence_parallel=sp)
+    impl = "auto" if cp > 1 else "xla"
+    model = LlamaForCausalLM(cfg, attention_impl=impl)
+    optimizer = make_optimizer(OptimizerConfig(zero1=zero1))
+
+    if pp > 1:
+        from neuronx_distributed_tpu.pipeline.llama import (
+            LlamaPipelineAdapter,
+            llama_params_to_pipeline,
+        )
+
+        # per-microbatch rows must divide dp; M=4 when it fits, else fewer
+        dp = mesh_lib.get_data_parallel_size()
+        M = min(4, max(1, B // dp))
+        adapter = LlamaPipelineAdapter(
+            config=cfg, num_microbatches=M, attention_impl=impl,
+            schedule=schedule, num_chunks=2 if schedule == "interleaved" else 1,
+        )
+        state, step, engine = adapter.build_state_and_step(
+            model, optimizer, jax.random.PRNGKey(0), llama_data["input_ids"],
+            zero1=zero1,
+        )
+        # same params as the baseline, re-laid-out
+        state = state.replace(
+            params=jax.device_put(
+                llama_params_to_pipeline({"params": base_params["params"]}, engine),
+                jax.tree.map(lambda x: x.sharding, state.params),
+            )
+        )
+        batch = adapter.prepare_batch(llama_data)
+    else:
+        state, p_sh, s_sh = create_train_state(
+            model, optimizer, jax.random.PRNGKey(0), llama_data["input_ids"],
+            zero1=zero1,
+        )
+        state = state.replace(params=jax.device_put(base_params, p_sh))
+        step = build_train_step(model, optimizer, p_sh, s_sh)
+        batch = shard_batch(llama_data)
+
+    state, metrics = step(state, batch)
+    np.testing.assert_allclose(float(metrics["loss"]), base_loss, rtol=2e-4)
+    assert float(metrics["grad_norm"]) > 0
+
+
+# --- MoE: the EP axis (incl. the ep>1/tp=1 blockwise case that regressed) ----
+
+MIXTRAL_MATRIX = [
+    ("blockwise", 2, 1, True),
+    ("blockwise", 2, 2, False),
+    ("capacity_factor", 2, 2, True),
+    ("all_experts", 4, 1, False),
+]
+
+
+@pytest.fixture(scope="module")
+def mixtral_data():
+    ids = jax.random.randint(jax.random.PRNGKey(3), (B, S), 0, 256)
+    return {"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}
+
+
+@pytest.fixture(scope="module")
+def mixtral_baseline(mixtral_data):
+    mesh_lib.destroy_model_parallel()
+    out = {}
+    for strategy in {s for s, *_ in MIXTRAL_MATRIX}:
+        cfg = tiny_mixtral(
+            max_seq_len=S, expert_strategy=strategy,
+            capacity_factor=4.0 if strategy == "capacity_factor" else None,
+        )
+        model = MixtralForCausalLM(cfg, attention_impl="xla")
+        params = meta.unbox(
+            jax.jit(model.init)(jax.random.PRNGKey(0), mixtral_data["input_ids"])
+        )
+        loss = float(
+            jax.jit(lambda p, m=model: m.loss(
+                p, mixtral_data["input_ids"], mixtral_data["labels"]
+            ))(params)
+        )
+        out[strategy] = (jax.device_get(params), loss)  # see llama_baseline
+    return out
+
+
+@pytest.mark.parametrize("strategy,ep,tp,zero1", MIXTRAL_MATRIX)
+def test_mixtral_matrix(mixtral_data, mixtral_baseline, strategy, ep, tp, zero1):
+    base_params, base_loss = mixtral_baseline[strategy]
+    mesh_lib.destroy_model_parallel()
+    mesh_lib.initialize_model_parallel(
+        tensor_model_parallel_size=tp, expert_model_parallel_size=ep
+    )
+    cfg = tiny_mixtral(
+        max_seq_len=S, expert_strategy=strategy,
+        capacity_factor=4.0 if strategy == "capacity_factor" else None,
+    )
+    model = MixtralForCausalLM(cfg, attention_impl="xla")
+    optimizer = make_optimizer(OptimizerConfig(zero1=zero1))
+
+    def loss_fn(p, batch):
+        return model.loss(p, batch["input_ids"], batch["labels"])
+
+    state, p_sh, s_sh = create_train_state(
+        model, optimizer, jax.random.PRNGKey(0), mixtral_data["input_ids"],
+        zero1=zero1,
+    )
+    state = state.replace(params=jax.device_put(base_params, p_sh))
+    step = build_train_step(model, optimizer, p_sh, s_sh, loss_fn=loss_fn)
+    state, metrics = step(state, shard_batch(mixtral_data))
+    np.testing.assert_allclose(float(metrics["loss"]), base_loss, rtol=2e-4)
+    assert float(metrics["grad_norm"]) > 0

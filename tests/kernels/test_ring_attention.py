@@ -133,3 +133,73 @@ def test_llama_cp_train_step():
     assert np.isfinite(float(metrics["loss"]))
     state, metrics2 = step(state, batch)
     assert float(metrics2["loss"]) < float(metrics["loss"]) + 1.0
+
+
+# --- flash-kernel ring path (VERDICT round-2 item #8) -------------------------
+
+
+def test_ring_flash_matches_golden_cp4():
+    """Pallas-kernel ring (interpret mode on CPU) == dense golden, fwd."""
+    q, k, v = _qkv()
+    ref = ring_attention_reference(q, k, v, True)
+    mesh_lib.initialize_model_parallel(
+        context_parallel_size=4, tensor_model_parallel_size=2
+    )
+    out = jax.jit(
+        lambda a, b_, c: ring_attention_sharded(a, b_, c, True, impl="flash")
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_flash_gqa_and_grads():
+    """Kernel-ring grads (dQ local, dK/dV rotated home) == dense golden's,
+    with GQA K/V riding the ring at native head count."""
+    q, k, v = _qkv(hkv=2)
+    mesh_lib.initialize_model_parallel(context_parallel_size=4)
+
+    def ring_loss(q_, k_, v_):
+        return (ring_attention_sharded(q_, k_, v_, True, impl="flash") ** 2).sum()
+
+    def ref_loss(q_, k_, v_):
+        return (ring_attention_reference(q_, k_, v_, True) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gg in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gg), atol=5e-4)
+
+
+def test_ring_pads_instead_of_replicating():
+    """S % cp != 0 now PADS to the next cp multiple (round-2: the replicated
+    fallback was an OOM at the context lengths cp exists for). Verified: the
+    sharded call stays on the ring path (cp>1 collective present) and matches
+    the golden on the real rows."""
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    s = 65  # pads to 66 over cp=2
+    q = jax.random.normal(ks[0], (B, s, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, s, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, s, H, D), jnp.float32)
+    ref = ring_attention_reference(q, k, v, True)
+    mesh_lib.initialize_model_parallel(context_parallel_size=2)
+    fn = jax.jit(lambda a, b_, c: ring_attention_sharded(a, b_, c, True, impl="xla"))
+    txt = fn.lower(q, k, v).compile().as_text()
+    assert "collective-permute" in txt  # ring ran, not the replicated fallback
+    out = fn(q, k, v)
+    assert out.shape == (B, s, H, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_flash_long_seq_cp4():
+    """≥8k-token cp=4 ring on the kernel path (interpret) — the long-context
+    shape the reference exercises in test_long_seqlen.py."""
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    b, s, h, d = 1, 8192, 2, 8
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+    ref = ring_attention_reference(q, k, v, True)
+    mesh_lib.initialize_model_parallel(context_parallel_size=4)
+    out = jax.jit(
+        lambda a, b_, c: ring_attention_sharded(a, b_, c, True, impl="flash")
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)

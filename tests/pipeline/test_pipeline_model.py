@@ -276,6 +276,122 @@ def test_1f1b_memory_bound_vs_gpipe():
     assert temps["1f1b"] < temps["gpipe"] / 2, temps
 
 
+@pytest.mark.parametrize("pp,chunks,tp", [(2, 2, 2), (2, 4, 1), (4, 2, 1)])
+def test_interleaved_grads_match_monolith(pp, chunks, tp):
+    """Interleaved (virtual-pipeline) runtime: loss AND grads must equal the
+    monolithic golden (VERDICT round-2 item #6; reference
+    TrainInterleavedSchedule consumed by model.py:1053 get_current_stage)."""
+    _pp_mesh(pp, tp)
+    cfg = tiny_llama(scan_layers=True, remat=False, num_layers=pp * chunks)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    key = jax.random.PRNGKey(0)
+    M = 4 if pp == 2 else 8  # M % pp == 0 required
+    ids = jax.random.randint(jax.random.fold_in(key, 1), (M * 2, 16), 0, cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+    params = meta.unbox(jax.jit(model.init)(key, ids))
+    engine = llama_pipeline_engine(
+        cfg, num_microbatches=M, attention_impl="xla", schedule="interleaved",
+        num_chunks=chunks,
+    )
+    pp_params = llama_params_to_pipeline({"params": params["params"]}, engine)
+    batch_mb = microbatch({"input_ids": ids, "labels": labels}, M)
+    loss, grads = jax.jit(engine.value_and_grad)(pp_params, batch_mb)
+
+    def mono_loss(p):
+        logits = model.apply(p, ids)
+        return parallel_cross_entropy(logits, labels).mean()
+
+    ref_loss, g_ref = jax.jit(jax.value_and_grad(mono_loss))(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+
+    g_as_llama = pipeline_params_to_llama(grads, engine)
+    flat_ref = jax.tree_util.tree_flatten_with_path(g_ref)[0]
+    flat_got = jax.tree_util.tree_flatten_with_path(g_as_llama)[0]
+    assert len(flat_ref) == len(flat_got)
+    for (path, v_ref), (_, v_got) in zip(flat_ref, flat_got):
+        np.testing.assert_allclose(
+            np.asarray(v_got), np.asarray(v_ref), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_interleaved_roundtrip_layer_layout():
+    """(L,) → (C, S, Lc) → (L,) reshape must be the identity and place virtual
+    stage v = k·S + r at [k, r]."""
+    _pp_mesh(pp=2, tp=1)
+    engine = llama_pipeline_engine(
+        tiny_llama(scan_layers=True, num_layers=8), num_microbatches=4,
+        schedule="interleaved", num_chunks=2,
+    )
+    layers = {"w": jnp.arange(8.0)}
+    stacked = engine.reshape_layer_params(layers)
+    assert stacked["w"].shape == (2, 2, 2)
+    # chunk k=1, rank r=0 → virtual stage 2 → layers 4,5
+    np.testing.assert_array_equal(np.asarray(stacked["w"][1, 0]), [4.0, 5.0])
+    np.testing.assert_array_equal(
+        np.asarray(engine.unshape_layer_params(stacked)["w"]), np.arange(8.0)
+    )
+
+
+def test_sync_interleaved_schedule_valid_and_consistent():
+    """The sync interleaved task stream passes every schedule invariant, it
+    covers the same (mb, chunk) set as the reference-shaped
+    TrainInterleavedSchedule, and at C=1 it degenerates to SyncTrain1F1B."""
+    from neuronx_distributed_tpu.pipeline.scheduler import (
+        BackwardTask,
+        ForwardTask,
+        SyncTrain1F1BSchedule,
+        SyncTrainInterleavedSchedule,
+        TrainInterleavedSchedule,
+        validate_schedule,
+    )
+
+    for S in (2, 4):
+        for M in (S, 2 * S, 4 * S):
+            for C in (1, 2, 3):
+                for r in range(S):
+                    sched = SyncTrainInterleavedSchedule(M, S, r, num_chunks=C)
+                    validate_schedule(sched)
+                    ref = TrainInterleavedSchedule(M, S, r, num_chunks=C)
+                    for cls in (ForwardTask, BackwardTask):
+                        got = {(t.mb, t.chunk) for t in sched.steps()
+                               if isinstance(t, cls)}
+                        want = {(t.mb, t.chunk) for t in ref.steps()
+                                if isinstance(t, cls)}
+                        assert got == want, (S, M, C, r, cls)
+                    if C == 1:
+                        legacy = SyncTrain1F1BSchedule(M, S, r)
+                        assert [
+                            (type(t), t.mb, t.chunk) for t in sched.steps()
+                        ] == [(type(t), t.mb, t.chunk) for t in legacy.steps()]
+
+
+def test_1f1b_head_is_rank_gated():
+    """The loss head (lm_head matmul + CE) must be inside a real runtime
+    conditional so non-last ranks skip its (S-1)/S FLOP tax (round-2 weak #4);
+    a lax.cond flattened into a select would execute both branches
+    everywhere. Checked structurally on the compiled HLO."""
+    _pp_mesh(pp=4, tp=1)
+    cfg = tiny_llama(scan_layers=True, remat=False)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    key = jax.random.PRNGKey(0)
+    M = 8
+    ids = jax.random.randint(jax.random.fold_in(key, 1), (8, 16), 0, cfg.vocab_size)
+    params = meta.unbox(jax.jit(model.init)(key, ids))
+    engine = llama_pipeline_engine(
+        cfg, num_microbatches=M, attention_impl="xla", schedule="1f1b"
+    )
+    pp_params = llama_params_to_pipeline({"params": params["params"]}, engine)
+    batch_mb = microbatch({"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}, M)
+    txt = (
+        jax.jit(engine.value_and_grad)
+        .lower(pp_params, batch_mb)
+        .compile()
+        .as_text()
+    )
+    assert " conditional(" in txt, "head cond was flattened out of the program"
+
+
 def test_zero1_under_pp_matches_unsharded_opt():
     """ZeRO-1 is a layout change, not a math change: params after n steps at
     pp=2 must be identical with and without optimizer-state sharding

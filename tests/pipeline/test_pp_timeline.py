@@ -1,0 +1,42 @@
+"""Pipeline timeline export (reference pipeline/timeline.py PPTimeline —
+here schedule-derived chrome traces, see pipeline/timeline.py docstring)."""
+
+import json
+
+from neuronx_distributed_tpu.models.llama import tiny_llama
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.pipeline.llama import llama_pipeline_engine
+from neuronx_distributed_tpu.pipeline.timeline import export_pipeline_timeline
+
+
+def test_timeline_events_cover_schedule(tmp_path):
+    mesh_lib.initialize_model_parallel(pipeline_model_parallel_size=4)
+    engine = llama_pipeline_engine(
+        tiny_llama(scan_layers=True, num_layers=8), num_microbatches=8,
+        schedule="1f1b",
+    )
+    path = str(tmp_path / "pp_timeline.json")
+    trace = export_pipeline_timeline(engine, path, step_time_s=0.5)
+    with open(path) as f:
+        assert json.load(f)["metadata"]["stages"] == 4
+    events = trace["traceEvents"]
+    # every (rank, mb) forward and backward appears exactly once
+    fwd = [(e["tid"], e["args"]["microbatch"]) for e in events if e["name"].startswith("fwd")]
+    bwd = [(e["tid"], e["args"]["microbatch"]) for e in events if e["name"].startswith("bwd")]
+    assert sorted(fwd) == sorted((r, m) for r in range(4) for m in range(8))
+    assert sorted(bwd) == sorted(fwd)
+    # cycles scale to the measured step time
+    cycles = trace["metadata"]["cycles"]
+    assert max(e["ts"] + e["dur"] for e in events) <= 0.5e6 + 1e-6
+    assert cycles == 8 + 2 * 3  # M + 2(S-1)
+
+
+def test_timeline_interleaved_chunks(tmp_path):
+    mesh_lib.initialize_model_parallel(pipeline_model_parallel_size=2)
+    engine = llama_pipeline_engine(
+        tiny_llama(scan_layers=True, num_layers=8), num_microbatches=4,
+        schedule="interleaved", num_chunks=2,
+    )
+    trace = export_pipeline_timeline(engine, str(tmp_path / "t.json"))
+    chunks = {e["args"]["chunk"] for e in trace["traceEvents"]}
+    assert chunks == {0, 1}

@@ -105,3 +105,129 @@ def test_scan_layout_stack_unstack():
         if "rotary_emb" in k:
             continue
         np.testing.assert_array_equal(back[k], v)
+
+
+# --- multi-family conversion (VERDICT r2 #9: Mixtral + NeoX with fused-QKV) --
+
+
+def test_mixtral_hf_native_logits_match():
+    """HF Mixtral → native: logits parity (expert stacks + router transpose)."""
+    from neuronx_distributed_tpu.models.mixtral import (
+        MixtralConfig,
+        MixtralForCausalLM,
+    )
+    from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+        hf_to_native_mixtral,
+        native_to_hf_mixtral,
+    )
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.MixtralForCausalLM(hf_cfg).eval()
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    params = hf_to_native_mixtral(state)
+
+    cfg = MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, num_layers=2,
+        num_heads=8, num_kv_heads=4, num_experts=4, top_k=2, max_seq_len=64,
+        rope_theta=10000.0, dtype=jnp.float32, remat=False, scan_layers=False,
+    )
+    model = MixtralForCausalLM(cfg, attention_impl="xla")
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16))
+    logits, _aux = model.apply(params, jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=2e-4)
+
+    # roundtrip: native → HF → native is the identity
+    back = hf_to_native_mixtral(native_to_hf_mixtral(params))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gpt_neox_fused_qkv_roundtrip_and_logits():
+    """HF NeoX fused query_key_value (per-head [q;k;v] interleave) splits into
+    the native separate Q/K/V kernels and fuses back to the identity — the
+    reference's fused/split-QKV transform (checkpoint_converter.py:21-252)."""
+    from neuronx_distributed_tpu.models.gpt_neox import (
+        GPTNeoXConfig,
+        GPTNeoXForCausalLM,
+    )
+    from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+        hf_to_native_gpt_neox,
+        native_to_hf_gpt_neox,
+    )
+
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=8,
+        max_position_embeddings=64, rotary_pct=0.25, rotary_emb_base=10000,
+        use_parallel_residual=True, layer_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    params = hf_to_native_gpt_neox(state, num_heads=8)
+
+    cfg = GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256, num_layers=2,
+        num_heads=8, max_seq_len=64, rotary_pct=0.25, rope_theta=10000.0,
+        use_parallel_residual=True, dtype=jnp.float32, remat=False,
+    )
+    model = GPTNeoXForCausalLM(cfg)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16))
+    logits = model.apply(params, jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=2e-4)
+
+    back = hf_to_native_gpt_neox(
+        native_to_hf_gpt_neox(params, num_heads=8), num_heads=8
+    )
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_offline_ckpt_cli_verify_strip_copy(tmp_path):
+    """Offline CLI (reference nxd_convert_zero_checkpoints analogue): verify,
+    strip-optimizer, and copy between directories; our global-array
+    checkpoints make the reference's DP merge/reshard an identity, so the
+    CLI covers the remaining offline uses (see its module docstring)."""
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.scripts.convert_zero_checkpoints import (
+        copy,
+        strip_optimizer,
+        verify,
+    )
+    from neuronx_distributed_tpu.trainer.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    src = str(tmp_path / "src")
+    save_checkpoint(
+        src, "step_5",
+        items={"model": {"w": jnp.ones((4,))}, "optimizer": {"m": jnp.zeros((4,))}},
+        user_content={"step": 5},
+    )
+    counts = verify(src, None)
+    assert counts == {"model": 1, "optimizer": 1}
+
+    stripped = str(tmp_path / "stripped")
+    strip_optimizer(src, stripped, None, None)
+    items, user, tag = load_checkpoint(stripped)
+    assert tag == "step_5" and user == {"step": 5}
+    assert set(items) == {"model"}
+
+    dst = str(tmp_path / "dst")
+    copy(src, dst, None, "imported")
+    items, _, tag = load_checkpoint(dst)
+    assert tag == "imported" and set(items) == {"model", "optimizer"}

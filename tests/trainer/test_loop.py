@@ -83,3 +83,31 @@ def test_throughput_meter():
         time.sleep(0.01)
         t = m.update()
     assert 0 < t < 8 / 0.01 * 2
+
+
+def test_progress_and_hooks_callbacks(tmp_path):
+    """ProgressBar + HooksCallback run through fit (reference Lightning TQDM
+    bar + NeuronHooksCallback plugins)."""
+    from neuronx_distributed_tpu.trainer.loop import HooksCallback, ProgressBar
+
+    mesh_lib.initialize_model_parallel()
+    cfg = tiny_llama(max_seq_len=32)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    seen = []
+    trainer = Trainer(
+        model=model,
+        optimizer_config=OptimizerConfig(zero1=False),
+        callbacks=[
+            ProgressBar(total_steps=2),
+            HooksCallback(every=1, sink=seen.append),
+        ],
+    )
+    ids = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, cfg.vocab_size)
+
+    def data():
+        while True:
+            yield {"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}
+
+    trainer.fit(data(), jax.random.PRNGKey(0), max_steps=2)
+    assert len(seen) == 2
+    assert all(v > 0 for v in seen[0].values())
