@@ -62,9 +62,7 @@ def generate(
             top_p=cfg.top_p,
         )
 
-    def _logits(out):
-        # MoE families return (logits, aux_losses); dense families bare logits
-        return out[0] if isinstance(out, tuple) else out
+    from neuronx_distributed_tpu.inference.utils import unwrap_logits as _logits
 
     @jax.jit
     def _prefill(params, ids, key):

@@ -56,7 +56,12 @@ def medusa_generate(
     n_nodes = buffers["attn_mask"].shape[0]
     depth = buffers["retrieve_indices"].shape[1] - 1
     max_len = getattr(model.config, "max_seq_len", None)
-    if max_len is not None and (
+    if max_len is None:
+        raise ValueError(
+            "medusa_generate needs model.config.max_seq_len (the tree-verify "
+            "attention mask spans the whole KV cache)"
+        )
+    if (
         prompt_ids.shape[1] + max_new_tokens + depth + n_nodes > max_len
     ):
         raise ValueError(
@@ -100,8 +105,7 @@ def medusa_generate(
         #    prefix+ancestor attention
         cur = base_pos + n_in
         node_pos = cur + tree_pos
-        cache_len = getattr(model.config, "max_seq_len")
-        k_pos = jnp.arange(cache_len)
+        k_pos = jnp.arange(max_len)
         prefix_ok = (k_pos[None, :] < cur)  # (1, L) → broadcast rows
         in_tree = (k_pos[None, :] >= cur) & (k_pos[None, :] < cur + n_nodes)
         tree_cols = jnp.clip(k_pos[None, :] - cur, 0, n_nodes - 1)

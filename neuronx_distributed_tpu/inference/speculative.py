@@ -85,9 +85,7 @@ def speculative_generate(
     d_prefill = draft_model.clone(mode="prefill")
     d_decode = draft_model.clone(mode="decode")
 
-    def _logits(out):
-        # MoE families return (logits, aux_losses); dense families bare logits
-        return out[0] if isinstance(out, tuple) else out
+    from neuronx_distributed_tpu.inference.utils import unwrap_logits as _logits
 
     sampled = temperature > 0.0
 

@@ -58,6 +58,7 @@ def tiny_codegen(**over) -> CodeGenConfig:
 
 class CodeGenBlock(nn.Module):
     config: CodeGenConfig
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -71,7 +72,7 @@ class CodeGenBlock(nn.Module):
             hidden_size=cfg.hidden_size, num_heads=cfg.num_heads, causal=True,
             use_bias=False, rotary_pct=cfg.rotary_dim / cfg.head_dim_,
             rope_theta=cfg.rope_theta, max_seq_len=cfg.max_seq_len,
-            name="attn", **common,
+            mode=self.mode, name="attn", **common,
         )(h, positions)
         mlp = ParallelMLP(
             hidden_size=cfg.hidden_size, intermediate_size=cfg.intermediate_size,
@@ -82,6 +83,7 @@ class CodeGenBlock(nn.Module):
 
 class CodeGenForCausalLM(nn.Module):
     config: CodeGenConfig
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, input_ids, positions=None):
@@ -92,7 +94,7 @@ class CodeGenForCausalLM(nn.Module):
         )(input_ids)
         block_cls = nn.remat(CodeGenBlock) if cfg.remat else CodeGenBlock
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"blocks_{i}")(x, positions)
+            x = block_cls(cfg, self.mode, name=f"blocks_{i}")(x, positions)
         x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
                       param_dtype=cfg.param_dtype, name="final_norm")(x)
         return ColumnParallelLinear(

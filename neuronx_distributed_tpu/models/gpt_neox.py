@@ -54,6 +54,7 @@ def tiny_gpt_neox(**over) -> GPTNeoXConfig:
 
 class GPTNeoXLayer(nn.Module):
     config: GPTNeoXConfig
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -65,7 +66,7 @@ class GPTNeoXLayer(nn.Module):
         attn = ParallelSelfAttention(
             hidden_size=cfg.hidden_size, num_heads=cfg.num_heads, causal=True,
             use_bias=True, rotary_pct=cfg.rotary_pct, rope_theta=cfg.rope_theta,
-            max_seq_len=cfg.max_seq_len, name="attn", **common,
+            max_seq_len=cfg.max_seq_len, mode=self.mode, name="attn", **common,
         )(attn_in, positions)
         if cfg.use_parallel_residual:
             # x + attn(ln1(x)) + mlp(ln2(x)) — NeoX's parallel formulation
@@ -85,6 +86,7 @@ class GPTNeoXLayer(nn.Module):
 
 class GPTNeoXForCausalLM(nn.Module):
     config: GPTNeoXConfig
+    mode: str = "train"
 
     @nn.compact
     def __call__(self, input_ids, positions=None):
@@ -95,7 +97,7 @@ class GPTNeoXForCausalLM(nn.Module):
         )(input_ids)
         layer_cls = nn.remat(GPTNeoXLayer) if cfg.remat else GPTNeoXLayer
         for i in range(cfg.num_layers):
-            x = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+            x = layer_cls(cfg, self.mode, name=f"layers_{i}")(x, positions)
         x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
                       param_dtype=cfg.param_dtype, name="final_norm")(x)
         return ColumnParallelLinear(

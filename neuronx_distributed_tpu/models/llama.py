@@ -98,24 +98,11 @@ from neuronx_distributed_tpu.modules.attention import (  # noqa: E402
 )
 
 
-def _decode_attention(q, k_cache, v_cache, q_pos, mask=None):
-    """Attention of q (B, S, H, D) rows at positions ``q_pos`` (S,) against
-    the full cache (B, L, Hkv, D), each row masked at its own position — the
-    single-block special case of the ring kernel's block primitive (one
-    source of masked-softmax numerics, kernels/ring_attention.py).
-    ``mask`` (S, L) overrides the positional mask (Medusa tree attention)."""
-    from neuronx_distributed_tpu.kernels.ring_attention import _block_attn
-
-    b, s, h, d = q.shape
-    hkv = k_cache.shape[2]
-    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, h // hkv, s, d)
-    kt = jnp.swapaxes(k_cache, 1, 2)
-    vt = jnp.swapaxes(v_cache, 1, 2)
-    q_pos = q_pos[None] if q_pos.ndim == 0 else q_pos
-    k_pos = jnp.arange(k_cache.shape[1])
-    num, _, l = _block_attn(qt, kt, vt, q_pos, k_pos, causal=True, mask=mask)
-    out = num / jnp.maximum(l, 1e-20)[..., None]
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2).astype(q.dtype)
+# shared decode-attention primitive (modules/attention.py); kept under the
+# old private name for this module's call sites
+from neuronx_distributed_tpu.modules.attention import (  # noqa: E402
+    decode_attention as _decode_attention,
+)
 
 
 class LlamaAttention(nn.Module):

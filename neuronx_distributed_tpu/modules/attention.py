@@ -95,7 +95,34 @@ def attention_op(q, k, v, causal: bool = True, impl: str = "auto",
         from neuronx_distributed_tpu.kernels.ring_attention import ring_attention_sharded
 
         return ring_attention_sharded(q, k, v, causal=causal)
+    if impl == "ulysses":
+        # all-to-all sequence parallelism — an extra over the reference
+        # (SURVEY §2.10: NxD has no Ulysses variant)
+        from neuronx_distributed_tpu.kernels.ulysses import (
+            ulysses_attention_sharded,
+        )
+
+        return ulysses_attention_sharded(q, k, v, causal=causal)
     return xla_attention(q, k, v, causal=causal)
+
+
+def decode_attention(q, k_cache, v_cache, q_pos, mask=None):
+    """Attention of q (B, S, H, D) rows at positions ``q_pos`` (S,) against
+    the full cache (B, L, Hkv, D), each row masked at its own position — the
+    single-block special case of the ring kernel's block primitive.
+    ``mask`` (S, L) overrides the positional mask (Medusa tree attention)."""
+    from neuronx_distributed_tpu.kernels.ring_attention import _block_attn
+
+    b, s, h, d = q.shape
+    hkv = k_cache.shape[2]
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, h // hkv, s, d)
+    kt = jnp.swapaxes(k_cache, 1, 2)
+    vt = jnp.swapaxes(v_cache, 1, 2)
+    q_pos = q_pos[None] if q_pos.ndim == 0 else q_pos
+    k_pos = jnp.arange(k_cache.shape[1])
+    num, _, l = _block_attn(qt, kt, vt, q_pos, k_pos, causal=True, mask=mask)
+    out = num / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2).astype(q.dtype)
 
 
 class ParallelSelfAttention(nn.Module):
@@ -103,7 +130,9 @@ class ParallelSelfAttention(nn.Module):
 
     ``rotary_pct`` ∈ (0, 1] applies RoPE to the first ``rotary_pct`` fraction
     of each head dim (GPT-NeoX partial rotary); 0 disables RoPE (BERT/ViT use
-    learned positions instead).
+    learned positions instead). ``mode`` selects KV-cache behaviour for
+    causal LMs (train | prefill | decode — the same contract as
+    LlamaAttention, reference StateInitializer cache trace/spmd.py:49).
     """
 
     hidden_size: int
@@ -116,8 +145,24 @@ class ParallelSelfAttention(nn.Module):
     max_seq_len: int = 2048
     sequence_parallel_enabled: bool = False
     attention_impl: str = "auto"
+    mode: str = "train"
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
+
+    def _rope(self, q, k, positions):
+        if self.rotary_pct <= 0.0:
+            return q, k
+        d = self.hidden_size // self.num_heads
+        rot = int(d * self.rotary_pct)
+        rot -= rot % 2
+        freqs = rope_frequencies(rot, self.max_seq_len, self.rope_theta)
+        q = jnp.concatenate(
+            [apply_rope(q[..., :rot], freqs, positions), q[..., rot:]], -1
+        )
+        k = jnp.concatenate(
+            [apply_rope(k[..., :rot], freqs, positions), k[..., rot:]], -1
+        )
+        return q, k
 
     @nn.compact
     def __call__(self, x, positions=None, attention_mask: Optional[jax.Array] = None):
@@ -142,20 +187,19 @@ class ParallelSelfAttention(nn.Module):
         k = k.reshape(b, s, hkv, d)
         v = v.reshape(b, s, hkv, d)
         q = constrain(q, P(UNC, UNC, mesh_lib.TP_AXIS, None))
-        if self.rotary_pct > 0.0:
-            rot = int(d * self.rotary_pct)
-            rot -= rot % 2
-            freqs = rope_frequencies(rot, self.max_seq_len, self.rope_theta)
-            q = jnp.concatenate(
-                [apply_rope(q[..., :rot], freqs, positions), q[..., rot:]], -1
+        if self.mode == "train":
+            q, k = self._rope(q, k, positions)
+            out = attention_op(
+                q, k, v, causal=self.causal, impl=self.attention_impl,
+                mask=attention_mask,
             )
-            k = jnp.concatenate(
-                [apply_rope(k[..., :rot], freqs, positions), k[..., rot:]], -1
-            )
-        out = attention_op(
-            q, k, v, causal=self.causal, impl=self.attention_impl,
-            mask=attention_mask,
-        )
+        else:
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "KV-cache modes do not support padding masks yet — "
+                    "left-strip the prompt padding before prefill"
+                )
+            out = self._cached_attention(q, k, v, positions)
         out = out.reshape(b, s, h * d)
         return RowParallelLinear(
             h * d,
@@ -166,6 +210,36 @@ class ParallelSelfAttention(nn.Module):
             param_dtype=self.param_dtype,
             name="o_proj",
         )(out)
+
+    def _cached_attention(self, q, k, v, positions):
+        if not self.causal:
+            raise ValueError("KV-cache modes require causal attention")
+        b, s = q.shape[0], q.shape[1]
+        hkv = self.num_kv_heads or self.num_heads
+        d = self.hidden_size // self.num_heads
+        cache_shape = (b, self.max_seq_len, hkv, d)
+        ck = self.variable("cache", "k", jnp.zeros, cache_shape, q.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, cache_shape, q.dtype)
+        cidx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        if self.mode == "prefill":
+            q, k = self._rope(q, k, positions)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
+            cidx.value = jnp.asarray(s, jnp.int32)
+            return attention_op(q, k, v, causal=True, impl=self.attention_impl)
+        if self.mode != "decode":
+            raise ValueError(f"unknown attention mode {self.mode!r}")
+        cur = cidx.value
+        if positions is not None:
+            # caller-supplied absolute positions (e.g. tree-step decoding)
+            pos = jnp.reshape(positions, (-1,)).astype(jnp.int32)
+        else:
+            pos = cur + jnp.arange(s, dtype=jnp.int32)
+        q, k = self._rope(q, k, jnp.broadcast_to(pos[None], (b, s)))
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+        cidx.value = cur + s
+        return decode_attention(q, ck.value, cv.value, pos)
 
 
 class ParallelMLP(nn.Module):

@@ -76,11 +76,11 @@ def mixtral_pipeline_engine(
             mask = jnp.ones_like(losses)
         return (losses * mask).sum(), mask.sum().astype(jnp.float32)
 
-    if schedule not in ("gpipe", "1f1b", "interleaved"):
-        raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    if schedule == "interleaved" and num_chunks < 2:
-        num_chunks = 2
-    kwargs = dict(
+    from neuronx_distributed_tpu.pipeline.model import build_pipeline_engine
+
+    return build_pipeline_engine(
+        schedule,
+        num_chunks=num_chunks,
         embed_apply=embed_apply,
         layer_apply=layer_apply,
         head_apply=head_apply,
@@ -88,11 +88,6 @@ def mixtral_pipeline_engine(
         num_microbatches=num_microbatches,
         remat_layers=config.remat,
         layer_aux=True,
-    )
-    if schedule == "gpipe":
-        return PipelineEngine(**kwargs)
-    return OneFOneBEngine(
-        **kwargs, num_chunks=num_chunks if schedule == "interleaved" else 1
     )
 
 

@@ -124,11 +124,24 @@ class PipelineEngine:
         branches touching sharded operands inside a partial-manual shard_map.)
         XLA slices the collected-output tensor at the last stage, so only that
         stage's activations move."""
+        final, aux_stacked = self._run_pipeline(params, batch, remat=True)
+        lsum, wsum = self.head_apply(params["head"], final, batch)
+        loss = lsum / jnp.maximum(wsum, 1.0)
+        if self.layer_aux:
+            loss = loss + aux_stacked.sum() / self.num_microbatches
+        return loss
+
+    def _run_pipeline(self, params, batch, remat: bool):
+        """The skewed tick loop shared by :meth:`loss_fn` (differentiated)
+        and :meth:`forward` (inference): embed once, M+S-1 ticks of
+        stage-apply + non-wrapping ppermute, returning the last stage's
+        per-microbatch outputs and the per-rank aux totals (stacked over pp)."""
         mesh = mesh_lib.get_mesh()
         S = self._stages()
         M = self.num_microbatches
         layer_apply = (
-            jax.checkpoint(self.layer_apply) if self.remat_layers else self.layer_apply
+            jax.checkpoint(self.layer_apply) if remat and self.remat_layers
+            else self.layer_apply
         )
         stage_fn = self._make_stage_fn(layer_apply)
 
@@ -179,11 +192,24 @@ class PipelineEngine:
         ticks = M + S - 1
         ys = ys.reshape((S, ticks) + ys.shape[1:])
         final = ys[S - 1, S - 1 :]  # (M, mb, ...)
-        lsum, wsum = self.head_apply(params["head"], final, batch)
-        loss = lsum / jnp.maximum(wsum, 1.0)
-        if self.layer_aux:
-            loss = loss + aux_stacked.sum() / M
-        return loss
+        return final, aux_stacked
+
+    def forward(self, params, batch, head_fn: Optional[Callable] = None):
+        """Forward-only pipelined inference — the ``InferenceSchedule``
+        (recv → fwd → send per microbatch, reference scheduler.py:144)
+        realized as the same skewed tick loop without a backward. Returns
+        the last stage's outputs per microbatch ``(M, mb, ...)``; with
+        ``head_fn(head_params, x)`` the head is applied to them (e.g. final
+        norm + lm_head for PP logits)."""
+        if getattr(self, "num_chunks", 1) > 1:
+            raise NotImplementedError(
+                "pipelined forward-only inference uses the linear stage "
+                "layout; build the engine with num_chunks=1"
+            )
+        final, _aux = self._run_pipeline(params, batch, remat=False)
+        if head_fn is not None:
+            final = head_fn(params["head"], final)
+        return final
 
     def _make_stage_fn(self, layer_apply):
         """Scan the local layers; with ``layer_aux`` the carry also sums the
@@ -553,6 +579,23 @@ class OneFOneBEngine(PipelineEngine):
         if self.num_chunks > 1:
             return self.value_and_grad(params, batch)[0]
         return PipelineEngine.loss_fn(self, params, batch)
+
+
+def build_pipeline_engine(schedule: str, num_chunks: int = 1, **engine_kwargs):
+    """Schedule-name → engine dispatch shared by every model adapter
+    (pipeline/llama.py, gpt_neox.py, mixtral.py): "gpipe" → scan engine,
+    "1f1b" → explicit sync 1F1B, "interleaved" → 1F1B with virtual chunks
+    (num_chunks < 2 bumped to 2)."""
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if schedule == "gpipe":
+        return PipelineEngine(**engine_kwargs)
+    if schedule == "interleaved" and num_chunks < 2:
+        num_chunks = 2
+    return OneFOneBEngine(
+        **engine_kwargs,
+        num_chunks=num_chunks if schedule == "interleaved" else 1,
+    )
 
 
 def shard_microbatched_batch(batch):

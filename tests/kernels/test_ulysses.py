@@ -1,0 +1,69 @@
+"""Ulysses all-to-all sequence parallelism (an extra over the reference —
+SURVEY §2.10 notes NxD ships only Megatron-SP + ring/CP)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.kernels.ring_attention import (
+    ring_attention_reference,
+)
+from neuronx_distributed_tpu.kernels.ulysses import ulysses_attention_sharded
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+B, S, H, D = 2, 64, 8, 16
+
+
+def _qkv(hkv=H, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (
+        jax.random.normal(ks[0], (B, S, H, D), jnp.float32),
+        jax.random.normal(ks[1], (B, S, hkv, D), jnp.float32),
+        jax.random.normal(ks[2], (B, S, hkv, D), jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_golden_cp4(causal):
+    q, k, v = _qkv()
+    ref = ring_attention_reference(q, k, v, causal)
+    mesh_lib.initialize_model_parallel(context_parallel_size=4)
+    out = jax.jit(lambda a, b_, c: ulysses_attention_sharded(a, b_, c, causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_gqa_with_tp():
+    q, k, v = _qkv(hkv=4)
+    ref = ring_attention_reference(q, k, v, True)
+    mesh_lib.initialize_model_parallel(
+        context_parallel_size=2, tensor_model_parallel_size=2
+    )
+    out = jax.jit(lambda a, b_, c: ulysses_attention_sharded(a, b_, c, True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_grads_match_golden():
+    q, k, v = _qkv()
+    mesh_lib.initialize_model_parallel(context_parallel_size=4)
+
+    def uly_loss(q_, k_, v_):
+        return (ulysses_attention_sharded(q_, k_, v_, True) ** 2).sum()
+
+    def ref_loss(q_, k_, v_):
+        return (ring_attention_reference(q_, k_, v_, True) ** 2).sum()
+
+    g_u = jax.jit(jax.grad(uly_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_r = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gu, gr in zip(g_u, g_r):
+        np.testing.assert_allclose(np.asarray(gu), np.asarray(gr), atol=5e-4)
+
+
+def test_ulysses_falls_back_to_ring_when_heads_dont_split():
+    """cp > kv-heads: Ulysses cannot split heads — must still be correct
+    (ring fallback)."""
+    q, k, v = _qkv(hkv=2)
+    ref = ring_attention_reference(q, k, v, True)
+    mesh_lib.initialize_model_parallel(context_parallel_size=4)
+    out = jax.jit(lambda a, b_, c: ulysses_attention_sharded(a, b_, c, True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

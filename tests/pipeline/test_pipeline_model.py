@@ -413,3 +413,30 @@ def test_zero1_under_pp_matches_unsharded_opt():
             atol=2e-5,
             err_msg=jax.tree_util.keystr(path),
         )
+
+
+def test_pipeline_forward_only_matches_monolith_logits():
+    """InferenceSchedule semantics (recv→fwd→send, reference scheduler.py:144)
+    as the forward-only tick loop: PP logits == monolithic logits."""
+    cfg, model, params, engine, pp_params, batch_mb, ids, labels = _setup()
+
+    def head_fn(hp, x):
+        from neuronx_distributed_tpu.modules.rms_norm import RMSNorm
+        from neuronx_distributed_tpu.parallel.layers import ColumnParallelLinear
+
+        norm = RMSNorm(cfg.hidden_size, eps=cfg.rms_eps, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype)
+        head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
+                                    use_bias=False, dtype=cfg.dtype,
+                                    param_dtype=cfg.param_dtype)
+        h = norm.apply({"params": hp["final_norm"]}, x)
+        return head.apply({"params": hp["lm_head"]}, h)
+
+    logits_mb = jax.jit(
+        lambda p, b: engine.forward(p, b, head_fn=head_fn)
+    )(pp_params, batch_mb)
+    ref = jax.jit(model.apply)(params, ids)
+    got = logits_mb.reshape(ref.shape)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32), atol=2e-5
+    )
