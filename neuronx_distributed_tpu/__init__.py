@@ -45,4 +45,16 @@ def __getattr__(name):
         "scripts",
     ):
         return importlib.import_module(f"neuronx_distributed_tpu.{name}")
+    # the reference root also re-exports the trainer entry points
+    # (src/neuronx_distributed/__init__.py: config + checkpoint functions)
+    if name in ("save_checkpoint", "load_checkpoint", "latest_checkpoint_tag"):
+        mod = importlib.import_module("neuronx_distributed_tpu.trainer.checkpoint")
+        return getattr(mod, name)
+    if name in (
+        "neuronx_distributed_tpu_config",
+        "initialize_parallel_model",
+        "initialize_parallel_optimizer",
+    ):
+        mod = importlib.import_module("neuronx_distributed_tpu.trainer.trainer")
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

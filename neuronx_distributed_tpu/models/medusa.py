@@ -35,6 +35,27 @@ class MedusaResBlock(nn.Module):
         return x + jax.nn.silu(h)
 
 
+def medusa_head_loss(model, params, input_ids, labels):
+    """Medusa-1 head-training objective (reference: the medusa training recipe
+    behind examples/inference/run_llama_medusa.py): head i predicts the token
+    ``i+2`` positions ahead, so its CE target is ``labels`` shifted left by
+    ``i+1``; positions without a target are masked. The base LM is typically
+    frozen — close over base params and differentiate w.r.t. the head subtree
+    only (the functional-freeze pattern modules/lora.py uses)."""
+    from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+
+    _logits, med = model.apply(params, input_ids)  # med: (B, S, heads, V)
+    b, s, n_heads, _v = med.shape
+    total = jnp.zeros((), jnp.float32)
+    for i in range(n_heads):
+        shift = i + 1
+        tgt = jnp.roll(labels, -shift, axis=1)
+        valid = (jnp.arange(s) < s - shift).astype(jnp.float32)[None]
+        losses = parallel_cross_entropy(med[:, :, i], tgt)
+        total = total + (losses * valid).sum() / jnp.maximum(valid.sum() * b, 1.0)
+    return total / n_heads
+
+
 class MedusaForCausalLM(nn.Module):
     """Base Llama + ``num_medusa_heads`` decoding heads. Returns
     ``(logits (B,S,V), medusa_logits (B,S,heads,V))``."""

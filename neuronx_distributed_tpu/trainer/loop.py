@@ -225,11 +225,12 @@ class Trainer:
         first = sample_batch if sample_batch is not None else next(data_iter)
         optimizer = make_optimizer(self.optimizer_config)
         if self.pipeline is not None:
-            self.state, train_step, _engine = self.pipeline.build_state_and_step(
+            self.state, train_step, engine = self.pipeline.build_state_and_step(
                 self.model, optimizer, rng_key, first["input_ids"],
                 zero1=self.optimizer_config.zero1,
                 max_grad_norm=self.optimizer_config.max_grad_norm,
             )
+            self._pipeline_engine = engine
             prepare = self.pipeline.prepare_batch
         else:
             self.state, p_sh, s_sh = create_train_state(
@@ -292,3 +293,35 @@ class Trainer:
             cb.on_train_end(self)
         tl.save()
         return metrics
+
+    def evaluate(self, data_iter: Iterable[dict], max_steps: int) -> dict:
+        """Mean loss over ``max_steps`` eval batches with the CURRENT params,
+        no updates (the reference's Lightning validation loop). Requires a
+        prior fit() (the jitted loss reuses its model/loss wiring)."""
+        if self.state is None:
+            raise RuntimeError("evaluate() needs a fitted Trainer (state is None)")
+        if getattr(self, "_eval_step", None) is None:
+            if self.pipeline is not None:
+                loss_fn = self._pipeline_engine.loss_fn
+                self._eval_prepare = self.pipeline.prepare_batch
+            else:
+                from functools import partial
+
+                from neuronx_distributed_tpu.trainer.trainer import default_loss_fn
+
+                loss_fn = self.loss_fn or partial(default_loss_fn, self.model)
+                self._eval_prepare = shard_batch
+            # cached: a fresh jit wrapper per call would retrace every time
+            self._eval_step = jax.jit(loss_fn)
+        data_iter = iter(data_iter)
+        total, n = 0.0, 0
+        while n < max_steps:
+            try:
+                batch = next(data_iter)  # never pull past max_steps
+            except StopIteration:
+                break
+            total += float(self._eval_step(self.state.params, self._eval_prepare(batch)))
+            n += 1
+        if n == 0:
+            raise ValueError("evaluate(): data_iter yielded no batches")
+        return {"eval_loss": total / n, "eval_steps": n}

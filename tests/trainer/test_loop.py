@@ -111,3 +111,24 @@ def test_progress_and_hooks_callbacks(tmp_path):
     trainer.fit(data(), jax.random.PRNGKey(0), max_steps=2)
     assert len(seen) == 2
     assert all(v > 0 for v in seen[0].values())
+
+
+def test_trainer_evaluate():
+    """evaluate(): mean loss with current params, no updates (Lightning
+    validation-loop parity)."""
+    mesh_lib.initialize_model_parallel()
+    cfg = tiny_llama(max_seq_len=32)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    trainer = Trainer(model=model, optimizer_config=OptimizerConfig(zero1=False))
+    ids = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, cfg.vocab_size)
+
+    def data():
+        while True:
+            yield {"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}
+
+    trainer.fit(data(), jax.random.PRNGKey(0), max_steps=2)
+    params_before = jax.tree.map(lambda a: np.asarray(a).copy(), trainer.state.params)
+    report = trainer.evaluate(data(), max_steps=3)
+    assert report["eval_steps"] == 3 and report["eval_loss"] > 0
+    for a, b in zip(jax.tree.leaves(params_before), jax.tree.leaves(trainer.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
