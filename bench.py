@@ -282,9 +282,10 @@ def child_parallel() -> None:
     )
 
     out = {}
-    for sched in ("1f1b", "gpipe"):
+    for sched in ("1f1b", "interleaved", "gpipe"):
         adapter = LlamaPipelineAdapter(
-            config=cfg, num_microbatches=M, attention_impl="xla", schedule=sched
+            config=cfg, num_microbatches=M, attention_impl="xla", schedule=sched,
+            num_chunks=2 if sched == "interleaved" else 1,
         )
         state, step, _engine = adapter.build_state_and_step(
             model, make_optimizer(OptimizerConfig()), key, ids

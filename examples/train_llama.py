@@ -71,6 +71,8 @@ def parse_args(argv=None):
     t.add_argument("--lr", type=float, default=3e-4)
     t.add_argument("--warmup-steps", type=int, default=0)
     t.add_argument("--lr-schedule", default="constant", choices=["constant", "cosine"])
+    t.add_argument("--grad-accum", type=int, default=1,
+                   help="gradient accumulation microbatches (pp=1 path)")
     t.add_argument("--no-zero1", action="store_true", help="disable ZeRO-1")
     t.add_argument("--max-grad-norm", type=float, default=1.0)
     t.add_argument("--seed", type=int, default=0)
@@ -200,6 +202,7 @@ def main(argv=None):
         total_steps=args.steps,
         zero1=not args.no_zero1,
         max_grad_norm=args.max_grad_norm,
+        grad_accum_steps=args.grad_accum if args.pp == 1 else 1,
     )
     model = LlamaForCausalLM(cfg, attention_impl=args.attention)
     pipeline = None

@@ -222,8 +222,16 @@ class Trainer:
             mesh_lib.initialize_model_parallel()
         data_iter = iter(data_iter)
         self.steps_run = 0  # per-fit counter (profiler window + throughput)
+        self._eval_step = None  # rebuilt lazily against this fit's wiring
+        self._eval_prepare = None
         first = sample_batch if sample_batch is not None else next(data_iter)
         optimizer = make_optimizer(self.optimizer_config)
+        if self.pipeline is not None and self.optimizer_config.grad_accum_steps > 1:
+            raise ValueError(
+                "grad_accum_steps does not apply under a pipeline adapter — "
+                "pipeline microbatches already accumulate; raise "
+                "num_microbatches instead"
+            )
         if self.pipeline is not None:
             self.state, train_step, engine = self.pipeline.build_state_and_step(
                 self.model, optimizer, rng_key, first["input_ids"],
@@ -237,12 +245,23 @@ class Trainer:
                 self.model, optimizer, rng_key, first["input_ids"],
                 zero1=self.optimizer_config.zero1,
             )
+            accum = self.optimizer_config.grad_accum_steps
             train_step = build_train_step(
                 self.model, optimizer, p_sh, s_sh,
                 max_grad_norm=self.optimizer_config.max_grad_norm,
                 loss_fn=self.loss_fn,
+                grad_accum_steps=accum,
             )
-            prepare = shard_batch
+            if accum > 1:
+                from neuronx_distributed_tpu.pipeline.model import (
+                    microbatch,
+                    shard_microbatched_batch,
+                )
+
+                def prepare(batch):
+                    return shard_microbatched_batch(microbatch(batch, accum))
+            else:
+                prepare = shard_batch
         if resume_from is not None:
             from neuronx_distributed_tpu.trainer.checkpoint import (
                 latest_checkpoint_tag,

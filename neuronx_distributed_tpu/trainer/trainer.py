@@ -49,6 +49,10 @@ class OptimizerConfig:
     eps: float = 1e-8
     max_grad_norm: float = 1.0
     zero1: bool = True
+    # >1: split each batch into this many microbatches and accumulate grads
+    # inside the jitted step (reference: the grad-accum loop of
+    # run_llama_nxd.py / Lightning accumulate_grad_batches)
+    grad_accum_steps: int = 1
     warmup_steps: int = 0
     lr_schedule: str = "constant"  # constant | cosine
     total_steps: int = 10000
@@ -188,6 +192,7 @@ def build_train_step(
     max_grad_norm: float = 1.0,
     loss_fn: Optional[Callable] = None,
     value_and_grad_fn: Optional[Callable] = None,
+    grad_accum_steps: int = 1,
 ):
     """One jitted SPMD train step: fwd → bwd → clip → update
     (reference: the whole NxDOptimizer.step pipeline, trainer/optimizer.py:122).
@@ -201,7 +206,27 @@ def build_train_step(
 
     if value_and_grad_fn is None:
         loss_fn = loss_fn or partial(default_loss_fn, model)
-        value_and_grad_fn = jax.value_and_grad(loss_fn)
+        if grad_accum_steps > 1:
+            # batch leaves arrive shaped (A, B/A, ...) (pipeline.model.
+            # microbatch); grads accumulate inside one jitted scan — the
+            # reference's host-side grad-accum loop, without the dispatches
+            base_vag = jax.value_and_grad(loss_fn)
+
+            def value_and_grad_fn(params, batch):
+                def body(carry, mb):
+                    loss_acc, g_acc = carry
+                    loss, g = base_vag(params, mb)
+                    g_acc = jax.tree.map(lambda a, b: a + b, g_acc, g)
+                    return (loss_acc + loss.astype(jnp.float32), g_acc), None
+
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                (loss_sum, g_sum), _ = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zeros), batch
+                )
+                inv = 1.0 / grad_accum_steps
+                return loss_sum * inv, jax.tree.map(lambda g: g * inv, g_sum)
+        else:
+            value_and_grad_fn = jax.value_and_grad(loss_fn)
     mesh = mesh_lib.get_mesh()
     repl = NamedSharding(mesh, P())
     state_shardings = TrainState(

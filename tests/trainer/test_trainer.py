@@ -95,3 +95,43 @@ def test_grad_norm_metric_reported():
     state, step, batch = _setup()
     _, metrics = step(state, batch)
     assert float(metrics["grad_norm"]) > 0
+
+
+def test_grad_accumulation_matches_full_batch():
+    """grad_accum_steps=A on (A·mb) rows must produce the same first-step
+    update as one full-batch step (the mean-of-means == full mean identity
+    holds when microbatches are equal-sized)."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+    from neuronx_distributed_tpu.pipeline.model import (
+        microbatch,
+        shard_microbatched_batch,
+    )
+
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=2)
+    cfg = tiny_llama(max_seq_len=32)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    key = jax.random.PRNGKey(0)
+    ids = jax.random.randint(key, (8, 16), 0, cfg.vocab_size)
+    batch = {"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}
+    optimizer = make_optimizer(OptimizerConfig(zero1=False))
+
+    outs = {}
+    for accum in (1, 2):
+        state, p_sh, s_sh = create_train_state(
+            model, optimizer, key, ids, zero1=False
+        )
+        step = build_train_step(
+            model, optimizer, p_sh, s_sh, grad_accum_steps=accum
+        )
+        data = (
+            shard_batch(batch)
+            if accum == 1
+            else shard_microbatched_batch(microbatch(batch, accum))  # mb=4 ≥ dp
+        )
+        new_state, metrics = step(state, data)
+        outs[accum] = (jax.device_get(new_state.params), float(metrics["loss"]))
+    np.testing.assert_allclose(outs[1][1], outs[2][1], rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(outs[1][0]), jax.tree.leaves(outs[2][0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
