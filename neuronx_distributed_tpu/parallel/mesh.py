@@ -146,6 +146,34 @@ def _build_device_grid(
         return np.asarray(devices, dtype=object).reshape(tuple(shape))
 
 
+def _build_hybrid_device_grid(
+    ici_shape: Sequence[int], dcn_shape: Sequence[int],
+    devices: Optional[Sequence[jax.Device]],
+) -> np.ndarray:
+    """Two-level mesh for multi-slice TPU: per-axis ICI extent × DCN extent
+    (``mesh_utils.create_hybrid_device_mesh``). On TPU a failure here is a
+    real multi-slice misconfiguration and aborts; only non-TPU device sets
+    (CPU test meshes, whose devices carry no slice topology) fall back to the
+    single-level grid builder — note the fallback's enumeration-order reshape
+    puts NO particular axis on the process boundary."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+    try:
+        from jax.experimental import mesh_utils
+
+        return mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape), devices=devices
+        )
+    except Exception as e:
+        if devices and getattr(devices[0], "platform", "") == "tpu":
+            raise  # silent degradation would put tp/pp collectives on DCN
+        logger.warning(
+            "hybrid (ICI×DCN) device mesh unavailable (%s); using the "
+            "single-level grid builder", e,
+        )
+        return _build_device_grid(shape, devices)
+
+
 def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
@@ -154,12 +182,22 @@ def initialize_model_parallel(
     data_parallel_size: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
     aot_mode: bool = False,
+    dcn_data_parallel_size: int = 1,
 ) -> ParallelState:
     """Build the global mesh state (reference: parallel_state.py:343).
 
     Keyword names mirror the reference API so users can port call sites
     mechanically. Returns the new :class:`ParallelState` and installs it
     globally for the getter functions below.
+
+    Multi-slice / multi-host: call ``jax.distributed.initialize()`` first so
+    ``jax.devices()`` spans all hosts, then set ``dcn_data_parallel_size`` to
+    the slice count — the (expert-)data-parallel dimension splits into
+    ``dcn × ici`` and the mesh is built with
+    ``mesh_utils.create_hybrid_device_mesh`` so ONLY the data-parallel
+    gradient reduction crosses DCN while tp/cp/pp/ep collectives stay on ICI
+    (the reference reaches multi-node the same way: DP gradient buckets over
+    EFA, model parallelism inside the node).
     """
     global _STATE
     if _STATE is not None:
@@ -190,7 +228,19 @@ def initialize_model_parallel(
         )
     edp = dp // ep
 
-    grid = _build_device_grid((pp, edp, ep, cp, tp), devices)
+    if dcn_data_parallel_size > 1:
+        if edp % dcn_data_parallel_size != 0:
+            raise ValueError(
+                f"dcn_data_parallel_size={dcn_data_parallel_size} must divide "
+                f"the expert-data-parallel dimension edp={edp}"
+            )
+        grid = _build_hybrid_device_grid(
+            ici_shape=(pp, edp // dcn_data_parallel_size, ep, cp, tp),
+            dcn_shape=(1, dcn_data_parallel_size, 1, 1, 1),
+            devices=devices,
+        )
+    else:
+        grid = _build_device_grid((pp, edp, ep, cp, tp), devices)
     mesh = Mesh(grid, MESH_AXES)
 
     _STATE = ParallelState(config=cfg, mesh=mesh, aot_mode=aot_mode)
