@@ -12,6 +12,9 @@ Modes:
                and (optionally) serialize the executables with --save-dir
   speculative— draft-model speculative decoding (tiny draft of the same
                family), reports mean accepted tokens/round
+  medusa     — Medusa tree decoding with freshly-initialized heads
+               (reference examples/inference/run_llama_medusa.py), reports
+               mean accepted tokens/round
 
 Examples (development host, virtual CPU devices):
 
@@ -42,14 +45,16 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model", default="tiny", choices=["tiny", "7b", "llama3-8b"])
     p.add_argument("--mode", default="generate",
-                   choices=["generate", "benchmark", "trace", "speculative"])
+                   choices=["generate", "benchmark", "trace", "speculative",
+                            "medusa"])
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--prompt-len", type=int, default=16)
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=None,
                    help="sampling temperature (generate default 1.0; "
-                        "speculative default 0.0 = greedy)")
+                        "speculative default 0.0 = greedy; medusa is "
+                        "always greedy and ignores sampling flags)")
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
     p.add_argument("--greedy", action="store_true", help="temperature-0 argmax")
@@ -66,6 +71,17 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+# Medusa tree used by both the KV-cache sizing (build_model) and the
+# generation call — one source of truth so they cannot desync.
+MEDUSA_TOP_K = 10
+
+
+def _medusa_choices():
+    from neuronx_distributed_tpu.inference.medusa import DEFAULT_CHOICES
+
+    return DEFAULT_CHOICES
+
+
 def build_model(args):
     import jax.numpy as jnp
 
@@ -77,7 +93,18 @@ def build_model(args):
         "7b": llama_lib.llama2_7b,
         "llama3-8b": llama_lib.llama3_8b,
     }[args.model]
-    need = args.prompt_len + args.max_new_tokens + args.gamma
+    # KV-cache slack beyond prompt+new: speculative looks ahead gamma draft
+    # tokens; medusa enters the whole candidate tree (+ its depth of accepted
+    # tokens) into the cache each round
+    slack = args.gamma if args.mode == "speculative" else 0
+    if args.mode == "medusa":
+        from neuronx_distributed_tpu.utils.medusa import generate_medusa_buffers
+
+        buffers = generate_medusa_buffers(_medusa_choices(), top_k=MEDUSA_TOP_K)
+        n_nodes = buffers["attn_mask"].shape[0]
+        depth = buffers["retrieve_indices"].shape[1] - 1
+        slack = n_nodes + depth
+    need = args.prompt_len + args.max_new_tokens + slack
     cfg = preset()
     if cfg.max_seq_len < need:
         cfg = dataclasses.replace(cfg, max_seq_len=need)
@@ -88,6 +115,8 @@ def build_model(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.mode == "medusa" and args.batch != 1:
+        raise SystemExit("medusa mode supports --batch 1 only")
     if args.force_cpu_devices:
         from neuronx_distributed_tpu.utils.platform import force_cpu_devices
 
@@ -117,7 +146,9 @@ def main(argv=None):
     )
     logger.info("initializing %s (tp=%d, %d layers)", args.model, args.tp,
                 cfg.num_layers)
-    params = meta.unbox(jax.jit(model.init)(key, prompt))
+    # medusa re-inits its own multi-head model below; skip the base init
+    params = (None if args.mode == "medusa"
+              else meta.unbox(jax.jit(model.init)(key, prompt)))
 
     gen_temp = 1.0 if args.temperature is None else args.temperature
     gen_cfg = GenerationConfig(
@@ -233,6 +264,24 @@ def main(argv=None):
               f"mean accepted/round {float(accepted):.2f}")
         print(f"generated ids[0]: {jax.device_get(toks)[0].tolist()}")
         return {"accepted_per_round": float(accepted)}
+
+    if args.mode == "medusa":
+        from neuronx_distributed_tpu.inference.medusa import medusa_generate
+        from neuronx_distributed_tpu.models.medusa import MedusaForCausalLM
+
+        medusa = MedusaForCausalLM(cfg, attention_impl=args.attention)
+        medusa_params = meta.unbox(jax.jit(medusa.init)(key, prompt))
+        t0 = time.perf_counter()
+        toks, accepted = medusa_generate(
+            medusa, medusa_params, prompt, max_new_tokens=args.max_new_tokens,
+            choices=_medusa_choices(), top_k=MEDUSA_TOP_K,
+        )
+        dt = time.perf_counter() - t0
+        print(f"medusa: {args.max_new_tokens} tokens in {dt:.2f}s, "
+              f"mean accepted/round {float(accepted):.2f}")
+        print(f"generated ids[0]: {jax.device_get(toks)[0].tolist()}")
+        return {"accepted_per_round": float(accepted),
+                "tokens": jax.device_get(toks)}
 
     raise ValueError(f"unknown mode {args.mode!r}")
 

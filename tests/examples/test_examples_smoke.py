@@ -84,3 +84,35 @@ def test_inference_example_trace(infer_mod, tmp_path):
     ])
     assert out["buckets"] == [16, 32]
     assert (tmp_path / "traced" / "manifest.json").exists()
+
+
+def test_inference_example_medusa(infer_mod):
+    out = infer_mod.main([
+        "--model", "tiny", "--mode", "medusa", "--prompt-len", "8",
+        "--max-new-tokens", "6",
+    ])
+    assert out["tokens"].shape == (1, 6)
+    assert out["accepted_per_round"] >= 0.0
+
+
+@pytest.fixture(scope="module")
+def moe_mod():
+    return _load("train_moe")
+
+
+def test_train_moe_example_ep_tp(moe_mod):
+    """Dropless blockwise experts under ep=2 x tp=2 (the MoE-specific
+    example — reference examples/training/mixtral analogue)."""
+    metrics = moe_mod.main([
+        "--model", "tiny", "--tp", "2", "--ep", "2", "--steps", "2",
+        "--seq-len", "32",
+    ])
+    assert float(metrics["loss"]) > 0
+
+
+def test_train_moe_example_capacity_shuffle(moe_mod):
+    metrics = moe_mod.main([
+        "--model", "tiny", "--capacity", "1.25", "--token-shuffle",
+        "--steps", "2", "--seq-len", "32",
+    ])
+    assert float(metrics["loss"]) > 0
