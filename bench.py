@@ -7,8 +7,7 @@ computed against a hardware-grounded target: 40% MFU at the chip's peak bf16
 FLOPs (v5e ≈ 197 TFLOP/s) — i.e. vs_baseline = achieved_MFU / 0.40. >1.0
 beats the target.
 
-FLOP accounting (round-3 correction, VERDICT.md weak #2): the headline MFU is
-the *corrected* one —
+FLOP accounting: the headline MFU is the *corrected* one —
 
     flops = 6 · (N − N_embed_table) · tokens   (input embedding is a lookup,
                                                 not a matmul; lm_head counts)
@@ -20,16 +19,27 @@ the *corrected* one —
 both the raw 6·N number and every component are in ``extras`` so the MFU can
 be recomputed from the artifact alone.
 
-A second, parallelism-exercising measurement runs on an 8-device virtual CPU
-mesh (pp=2×tp=2×dp=2): per-step wall time of the explicit-1F1B engine vs the
-GPipe scan engine plus their XLA temp-allocation sizes, logged under
-``extras.parallel_proxy`` (VERDICT.md weak #3 — the single-chip number alone
-cannot regress if sharded paths get slow).
+Relay-resilience (round-4 redesign, VERDICT r3 missing #1): the TPU relay has
+hung during 2 of 3 driver runs, and in round 3 that meant a recorded 0 with no
+perf signal at all. The harness is now structured so a dead relay still yields
+evidence:
 
-Round-2 hardening (kept): the measurement runs in child processes with
-bounded timeouts and retries; backend-init failures emit a parseable JSON
-error line instead of a traceback. The child forces ``attention_impl="flash"``
-on TPU so the Pallas kernel demonstrably compiles under Mosaic.
+  1. A cheap ``jax.devices()`` PROBE child (90 s cap) runs before any long
+     attempt; a hung probe is retried once and then short-circuits the TPU
+     path entirely — no 600 s attempt is ever launched against a relay that
+     cannot even enumerate devices.
+  2. The CPU parallelism proxy (1f1b/interleaved/gpipe engine step-time +
+     temp-alloc on an 8-device virtual mesh) is launched CONCURRENTLY at
+     startup and merged into ``extras.parallel_proxy`` UNCONDITIONALLY — TPU
+     success or not.
+  3. A TINY TPU measurement (1 layer, small batch — compiles in seconds) runs
+     before the full config, so *some* real-chip number lands even if the
+     budget expires mid-way through the full compile. If the full config
+     succeeds it replaces the tiny number; otherwise the tiny number is the
+     headline with ``extras.scope = "tiny_fallback"``.
+  4. Previously *measured* numbers live in ``extras.prior_measurements`` (not
+     in comments) so the artifact itself carries the progression and the next
+     run can re-verify it.
 """
 
 import json
@@ -38,9 +48,26 @@ import subprocess
 import sys
 import time
 
-# Equal per-attempt budgets: a timed-out compile writes nothing to the cache,
-# so the retry needs as much time as the first try.
-ATTEMPT_TIMEOUTS = (600, 600)
+PROBE_TIMEOUT_S = 90
+TINY_TIMEOUT_S = 300
+FULL_TIMEOUT_S = 600
+PROXY_TIMEOUT_S = 420
+
+METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
+
+# Numbers actually measured by earlier rounds' bench runs (artifact-borne so
+# they cannot rot in prose; see BENCH_r02.json for the recorded r2 artifact).
+PRIOR_MEASUREMENTS = {
+    "r2_recorded_tokens_per_sec": 24182.0,  # BENCH_r02.json, remat=True batch=2
+    "r3_builder_measured": {
+        # measured mid-round-3 on the relay, never landed in BENCH_r03.json
+        # because the relay hung during the driver run (value=0 recorded):
+        "remat_on_batch2": 24200.0,
+        "remat_off_batch2": 27300.0,
+        "remat_off_batch4": 35500.0,
+        "note": "batch=8 added only ~3% at 2x step latency (past the knee)",
+    },
+}
 
 
 def peak_flops_per_chip(dev) -> float:
@@ -60,10 +87,25 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
-def child() -> None:
-    """The actual measurement. Prints the one JSON line on success; on
-    failure prints an error JSON (rc stays 0 — the parent decides whether to
-    retry based on the ``retryable`` flag)."""
+def _error_payload(msg: str, **extras) -> dict:
+    p = {
+        "metric": METRIC,
+        "value": 0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "error": msg,
+    }
+    if extras:
+        p["extras"] = extras
+    return p
+
+
+# --------------------------------------------------------------------------
+# children
+# --------------------------------------------------------------------------
+
+
+def _child_setup_jax():
     import jax
 
     # The axon sitecustomize force-selects the TPU platform regardless of the
@@ -81,39 +123,54 @@ def child() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+    return jax
+
+
+def child_probe() -> None:
+    """Cheap relay healthcheck: enumerate devices, run one trivial computation.
+    Prints a JSON line with the platform/device kind; the parent treats a hang
+    (no output before timeout) as a dead relay."""
+    jax = _child_setup_jax()
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    import jax.numpy as jnp
+
+    x = float(jnp.asarray(2.0) * 3)  # round-trip through the backend
+    _emit(
+        {
+            "metric": "probe",
+            "platform": devs[0].platform,
+            "device_kind": getattr(devs[0], "device_kind", "?"),
+            "n_devices": len(devs),
+            "probe_s": round(time.perf_counter() - t0, 2),
+            "ok": x == 6.0,
+        }
+    )
+
+
+def child(tiny: bool) -> None:
+    """The actual measurement. Prints the one JSON line on success; on
+    failure prints an error JSON (rc stays 0 — the parent decides whether to
+    retry based on the ``retryable`` flag)."""
+    jax = _child_setup_jax()
 
     try:
         devs = jax.devices()
     except Exception as e:  # backend init failed — retryable
-        _emit(
-            {
-                "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
-                "value": 0,
-                "unit": "tokens/s",
-                "vs_baseline": 0.0,
-                "error": f"backend init failed: {type(e).__name__}: {str(e)[:400]}",
-                "retryable": True,
-            }
-        )
+        p = _error_payload(f"backend init failed: {type(e).__name__}: {str(e)[:400]}")
+        p["retryable"] = True
+        _emit(p)
         return
 
     try:
-        _measure(devs)
+        _measure(devs, tiny)
     except Exception as e:
-        _emit(
-            {
-                "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
-                "value": 0,
-                "unit": "tokens/s",
-                "vs_baseline": 0.0,
-                "error": f"{type(e).__name__}: {str(e)[:400]}",
-                "retryable": False,
-                "extras": {"platform": devs[0].platform},
-            }
-        )
+        p = _error_payload(f"{type(e).__name__}: {str(e)[:400]}", platform=devs[0].platform)
+        p["retryable"] = False
+        _emit(p)
 
 
-def _measure(devs) -> None:
+def _measure(devs, tiny: bool) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -132,17 +189,20 @@ def _measure(devs) -> None:
     mesh_lib.initialize_model_parallel(tensor_model_parallel_size=1)
 
     # Llama-2-7B layer geometry, depth scaled to single-chip HBM (the
-    # reference integration-test trick: full width, few layers).
-    # remat=False: at 2 layers the activations fit HBM comfortably and
-    # rematerialization's ~1/3 extra forward FLOPs cost 12% throughput
-    # (measured r3: 24.2k → 27.3k tok/s); batch=4 amortizes the weight-grad
-    # matmuls further (→ 35.5k tok/s; batch=8 adds only 3% more at 2× the
-    # step latency, past the knee).
+    # reference integration-test trick: full width, few layers). Tuning
+    # rationale (measured r3, recorded in PRIOR_MEASUREMENTS above): remat off
+    # and batch=4 are the knee of the throughput curve at this depth.
+    if tiny:
+        num_layers, batch = 1, 1
+        seq = 512 if on_tpu else 64
+    else:
+        num_layers = 2 if on_tpu else 1
+        batch, seq = (4, 2048) if on_tpu else (1, 128)
     cfg = LlamaConfig(
         vocab_size=32000,
         hidden_size=4096,
         intermediate_size=11008,
-        num_layers=2 if on_tpu else 1,
+        num_layers=num_layers,
         num_heads=32,
         num_kv_heads=32,
         max_seq_len=2048,
@@ -151,7 +211,6 @@ def _measure(devs) -> None:
         remat=False,
         scan_layers=False,
     )
-    batch, seq = (4, 2048) if on_tpu else (1, 128)
 
     # Force the Pallas flash kernel on TPU (compiled by Mosaic — no interpret
     # fallback); XLA einsum path elsewhere.
@@ -167,7 +226,7 @@ def _measure(devs) -> None:
 
     n_params = sum(p.size for p in jax.tree.leaves(state.params))
     # input embedding table does a lookup, not a matmul — exclude from the
-    # 6·N count (the lm_head, a real matmul, stays); VERDICT.md round-2 weak #2
+    # 6·N count (the lm_head, a real matmul, stays)
     embed_params = cfg.vocab_size * cfg.hidden_size
 
     # warmup (compile). NOTE: on the axon TPU relay block_until_ready does not
@@ -208,11 +267,12 @@ def _measure(devs) -> None:
     target_mfu = 0.40
     _emit(
         {
-            "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
+            "metric": METRIC,
             "value": round(tokens_per_sec, 2),
             "unit": "tokens/s",
             "vs_baseline": round(mfu / target_mfu, 4),
             "extras": {
+                "scope": "tiny" if tiny else "full",
                 "mfu": round(mfu, 4),
                 "mfu_raw_6n": round(mfu_raw, 4),
                 "flops_matmul_per_step": flops_matmul,
@@ -319,6 +379,11 @@ def child_parallel() -> None:
     )
 
 
+# --------------------------------------------------------------------------
+# parent orchestration
+# --------------------------------------------------------------------------
+
+
 def _parse_result(stdout: str):
     """Last stdout line that parses as a JSON object with a 'metric' key."""
     for line in reversed(stdout.strip().splitlines()):
@@ -334,108 +399,138 @@ def _parse_result(stdout: str):
     return None
 
 
-def _run_parallel_proxy():
-    """Run the CPU-mesh 1F1B-vs-GPipe proxy child; returns the proxy dict, or
-    a dict with an 'error' key on failure (the proxy augments the headline
-    metric, it must never sink it)."""
+def _run_child(flag: str, timeout_s: float):
+    """Run a child process; returns (parsed_json_or_None, error_string_or_None)."""
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child-parallel"],
+            [sys.executable, os.path.abspath(__file__), flag],
             capture_output=True,
             text=True,
-            timeout=420,
+            timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:
-        return {"error": "parallel proxy timed out"}
+        return None, f"timed out after {int(timeout_s)}s"
     result = _parse_result(proc.stdout)
-    if result is None or result.get("metric") != "parallel_proxy":
-        tail = (proc.stderr or proc.stdout or "").strip()[-300:]
-        return {"error": f"parallel proxy failed: {tail}"}
-    result.pop("metric", None)
-    return result
+    if result is None:
+        tail = (proc.stderr or proc.stdout or "").strip()[-400:]
+        return None, f"rc={proc.returncode}, no JSON: {tail}"
+    return result, None
 
 
 def main() -> None:
     errors = []
-    # A successful headline result is stashed here so that a driver SIGTERM
-    # during the (optional, slow) parallel proxy still emits the real TPU
-    # measurement instead of discarding it.
+    # Best result so far — a driver SIGTERM at any point emits this plus
+    # whatever diagnosis has accumulated, instead of discarding everything.
     headline = {}
-    # If the driver kills the harness mid-retry (its outer budget may be
-    # shorter than ours), still flush a parseable error JSON on the way out.
+    probe_info = None
+    proxy_result = None
+
     import signal
 
+    def _finalize():
+        result = dict(headline) if headline else _error_payload(
+            "; ".join(errors) or "no attempt produced output"
+        )
+        extras = result.setdefault("extras", {})
+        if errors and "error" not in result:
+            extras["attempt_errors"] = errors
+        if probe_info is not None:
+            extras["probe"] = probe_info
+        extras["parallel_proxy"] = (
+            proxy_result if proxy_result is not None else {"error": "proxy did not finish"}
+        )
+        extras["prior_measurements"] = PRIOR_MEASUREMENTS
+        _emit(result)
+
     def _on_term(signum, frame):
-        if headline:
-            result = dict(headline)
-            result.setdefault("extras", {})["parallel_proxy"] = {
-                "error": f"killed by signal {signum} during proxy"
-            }
-            _emit(result)
-        else:
-            _emit(
-                {
-                    "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
-                    "value": 0,
-                    "unit": "tokens/s",
-                    "vs_baseline": 0.0,
-                    "error": "; ".join(
-                        errors + [f"killed by signal {signum} mid-attempt"]
-                    ),
-                }
-            )
+        errors.append(f"killed by signal {signum}")
+        try:
+            proxy_proc.kill()  # don't orphan a CPU-burning XLA compile
+        except Exception:
+            pass
+        _finalize()
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
 
-    for attempt, timeout_s in enumerate(ATTEMPT_TIMEOUTS, 1):
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True,
-                text=True,
-                timeout=timeout_s,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-        except subprocess.TimeoutExpired:
-            errors.append(f"attempt {attempt}: timed out after {timeout_s}s (backend hang)")
-            continue
-        result = _parse_result(proc.stdout)
-        if result is None:
-            tail = (proc.stderr or proc.stdout or "").strip()[-400:]
-            errors.append(f"attempt {attempt}: rc={proc.returncode}, no JSON: {tail}")
-            continue
-        if "error" in result and result.get("retryable") and attempt < len(ATTEMPT_TIMEOUTS):
-            errors.append(f"attempt {attempt}: {result['error']}")
-            continue
-        if "error" in result:
-            errors.append(f"attempt {attempt}: {result['error']}")
-            result["error"] = "; ".join(errors)
-            result.pop("retryable", None)
-        headline.update(result)
-        if "error" not in result:
-            # only augment a successful headline — a dead bench should not
-            # spend minutes compiling the CPU proxy before reporting
-            result.setdefault("extras", {})["parallel_proxy"] = _run_parallel_proxy()
-        print(json.dumps(result), flush=True)
-        return
-    _emit(
-        {
-            "metric": "llama2_7b_width_train_tokens_per_sec_per_chip",
-            "value": 0,
-            "unit": "tokens/s",
-            "vs_baseline": 0.0,
-            "error": "; ".join(errors) or "no attempt produced output",
-        }
+    # 1. CPU parallel proxy: launch concurrently, collect later, merge
+    #    UNCONDITIONALLY (a dead relay must still yield engine-relative perf
+    #    evidence).
+    proxy_proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child-parallel"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
     )
+    proxy_t0 = time.perf_counter()
+
+    # 2. Relay probe: cheap, bounded, retried once. A relay that cannot
+    #    enumerate devices within 90 s gets no 600 s attempt at all.
+    relay_ok = False
+    for attempt in (1, 2):
+        probe, err = _run_child("--probe", PROBE_TIMEOUT_S)
+        if probe is not None and probe.get("ok"):
+            probe_info = probe
+            relay_ok = True
+            break
+        errors.append(f"probe attempt {attempt}: {err or json.dumps(probe)[:200]}")
+    if not relay_ok:
+        errors.append("relay probe failed twice; skipping TPU measurement")
+
+    # 3. Tiny TPU measurement first (compiles in seconds) — guarantees a
+    #    real-chip number even under a tight budget; then the full config.
+    if relay_ok:
+        tiny, err = _run_child("--child-tiny", TINY_TIMEOUT_S)
+        if tiny is not None and "error" not in tiny:
+            # the tiny config (1 layer, batch 1, seq 512) yields ~2x the
+            # tokens/s of the full 2-layer/batch-4 config, so its raw value is
+            # NOT comparable to prior full-config artifacts — mark the unit
+            # and scope; vs_baseline (MFU-normalized) remains comparable
+            tiny.setdefault("extras", {})["scope"] = "tiny_fallback"
+            tiny["unit"] = "tokens/s (tiny 1-layer config — MFU is the comparable field)"
+            headline = tiny
+        else:
+            errors.append(f"tiny: {err or tiny.get('error', '?')}")
+
+        for attempt in (1, 2):
+            full, err = _run_child("--child", FULL_TIMEOUT_S)
+            if full is not None and "error" not in full:
+                headline = full
+                break
+            msg = err or full.get("error", "?")
+            errors.append(f"full attempt {attempt}: {msg}")
+            if full is not None and not full.get("retryable", False):
+                break
+
+    # 4. Collect the proxy (bounded by its own budget) and finalize.
+    remaining = max(30.0, PROXY_TIMEOUT_S - (time.perf_counter() - proxy_t0))
+    try:
+        stdout, stderr = proxy_proc.communicate(timeout=remaining)
+        parsed = _parse_result(stdout)
+        if parsed is not None and parsed.get("metric") == "parallel_proxy":
+            parsed.pop("metric", None)
+            proxy_result = parsed
+        else:
+            tail = (stderr or stdout or "").strip()[-300:]
+            proxy_result = {"error": f"parallel proxy failed: {tail}"}
+    except subprocess.TimeoutExpired:
+        proxy_proc.kill()
+        proxy_result = {"error": "parallel proxy timed out"}
+
+    _finalize()
 
 
 if __name__ == "__main__":
     if "--child-parallel" in sys.argv:
         child_parallel()
+    elif "--child-tiny" in sys.argv:
+        child(tiny=True)
     elif "--child" in sys.argv:
-        child()
+        child(tiny=False)
+    elif "--probe" in sys.argv:
+        child_probe()
     else:
         main()

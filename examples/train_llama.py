@@ -159,6 +159,8 @@ def make_data_iter(args, cfg, batch_size: int, seq_len: int):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.resume and not args.ckpt_dir:
+        raise SystemExit("--resume requires --ckpt-dir (nothing to resume from)")
     if args.force_cpu_devices:
         from neuronx_distributed_tpu.utils.platform import force_cpu_devices
 

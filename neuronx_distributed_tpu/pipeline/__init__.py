@@ -1,3 +1,8 @@
+from neuronx_distributed_tpu.pipeline.generic import (
+    FamilyPipeline,
+    GenericPipelineAdapter,
+    TreeLayout,
+)
 from neuronx_distributed_tpu.pipeline.model import PipelineEngine, microbatch
 from neuronx_distributed_tpu.pipeline.scheduler import (
     InferenceSchedule,
@@ -7,6 +12,9 @@ from neuronx_distributed_tpu.pipeline.scheduler import (
 )
 
 __all__ = [
+    "FamilyPipeline",
+    "GenericPipelineAdapter",
+    "TreeLayout",
     "PipelineEngine",
     "microbatch",
     "InferenceSchedule",

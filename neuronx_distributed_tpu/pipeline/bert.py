@@ -1,0 +1,92 @@
+"""BERT ↔ PipelineEngine adapter via the generic declarative layer — the
+encoder variant (reference: NxDPPModel pipelines the BERT pretrain example,
+pipeline/model.py:80).
+
+The embed stage is the full BERT embedding block (token + position + type
+embeddings + embed LayerNorm); the head is the MLM transform + decoder.
+Padding attention masks are not threaded to per-layer attention under PP
+(activations are the only inter-stage channel — the fixed-length packed
+pretraining batches the reference example uses need none); the MLM
+``loss_mask`` applies at the head as usual."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.models.bert import BertConfig, BertLayer
+from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+)
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+from neuronx_distributed_tpu.pipeline.generic import FamilyPipeline, TreeLayout
+
+BERT_LAYOUT = TreeLayout(
+    embed={
+        "tok_embed": ("bert", "tok_embed"),
+        "pos_embed": ("bert", "pos_embed"),
+        "type_embed": ("bert", "type_embed"),
+        "embed_norm": ("bert", "embed_norm"),
+    },
+    head={
+        "transform": ("transform",),
+        "transform_norm": ("transform_norm",),
+        "decoder": ("decoder",),
+    },
+    unrolled_parent=("bert",),
+    unrolled_prefix="layers_",
+)
+
+
+def bert_family(config: BertConfig) -> FamilyPipeline:
+    import jax
+
+    cfg = config
+    emb = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    tok_embed = ParallelEmbedding(cfg.vocab_size, cfg.hidden_size, **emb)
+    pos_embed = ParallelEmbedding(cfg.max_seq_len, cfg.hidden_size, **emb)
+    type_embed = ParallelEmbedding(cfg.type_vocab_size, cfg.hidden_size, **emb)
+    norm = dict(eps=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+    embed_norm = LayerNorm(cfg.hidden_size, **norm)
+    layer = BertLayer(cfg)
+    transform = ColumnParallelLinear(
+        cfg.hidden_size, cfg.hidden_size, use_bias=True, gather_output=True, **emb
+    )
+    transform_norm = LayerNorm(cfg.hidden_size, **norm)
+    decoder = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size, use_bias=True, **emb)
+
+    def embed_apply(ep, mb_batch):
+        ids = mb_batch["input_ids"]
+        b, s = ids.shape
+        x = tok_embed.apply({"params": ep["tok_embed"]}, ids)
+        pos = jnp.arange(s)[None, :].repeat(b, 0)
+        x = x + pos_embed.apply({"params": ep["pos_embed"]}, pos)
+        types = mb_batch.get("token_type_ids")
+        if types is None:
+            types = jnp.zeros_like(ids)
+        x = x + type_embed.apply({"params": ep["type_embed"]}, types)
+        return embed_norm.apply({"params": ep["embed_norm"]}, x)
+
+    def layer_apply(lp, x):
+        return layer.apply({"params": lp}, x)
+
+    def head_apply(hp, x, mb_batch):
+        h = transform.apply({"params": hp["transform"]}, x)
+        h = jax.nn.gelu(h)
+        h = transform_norm.apply({"params": hp["transform_norm"]}, h)
+        logits = decoder.apply({"params": hp["decoder"]}, h)
+        losses = parallel_cross_entropy(logits, mb_batch["labels"])
+        mask = mb_batch.get("loss_mask")
+        if mask is None:
+            mask = jnp.ones_like(losses)
+        return (losses * mask).sum(), mask.sum().astype(jnp.float32)
+
+    return FamilyPipeline(
+        embed_apply=embed_apply,
+        layer_apply=layer_apply,
+        head_apply=head_apply,
+        num_layers=cfg.num_layers,
+        layout=BERT_LAYOUT,
+        remat=cfg.remat,
+    )

@@ -1,0 +1,58 @@
+"""CodeGen ↔ PipelineEngine adapter via the generic declarative layer
+(reference: NxDPPModel pipelines the codegen25 example, pipeline/model.py:80;
+round-3 coverage #15 flagged CodeGen as unable to pipeline).
+
+CodeGen's lm_head carries a bias (unlike Llama/NeoX) — covered by the shared
+``lm_head_apply`` since the bias lives inside the ColumnParallelLinear
+subtree."""
+
+from __future__ import annotations
+
+from neuronx_distributed_tpu.models.codegen import CodeGenBlock, CodeGenConfig
+from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    ParallelEmbedding,
+)
+from neuronx_distributed_tpu.pipeline.generic import (
+    FamilyPipeline,
+    TreeLayout,
+    lm_head_apply,
+)
+
+CODEGEN_LAYOUT = TreeLayout(
+    embed={"embed": ("embed",)},
+    head={"final_norm": ("final_norm",), "lm_head": ("lm_head",)},
+    unrolled_prefix="blocks_",
+)
+
+
+def codegen_family(config: CodeGenConfig) -> FamilyPipeline:
+    embed = ParallelEmbedding(
+        config.vocab_size, config.hidden_size, dtype=config.dtype,
+        param_dtype=config.param_dtype,
+    )
+    block = CodeGenBlock(config)
+    final_norm = LayerNorm(
+        config.hidden_size, eps=config.layer_norm_eps, dtype=config.dtype,
+        param_dtype=config.param_dtype,
+    )
+    lm_head = ColumnParallelLinear(
+        config.hidden_size, config.vocab_size, use_bias=True,
+        dtype=config.dtype, param_dtype=config.param_dtype,
+    )
+
+    def embed_apply(ep, mb_batch):
+        return embed.apply({"params": ep["embed"]}, mb_batch["input_ids"])
+
+    def layer_apply(lp, x):
+        return block.apply({"params": lp}, x)
+
+    return FamilyPipeline(
+        embed_apply=embed_apply,
+        layer_apply=layer_apply,
+        head_apply=lm_head_apply(final_norm, lm_head),
+        num_layers=config.num_layers,
+        layout=CODEGEN_LAYOUT,
+        remat=config.remat,
+    )

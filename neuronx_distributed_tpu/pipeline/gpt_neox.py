@@ -1,17 +1,13 @@
 """GPT-NeoX ↔ PipelineEngine adapter (reference: manual pipe stages for
-arbitrary models, ``pipeline/manual_pipe_stage.py`` — round-2 coverage #15
-flagged Llama as the sole adapter).
+arbitrary models, ``pipeline/manual_pipe_stage.py``).
 
-NeoX uses the unrolled ``layers_{i}`` layout; the adapter stacks the
-per-layer subtrees into the engine's (L, ...) layout and back."""
+NeoX uses the unrolled ``layers_{i}`` layout — handled declaratively by the
+generic TreeLayout (pipeline/generic.py), which stacks the per-layer subtrees
+into the engine's (L, ...) layout and back."""
 
 from __future__ import annotations
 
 from typing import Any, Dict
-
-import jax
-import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_tpu.models.gpt_neox import GPTNeoXConfig, GPTNeoXLayer
 from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
@@ -19,16 +15,21 @@ from neuronx_distributed_tpu.parallel.layers import (
     ColumnParallelLinear,
     ParallelEmbedding,
 )
-from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
-from neuronx_distributed_tpu.pipeline.model import OneFOneBEngine, PipelineEngine
+from neuronx_distributed_tpu.pipeline.generic import (
+    FamilyPipeline,
+    TreeLayout,
+    lm_head_apply,
+)
+from neuronx_distributed_tpu.pipeline.model import PipelineEngine
+
+GPT_NEOX_LAYOUT = TreeLayout(
+    embed={"embed": ("embed",)},
+    head={"final_norm": ("final_norm",), "lm_head": ("lm_head",)},
+    unrolled_prefix="layers_",
+)
 
 
-def gpt_neox_pipeline_engine(
-    config: GPTNeoXConfig,
-    num_microbatches: int,
-    schedule: str = "1f1b",
-    num_chunks: int = 1,
-) -> PipelineEngine:
+def gpt_neox_family(config: GPTNeoXConfig) -> FamilyPipeline:
     embed = ParallelEmbedding(
         config.vocab_size, config.hidden_size, dtype=config.dtype,
         param_dtype=config.param_dtype,
@@ -44,89 +45,39 @@ def gpt_neox_pipeline_engine(
     )
 
     def embed_apply(ep, mb_batch):
-        return embed.apply({"params": ep}, mb_batch["input_ids"])
+        return embed.apply({"params": ep["embed"]}, mb_batch["input_ids"])
 
     def layer_apply(lp, x):
         return layer.apply({"params": lp}, x, None)
 
-    def head_apply(hp, x, mb_batch):
-        h = final_norm.apply({"params": hp["final_norm"]}, x)
-        logits = lm_head.apply({"params": hp["lm_head"]}, h)
-        losses = parallel_cross_entropy(logits, mb_batch["labels"])
-        mask = mb_batch.get("loss_mask")
-        if mask is None:
-            mask = jnp.ones_like(losses)
-        return (losses * mask).sum(), mask.sum().astype(jnp.float32)
-
-    from neuronx_distributed_tpu.pipeline.model import build_pipeline_engine
-
-    return build_pipeline_engine(
-        schedule,
-        num_chunks=num_chunks,
+    return FamilyPipeline(
         embed_apply=embed_apply,
         layer_apply=layer_apply,
-        head_apply=head_apply,
+        head_apply=lm_head_apply(final_norm, lm_head),
         num_layers=config.num_layers,
-        num_microbatches=num_microbatches,
-        remat_layers=config.remat,
+        layout=GPT_NEOX_LAYOUT,
+        remat=config.remat,
     )
 
 
-def _stack_unrolled(params: Dict[str, Any], n: int):
-    per_layer = [params[f"layers_{i}"] for i in range(n)]
-    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_layer)
+def gpt_neox_pipeline_engine(
+    config: GPTNeoXConfig,
+    num_microbatches: int,
+    schedule: str = "1f1b",
+    num_chunks: int = 1,
+) -> PipelineEngine:
+    return gpt_neox_family(config).engine(
+        num_microbatches, schedule=schedule, num_chunks=num_chunks
+    )
 
 
 def gpt_neox_params_to_pipeline(params: Dict[str, Any], engine: PipelineEngine):
-    p = params["params"]
-    return {
-        "embed": p["embed"],
-        "layers": engine.reshape_layer_params(
-            _stack_unrolled(p, engine.num_layers)
-        ),
-        "head": {"final_norm": p["final_norm"], "lm_head": p["lm_head"]},
-    }
+    return GPT_NEOX_LAYOUT.params_to_pipeline(params, engine)
 
 
 def pipeline_params_to_gpt_neox(pp_params: Dict[str, Any], engine: PipelineEngine):
-    stacked = engine.unshape_layer_params(pp_params["layers"])
-    n = engine.num_layers
-    out: Dict[str, Any] = {
-        "embed": pp_params["embed"],
-        "final_norm": pp_params["head"]["final_norm"],
-        "lm_head": pp_params["head"]["lm_head"],
-    }
-    for i in range(n):
-        out[f"layers_{i}"] = jax.tree.map(lambda x: x[i], stacked)
-    return {"params": out}
+    return GPT_NEOX_LAYOUT.pipeline_to_params(pp_params, engine)
 
 
 def gpt_neox_pipeline_shardings(boxed_variables, engine: PipelineEngine):
-    """NamedShardings for the pipeline layout from flax metadata (the
-    unrolled layers share one structure — layer 0's specs gain the stacked
-    layer dim, then the engine's stage layout)."""
-    from flax import linen as nn
-    from jax.sharding import NamedSharding
-
-    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
-
-    mesh = mesh_lib.get_mesh()
-    specs = nn.get_partition_spec(boxed_variables)["params"]
-    layer_specs = jax.tree.map(
-        lambda s: P(None, *s) if isinstance(s, P) else P(None),
-        specs["layers_0"],
-        is_leaf=lambda s: isinstance(s, P),
-    )
-    pp_specs = {
-        "embed": specs["embed"],
-        "layers": engine.stack_layer_specs(layer_specs),
-        "head": {
-            "final_norm": specs["final_norm"],
-            "lm_head": specs["lm_head"],
-        },
-    }
-    return jax.tree.map(
-        lambda s: NamedSharding(mesh, s),
-        pp_specs,
-        is_leaf=lambda s: isinstance(s, P),
-    )
+    return GPT_NEOX_LAYOUT.pipeline_shardings(boxed_variables, engine)

@@ -2,15 +2,16 @@
 (reference: ``pipeline/manual_pipe_stage.py`` ``PipelineStageModule`` — the
 user-supplied-layer-list mode, which SURVEY.md §7 identifies as the idiomatic
 one for a scan-form JAX model; FX graph tracing is a torch-ism with no TPU
-equivalent needed)."""
+equivalent needed).
+
+Round 4: the shared machinery (param/spec reshaping, Trainer integration)
+lives in pipeline/generic.py; this module is the Llama-specific declaration
+plus the long-standing public function names."""
 
 from __future__ import annotations
 
 import dataclasses
 from typing import Any, Dict
-
-import jax
-import jax.numpy as jnp
 
 from neuronx_distributed_tpu.models.llama import (
     LlamaConfig,
@@ -22,24 +23,22 @@ from neuronx_distributed_tpu.parallel.layers import (
     ColumnParallelLinear,
     ParallelEmbedding,
 )
-from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
-from neuronx_distributed_tpu.pipeline.model import OneFOneBEngine, PipelineEngine
+from neuronx_distributed_tpu.pipeline.generic import (
+    FamilyPipeline,
+    GenericPipelineAdapter,
+    TreeLayout,
+    lm_head_apply,
+)
+from neuronx_distributed_tpu.pipeline.model import PipelineEngine
+
+LLAMA_LAYOUT = TreeLayout(
+    embed={"embed": ("model", "embed")},
+    head={"final_norm": ("model", "final_norm"), "lm_head": ("lm_head",)},
+    scan_path=("model", "layers", "layer"),
+)
 
 
-def llama_pipeline_engine(
-    config: LlamaConfig,
-    num_microbatches: int,
-    attention_impl: str = "auto",
-    schedule: str = "gpipe",
-    num_chunks: int = 1,
-) -> PipelineEngine:
-    """Build a pipeline engine for a scan-form Llama (config.scan_layers=True).
-
-    ``schedule``: "gpipe" (scan engine, backward by autodiff — time-optimal,
-    activation memory O(M)), "1f1b" (OneFOneBEngine — explicit synchronous
-    1F1B, activation memory O(S)), or "interleaved" (OneFOneBEngine with
-    ``num_chunks`` virtual chunks per rank — the bubble-shrinking schedule;
-    see pipeline/model.py)."""
+def llama_family(config: LlamaConfig, attention_impl: str = "auto") -> FamilyPipeline:
     embed = ParallelEmbedding(
         num_embeddings=config.vocab_size,
         features=config.hidden_size,
@@ -65,95 +64,62 @@ def llama_pipeline_engine(
     freqs = rope_frequencies(config.head_dim_, config.max_seq_len, config.rope_theta)
 
     def embed_apply(ep, mb_batch):
-        return embed.apply({"params": ep}, mb_batch["input_ids"])
+        return embed.apply({"params": ep["embed"]}, mb_batch["input_ids"])
 
     def layer_apply(lp, x):
         return layer.apply({"params": lp}, x, freqs, None)
 
-    def head_apply(hp, x, mb_batch):
-        h = final_norm.apply({"params": hp["final_norm"]}, x)
-        logits = lm_head.apply({"params": hp["lm_head"]}, h)
-        losses = parallel_cross_entropy(logits, mb_batch["labels"])
-        mask = mb_batch.get("loss_mask")
-        if mask is None:
-            mask = jnp.ones_like(losses)
-        return (losses * mask).sum(), mask.sum().astype(jnp.float32)
-
-    from neuronx_distributed_tpu.pipeline.model import build_pipeline_engine
-
-    return build_pipeline_engine(
-        schedule,
-        num_chunks=num_chunks,
+    return FamilyPipeline(
         embed_apply=embed_apply,
         layer_apply=layer_apply,
-        head_apply=head_apply,
+        head_apply=lm_head_apply(final_norm, lm_head),
         num_layers=config.num_layers,
-        num_microbatches=num_microbatches,
-        remat_layers=config.remat,
+        layout=LLAMA_LAYOUT,
+        remat=config.remat,
+    )
+
+
+def llama_pipeline_engine(
+    config: LlamaConfig,
+    num_microbatches: int,
+    attention_impl: str = "auto",
+    schedule: str = "gpipe",
+    num_chunks: int = 1,
+) -> PipelineEngine:
+    """Build a pipeline engine for a scan-form Llama (config.scan_layers=True).
+
+    ``schedule``: "gpipe" (scan engine, backward by autodiff — time-optimal,
+    activation memory O(M)), "1f1b" (OneFOneBEngine — explicit synchronous
+    1F1B, activation memory O(S)), or "interleaved" (OneFOneBEngine with
+    ``num_chunks`` virtual chunks per rank — the bubble-shrinking schedule;
+    see pipeline/model.py)."""
+    return llama_family(config, attention_impl).engine(
+        num_microbatches, schedule=schedule, num_chunks=num_chunks
     )
 
 
 def llama_params_to_pipeline(params: Dict[str, Any], engine: PipelineEngine):
-    """Convert scan-form LlamaForCausalLM params into the engine's layout.
-    The scan adapter nests each layer under 'layer'
-    (models/llama.py _ScanLayerAdapter)."""
-    p = params["params"]
-    return {
-        "embed": p["model"]["embed"],
-        "layers": engine.reshape_layer_params(p["model"]["layers"]["layer"]),
-        "head": {
-            "final_norm": p["model"]["final_norm"],
-            "lm_head": p["lm_head"],
-        },
-    }
+    """Convert scan-form LlamaForCausalLM params into the engine's layout."""
+    return LLAMA_LAYOUT.params_to_pipeline(params, engine)
+
+
+def pipeline_params_to_llama(pp_params: Dict[str, Any], engine: PipelineEngine):
+    """Inverse conversion (for checkpoint interchange)."""
+    return LLAMA_LAYOUT.pipeline_to_params(pp_params, engine)
 
 
 def llama_pipeline_shardings(boxed_variables, engine: PipelineEngine):
     """NamedShardings for the pipeline param layout, from the scan-form model's
     flax metadata: layers get (pp, None, *param-spec), embed/head keep theirs."""
-    from flax import linen as nn
-    from jax.sharding import NamedSharding
-
-    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
-
-    mesh = mesh_lib.get_mesh()
-    specs = nn.get_partition_spec(boxed_variables)["params"]
-    pp_specs = {
-        "embed": specs["model"]["embed"],
-        "layers": engine.stack_layer_specs(specs["model"]["layers"]["layer"]),
-        "head": {
-            "final_norm": specs["model"]["final_norm"],
-            "lm_head": specs["lm_head"],
-        },
-    }
-    return jax.tree.map(
-        lambda s: NamedSharding(mesh, s),
-        pp_specs,
-        is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec),
-    )
-
-
-def pipeline_params_to_llama(pp_params: Dict[str, Any], engine: PipelineEngine):
-    """Inverse conversion (for checkpoint interchange)."""
-    return {
-        "params": {
-            "model": {
-                "embed": pp_params["embed"],
-                "layers": {"layer": engine.unshape_layer_params(pp_params["layers"])},
-                "final_norm": pp_params["head"]["final_norm"],
-            },
-            "lm_head": pp_params["head"]["lm_head"],
-        }
-    }
+    return LLAMA_LAYOUT.pipeline_shardings(boxed_variables, engine)
 
 
 @dataclasses.dataclass
 class LlamaPipelineAdapter:
     """Plugs a scan-form Llama into the Trainer's pipeline path
-    (trainer/loop.py): builds the engine, converts params to the pipeline
-    layout, and produces the jitted train step. The reference analogue is
-    ``initialize_parallel_model``'s NxDPPModel wrap (trainer/trainer.py:147)
-    followed by ``NxDPPModel.run_train``."""
+    (trainer/loop.py). The reference analogue is ``initialize_parallel_model``'s
+    NxDPPModel wrap (trainer/trainer.py:147) followed by
+    ``NxDPPModel.run_train``. All machinery is the generic adapter's."""
 
     config: LlamaConfig
     num_microbatches: int
@@ -161,54 +127,23 @@ class LlamaPipelineAdapter:
     schedule: str = "1f1b"
     num_chunks: int = 1
 
-    def build_state_and_step(self, model, optimizer, rng_key, sample_ids,
-                             zero1: bool = True, max_grad_norm: float = 1.0):
-        import jax.numpy as jnp
-        from flax.core import meta
-
-        from neuronx_distributed_tpu.optim.zero1 import zero1_shardings_for_opt_state
-        from neuronx_distributed_tpu.trainer.trainer import (
-            TrainState,
-            build_train_step,
-        )
-
-        engine = llama_pipeline_engine(
-            self.config,
+    def _generic(self) -> GenericPipelineAdapter:
+        return GenericPipelineAdapter(
+            family=llama_family(self.config, self.attention_impl),
             num_microbatches=self.num_microbatches,
-            attention_impl=self.attention_impl,
             schedule=self.schedule,
             num_chunks=self.num_chunks,
         )
-        boxed = jax.jit(model.init)(rng_key, sample_ids)
-        pp_sh = llama_pipeline_shardings(boxed, engine)
-        params = jax.device_put(
-            llama_params_to_pipeline({"params": meta.unbox(boxed)["params"]}, engine),
-            pp_sh,
+
+    def build_state_and_step(self, model, optimizer, rng_key, sample_ids,
+                             zero1: bool = True, max_grad_norm: float = 1.0):
+        return self._generic().build_state_and_step(
+            model, optimizer, rng_key, sample_ids,
+            zero1=zero1, max_grad_norm=max_grad_norm,
         )
-        specs = jax.tree.map(lambda s: s.spec, pp_sh)
-        opt_sh = zero1_shardings_for_opt_state(
-            jax.eval_shape(optimizer.init, params), params, specs, enabled=zero1
-        )
-        opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)(params)
-        step_kw = (
-            {"value_and_grad_fn": engine.value_and_grad}
-            if self.schedule in ("1f1b", "interleaved")
-            else {"loss_fn": engine.loss_fn}
-        )
-        step = build_train_step(
-            model=None,
-            optimizer=optimizer,
-            params_shardings=pp_sh,
-            opt_state_shardings=opt_sh,
-            max_grad_norm=max_grad_norm,
-            **step_kw,
-        )
-        state = TrainState(
-            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
-        )
-        return state, step, engine
 
     def prepare_batch(self, batch):
+        # called once per training step — must not rebuild the family modules
         from neuronx_distributed_tpu.pipeline.model import (
             microbatch,
             shard_microbatched_batch,

@@ -1,6 +1,5 @@
-"""Mixtral (MoE) ↔ PipelineEngine adapter (round-2 coverage #15: only Llama
-could pipeline; reference: NxDPPModel wraps arbitrary models incl. the
-Mixtral example, pipeline/model.py:80).
+"""Mixtral (MoE) ↔ PipelineEngine adapter (reference: NxDPPModel wraps
+arbitrary models incl. the Mixtral example, pipeline/model.py:80).
 
 MoE specifics: each decoder layer returns ``(x, aux_vec)`` router aux terms —
 the engines' ``layer_aux`` channel sums them (pre-weighted by the config's
@@ -15,9 +14,6 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-import jax
-import jax.numpy as jnp
-
 from neuronx_distributed_tpu.models.llama import rope_frequencies
 from neuronx_distributed_tpu.models.mixtral import (
     MixtralConfig,
@@ -28,17 +24,23 @@ from neuronx_distributed_tpu.parallel.layers import (
     ColumnParallelLinear,
     ParallelEmbedding,
 )
-from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
-from neuronx_distributed_tpu.pipeline.model import OneFOneBEngine, PipelineEngine
+from neuronx_distributed_tpu.pipeline.generic import (
+    FamilyPipeline,
+    TreeLayout,
+    lm_head_apply,
+)
+from neuronx_distributed_tpu.pipeline.model import PipelineEngine
+
+MIXTRAL_LAYOUT = TreeLayout(
+    embed={"embed": ("model", "embed")},
+    head={"final_norm": ("model", "final_norm"), "lm_head": ("lm_head",)},
+    scan_path=("model", "layers", "layer"),
+)
 
 
-def mixtral_pipeline_engine(
-    config: MixtralConfig,
-    num_microbatches: int,
-    attention_impl: str = "auto",
-    schedule: str = "1f1b",
-    num_chunks: int = 1,
-) -> PipelineEngine:
+def mixtral_family(
+    config: MixtralConfig, attention_impl: str = "auto"
+) -> FamilyPipeline:
     embed = ParallelEmbedding(
         config.vocab_size, config.hidden_size, dtype=config.dtype,
         param_dtype=config.param_dtype,
@@ -57,7 +59,7 @@ def mixtral_pipeline_engine(
     freqs = rope_frequencies(config.head_dim_, config.max_seq_len, config.rope_theta)
 
     def embed_apply(ep, mb_batch):
-        return embed.apply({"params": ep}, mb_batch["input_ids"])
+        return embed.apply({"params": ep["embed"]}, mb_batch["input_ids"])
 
     def layer_apply(lp, x):
         x, aux_vec = layer.apply({"params": lp}, x, freqs, None)
@@ -67,76 +69,37 @@ def mixtral_pipeline_engine(
         )
         return x, aux
 
-    def head_apply(hp, x, mb_batch):
-        h = final_norm.apply({"params": hp["final_norm"]}, x)
-        logits = lm_head.apply({"params": hp["lm_head"]}, h)
-        losses = parallel_cross_entropy(logits, mb_batch["labels"])
-        mask = mb_batch.get("loss_mask")
-        if mask is None:
-            mask = jnp.ones_like(losses)
-        return (losses * mask).sum(), mask.sum().astype(jnp.float32)
-
-    from neuronx_distributed_tpu.pipeline.model import build_pipeline_engine
-
-    return build_pipeline_engine(
-        schedule,
-        num_chunks=num_chunks,
+    return FamilyPipeline(
         embed_apply=embed_apply,
         layer_apply=layer_apply,
-        head_apply=head_apply,
+        head_apply=lm_head_apply(final_norm, lm_head),
         num_layers=config.num_layers,
-        num_microbatches=num_microbatches,
-        remat_layers=config.remat,
+        layout=MIXTRAL_LAYOUT,
+        remat=config.remat,
         layer_aux=True,
     )
 
 
+def mixtral_pipeline_engine(
+    config: MixtralConfig,
+    num_microbatches: int,
+    attention_impl: str = "auto",
+    schedule: str = "1f1b",
+    num_chunks: int = 1,
+) -> PipelineEngine:
+    return mixtral_family(config, attention_impl).engine(
+        num_microbatches, schedule=schedule, num_chunks=num_chunks
+    )
+
+
 def mixtral_params_to_pipeline(params: Dict[str, Any], engine: PipelineEngine):
-    """Scan-form MixtralForCausalLM params → engine layout (the scan adapter
-    nests each layer under 'layer', models/mixtral.py)."""
-    p = params["params"]
-    return {
-        "embed": p["model"]["embed"],
-        "layers": engine.reshape_layer_params(p["model"]["layers"]["layer"]),
-        "head": {
-            "final_norm": p["model"]["final_norm"],
-            "lm_head": p["lm_head"],
-        },
-    }
+    """Scan-form MixtralForCausalLM params → engine layout."""
+    return MIXTRAL_LAYOUT.params_to_pipeline(params, engine)
 
 
 def pipeline_params_to_mixtral(pp_params: Dict[str, Any], engine: PipelineEngine):
-    return {
-        "params": {
-            "model": {
-                "embed": pp_params["embed"],
-                "layers": {"layer": engine.unshape_layer_params(pp_params["layers"])},
-                "final_norm": pp_params["head"]["final_norm"],
-            },
-            "lm_head": pp_params["head"]["lm_head"],
-        }
-    }
+    return MIXTRAL_LAYOUT.pipeline_to_params(pp_params, engine)
 
 
 def mixtral_pipeline_shardings(boxed_variables, engine: PipelineEngine):
-    from flax import linen as nn
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
-
-    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
-
-    mesh = mesh_lib.get_mesh()
-    specs = nn.get_partition_spec(boxed_variables)["params"]
-    pp_specs = {
-        "embed": specs["model"]["embed"],
-        "layers": engine.stack_layer_specs(specs["model"]["layers"]["layer"]),
-        "head": {
-            "final_norm": specs["model"]["final_norm"],
-            "lm_head": specs["lm_head"],
-        },
-    }
-    return jax.tree.map(
-        lambda s: NamedSharding(mesh, s),
-        pp_specs,
-        is_leaf=lambda s: isinstance(s, P),
-    )
+    return MIXTRAL_LAYOUT.pipeline_shardings(boxed_variables, engine)

@@ -1,0 +1,84 @@
+"""ViT ↔ PipelineEngine adapter via the generic declarative layer — the
+vision-encoder variant (reference: NxDPPModel pipelines the ViT example,
+pipeline/model.py:80).
+
+The embed stage is patch conv + [CLS] + learned positions; the head is the
+final norm + classifier over the CLS token with softmax cross entropy (the
+loss weight is the example count, not a token count)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.models.vit import ViTBlock, ViTConfig
+from neuronx_distributed_tpu.modules.layer_norm import LayerNorm
+from neuronx_distributed_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    OutputChannelParallelConv2d,
+)
+from neuronx_distributed_tpu.pipeline.generic import FamilyPipeline, TreeLayout
+
+VIT_LAYOUT = TreeLayout(
+    embed={
+        "patch_embed": ("patch_embed",),
+        "cls_token": ("cls_token",),
+        "pos_embed": ("pos_embed",),
+    },
+    head={"final_norm": ("final_norm",), "classifier": ("classifier",)},
+    unrolled_prefix="blocks_",
+)
+
+
+def vit_family(config: ViTConfig) -> FamilyPipeline:
+    cfg = config
+    patch_embed = OutputChannelParallelConv2d(
+        in_channels=cfg.num_channels,
+        out_channels=cfg.hidden_size,
+        kernel_size=(cfg.patch_size, cfg.patch_size),
+        strides=(cfg.patch_size, cfg.patch_size),
+        padding="VALID",
+        gather_output=True,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+    )
+    block = ViTBlock(cfg)
+    final_norm = LayerNorm(
+        cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+    )
+    classifier = ColumnParallelLinear(
+        cfg.hidden_size, cfg.num_classes, use_bias=True, gather_output=True,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+    )
+
+    def embed_apply(ep, mb_batch):
+        x = patch_embed.apply({"params": ep["patch_embed"]}, mb_batch["pixels"])
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.hidden_size)
+        cls = jnp.tile(ep["cls_token"].astype(cfg.dtype), (b, 1, 1))
+        x = jnp.concatenate([cls, x], axis=1)
+        return x + ep["pos_embed"].astype(cfg.dtype)
+
+    def layer_apply(lp, x):
+        return block.apply({"params": lp}, x)
+
+    def head_apply(hp, x, mb_batch):
+        # leading dims vary by engine: (mb, T, H) per-microbatch under 1F1B,
+        # (M, mb, T, H) stacked under the gpipe scan — select the CLS token
+        # along the token axis, not a fixed position
+        h = final_norm.apply({"params": hp["final_norm"]}, x)
+        logits = classifier.apply({"params": hp["classifier"]}, h[..., 0, :])
+        logits = logits.astype(jnp.float32)
+        onehot = jax.nn.one_hot(mb_batch["labels"], cfg.num_classes)
+        losses = -(onehot * jax.nn.log_softmax(logits)).sum(-1)
+        return losses.sum(), jnp.asarray(float(losses.size), jnp.float32)
+
+    return FamilyPipeline(
+        embed_apply=embed_apply,
+        layer_apply=layer_apply,
+        head_apply=head_apply,
+        num_layers=cfg.num_layers,
+        layout=VIT_LAYOUT,
+        remat=cfg.remat,
+    )
