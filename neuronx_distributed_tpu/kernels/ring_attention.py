@@ -115,13 +115,12 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, bq, bk, interpret):
     cp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
-    hkv = k.shape[2]
-    rep = h // hkv
     qt = jnp.swapaxes(q, 1, 2)  # (B, H, S, D)
 
     def kv_t(x):
-        # repeat ON ARRIVAL so ring traffic stays at Hkv heads
-        return jnp.swapaxes(jnp.repeat(x, rep, axis=2), 1, 2)
+        # (B, S, Hkv, D) → (B, Hkv, S, D); the kernel serves GQA natively so
+        # K/V stay at Hkv heads everywhere — ring traffic AND HBM
+        return jnp.swapaxes(x, 1, 2)
 
     q_off = rank * s_loc
     out, lse = _flash_fwd(
@@ -174,8 +173,6 @@ def _ring_flash_bwd_rule(axis_name, bq, bk, interpret, res, g):
     cp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
-    hkv = k.shape[2]
-    rep = h // hkv
     qt = jnp.swapaxes(q, 1, 2)
     gt = jnp.swapaxes(g, 1, 2)
     ot = jnp.swapaxes(out, 1, 2)
@@ -185,11 +182,10 @@ def _ring_flash_bwd_rule(axis_name, bq, bk, interpret, res, g):
     q_off = rank * s_loc
 
     def kv_t(x):
-        return jnp.swapaxes(jnp.repeat(x, rep, axis=2), 1, 2)
+        return jnp.swapaxes(x, 1, 2)
 
     def fold_kv(dx):
-        # (B, H, S, D) repeated-head grads → (B, S, Hkv, D)
-        dx = dx.reshape(b, hkv, rep, s_loc, d).sum(2)
+        # kernel dK/dV come back at native Hkv heads: (B, Hkv, S, D) → (B, S, Hkv, D)
         return jnp.swapaxes(dx, 1, 2)
 
     perm = [(i, (i + 1) % cp) for i in range(cp)]

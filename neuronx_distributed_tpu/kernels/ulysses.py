@@ -64,14 +64,13 @@ def ulysses_attention(
             _pick_block,
         )
 
-        rep = q.shape[2] // k.shape[2]
-        kt = jnp.repeat(k, rep, axis=2) if rep > 1 else k
-        vt = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+        # the kernel serves GQA natively — K/V stay at their (scattered)
+        # Hkv/cp head count, no HBM replication
         bq = bk = _pick_block(q.shape[1], 512)
         interpret = jax.devices()[0].platform != "tpu"
         out = _flash_attention_bhsd(
-            jnp.swapaxes(q, 1, 2), jnp.swapaxes(kt, 1, 2),
-            jnp.swapaxes(vt, 1, 2), causal, bq, bk, interpret,
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), causal, bq, bk, interpret,
         )
         out = jnp.swapaxes(out, 1, 2)
     else:
