@@ -48,8 +48,10 @@ def medusa_generate(
     top_k: int = 10,
 ) -> Tuple[jax.Array, float]:
     """Greedy Medusa generation with a ``MedusaForCausalLM``-shaped model
-    (returns ``(logits, medusa_logits)``). B=1 (acceptance lengths diverge
-    across rows — same restriction as ``speculative_generate``). Returns
+    (returns ``(logits, medusa_logits)``). B=1: the host loop re-feeds a
+    per-row VARIABLE number of accepted-chain tokens each round, so rows
+    cannot share the fixed-width round function (unlike speculative decoding,
+    whose pad-to-shortest batch advance lifted its B=1 limit). Returns
     ``(tokens (1, max_new_tokens), mean_accepted_per_round)``."""
     assert prompt_ids.shape[0] == 1, "medusa decoding supports B=1"
     buffers = generate_medusa_buffers(choices, top_k=top_k)

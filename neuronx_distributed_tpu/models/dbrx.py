@@ -82,7 +82,10 @@ class DbrxBlock(nn.Module):
     @nn.compact
     def __call__(self, x, freqs, positions=None):
         cfg = self.config
-        norm = dict(eps=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        # bias-free LayerNorm — DBRX's norms carry no bias (HF modeling_dbrx),
+        # and a native-only bias would be silently dropped on HF export
+        norm = dict(eps=cfg.layer_norm_eps, use_bias=False, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype)
         h = LayerNorm(cfg.hidden_size, name="norm_1", **norm)(x)
         x = x + LlamaAttention(
             cfg.as_llama(), self.attention_impl, self.mode, name="attn"
@@ -126,8 +129,9 @@ class DbrxForCausalLM(nn.Module):
                 name=f"blocks_{i}",
             )(x, freqs, positions)
             aux_sum = aux_sum + aux
-        x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
-                      param_dtype=cfg.param_dtype, name="final_norm")(x)
+        x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, use_bias=False,
+                      dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                      name="final_norm")(x)
         logits = ColumnParallelLinear(
             cfg.hidden_size, cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="lm_head",

@@ -38,8 +38,8 @@ def dbrx_family(
     )
     block = DbrxBlock(config, attention_impl, deterministic)
     final_norm = LayerNorm(
-        config.hidden_size, eps=config.layer_norm_eps, dtype=config.dtype,
-        param_dtype=config.param_dtype,
+        config.hidden_size, eps=config.layer_norm_eps, use_bias=False,
+        dtype=config.dtype, param_dtype=config.param_dtype,
         sequence_parallel_enabled=config.sequence_parallel,
     )
     lm_head = ColumnParallelLinear(

@@ -312,7 +312,9 @@ class OneFOneBEngine(PipelineEngine):
         shared by :meth:`value_and_grad` and
         :meth:`_run_interleaved_forward` (ONE copy of the schedule math —
         validated against ``SyncTrainInterleavedSchedule`` by
-        :meth:`_cycle_tables`). Returns ``(fwd_valid, k_f, mb_f)``."""
+        :meth:`_cycle_tables`). Returns ``(fwd_valid, k_f, mb_f, u_c)`` where
+        ``u_c`` is the clamped slot id (the circular activation buffer keys
+        off it)."""
         S, C = self._stages(), self.num_chunks
         MC = self.num_microbatches * C
         SC = S * C
@@ -321,7 +323,7 @@ class OneFOneBEngine(PipelineEngine):
         u_c = jnp.clip(u, 0, MC - 1)
         k_f = (u_c % SC) // S
         mb_f = (u_c // SC) * S + (u_c % S)
-        return fwd_valid, k_f, mb_f
+        return fwd_valid, k_f, mb_f, u_c
 
     # --- interleaved param layout: (L,...) → (C, S, L/(S·C), ...) -------------
     # Virtual stage v = k·S + r covers layers [v·Lc, (v+1)·Lc), so a plain
@@ -427,8 +429,7 @@ class OneFOneBEngine(PipelineEngine):
                 y_in, cot_in, x_buf, g_layers, g_head, d_emb, loss_sum = carry
 
                 # ---- forward slot ----
-                fwd_valid, k_f, mb_f = self._fwd_slot(c, rank)
-                u_c = jnp.clip(c - rank, 0, MC - 1)  # circular-buffer slot id
+                fwd_valid, k_f, mb_f, u_c = self._fwd_slot(c, rank)
                 mb_batch = jax.tree.map(
                     lambda a: lax.dynamic_index_in_dim(a, mb_f, 0, keepdims=False),
                     batch,
@@ -625,7 +626,7 @@ class OneFOneBEngine(PipelineEngine):
 
             def cycle(carry, c):
                 y_in, out_buf, aux_acc = carry
-                fwd_valid, k_f, mb_f = self._fwd_slot(c, rank)
+                fwd_valid, k_f, mb_f, _u_c = self._fwd_slot(c, rank)
                 x_in = jnp.where(
                     is_first & (k_f == 0),
                     lax.dynamic_index_in_dim(embedded, mb_f, 0, keepdims=False),

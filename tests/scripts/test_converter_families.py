@@ -1,0 +1,336 @@
+"""HF ↔ native converters for DBRX / CodeGen / BERT / ViT (VERDICT r3 next #4
+— the reference converts every example family, checkpoint_converter.py:21-252).
+
+The gold standard everywhere it's decidable: load a REAL HF transformers
+model's state dict, convert, and demand logits parity from our model — this
+pins down the fused-QKV splits (DBRX GQA widths, CodeGen's mp_num-blocked
+[q,v,k] interior) and the GPT-J interleaved→half-split rotary permutation
+numerically, not just structurally. Roundtrip identity covers the export
+direction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+    hf_to_native_bert,
+    hf_to_native_codegen,
+    hf_to_native_dbrx,
+    hf_to_native_vit,
+    native_to_hf_bert,
+    native_to_hf_codegen,
+    native_to_hf_dbrx,
+    native_to_hf_vit,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _state(model):
+    return {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+
+def _assert_same_structure(got, want_tree):
+    from flax.core import meta
+
+    want_tree = meta.unbox(want_tree)
+    got_paths = {jax.tree_util.keystr(p) for p, _ in
+                 jax.tree_util.tree_flatten_with_path(got)[0]}
+    want_paths = {jax.tree_util.keystr(p) for p, _ in
+                  jax.tree_util.tree_flatten_with_path(want_tree)[0]}
+    assert got_paths == want_paths, (
+        f"missing: {sorted(want_paths - got_paths)[:5]} "
+        f"extra: {sorted(got_paths - want_paths)[:5]}"
+    )
+
+
+# --- CodeGen ------------------------------------------------------------------
+
+
+def _tiny_hf_codegen():
+    cfg = transformers.CodeGenConfig(
+        vocab_size=128, n_embd=64, n_inner=128, n_layer=2, n_head=8,
+        n_positions=64, rotary_dim=4, activation_function="gelu_new",
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    return transformers.CodeGenForCausalLM(cfg).eval(), cfg
+
+
+def test_codegen_hf_native_logits_match():
+    """Fused qkv mp_num-block [q,v,k] split + interleaved→half-split rotary
+    permutation: logits parity against HF CodeGen."""
+    from neuronx_distributed_tpu.models.codegen import (
+        CodeGenConfig,
+        CodeGenForCausalLM,
+    )
+
+    hf_model, hf_cfg = _tiny_hf_codegen()
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=1)
+    cfg = CodeGenConfig(
+        vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.n_embd,
+        intermediate_size=hf_cfg.n_inner, num_layers=hf_cfg.n_layer,
+        num_heads=hf_cfg.n_head, max_seq_len=hf_cfg.n_positions,
+        rotary_dim=hf_cfg.rotary_dim, dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    model = CodeGenForCausalLM(cfg)
+    params = jax.tree.map(
+        jnp.asarray,
+        hf_to_native_codegen(
+            _state(hf_model), num_heads=cfg.num_heads, rotary_dim=cfg.rotary_dim
+        ),
+    )
+    _assert_same_structure(
+        params["params"],
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"],
+    )
+    ids = np.array([[1, 5, 9, 2, 7, 3, 11, 4]], dtype=np.int32)
+    ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_codegen_roundtrip_identity():
+    hf_model, hf_cfg = _tiny_hf_codegen()
+    state = {
+        k: v for k, v in _state(hf_model).items()
+        if not k.endswith("attn.causal_mask")
+    }
+    native = hf_to_native_codegen(state, hf_cfg.n_head, hf_cfg.rotary_dim)
+    back = native_to_hf_codegen(native, hf_cfg.n_head, hf_cfg.rotary_dim)
+    assert set(back) == set(state)
+    for k, v in state.items():
+        np.testing.assert_allclose(back[k], v, atol=1e-6, err_msg=k)
+
+
+# --- DBRX ---------------------------------------------------------------------
+
+
+def _tiny_hf_dbrx():
+    cfg = transformers.DbrxConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=8,
+        max_seq_len=64,
+        attn_config=transformers.models.dbrx.configuration_dbrx.DbrxAttentionConfig(
+            kv_n_heads=4, rope_theta=1e4,
+        ),
+        ffn_config=transformers.models.dbrx.configuration_dbrx.DbrxFFNConfig(
+            ffn_hidden_size=96, moe_num_experts=4, moe_top_k=2,
+            moe_jitter_eps=None, moe_normalize_expert_weights=1.0,
+        ),
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    return transformers.DbrxForCausalLM(cfg).eval(), cfg
+
+
+def test_dbrx_hf_native_logits_match():
+    """GQA Wqkv split + stacked expert tensor reshapes: logits parity against
+    HF DBRX (router = softmax→topk→L1-renormalize in both)."""
+    from neuronx_distributed_tpu.models.dbrx import DbrxConfig, DbrxForCausalLM
+
+    hf_model, hf_cfg = _tiny_hf_dbrx()
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=1)
+    cfg = DbrxConfig(
+        vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.d_model,
+        intermediate_size=hf_cfg.ffn_config.ffn_hidden_size,
+        num_layers=hf_cfg.n_layers, num_heads=hf_cfg.n_heads,
+        num_kv_heads=hf_cfg.attn_config.kv_n_heads,
+        max_seq_len=hf_cfg.max_seq_len,
+        rope_theta=hf_cfg.attn_config.rope_theta,
+        num_experts=hf_cfg.ffn_config.moe_num_experts,
+        top_k=hf_cfg.ffn_config.moe_top_k,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    model = DbrxForCausalLM(cfg, attention_impl="xla")
+    params = jax.tree.map(
+        jnp.asarray,
+        hf_to_native_dbrx(
+            _state(hf_model), num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+        ),
+    )
+    _assert_same_structure(
+        params["params"],
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"],
+    )
+    ids = np.array([[1, 5, 9, 2, 7, 3, 11, 4]], dtype=np.int32)
+    ours, _aux = model.apply(params, jnp.asarray(ids))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=5e-4, rtol=2e-3)
+
+
+def test_dbrx_roundtrip_identity():
+    hf_model, hf_cfg = _tiny_hf_dbrx()
+    state = _state(hf_model)
+    native = hf_to_native_dbrx(
+        state, num_heads=hf_cfg.n_heads,
+        num_kv_heads=hf_cfg.attn_config.kv_n_heads,
+    )
+    back = native_to_hf_dbrx(native)
+    assert set(back) == set(state)
+    for k, v in state.items():
+        np.testing.assert_allclose(back[k], v, atol=1e-6, err_msg=k)
+
+
+# --- BERT ---------------------------------------------------------------------
+
+
+def _tiny_hf_bert():
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=8,
+        max_position_embeddings=64, type_vocab_size=2, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12,
+    )
+    torch.manual_seed(0)
+    return transformers.BertForMaskedLM(cfg).eval(), cfg
+
+
+def test_bert_hf_native_logits_match():
+    from neuronx_distributed_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    hf_model, hf_cfg = _tiny_hf_bert()
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=1)
+    cfg = BertConfig(
+        vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        type_vocab_size=hf_cfg.type_vocab_size,
+        layer_norm_eps=hf_cfg.layer_norm_eps,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    model = BertForMaskedLM(cfg)
+    params = jax.tree.map(jnp.asarray, hf_to_native_bert(_state(hf_model)))
+    _assert_same_structure(
+        params["params"],
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"],
+    )
+    ids = np.array([[1, 5, 9, 2, 7, 3, 11, 4]], dtype=np.int32)
+    ours = np.asarray(model.apply(params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_bert_roundtrip_identity():
+    hf_model, _ = _tiny_hf_bert()
+    state = {
+        k: v for k, v in _state(hf_model).items()
+        if k != "bert.embeddings.position_ids"
+    }
+    native = hf_to_native_bert(state)
+    back = native_to_hf_bert(native)
+    assert set(back) == set(state)
+    for k, v in state.items():
+        np.testing.assert_allclose(back[k], v, atol=1e-6, err_msg=k)
+
+
+# --- ViT ----------------------------------------------------------------------
+
+
+def _tiny_hf_vit():
+    cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, num_channels=3, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=8,
+        hidden_act="gelu", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, layer_norm_eps=1e-6,
+    )
+    torch.manual_seed(0)
+    model = transformers.ViTForImageClassification(cfg)
+    model.config.num_labels = model.classifier.out_features
+    return model.eval(), cfg
+
+
+def test_vit_hf_native_logits_match():
+    from neuronx_distributed_tpu.models.vit import (
+        ViTConfig,
+        ViTForImageClassification,
+    )
+
+    hf_model, hf_cfg = _tiny_hf_vit()
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=1)
+    cfg = ViTConfig(
+        image_size=hf_cfg.image_size, patch_size=hf_cfg.patch_size,
+        num_channels=hf_cfg.num_channels, hidden_size=hf_cfg.hidden_size,
+        intermediate_size=hf_cfg.intermediate_size,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        num_classes=hf_model.classifier.out_features,
+        layer_norm_eps=hf_cfg.layer_norm_eps,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    model = ViTForImageClassification(cfg)
+    params = jax.tree.map(jnp.asarray, hf_to_native_vit(_state(hf_model)))
+    _assert_same_structure(
+        params["params"],
+        model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3), jnp.float32)
+        )["params"],
+    )
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((1, 32, 32, 3), dtype=np.float32)
+    ours = np.asarray(model.apply(params, jnp.asarray(pixels)))
+    with torch.no_grad():
+        # HF ViT expects NCHW
+        theirs = hf_model(
+            torch.from_numpy(pixels.transpose(0, 3, 1, 2))
+        ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+
+def test_cli_roundtrip_through_files(tmp_path):
+    """The full CLI path: HF safetensors dir → hf2native checkpoint →
+    native2hf safetensors — file content must equal the original. Regression
+    for the stride bug: safetensors writes raw buffers ignoring strides, so
+    the transposed VIEWS the native2hf mappings produce were silently saved
+    with pre-transpose content until export forces contiguity."""
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    from neuronx_distributed_tpu.scripts.checkpoint_converter import (
+        convert_hf_to_native,
+        convert_native_to_hf,
+    )
+
+    hf_model, hf_cfg = _tiny_hf_codegen()
+    state = {
+        k: v for k, v in _state(hf_model).items()
+        if not k.endswith("attn.causal_mask")
+    }
+    hf_dir = tmp_path / "hf"
+    hf_dir.mkdir()
+    save_file(state, str(hf_dir / "model.safetensors"))
+    convert_hf_to_native(
+        str(hf_dir), str(tmp_path / "native"), family="codegen",
+        num_heads=hf_cfg.n_head, rotary_dim=hf_cfg.rotary_dim,
+    )
+    convert_native_to_hf(
+        str(tmp_path / "native"), str(tmp_path / "hf_back"), family="codegen",
+        num_heads=hf_cfg.n_head, rotary_dim=hf_cfg.rotary_dim,
+    )
+    with safe_open(str(tmp_path / "hf_back" / "model.safetensors"),
+                   framework="numpy") as f:
+        assert set(f.keys()) == set(state)
+        for k in state:
+            np.testing.assert_allclose(
+                f.get_tensor(k), state[k], atol=1e-6, err_msg=k
+            )
+
+
+def test_vit_roundtrip_identity():
+    hf_model, _ = _tiny_hf_vit()
+    state = _state(hf_model)
+    native = hf_to_native_vit(state)
+    back = native_to_hf_vit(native)
+    assert set(back) == set(state)
+    for k, v in state.items():
+        np.testing.assert_allclose(back[k], v, atol=1e-6, err_msg=k)
