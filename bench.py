@@ -423,14 +423,96 @@ def child_parallel() -> None:
             "temp_alloc_bytes": temp_bytes,
             "loss": round(float(metrics["loss"]), 4),
         }
+    # runs LAST — it tears down and rebuilds the global mesh (ep=2 x tp=2)
+    blockwise = _blockwise_ep_comparison()
     _emit(
         {
             "metric": "parallel_proxy",
             "mesh": "cpu pp=2 tp=2 dp=2 sp=on zero1=on",
             "microbatches": M,
             "schedules": out,
+            "blockwise_ep": blockwise,
         }
     )
+
+
+def _blockwise_ep_comparison():
+    """Timed comparison (VERDICT r3 next #10): the blockwise-EP local-offset
+    GATHER alignment vs the legacy double-ROLL formulation, fwd+bwd at ep=2
+    x tp=2 on the virtual mesh. Returns per-variant step times + the gather
+    speedup; failures are reported, never fatal (this augments the proxy)."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.modules.moe.expert_mlps import (
+        _grouped_mlp,
+        _sharded_blockwise_mlp,
+        _sharded_blockwise_mlp_rolled,
+    )
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    try:
+        mesh_lib.destroy_model_parallel()
+        mesh_lib.initialize_model_parallel(
+            tensor_model_parallel_size=2, expert_model_parallel_size=2
+        )
+        mesh = mesh_lib.get_mesh()
+        T, H, I, E, k = 2048, 256, 512, 8, 2
+        key = jax.random.PRNGKey(0)
+        ks = jax.random.split(key, 5)
+        x = jax.random.normal(ks[0], (T, H), jnp.float32)
+        top_e = jax.random.randint(ks[1], (T, k), 0, E)
+        top_w = jax.nn.softmax(jax.random.normal(ks[2], (T, k)), -1)
+        gate = jax.random.normal(ks[3], (E, H, I)) * 0.02
+        up = jax.random.normal(ks[4], (E, H, I)) * 0.02
+        down = jax.random.normal(ks[0], (E, I, H)) * 0.02
+
+        flat_e = top_e.reshape(-1)
+        order = jnp.argsort(flat_e, stable=True)
+        token_idx = order // k
+        sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+        ws = top_w.reshape(-1)[order]
+
+        gathered = _sharded_blockwise_mlp(
+            mesh, mesh_lib.EP_AXIS, mesh_lib.TP_AXIS, E // 2, 2, True, "silu")
+        rolled = _sharded_blockwise_mlp_rolled(
+            mesh, mesh_lib.EP_AXIS, mesh_lib.TP_AXIS, E // 2, 2, True, "silu")
+
+        def loss_gather(g, u, d):
+            return gathered(x, token_idx, ws, sizes, g, u, d).sum(
+                axis=(0, 1)).sum()
+
+        def loss_rolled(g, u, d):
+            ys = rolled(x[token_idx], sizes, g, u, d).sum(axis=(0, 1))
+            return (
+                jnp.zeros((T, H)).at[token_idx].add(ys * ws[:, None]).sum()
+            )
+
+        results = {}
+        vals = {}
+        for name, fn in (("gather", loss_gather), ("rolled", loss_rolled)):
+            step = jax.jit(jax.value_and_grad(fn, argnums=(0, 1, 2)))
+            v, g = step(gate, up, down)  # compile + correctness sample
+            jax.block_until_ready(g)
+            vals[name] = float(v)
+            t0 = time.perf_counter()
+            iters = 5
+            for _ in range(iters):
+                v, g = step(gate, up, down)
+            jax.block_until_ready(g)
+            results[name + "_step_s"] = round(
+                (time.perf_counter() - t0) / iters, 4
+            )
+        results["loss_match"] = abs(vals["gather"] - vals["rolled"]) < 1e-3
+        results["gather_speedup"] = round(
+            results["rolled_step_s"] / max(results["gather_step_s"], 1e-9), 3
+        )
+        results["shape"] = f"T={T} H={H} I={I} E={E} k={k} ep=2 tp=2 fwd+bwd"
+        return results
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    finally:
+        mesh_lib.destroy_model_parallel()
 
 
 # --------------------------------------------------------------------------

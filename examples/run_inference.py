@@ -32,7 +32,6 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import os
-import statistics
 import sys
 import time
 
@@ -166,19 +165,17 @@ def main(argv=None):
         return {"tokens": toks}
 
     if args.mode == "benchmark":
-        # reference benchmark_sampling (runner.py:521): warmup, then N e2e
-        # timed runs → p50/p99 latency + throughput
-        lat = []
-        for i in range(args.warmup + args.iters):
-            t0 = time.perf_counter()
-            toks = generate(model, params, prompt, key, gen_cfg)
-            jax.block_until_ready(toks)
-            dt = time.perf_counter() - t0
-            if i >= args.warmup:
-                lat.append(dt)
-        lat.sort()
-        p50 = statistics.median(lat)
-        p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))]
+        # reference benchmark_sampling (runner.py:521-765): e2e latency AND
+        # per-submodule collectors (context-encoding / per-token-gen /
+        # sampling), each reported p50/p90/p95/p99/p100/avg + throughput
+        from neuronx_distributed_tpu.inference.benchmark import benchmark_generate
+
+        sub = benchmark_generate(
+            model, params, prompt, key, gen_cfg,
+            iters=args.iters, warmup=args.warmup,
+        )
+        p50 = sub["e2e_model"]["latency_ms_p50"] / 1e3
+        p99 = sub["e2e_model"]["latency_ms_p99"] / 1e3
         new_tokens = args.batch * args.max_new_tokens
         report = {
             "e2e_p50_s": round(p50, 4),
@@ -189,8 +186,11 @@ def main(argv=None):
             "batch": args.batch,
             "prompt_len": args.prompt_len,
             "max_new_tokens": args.max_new_tokens,
+            "submodules": sub,
         }
-        print(report)
+        import json as _json
+
+        print(_json.dumps(report, indent=2))
         return report
 
     if args.mode == "trace":

@@ -79,7 +79,14 @@ def parse_args(argv=None):
 
     d = p.add_argument_group("data")
     d.add_argument("--data", default="synthetic",
-                   help="'synthetic' or 'npy:<path>' token-id array")
+                   help="'synthetic', 'npy:<path>' (raw token stream, chopped "
+                        "in file order), or 'packed:<path>' (.npy/.npz packed "
+                        "corpus with per-epoch deterministic shuffle — see "
+                        "neuronx_distributed_tpu/trainer/data.py for the "
+                        "offline tokenization recipe)")
+    d.add_argument("--eos-token-id", type=int, default=None,
+                   help="document separator inserted while packing "
+                        "('packed:' .npz corpora with offsets)")
 
     io = p.add_argument_group("io")
     io.add_argument("--ckpt-dir", default=None, help="checkpoint directory (local or gs://)")
@@ -95,6 +102,14 @@ def parse_args(argv=None):
     e = p.add_argument_group("environment")
     e.add_argument("--force-cpu-devices", type=int, default=None,
                    help="run on N virtual CPU devices (development mode)")
+    e.add_argument("--dcn-dp", type=int, default=1,
+                   help="multi-slice: number of TPU slices; the data-parallel "
+                        "dimension splits into dcn x ici so only DP gradient "
+                        "reduction crosses DCN (see examples/README.md runbook)")
+    e.add_argument("--distributed", action="store_true",
+                   help="call jax.distributed.initialize() first (multi-host: "
+                        "run one process per host under the TPU runtime; "
+                        "coordinator/process env comes from the TPU metadata)")
     return p.parse_args(argv)
 
 
@@ -134,6 +149,17 @@ def make_data_iter(args, cfg, batch_size: int, seq_len: int):
             ids = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1),
                                dtype=np.int32)
             yield {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    elif args.data.startswith("packed:"):
+        from neuronx_distributed_tpu.trainer.data import PackedCorpus
+
+        corpus = PackedCorpus(
+            args.data[len("packed:") :], seq_len=seq_len,
+            batch_size=batch_size, seed=args.seed,
+            eos_token_id=args.eos_token_id,
+        )
+        print(f"packed corpus: {len(corpus.windows)} windows, "
+              f"{corpus.num_batches_per_epoch} batches/epoch")
+        yield from corpus
     elif args.data.startswith("npy:"):
         path = args.data[4:]
         tokens = np.load(path, mmap_mode="r")
@@ -168,6 +194,12 @@ def main(argv=None):
 
     import jax
 
+    if args.distributed:
+        # multi-host: makes jax.devices() span every host of every slice
+        # (reference analogue: torchrun + init_process_group("xla") across
+        # nodes, examples/training/llama/tp_pp_llama_hf_pretrain)
+        jax.distributed.initialize()
+
     from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
     from neuronx_distributed_tpu.parallel import mesh as mesh_lib
     from neuronx_distributed_tpu.trainer import OptimizerConfig
@@ -187,6 +219,7 @@ def main(argv=None):
         tensor_model_parallel_size=args.tp,
         pipeline_model_parallel_size=args.pp,
         context_parallel_size=args.cp,
+        dcn_data_parallel_size=args.dcn_dp,
     )
     dp = mesh_lib.get_data_parallel_size()
     cfg = build_config(args)

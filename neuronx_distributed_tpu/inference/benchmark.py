@@ -1,0 +1,174 @@
+"""Serving latency benchmark with per-submodule collectors (reference:
+``examples/inference/runner.py:521-765`` ``benchmark_sampling`` +
+``modules/benchmark.py`` ``LatencyCollector``/``generate_report``).
+
+The reference registers forward hooks on the compiled submodules
+(context-encoding model, token-generation model) and reports each collector
+with p50/p90/p95/p99/p100/avg latency + throughput. Here the submodules are
+the jitted prefill / single-decode-step / sampling functions — each timed
+directly (host-side wall clock around a blocked device call, the same thing a
+torch forward hook measures on a synchronous NEFF call)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+E2E_MODEL = "e2e_model"
+CONTEXT_ENCODING_MODEL = "context_encoding_model"
+TOKEN_GENERATION_MODEL = "token_generation_model"
+SAMPLING = "sampling"
+
+
+class LatencyCollector:
+    """Accumulates per-call wall-clock latencies (reference
+    ``modules/benchmark.py`` pre/post forward-hook pair)."""
+
+    def __init__(self) -> None:
+        self.latency_list: List[float] = []
+        self._t0: Optional[float] = None
+
+    def pre(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def post(self) -> None:
+        self.latency_list.append(time.perf_counter() - self._t0)
+
+    def timed(self, fn, *args, **kw):
+        self.pre()
+        out = fn(*args, **kw)
+        import jax
+
+        jax.block_until_ready(out)
+        self.post()
+        return out
+
+
+def generate_report(
+    latency_list: List[float], max_length: int = 1, max_batch_size: int = 1
+) -> Dict[str, float]:
+    """Reference ``generate_report`` shape: latency percentiles in ms + a
+    tokens-based throughput. An empty collector (e.g. the token-generation
+    collector at ``max_new_tokens=1`` — zero decode steps) reports zeros."""
+    if not latency_list:
+        return {
+            "latency_ms_p50": 0.0, "latency_ms_p90": 0.0,
+            "latency_ms_p95": 0.0, "latency_ms_p99": 0.0,
+            "latency_ms_p100": 0.0, "latency_ms_avg": 0.0, "throughput": 0.0,
+        }
+    arr = np.asarray(latency_list)
+    total = float(arr.sum())
+    return {
+        "latency_ms_p50": float(np.percentile(arr, 50) * 1e3),
+        "latency_ms_p90": float(np.percentile(arr, 90) * 1e3),
+        "latency_ms_p95": float(np.percentile(arr, 95) * 1e3),
+        "latency_ms_p99": float(np.percentile(arr, 99) * 1e3),
+        "latency_ms_p100": float(np.percentile(arr, 100) * 1e3),
+        "latency_ms_avg": float(arr.mean() * 1e3),
+        "throughput": (len(arr) * max_length * max_batch_size) / total
+        if total > 0
+        else 0.0,
+    }
+
+
+def benchmark_generate(
+    model,
+    params,
+    prompt_ids,
+    key,
+    config,
+    iters: int = 5,
+    warmup: int = 1,
+) -> Dict[str, Any]:
+    """Benchmark e2e generation AND the per-submodule breakdown.
+
+    Returns the reference report shape: ``{"e2e_model": {...},
+    "context_encoding_model": {...}, "token_generation_model": {...},
+    "sampling": {...}}`` — each a :func:`generate_report` dict. The
+    token-generation collector records EVERY decode step individually (the
+    per-token latency distribution), the sampling collector every sampling
+    call; e2e runs use the fused scan exactly as production ``generate``
+    does, so the sum of submodule times exceeding the e2e time measures the
+    scan fusion win."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.inference.generate import generate
+    from neuronx_distributed_tpu.inference.utils import unwrap_logits as _logits
+    from neuronx_distributed_tpu.utils.sampling import sample
+
+    b, prompt_len = prompt_ids.shape
+    new_tokens = config.max_new_tokens
+    max_length = prompt_len + new_tokens
+
+    collectors = {
+        E2E_MODEL: LatencyCollector(),
+        CONTEXT_ENCODING_MODEL: LatencyCollector(),
+        TOKEN_GENERATION_MODEL: LatencyCollector(),
+        SAMPLING: LatencyCollector(),
+    }
+
+    # --- e2e (the fused production path) ---------------------------------
+    for i in range(warmup + iters):
+        key, k = jax.random.split(key)
+        if i < warmup:
+            jax.block_until_ready(generate(model, params, prompt_ids, k, config))
+        else:
+            collectors[E2E_MODEL].timed(
+                generate, model, params, prompt_ids, k, config
+            )
+
+    # --- submodules (unfused, per-call timing) ---------------------------
+    prefill = model.clone(mode="prefill")
+    decode = model.clone(mode="decode")
+
+    @jax.jit
+    def prefill_fwd(params, ids):
+        out, variables = prefill.apply(params, ids, mutable=["cache"])
+        return _logits(out)[:, -1], variables["cache"]
+
+    @jax.jit
+    def decode_fwd(params, cache, tok):
+        out, variables = decode.apply(
+            {**params, "cache": cache}, tok[:, None], mutable=["cache"]
+        )
+        return _logits(out)[:, -1], variables["cache"]
+
+    @jax.jit
+    def sample_fn(logits, k):
+        return sample(logits, k, temperature=config.temperature,
+                      top_k=config.top_k, top_p=config.top_p)
+
+    # warmup compiles
+    logits, cache = prefill_fwd(params, prompt_ids)
+    tok = sample_fn(logits, key)
+    jax.block_until_ready(decode_fwd(dict(params), cache, tok))
+
+    for _ in range(iters):
+        key, k = jax.random.split(key)
+        logits, cache = collectors[CONTEXT_ENCODING_MODEL].timed(
+            prefill_fwd, params, prompt_ids
+        )
+        tok = collectors[SAMPLING].timed(sample_fn, logits, k)
+        for _step in range(new_tokens - 1):
+            k, sub = jax.random.split(k)
+            logits, cache = collectors[TOKEN_GENERATION_MODEL].timed(
+                decode_fwd, dict(params), cache, tok
+            )
+            tok = collectors[SAMPLING].timed(sample_fn, logits, sub)
+
+    report = {
+        E2E_MODEL: generate_report(
+            collectors[E2E_MODEL].latency_list, max_length, b
+        ),
+        CONTEXT_ENCODING_MODEL: generate_report(
+            collectors[CONTEXT_ENCODING_MODEL].latency_list, max_length, b
+        ),
+        TOKEN_GENERATION_MODEL: generate_report(
+            collectors[TOKEN_GENERATION_MODEL].latency_list, 1, b
+        ),
+        SAMPLING: generate_report(collectors[SAMPLING].latency_list, 1, b),
+    }
+    return report

@@ -656,7 +656,8 @@ def hf_to_native_bert(hf_state: Mapping[str, np.ndarray]) -> Dict[str, Any]:
                  tensor.T if transpose else tensor)
             continue
         raise KeyError(f"unmapped HF BERT tensor: {name}")
-    if "decoder" not in params:
+    if "kernel" not in params.get("decoder", {}):
+        # tied export: decoder.weight stripped (bias may still be present)
         _set(params, "decoder/kernel",
              np.asarray(_get(params, "bert/tok_embed/embedding")).T)
     if "bias" not in params.get("decoder", {}):

@@ -1,0 +1,148 @@
+"""Offline-tokenized corpus → packed fixed-length training batches
+(reference: ``examples/training/llama/training_utils.py`` ``pack_dataset:33``
+concat-and-chunk packing + ``create_llama_pretraining_dataset:104`` seeded
+DistributedSampler/DataLoader).
+
+The TPU-native formulation is single-controller: ONE iterator yields the
+GLOBAL batch (the train step's ``shard_batch``/``prepare_batch`` places rows
+across dp), so the per-rank DistributedSampler machinery disappears. What
+stays, redesigned:
+
+* **packing** — documents are concatenated (optionally separated by an EOS
+  token) and chopped into ``seq_len + 1`` windows; window ``w`` yields
+  ``input_ids = w[:-1]``, ``labels = w[1:]`` (the reference's
+  concat-and-chunk with the remainder dropped at the corpus end);
+* **deterministic shuffle** — window order is a seeded permutation,
+  re-drawn per epoch from ``fold(seed, epoch)`` — resume-stable and
+  dp-size-independent;
+* **memory-mapped input** — ``.npy`` token streams load lazily; only the
+  windows of the current batch are materialized.
+
+Offline tokenization (this container has no network egress; on a dev host):
+
+    from transformers import AutoTokenizer
+    import numpy as np
+    tok = AutoTokenizer.from_pretrained(...)
+    ids = [tok(d)["input_ids"] for d in documents]
+    np.savez("corpus.npz",
+             tokens=np.concatenate(ids).astype(np.int32),
+             offsets=np.cumsum([0] + [len(x) for x in ids]).astype(np.int64))
+
+Accepted inputs: ``.npy`` 1-D token stream, ``.npy`` 2-D pre-packed
+``(N, seq_len+1)`` windows, or ``.npz`` with ``tokens`` (+ optional
+``offsets`` document boundaries, used to insert EOS separators).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def pack_documents(
+    docs, seq_len: int, eos_token_id: Optional[int] = None
+) -> np.ndarray:
+    """Concatenate ``docs`` (list of 1-D int arrays), optionally separated by
+    ``eos_token_id``, and chop into ``(N, seq_len + 1)`` windows (the
+    reference's chunk(); the tail remainder shorter than a window is
+    dropped)."""
+    parts = []
+    for d in docs:
+        parts.append(np.asarray(d, np.int32).reshape(-1))
+        if eos_token_id is not None:
+            parts.append(np.asarray([eos_token_id], np.int32))
+    stream = np.concatenate(parts) if parts else np.zeros((0,), np.int32)
+    w = seq_len + 1
+    n = len(stream) // w
+    if n == 0:
+        raise ValueError(
+            f"corpus has {len(stream)} tokens — not enough for one "
+            f"{w}-token window"
+        )
+    return stream[: n * w].reshape(n, w)
+
+
+class PackedCorpus:
+    """Iterable over packed ``{"input_ids", "labels"}`` batches with a
+    deterministic per-epoch shuffle.
+
+    ``path``: ``.npy`` / ``.npz`` per the module docstring. The iterator is
+    infinite (epochs chain), matching ``Trainer.fit``'s data contract;
+    ``num_batches_per_epoch`` tells the caller what one pass covers."""
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        batch_size: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        eos_token_id: Optional[int] = None,
+    ) -> None:
+        self.seq_len = int(seq_len)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.shuffle = shuffle
+        w = self.seq_len + 1
+
+        if path.endswith(".npz"):
+            archive = np.load(path)
+            if "tokens" in archive.files:
+                tokens = archive["tokens"]
+                if "offsets" in archive.files:
+                    off = archive["offsets"]
+                    docs = [tokens[off[i] : off[i + 1]] for i in range(len(off) - 1)]
+                    self.windows = pack_documents(docs, seq_len, eos_token_id)
+                else:
+                    self.windows = pack_documents([tokens], seq_len, None)
+            else:
+                self.windows = pack_documents(
+                    [archive[archive.files[0]].reshape(-1)], seq_len, None
+                )
+        else:
+            arr = np.load(path, mmap_mode="r")
+            if arr.ndim == 2:
+                if arr.shape[1] != w:
+                    raise ValueError(
+                        f"pre-packed corpus windows are {arr.shape[1]} wide; "
+                        f"need seq_len+1 = {w}"
+                    )
+                self.windows = arr  # stays memory-mapped
+            else:
+                n = arr.shape[0] // w
+                if n == 0:
+                    raise ValueError(
+                        f"corpus has {arr.shape[0]} tokens — not enough for "
+                        f"one {w}-token window"
+                    )
+                # a reshaped view of the memmap — windows stay lazy
+                self.windows = arr[: n * w].reshape(n, w)
+
+        if len(self.windows) < self.batch_size:
+            raise ValueError(
+                f"corpus has {len(self.windows)} windows < batch_size "
+                f"{self.batch_size}"
+            )
+        self.num_batches_per_epoch = len(self.windows) // self.batch_size
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(len(self.windows))
+        return np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch])
+        ).permutation(len(self.windows))
+
+    def __iter__(self) -> Iterator[dict]:
+        epoch = 0
+        while True:
+            order = self._epoch_order(epoch)
+            for b in range(self.num_batches_per_epoch):
+                idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+                # fancy-index materializes just this batch from the memmap;
+                # sorted first (memmap reads in file order), then restored
+                sort = np.argsort(idx)
+                rows = np.asarray(self.windows[idx[sort]], np.int32)
+                rows = rows[np.argsort(sort)]
+                yield {"input_ids": rows[:, :-1], "labels": rows[:, 1:]}
+            epoch += 1

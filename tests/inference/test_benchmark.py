@@ -1,0 +1,62 @@
+"""Per-submodule serving latency benchmark (VERDICT r3 next #8; reference
+``examples/inference/runner.py:521-765`` report shape)."""
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.inference import GenerationConfig
+from neuronx_distributed_tpu.inference.benchmark import (
+    CONTEXT_ENCODING_MODEL,
+    E2E_MODEL,
+    SAMPLING,
+    TOKEN_GENERATION_MODEL,
+    LatencyCollector,
+    benchmark_generate,
+    generate_report,
+)
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+
+REPORT_KEYS = {
+    "latency_ms_p50", "latency_ms_p90", "latency_ms_p95", "latency_ms_p99",
+    "latency_ms_p100", "latency_ms_avg", "throughput",
+}
+
+
+def test_generate_report_shape():
+    rep = generate_report([0.01, 0.02, 0.03], max_length=10, max_batch_size=2)
+    assert set(rep) == REPORT_KEYS
+    assert rep["latency_ms_p50"] == 20.0
+    # 3 runs x 10 tokens x batch 2 over 0.06 s
+    assert abs(rep["throughput"] - 3 * 10 * 2 / 0.06) < 1e-6
+
+
+def test_latency_collector_counts():
+    c = LatencyCollector()
+    for _ in range(4):
+        c.timed(lambda: jnp.zeros(4))
+    assert len(c.latency_list) == 4 and all(t > 0 for t in c.latency_list)
+
+
+def test_benchmark_generate_submodule_report():
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    new = 4
+    iters = 2
+    rep = benchmark_generate(
+        model, params, ids, jax.random.PRNGKey(2),
+        GenerationConfig(max_new_tokens=new, temperature=0.0),
+        iters=iters, warmup=1,
+    )
+    assert set(rep) == {
+        E2E_MODEL, CONTEXT_ENCODING_MODEL, TOKEN_GENERATION_MODEL, SAMPLING
+    }
+    for sub in rep.values():
+        assert set(sub) == REPORT_KEYS
+        assert sub["latency_ms_p50"] > 0
+        assert sub["latency_ms_p99"] >= sub["latency_ms_p50"]
+    # decode-step throughput is per single call; e2e throughput covers the
+    # full max_length window — both positive
+    assert rep[TOKEN_GENERATION_MODEL]["throughput"] > 0
+    assert rep[E2E_MODEL]["throughput"] > 0

@@ -1,0 +1,121 @@
+"""Packed-corpus data pipeline (VERDICT r3 next #9; reference
+``training_utils.py`` ``pack_dataset:33`` concat-and-chunk + seeded
+sampler)."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.trainer.data import PackedCorpus, pack_documents
+
+
+def test_pack_documents_concat_chunk_and_eos():
+    docs = [np.arange(5), np.arange(10, 17)]
+    # with EOS 99: stream = [0..4, 99, 10..16, 99] = 14 tokens → 3 windows of 4
+    out = pack_documents(docs, seq_len=3, eos_token_id=99)
+    assert out.shape == (3, 4)
+    np.testing.assert_array_equal(out[0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(out[1], [4, 99, 10, 11])
+    # remainder (2 tokens) dropped
+    out2 = pack_documents(docs, seq_len=3)
+    np.testing.assert_array_equal(out2[0], [0, 1, 2, 3])
+    np.testing.assert_array_equal(out2[1], [4, 10, 11, 12])
+
+
+def test_pack_documents_too_small():
+    with pytest.raises(ValueError, match="not enough"):
+        pack_documents([np.arange(3)], seq_len=7)
+
+
+def _write_stream(tmp_path, n=1000):
+    path = tmp_path / "corpus.npy"
+    np.save(path, (np.arange(n) % 256).astype(np.int32))
+    return str(path)
+
+
+def test_packed_corpus_labels_are_shifted(tmp_path):
+    c = PackedCorpus(_write_stream(tmp_path), seq_len=16, batch_size=4,
+                     shuffle=False)
+    batch = next(iter(c))
+    assert batch["input_ids"].shape == (4, 16)
+    np.testing.assert_array_equal(
+        batch["labels"][:, :-1], batch["input_ids"][:, 1:]
+    )
+    # unshuffled window 0 starts at token 0
+    np.testing.assert_array_equal(batch["input_ids"][0], np.arange(16) % 256)
+
+
+def test_packed_corpus_deterministic_shuffle(tmp_path):
+    path = _write_stream(tmp_path)
+    a = PackedCorpus(path, seq_len=16, batch_size=4, seed=7)
+    b = PackedCorpus(path, seq_len=16, batch_size=4, seed=7)
+    xa = next(iter(a))["input_ids"]
+    np.testing.assert_array_equal(xa, next(iter(b))["input_ids"])
+    c = PackedCorpus(path, seq_len=16, batch_size=4, seed=8)
+    assert not np.array_equal(next(iter(c))["input_ids"], xa)
+    # per-epoch reshuffle: the order function differs between epochs but is
+    # reproducible within one
+    np.testing.assert_array_equal(a._epoch_order(0), a._epoch_order(0))
+    assert not np.array_equal(a._epoch_order(0), a._epoch_order(1))
+
+
+def test_packed_corpus_epoch_coverage(tmp_path):
+    """One epoch touches every window exactly once (shuffle is a
+    permutation, not sampling with replacement)."""
+    c = PackedCorpus(_write_stream(tmp_path, 17 * 20), seq_len=16,
+                     batch_size=5, seed=3)
+    # assert on the order function itself: a true permutation of all windows
+    order = c._epoch_order(0)
+    assert len(order) == len(c.windows)
+    assert len(np.unique(order)) == len(order)  # no duplicates/drops
+    # and the iterator consumes it in batch-size chunks
+    it = iter(c)
+    seen = [next(it)["input_ids"][:, 0] for _ in range(c.num_batches_per_epoch)]
+    assert len(np.concatenate(seen)) == c.num_batches_per_epoch * 5
+
+
+def test_packed_corpus_npz_offsets_eos(tmp_path):
+    tokens = np.concatenate([np.arange(40), np.arange(100, 140)]).astype(np.int32)
+    offsets = np.array([0, 40, 80], np.int64)
+    path = tmp_path / "docs.npz"
+    np.savez(path, tokens=tokens, offsets=offsets)
+    c = PackedCorpus(str(path), seq_len=9, batch_size=2, shuffle=False,
+                     eos_token_id=255)
+    flat = np.asarray(c.windows).reshape(-1)
+    # EOS separator appears after each document
+    assert flat[40] == 255
+    assert (flat == 255).sum() >= 1
+
+
+def test_packed_corpus_prepacked_2d(tmp_path):
+    win = np.arange(6 * 17, dtype=np.int32).reshape(6, 17)
+    path = tmp_path / "packed.npy"
+    np.save(path, win)
+    c = PackedCorpus(str(path), seq_len=16, batch_size=2, shuffle=False)
+    np.testing.assert_array_equal(next(iter(c))["input_ids"], win[:2, :-1])
+    with pytest.raises(ValueError, match="seq_len"):
+        PackedCorpus(str(path), seq_len=8, batch_size=2)
+
+
+def test_train_example_on_packed_corpus(tmp_path):
+    """Loss-curve sanity (the 'done' criterion): the example trains from a
+    packed corpus file and the loss drops fast on a highly regular stream."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        "examples_train_llama_data", os.path.join(repo, "examples", "train_llama.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    rng = np.random.default_rng(0)
+    # a 16-token motif repeated — trivially learnable
+    motif = rng.integers(0, 256, 16)
+    np.save(tmp_path / "c.npy", np.tile(motif, 400).astype(np.int32))
+    metrics = mod.main([
+        "--model", "tiny", "--steps", "8", "--seq-len", "32",
+        "--data", f"packed:{tmp_path / 'c.npy'}", "--batch-size", "8",
+        "--lr", "1e-2",
+    ])
+    assert float(metrics["loss"]) < 4.0  # vocab-256 uniform would be ~5.5
