@@ -423,17 +423,19 @@ def child_parallel() -> None:
             "temp_alloc_bytes": temp_bytes,
             "loss": round(float(metrics["loss"]), 4),
         }
-    # runs LAST — it tears down and rebuilds the global mesh (ep=2 x tp=2)
-    blockwise = _blockwise_ep_comparison()
-    _emit(
-        {
-            "metric": "parallel_proxy",
-            "mesh": "cpu pp=2 tp=2 dp=2 sp=on zero1=on",
-            "microbatches": M,
-            "schedules": out,
-            "blockwise_ep": blockwise,
-        }
-    )
+    # emit the schedule measurements FIRST (the parent takes the last
+    # parseable line and salvages partial stdout on timeout), then augment
+    # with the blockwise-EP comparison — it tears down and rebuilds the
+    # global mesh and must never sink the already-measured schedules
+    payload = {
+        "metric": "parallel_proxy",
+        "mesh": "cpu pp=2 tp=2 dp=2 sp=on zero1=on",
+        "microbatches": M,
+        "schedules": out,
+    }
+    _emit(payload)
+    payload["blockwise_ep"] = _blockwise_ep_comparison()
+    _emit(payload)
 
 
 def _blockwise_ep_comparison():
@@ -445,7 +447,6 @@ def _blockwise_ep_comparison():
     import jax.numpy as jnp
 
     from neuronx_distributed_tpu.modules.moe.expert_mlps import (
-        _grouped_mlp,
         _sharded_blockwise_mlp,
         _sharded_blockwise_mlp_rolled,
     )
@@ -652,18 +653,29 @@ def main() -> None:
 
     # 4. Collect the proxy (bounded by its own budget) and finalize.
     remaining = max(30.0, PROXY_TIMEOUT_S - (time.perf_counter() - proxy_t0))
+    timed_out = False
     try:
         stdout, stderr = proxy_proc.communicate(timeout=remaining)
-        parsed = _parse_result(stdout)
-        if parsed is not None and parsed.get("metric") == "parallel_proxy":
-            parsed.pop("metric", None)
-            proxy_result = parsed
-        else:
-            tail = (stderr or stdout or "").strip()[-300:]
-            proxy_result = {"error": f"parallel proxy failed: {tail}"}
     except subprocess.TimeoutExpired:
+        # kill, then collect whatever the child already printed — it emits
+        # the schedule measurements before the slow blockwise comparison
+        timed_out = True
         proxy_proc.kill()
+        try:
+            stdout, stderr = proxy_proc.communicate(timeout=10)
+        except Exception:
+            stdout, stderr = "", ""
+    parsed = _parse_result(stdout or "")
+    if parsed is not None and parsed.get("metric") == "parallel_proxy":
+        parsed.pop("metric", None)
+        if timed_out:
+            parsed["note"] = "proxy timed out mid-augmentation; partial result"
+        proxy_result = parsed
+    elif timed_out:
         proxy_result = {"error": "parallel proxy timed out"}
+    else:
+        tail = ((stderr or stdout) or "").strip()[-300:]
+        proxy_result = {"error": f"parallel proxy failed: {tail}"}
 
     _finalize()
 

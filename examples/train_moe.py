@@ -62,6 +62,13 @@ def parse_args(argv=None):
     par.add_argument("--ep", type=int, default=1, help="expert parallel size")
     par.add_argument("--sp", action="store_true",
                      help="Megatron sequence parallel")
+    par.add_argument("--pp", type=int, default=1,
+                     help="pipeline parallel size (generic Mixtral adapter)")
+    par.add_argument("--schedule", default="1f1b",
+                     choices=["gpipe", "1f1b", "interleaved"])
+    par.add_argument("--chunks", type=int, default=2,
+                     help="virtual chunks per rank (interleaved)")
+    par.add_argument("--microbatches", type=int, default=4)
 
     t = p.add_argument_group("training")
     t.add_argument("--batch-size", type=int, default=None,
@@ -117,7 +124,8 @@ def build_config(args):
     return cfg
 
 
-def make_data_iter(args, cfg, batch_size: int, seq_len: int):
+def make_data_iter(args, cfg, batch_size: int, seq_len: int,
+                   include_step: bool = True):
     import numpy as np
 
     rng = np.random.default_rng(args.seed)
@@ -125,10 +133,14 @@ def make_data_iter(args, cfg, batch_size: int, seq_len: int):
     while True:
         ids = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1),
                            dtype=np.int32)
-        # "step" seeds the per-step shuffle/jitter rng streams inside the
-        # jitted loss (scalars pass through shard_batch replicated)
-        yield {"input_ids": ids[:, :-1], "labels": ids[:, 1:],
-               "step": np.int32(step)}
+        batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+        if include_step:
+            # "step" seeds the per-step shuffle/jitter rng streams inside the
+            # jitted loss (scalars pass through shard_batch replicated; the
+            # pipeline prepare_batch microbatches every leaf, so pp runs —
+            # which forbid the stochastic paths anyway — omit it)
+            batch["step"] = np.int32(step)
+        yield batch
         step += 1
 
 
@@ -159,11 +171,17 @@ def main(argv=None):
     mesh_lib.initialize_model_parallel(
         tensor_model_parallel_size=args.tp,
         expert_model_parallel_size=args.ep,
+        pipeline_model_parallel_size=args.pp,
     )
     dp = mesh_lib.get_data_parallel_size()
     cfg = build_config(args)
+    if args.pp > 1:
+        cfg = dataclasses.replace(cfg, scan_layers=True)
     seq_len = min(cfg.max_seq_len, args.seq_len or cfg.max_seq_len)
-    batch_size = args.batch_size if args.batch_size is not None else dp
+    if args.batch_size is None:
+        batch_size = dp * (args.microbatches if args.pp > 1 else 1)
+    else:
+        batch_size = args.batch_size
 
     opt_cfg = OptimizerConfig(
         learning_rate=args.lr,
@@ -196,9 +214,30 @@ def main(argv=None):
                               deterministic=False, rngs=rngs)
         return model.loss(params, batch["input_ids"], batch["labels"])
 
+    pipeline = None
+    if args.pp > 1:
+        if stochastic:
+            raise SystemExit(
+                "--pp with --token-shuffle/jitter is unsupported: the "
+                "pipeline adapters run layers without per-step rng streams"
+            )
+        from neuronx_distributed_tpu.pipeline.generic import (
+            GenericPipelineAdapter,
+        )
+        from neuronx_distributed_tpu.pipeline.mixtral import mixtral_family
+
+        pipeline = GenericPipelineAdapter(
+            family=mixtral_family(cfg, attention_impl=args.attention),
+            num_microbatches=args.microbatches,
+            schedule=args.schedule,
+            num_chunks=args.chunks if args.schedule == "interleaved" else 1,
+        )
+
     trainer = Trainer(model=model, optimizer_config=opt_cfg,
-                      callbacks=callbacks, loss_fn=moe_loss)
-    data = make_data_iter(args, cfg, batch_size, seq_len)
+                      callbacks=callbacks, loss_fn=moe_loss,
+                      pipeline=pipeline)
+    data = make_data_iter(args, cfg, batch_size, seq_len,
+                          include_step=pipeline is None)
     logger.info(
         "training mixtral-%s: %d layers, %d experts top-%d, strategy=%s "
         "capacity=%s shuffle=%s tp=%d ep=%d dp=%d sp=%s batch=%d seq=%d",

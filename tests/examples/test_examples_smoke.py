@@ -107,6 +107,26 @@ def moe_mod():
     return _load("train_moe")
 
 
+def test_train_moe_example_pp(moe_mod):
+    """MoE + pipeline parallelism through the generic Mixtral adapter
+    (reference: NxDPPModel wraps the Mixtral example)."""
+    metrics = moe_mod.main([
+        "--model", "tiny", "--tp", "2", "--pp", "2", "--schedule", "1f1b",
+        "--microbatches", "4", "--steps", "2", "--seq-len", "32",
+        "--layers", "2",
+    ])
+    assert float(metrics["loss"]) > 0
+
+
+def test_train_moe_pp_rejects_stochastic(moe_mod):
+    import pytest
+
+    with pytest.raises(SystemExit, match="token-shuffle"):
+        moe_mod.main([
+            "--model", "tiny", "--pp", "2", "--token-shuffle", "--steps", "1",
+        ])
+
+
 def test_train_moe_example_ep_tp(moe_mod):
     """Dropless blockwise experts under ep=2 x tp=2 (the MoE-specific
     example — reference examples/training/mixtral analogue)."""
