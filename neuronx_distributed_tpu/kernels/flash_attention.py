@@ -16,8 +16,8 @@ Structure (canonical TPU flash attention):
 
 GQA is native (round-4, VERDICT r3 weak #2): K/V stay at their Hkv head count
 in HBM — the BlockSpec index maps send q-head ``h`` to kv-head ``h // group``
-(forward and dQ kernels), and the dK/dV kernel runs a 5-dim grid
-``(B, Hkv, nK, group, nQ)`` whose two innermost sequential dims accumulate
+(forward and dQ kernels), and the dK/dV kernel runs a grid
+``(B, Hkv, nK, group·nQ)`` whose fused innermost sequential dim accumulates
 every q-head of the group into its kv-head's output block while it stays
 resident in VMEM (Pallas keeps an output block live across consecutive
 iterations with the same index). At Llama-70B geometry (8 kv / 64 q heads)
@@ -163,14 +163,17 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int, interpret: boo
 def _dkdv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                  dk_scr, dv_scr, *, causal, scale, block_q, block_k, num_q_blocks,
                  num_groups, dyn_offsets):
-    # grid (B, Hkv, nK, group, nQ): the two innermost sequential dims sweep
-    # every q-head of the kv-head's group and every q block, accumulating into
-    # the kv-head's dK/dV output block (resident in VMEM across the sweep)
+    # grid (B, Hkv, nK, group·nQ): ONE innermost sequential dim sweeps every
+    # q-head of the kv-head's group and every q block (t = g·nQ + i),
+    # accumulating into the kv-head's dK/dV output block, which stays
+    # VMEM-resident across the whole sweep (its index map is constant in t).
+    # A single sequential dim keeps the revisit pattern identical to the
+    # pre-GQA kernel's — the Mosaic-proven shape.
     j = pl.program_id(2)  # k block
-    g = pl.program_id(3)  # q-head within the group (sequential)
-    i = pl.program_id(4)  # q block (sequential)
+    t = pl.program_id(3)  # fused (q-head-in-group, q block), sequential
+    i = t % num_q_blocks
 
-    @pl.when((g == 0) & (i == 0))
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -210,7 +213,7 @@ def _dkdv_kernel(q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, del
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )                                               # (BK, D)
 
-    @pl.when((g == num_groups - 1) & (i == num_q_blocks - 1))
+    @pl.when(t == num_groups * num_q_blocks - 1)
     def _finish():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
@@ -270,19 +273,20 @@ def _flash_dkdv(q, k, v, g, lse, delta, causal, block_q, block_k, interpret,
     nq, nk = s // block_q, sk // block_k
     scale = 1.0 / (d ** 0.5)
     dyn = q_off is not None or k_off is not None
-    # dK/dV: grid over kv heads + k blocks; q-heads of the group and q blocks
-    # are the innermost SEQUENTIAL dims so the group's contributions accumulate
-    # into the kv-head output block while it stays resident (the GQA-native
-    # replacement for repeating K/V to the full head count in HBM).
-    qmap = lambda b_, hk, j, g_, i: (b_, hk * group + g_, i, 0)  # noqa: E731
-    kmap = lambda b_, hk, j, g_, i: (b_, hk, j, 0)  # noqa: E731
+    # dK/dV: grid over kv heads + k blocks; the fused (q-head-in-group,
+    # q-block) dim is the innermost SEQUENTIAL one so the group's
+    # contributions accumulate into the kv-head output block while it stays
+    # resident (the GQA-native replacement for repeating K/V to the full
+    # head count in HBM).
+    qmap = lambda b_, hk, j, t: (b_, hk * group + t // nq, t % nq, 0)  # noqa: E731
+    kmap = lambda b_, hk, j, t: (b_, hk, j, 0)  # noqa: E731
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkdv_kernel, causal=causal, scale=scale,
             block_q=block_q, block_k=block_k, num_q_blocks=nq,
             num_groups=group, dyn_offsets=dyn,
         ),
-        grid=(b, hkv, nk, group, nq),
+        grid=(b, hkv, nk, group * nq),
         in_specs=[
             _SMEM_SPEC,
             _SMEM_SPEC,
@@ -306,9 +310,7 @@ def _flash_dkdv(q, k, v, g, lse, delta, causal, block_q, block_k, interpret,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(
-                "parallel", "parallel", "parallel", "arbitrary", "arbitrary"
-            ),
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(
