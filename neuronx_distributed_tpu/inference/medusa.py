@@ -48,12 +48,20 @@ def medusa_generate(
     top_k: int = 10,
 ) -> Tuple[jax.Array, float]:
     """Greedy Medusa generation with a ``MedusaForCausalLM``-shaped model
-    (returns ``(logits, medusa_logits)``). B=1: the host loop re-feeds a
-    per-row VARIABLE number of accepted-chain tokens each round, so rows
-    cannot share the fixed-width round function (unlike speculative decoding,
-    whose pad-to-shortest batch advance lifted its B=1 limit). Returns
-    ``(tokens (1, max_new_tokens), mean_accepted_per_round)``."""
-    assert prompt_ids.shape[0] == 1, "medusa decoding supports B=1"
+    (returns ``(logits, medusa_logits)``) for ``prompt_ids`` (B, S) — any
+    batch size (round 4; the reference example is B=1). Rows accept divergent
+    chain lengths but share one cache index, so every round advances all
+    rows by the BATCH-MIN accepted length + 1 (the same pad-to-shortest
+    schedule as batched speculative decoding) — greedy Medusa emits exactly
+    the base model's greedy sequence per row independent of the advance
+    schedule, so discarded over-acceptances cost draft work, never tokens.
+    Returns ``(tokens (B, max_new_tokens), mean_accepted_per_round)`` — the
+    mean over rounds AND rows of each row's own accepted chain length, i.e.
+    a DRAFT-QUALITY metric comparable across batch sizes. At B>1 the
+    REALIZED advance per round is ``min over rows + 1`` tokens (the
+    pad-to-shortest schedule), so wall-clock tokens/s is bounded by the
+    worst row, not this mean."""
+    B = prompt_ids.shape[0]
     buffers = generate_medusa_buffers(choices, top_k=top_k)
     n_nodes = buffers["attn_mask"].shape[0]
     depth = buffers["retrieve_indices"].shape[1] - 1
@@ -84,7 +92,8 @@ def medusa_generate(
 
     @jax.jit
     def _round(params, cache, tokens_in, base_pos, n_in):
-        """tokens_in (1, W) with the first n_in entries valid (W static)."""
+        """tokens_in (B, W) with the first n_in entries valid per row (W
+        static; the pad-to-shortest schedule keeps n_in uniform)."""
         # 1. write accepted tokens' K/V, get logits at the last VALID slot.
         #    Cache index must land at base_pos + n_in, so feed exactly the
         #    valid window via position masking: invalid tail slots get
@@ -97,14 +106,14 @@ def medusa_generate(
         )
         cache = _set_cache_index(variables["cache"], base_pos + n_in)
         last = n_in - 1
-        base = jnp.argmax(logits[0, last], -1).astype(jnp.int32)[None]
-        med_last = med[:, last]  # (1, heads, V)
+        base = jnp.argmax(logits[:, last], -1).astype(jnp.int32)  # (B,)
+        med_last = med[:, last]  # (B, heads, V)
 
-        # 2. candidates + tree tokens
+        # 2. candidates + tree tokens (per row)
         tree_tokens, cands = generate_candidates(base, med_last, buffers)
 
         # 3. tree verify: nodes at positions (base_pos + n_in) + depth with
-        #    prefix+ancestor attention
+        #    prefix+ancestor attention (mask shared — rows advance together)
         cur = base_pos + n_in
         node_pos = cur + tree_pos
         k_pos = jnp.arange(max_len)
@@ -122,37 +131,45 @@ def medusa_generate(
             attn_mask=full_mask,
             mutable=["cache"],
         )
-        # logits per candidate-chain node: (1, L, depth+1, V)
+        # logits per candidate-chain node: (B, L, depth+1, V)
         chain_logits = v_logits[:, jnp.clip(retrieve, 0)]
 
-        # 4. greedy acceptance
+        # 4. greedy acceptance per row
         best, acc = evaluate_posterior_greedy(chain_logits, cands)
-        chain = cands[0, best[0]]  # (depth+1,) = [base, c1, c2, ...]
-        return cache, base, chain, acc[0]
+        chain = jnp.take_along_axis(
+            cands, best[:, None, None], axis=1
+        )[:, 0]  # (B, depth+1) = [base, c1, c2, ...]
+        return cache, base, chain, acc
 
     base, _med, cache = _prefill(dict(params), prompt_ids)
-    tokens = [int(base[0])]
+    tokens = [np.asarray(base)[:, None]]  # list of (B, n) chunks
+    count = 1
     W = depth + 1  # max tokens emitted (and re-fed) per round
     base_pos = prompt_ids.shape[1]
-    tokens_in = jnp.zeros((1, W), jnp.int32).at[0, 0].set(base[0])
+    tokens_in = jnp.zeros((B, W), jnp.int32).at[:, 0].set(base)
     n_in = 1
-    rounds, accepted_total = 0, 0
-    while len(tokens) < max_new_tokens:
+    rounds, accepted_rows = 0, 0.0
+    while count < max_new_tokens:
         cache, new_base, chain, acc = _round(
             dict(params), cache, tokens_in,
             jnp.asarray(base_pos, jnp.int32), jnp.asarray(n_in, jnp.int32),
         )
-        n_acc = int(acc)
-        emitted = [int(new_base[0])] + [int(v) for v in chain[1 : n_acc + 1]]
-        tokens.extend(emitted)
+        acc_h = np.asarray(acc)
+        # shared cache index → advance every row by the batch-min accepted
+        # chain length (+1 for the fresh base token); see docstring
+        n_min = int(acc_h.min())
+        emitted = np.concatenate(
+            [np.asarray(new_base)[:, None], np.asarray(chain[:, 1 : n_min + 1])],
+            axis=1,
+        )  # (B, n_min + 1)
+        tokens.append(emitted)
+        count += emitted.shape[1]
         base_pos += n_in
-        tokens_in = jnp.zeros((1, W), jnp.int32)
-        for i, t in enumerate(emitted):
-            tokens_in = tokens_in.at[0, i].set(t)
-        n_in = len(emitted)
+        tokens_in = jnp.zeros((B, W), jnp.int32).at[:, : emitted.shape[1]].set(
+            jnp.asarray(emitted)
+        )
+        n_in = emitted.shape[1]
         rounds += 1
-        accepted_total += n_acc
-    return (
-        jnp.asarray(tokens[:max_new_tokens], jnp.int32)[None],
-        accepted_total / max(rounds, 1),
-    )
+        accepted_rows += float(acc_h.mean())
+    toks = np.concatenate(tokens, axis=1)[:, :max_new_tokens]
+    return jnp.asarray(toks, jnp.int32), accepted_rows / max(rounds, 1)

@@ -71,7 +71,10 @@ def speculative_generate(
     prob ``min(1, p_target(x)/p_draft(x))``, resample rejections from
     ``norm(max(0, p_t − p_d))`` — the output distribution equals sampling the
     target directly). Returns ``(tokens (B, max_new_tokens),
-    mean_accepted_per_round)`` where the mean is over rounds AND rows."""
+    mean_accepted_per_round)`` — the mean over rounds AND rows of each row's
+    own accepted length (a draft-quality metric comparable across batch
+    sizes); at B>1 the REALIZED advance per round is ``min over rows + 1``
+    tokens, so wall-clock tokens/s is bounded by the worst row."""
     B = prompt_ids.shape[0]
     if temperature > 0.0 and key is None:
         raise ValueError("sampled speculative decoding needs a PRNG key")

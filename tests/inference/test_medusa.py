@@ -44,6 +44,29 @@ def test_medusa_matches_base_greedy(scan_layers):
     assert acc >= 0.0
 
 
+def test_batched_medusa_matches_per_row_runs():
+    """B=3 (round 4; reference medusa example is B=1): batched output must
+    equal each row's own B=1 run AND the base model's greedy continuation —
+    the pad-to-shortest batch advance cannot change tokens."""
+    cfg = tiny_llama()
+    model = MedusaForCausalLM(cfg, num_medusa_heads=3, attention_impl="xla")
+    B = 3
+    ids = jax.random.randint(jax.random.PRNGKey(5), (B, S), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    toks, acc = medusa_generate(model, params, ids, max_new_tokens=NEW)
+    assert toks.shape == (B, NEW)
+    assert acc >= 0.0
+    ref = _greedy_base(model, params, ids, NEW)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    for b in range(B):
+        row, _ = medusa_generate(
+            model, params, ids[b : b + 1], max_new_tokens=NEW
+        )
+        np.testing.assert_array_equal(
+            np.asarray(toks[b]), np.asarray(row[0]), err_msg=f"row {b}"
+        )
+
+
 def test_medusa_guard_on_overflow():
     cfg, model, ids, params = _setup()
     with pytest.raises(ValueError, match="max_seq_len"):

@@ -200,3 +200,53 @@ def test_lora_checkpoint_flows(tmp_path):
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=1e-6
     )
+
+
+def test_conv2d_adapter_on_vit():
+    """Conv kernels adapt through the same leaf machinery (reference
+    LoraConv2d, lora/layer.py:331): a 4-D patch-embed kernel (kh, kw, in,
+    out) gets per-position rank-r A/B factors, fresh adapters are identity,
+    and training moves only the adapters."""
+    from neuronx_distributed_tpu.models.vit import (
+        ViTForImageClassification,
+        tiny_vit,
+    )
+
+    mesh_lib.initialize_model_parallel()
+    cfg = tiny_vit()
+    model = ViTForImageClassification(cfg)
+    pixels = jax.random.normal(
+        jax.random.PRNGKey(0), (2, cfg.image_size, cfg.image_size, 3)
+    )
+    labels = jnp.array([1, 2])
+    params = model.init(jax.random.PRNGKey(1), pixels)
+
+    lcfg = LoraConfig(r=2, target_modules=("patch_embed", "classifier"))
+    lora = init_lora_params(params, lcfg, jax.random.PRNGKey(2))
+    pk = lora["params"]["patch_embed"]["kernel"]
+    kh = kw = cfg.patch_size
+    assert pk["lora_a"].shape == (kh, kw, 3, 2)
+    assert pk["lora_b"].shape == (kh, kw, 2, cfg.hidden_size)
+    assert "blocks_0" not in lora["params"]  # untargeted modules untouched
+
+    # zero-B adapters are identity
+    from flax.core import meta
+
+    merged = merge_lora_params(params, lora, lcfg)
+    ref = jax.jit(model.apply)(meta.unbox(params), pixels)
+    got = jax.jit(model.apply)(merged, pixels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+    # one adapter-only train step changes the merged conv kernel
+    loss = lora_train_loss_fn(
+        params, lcfg, lambda p, b: model.loss(p, b["pixels"], b["labels"])
+    )
+    g = jax.grad(loss)(lora, {"pixels": pixels, "labels": labels})
+    # at zero-init B, dL/dA = dL/dDelta @ B^T = 0 — B carries the first grads
+    assert float(jnp.abs(g["params"]["patch_embed"]["kernel"]["lora_b"]).sum()) > 0
+    stepped = jax.tree.map(lambda p, gg: p - 1e-2 * gg, lora, g)
+    merged2 = merge_lora_params(params, stepped, lcfg)
+    assert not np.allclose(
+        np.asarray(merged2["params"]["patch_embed"]["kernel"]),
+        np.asarray(meta.unbox(params)["params"]["patch_embed"]["kernel"]),
+    )
