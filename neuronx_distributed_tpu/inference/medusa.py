@@ -134,12 +134,13 @@ def medusa_generate(
         # logits per candidate-chain node: (B, L, depth+1, V)
         chain_logits = v_logits[:, jnp.clip(retrieve, 0)]
 
-        # 4. greedy acceptance per row
+        # 4. greedy acceptance per row; chain[:, 0] IS the base argmax
+        # (every candidate chain is rooted at it in generate_candidates)
         best, acc = evaluate_posterior_greedy(chain_logits, cands)
         chain = jnp.take_along_axis(
             cands, best[:, None, None], axis=1
         )[:, 0]  # (B, depth+1) = [base, c1, c2, ...]
-        return cache, base, chain, acc
+        return cache, chain, acc
 
     base, _med, cache = _prefill(dict(params), prompt_ids)
     tokens = [np.asarray(base)[:, None]]  # list of (B, n) chunks
@@ -150,18 +151,17 @@ def medusa_generate(
     n_in = 1
     rounds, accepted_rows = 0, 0.0
     while count < max_new_tokens:
-        cache, new_base, chain, acc = _round(
+        cache, chain, acc = _round(
             dict(params), cache, tokens_in,
             jnp.asarray(base_pos, jnp.int32), jnp.asarray(n_in, jnp.int32),
         )
-        acc_h = np.asarray(acc)
+        # ONE blocking transfer per round; the n_min-dependent slice happens
+        # on host so no per-n_min device executables are compiled
+        chain_h, acc_h = jax.device_get((chain, acc))
         # shared cache index → advance every row by the batch-min accepted
-        # chain length (+1 for the fresh base token); see docstring
+        # chain length (+1 for the fresh base token = chain[:, 0]); docstring
         n_min = int(acc_h.min())
-        emitted = np.concatenate(
-            [np.asarray(new_base)[:, None], np.asarray(chain[:, 1 : n_min + 1])],
-            axis=1,
-        )  # (B, n_min + 1)
+        emitted = np.asarray(chain_h[:, : n_min + 1])  # (B, n_min + 1)
         tokens.append(emitted)
         count += emitted.shape[1]
         base_pos += n_in

@@ -35,19 +35,38 @@ def _greedy_base(model, params, ids, steps):
     return jnp.stack(out, axis=1)
 
 
+def _assert_greedy_continuation(model, params, ids, toks):
+    """Teacher-forced form of the same invariant — ONE full-recompute apply
+    on [prompt, toks] verifies every emitted token equals the base head's
+    argmax at its position (a greedy continuation is exactly the fixpoint of
+    this check), without the golden's per-length recompiles."""
+    full = jnp.concatenate([ids, jnp.asarray(toks)], axis=1)
+    logits, _med = jax.jit(model.apply)(params, full)
+    s0 = ids.shape[1]
+    preds = jnp.argmax(logits[:, s0 - 1 : -1], -1).astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(preds))
+
+
 @pytest.mark.parametrize("scan_layers", [False, True])
 def test_medusa_matches_base_greedy(scan_layers):
     cfg, model, ids, params = _setup(scan_layers)
-    ref = _greedy_base(model, params, ids, NEW)
     toks, acc = medusa_generate(model, params, ids, max_new_tokens=NEW)
-    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    # exact-match against the step-by-step golden for the unrolled layout
+    # (the strongest form); the scan layout uses the one-shot teacher-forced
+    # equivalent to avoid NEW per-length recompiles
+    if not scan_layers:
+        ref = _greedy_base(model, params, ids, NEW)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    else:
+        _assert_greedy_continuation(model, params, ids, toks)
     assert acc >= 0.0
 
 
 def test_batched_medusa_matches_per_row_runs():
     """B=3 (round 4; reference medusa example is B=1): batched output must
-    equal each row's own B=1 run AND the base model's greedy continuation —
-    the pad-to-shortest batch advance cannot change tokens."""
+    equal the base model's greedy continuation per row (the pad-to-shortest
+    batch advance cannot change tokens) — and one row's own B=1 run, which
+    pins batched == B=1 transitively (B=1 vs greedy is covered above)."""
     cfg = tiny_llama()
     model = MedusaForCausalLM(cfg, num_medusa_heads=3, attention_impl="xla")
     B = 3
@@ -56,15 +75,9 @@ def test_batched_medusa_matches_per_row_runs():
     toks, acc = medusa_generate(model, params, ids, max_new_tokens=NEW)
     assert toks.shape == (B, NEW)
     assert acc >= 0.0
-    ref = _greedy_base(model, params, ids, NEW)
-    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
-    for b in range(B):
-        row, _ = medusa_generate(
-            model, params, ids[b : b + 1], max_new_tokens=NEW
-        )
-        np.testing.assert_array_equal(
-            np.asarray(toks[b]), np.asarray(row[0]), err_msg=f"row {b}"
-        )
+    _assert_greedy_continuation(model, params, ids, toks)
+    row, _ = medusa_generate(model, params, ids[:1], max_new_tokens=NEW)
+    np.testing.assert_array_equal(np.asarray(toks[0]), np.asarray(row[0]))
 
 
 def test_medusa_guard_on_overflow():

@@ -120,7 +120,14 @@ def test_batched_speculative_matches_per_row_runs():
     )
     assert toks.shape == (B, NEW)
     assert 0.0 <= mean_acc <= 3.0
-    for b in range(B):
+    # every row against the target's own greedy decode (ONE batched call);
+    # first and last rows additionally against their own B=1 runs
+    ref = generate(
+        target, t_params, ids, jax.random.PRNGKey(2),
+        GenerationConfig(max_new_tokens=NEW, temperature=0.0),
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    for b in (0, B - 1):
         row, _ = speculative_generate(
             target, t_params, draft, d_params, ids[b : b + 1],
             max_new_tokens=NEW, gamma=3,
@@ -128,11 +135,6 @@ def test_batched_speculative_matches_per_row_runs():
         np.testing.assert_array_equal(
             np.asarray(toks[b]), np.asarray(row[0]), err_msg=f"row {b}"
         )
-        ref = generate(
-            target, t_params, ids[b : b + 1], jax.random.PRNGKey(2),
-            GenerationConfig(max_new_tokens=NEW, temperature=0.0),
-        )
-        np.testing.assert_array_equal(np.asarray(toks[b]), np.asarray(ref[0]))
 
 
 def test_batched_sampled_speculative_valid():
