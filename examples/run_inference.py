@@ -65,6 +65,10 @@ def parse_args(argv=None):
     p.add_argument("--save-dir", default=None,
                    help="serialize traced executables here (trace mode)")
     p.add_argument("--attention", default="auto", choices=["auto", "flash", "xla"])
+    p.add_argument("--quantize", default=None, choices=["int8", "fp8"],
+                   help="weight-only serving quantization: every linear "
+                        "kernel stored int8/fp8e4m3 + per-channel scale "
+                        "(generate/benchmark modes)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--force-cpu-devices", type=int, default=None)
     return p.parse_args(argv)
@@ -146,6 +150,37 @@ def main(argv=None):
     # medusa re-inits its own multi-head model below; skip the base init
     params = (None if args.mode == "medusa"
               else meta.unbox(jax.jit(model.init)(key, prompt)))
+
+    if args.quantize and args.mode not in ("generate", "benchmark"):
+        # silent float serving while the user believes int8 is active would
+        # invalidate whatever they measure next
+        raise SystemExit(
+            f"--quantize is not supported in --mode {args.mode} "
+            "(generate/benchmark only)"
+        )
+    if args.quantize:
+        # weight-only serving quantization: quantize the float checkpoint
+        # tree and serve it through the quantized model (HBM holds 1-byte
+        # weights; XLA fuses the dequant scale into the matmul epilogue)
+        from neuronx_distributed_tpu.quantization.config import (
+            QuantizationConfig,
+            QuantizedDtype,
+        )
+        from neuronx_distributed_tpu.quantization.utils import (
+            quantize_param_tree,
+        )
+
+        qcfg = QuantizationConfig(
+            quantized_dtype={"int8": QuantizedDtype.INT8,
+                             "fp8": QuantizedDtype.FP8E4M3}[args.quantize]
+        )
+        params = quantize_param_tree(params, qcfg)
+        cfg = dataclasses.replace(cfg, quantization=qcfg)
+        from neuronx_distributed_tpu.models.llama import LlamaForCausalLM
+
+        model = LlamaForCausalLM(cfg, attention_impl=args.attention)
+        logger.info("serving %s weights (weight-only quantization)",
+                    args.quantize)
 
     gen_temp = 1.0 if args.temperature is None else args.temperature
     gen_cfg = GenerationConfig(

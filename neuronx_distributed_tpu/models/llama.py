@@ -48,6 +48,12 @@ class LlamaConfig:
     sequence_parallel: bool = False
     remat: bool = True  # activation checkpointing per decoder layer
     scan_layers: bool = True  # lax.scan over layers (fast compile at depth)
+    # weight-only serving quantization (a QuantizationConfig): every linear
+    # kernel (qkv/o/gate/up/down/lm_head — not the embedding lookup) becomes
+    # int8/fp8 + scale, matching quantize_param_tree's output on a trained
+    # float checkpoint (reference: module-swap convert, quantization/
+    # quantize.py:18 + quantization_mappings.py:19)
+    quantization: Optional[Any] = None
 
     @property
     def head_dim_(self) -> int:
@@ -132,6 +138,7 @@ class LlamaAttention(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
+            quantization_config=cfg.quantization,
             name="qkv",
         )(x)
         b, s = q.shape[0], q.shape[1]
@@ -158,6 +165,7 @@ class LlamaAttention(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
+            quantization_config=cfg.quantization,
             name="o_proj",
         )(out)
 
@@ -217,6 +225,7 @@ class LlamaMLP(nn.Module):
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             sequence_parallel_enabled=cfg.sequence_parallel,
+            quantization_config=cfg.quantization,
         )
         gate = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size, name="gate_proj", **common)(x)
         up = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size, name="up_proj", **common)(x)
@@ -321,7 +330,8 @@ class LlamaForCausalLM(nn.Module):
             x = constrain(x, P(UNC, None, None))
         logits = ColumnParallelLinear(
             cfg.hidden_size, cfg.vocab_size, use_bias=False,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            quantization_config=cfg.quantization, name="lm_head",
         )(x)
         return logits
 

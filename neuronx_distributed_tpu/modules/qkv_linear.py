@@ -44,6 +44,7 @@ class GQAQKVColumnParallelLinear(nn.Module):
     param_dtype: Any = jnp.float32
     kernel_init: Any = default_kernel_init
     axis: str = mesh_lib.TP_AXIS
+    quantization_config: Any = None  # weight-only serving quantization
 
     def _kv_shardable(self) -> bool:
         if not mesh_lib.model_parallel_is_initialized():
@@ -53,51 +54,29 @@ class GQAQKVColumnParallelLinear(nn.Module):
 
     @nn.compact
     def __call__(self, x) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        q = ColumnParallelLinear(
-            self.hidden_size,
-            self.num_heads * self.head_dim,
+        common = dict(
             use_bias=self.use_bias,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=self.kernel_init,
-            axis=self.axis,
-            name="q_proj",
-        )(x)
-        kv_axis = self.axis if self._kv_shardable() else None
-        kv_kwargs = dict(
-            use_bias=self.use_bias,
-            sequence_parallel_enabled=self.sequence_parallel_enabled,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=self.kernel_init,
+            quantization_config=self.quantization_config,
         )
-        if kv_axis is None:
-            # tp > num_kv_heads: replicated KV params (the reference's
-            # kv_size_multiplier replication, expressed as sharding)
-            k = nn.DenseGeneral(
-                self.num_kv_heads * self.head_dim,
-                use_bias=self.use_bias,
-                dtype=self.dtype,
-                param_dtype=self.param_dtype,
-                kernel_init=self.kernel_init,
-                name="k_proj",
-            )(x.astype(self.dtype))
-            v = nn.DenseGeneral(
-                self.num_kv_heads * self.head_dim,
-                use_bias=self.use_bias,
-                dtype=self.dtype,
-                param_dtype=self.param_dtype,
-                kernel_init=self.kernel_init,
-                name="v_proj",
-            )(x.astype(self.dtype))
-        else:
-            k = ColumnParallelLinear(
-                self.hidden_size, self.num_kv_heads * self.head_dim,
-                axis=self.axis, name="k_proj", **kv_kwargs,
-            )(x)
-            v = ColumnParallelLinear(
-                self.hidden_size, self.num_kv_heads * self.head_dim,
-                axis=self.axis, name="v_proj", **kv_kwargs,
-            )(x)
+        q = ColumnParallelLinear(
+            self.hidden_size, self.num_heads * self.head_dim,
+            axis=self.axis, name="q_proj", **common,
+        )(x)
+        # tp > num_kv_heads: axis=None keeps the (small) KV params replicated
+        # (the reference's kv_size_multiplier replication, expressed as a
+        # sharding decision) through the SAME layer class — one param tree
+        # either way
+        kv_axis = self.axis if self._kv_shardable() else None
+        k = ColumnParallelLinear(
+            self.hidden_size, self.num_kv_heads * self.head_dim,
+            axis=kv_axis, name="k_proj", **common,
+        )(x)
+        v = ColumnParallelLinear(
+            self.hidden_size, self.num_kv_heads * self.head_dim,
+            axis=kv_axis, name="v_proj", **common,
+        )(x)
         return q, k, v

@@ -29,7 +29,7 @@ init path (jax.eval_shape + jit init subsume it).
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,48 @@ Dtype = Any
 Initializer = Callable[..., jax.Array]
 
 default_kernel_init = nn.initializers.lecun_normal()
+
+
+def _declare_kernel(module, shape, partition, kernel_init, param_dtype, dtype,
+                    scale_partition):
+    """Kernel declaration shared by the parallel linears: float by default; a
+    ``quantization_config`` on the module declares the weight-only serving
+    form instead — a quantized-dtype kernel plus a float ``scale`` sibling
+    (the exact tree ``quantization.utils.quantize_param_tree`` produces from
+    a trained float checkpoint — reference ``from_float`` converters +
+    module-swap ``convert``, quantization/quantize.py:18). Forward
+    dequantizes; XLA fuses the scale multiply into the matmul epilogue, so
+    HBM holds 1-byte weights while the MXU sees a dense GEMM."""
+    qcfg = module.quantization_config
+    if qcfg is None:
+        kernel = module.param(
+            "kernel",
+            nn.with_partitioning(kernel_init, partition),
+            shape,
+            module.param_dtype,
+        )
+        return kernel.astype(dtype)
+    from neuronx_distributed_tpu.quantization.layers import _scale_shape
+
+    kernel = module.param(
+        "kernel",
+        nn.with_partitioning(
+            lambda key, shp, dt: jnp.zeros(shp, dt), partition
+        ),
+        shape,
+        qcfg.quantized_dtype.jnp_dtype,
+    )
+    sshape = _scale_shape(qcfg, shape, channel_dim=1)
+    scale = module.param(
+        "scale",
+        nn.with_partitioning(
+            nn.initializers.ones_init(),
+            scale_partition if len(sshape) == len(shape) else (),
+        ),
+        sshape,
+        jnp.float32,
+    )
+    return (kernel.astype(jnp.float32) * scale).astype(dtype)
 
 
 class ColumnParallelLinear(nn.Module):
@@ -63,15 +105,21 @@ class ColumnParallelLinear(nn.Module):
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = default_kernel_init
     bias_init: Initializer = nn.initializers.zeros_init()
-    axis: str = mesh_lib.TP_AXIS
+    axis: Optional[str] = mesh_lib.TP_AXIS
+    # weight-only serving quantization (int8/fp8 kernel + float scale); see
+    # _declare_kernel
+    quantization_config: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x):
-        kernel = self.param(
-            "kernel",
-            nn.with_partitioning(self.kernel_init, (None, self.axis)),
+        kernel = _declare_kernel(
+            self,
             (self.input_size, self.output_size),
+            (None, self.axis),
+            self.kernel_init,
             self.param_dtype,
+            self.dtype,
+            scale_partition=(None, self.axis),
         )
         if self.use_bias:
             bias = self.param(
@@ -81,7 +129,6 @@ class ColumnParallelLinear(nn.Module):
                 self.param_dtype,
             )
         x = x.astype(self.dtype)
-        kernel = kernel.astype(self.dtype)
         if self.sequence_parallel_enabled and x.ndim >= 3:
             # Declare the incoming SP layout so the partitioner knows to
             # all-gather seq right here (reference fwd all-gather,
@@ -117,15 +164,20 @@ class RowParallelLinear(nn.Module):
     param_dtype: Dtype = jnp.float32
     kernel_init: Initializer = default_kernel_init
     bias_init: Initializer = nn.initializers.zeros_init()
-    axis: str = mesh_lib.TP_AXIS
+    axis: Optional[str] = mesh_lib.TP_AXIS
+    quantization_config: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x):
-        kernel = self.param(
-            "kernel",
-            nn.with_partitioning(self.kernel_init, (self.axis, None)),
+        kernel = _declare_kernel(
+            self,
             (self.input_size, self.output_size),
+            (self.axis, None),
+            self.kernel_init,
             self.param_dtype,
+            self.dtype,
+            # per-channel scales live on the (unsharded) out dim
+            scale_partition=(None, None),
         )
         if self.use_bias:
             # bias is applied after the reduction → replicated (not sharded),
@@ -138,7 +190,6 @@ class RowParallelLinear(nn.Module):
                 self.param_dtype,
             )
         x = x.astype(self.dtype)
-        kernel = kernel.astype(self.dtype)
         if self.input_is_parallel:
             x = constrain(x, P(*([UNC] * (x.ndim - 1)), self.axis))
         y = jax.lax.dot_general(

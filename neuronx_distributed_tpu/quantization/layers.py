@@ -59,26 +59,23 @@ class QuantizedColumnParallel(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        qcfg = self.quantization_config
-        kshape = (self.input_size, self.output_size)
-        kernel = self.param(
-            "kernel",
-            nn.with_partitioning(
-                lambda key, shape, dt: jnp.zeros(shape, dt), (None, self.axis)
-            ),
-            kshape,
-            qcfg.quantized_dtype.jnp_dtype,
+        from neuronx_distributed_tpu.parallel.layers import (
+            _declare_kernel,
+            default_kernel_init,
         )
-        # per-channel scales live on the output dim → shard with it
-        sshape = _scale_shape(qcfg, kshape, channel_dim=1)
-        spart = (None, self.axis) if len(sshape) == 2 else ()
-        scale = self.param(
-            "scale",
-            nn.with_partitioning(nn.initializers.ones_init(), spart),
-            sshape,
-            jnp.float32,
+
+        # ONE declaration/dequant implementation shared with
+        # ColumnParallelLinear(quantization_config=...) — per-channel scales
+        # live on the output dim and shard with it
+        w = _declare_kernel(
+            self,
+            (self.input_size, self.output_size),
+            (None, self.axis),
+            default_kernel_init,
+            self.param_dtype,
+            self.dtype,
+            scale_partition=(None, self.axis),
         )
-        w = (kernel.astype(jnp.float32) * scale).astype(self.dtype)
         y = jax.lax.dot_general(
             x.astype(self.dtype), w, (((x.ndim - 1,), (0,)), ((), ()))
         )
@@ -215,26 +212,21 @@ class QuantizedRowParallel(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        qcfg = self.quantization_config
-        kshape = (self.input_size, self.output_size)
-        kernel = self.param(
-            "kernel",
-            nn.with_partitioning(
-                lambda key, shape, dt: jnp.zeros(shape, dt), (self.axis, None)
-            ),
-            kshape,
-            qcfg.quantized_dtype.jnp_dtype,
+        from neuronx_distributed_tpu.parallel.layers import (
+            _declare_kernel,
+            default_kernel_init,
         )
+
         # per-channel scales on the output dim are NOT sharded for row layers
-        sshape = _scale_shape(qcfg, kshape, channel_dim=1)
-        spart = (None, None) if len(sshape) == 2 else ()
-        scale = self.param(
-            "scale",
-            nn.with_partitioning(nn.initializers.ones_init(), spart),
-            sshape,
-            jnp.float32,
+        w = _declare_kernel(
+            self,
+            (self.input_size, self.output_size),
+            (self.axis, None),
+            default_kernel_init,
+            self.param_dtype,
+            self.dtype,
+            scale_partition=(None, None),
         )
-        w = (kernel.astype(jnp.float32) * scale).astype(self.dtype)
         x = x.astype(self.dtype)
         if self.input_is_parallel:
             x = constrain(x, P(*([UNC] * (x.ndim - 1)), self.axis))

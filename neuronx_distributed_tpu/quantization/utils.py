@@ -64,7 +64,16 @@ def quantize_param_tree(
     """Convert a float param pytree into a quantized one: every kernel leaf
     selected by ``select`` (default: name == "kernel" and ndim >= 2) becomes
     ``{"kernel": q, "scale": s}`` (reference ``from_float`` converters +
-    state-dict adaptor, quantization_layers.py:286)."""
+    state-dict adaptor, quantization_layers.py:286).
+
+    Kernels with ndim > 2 are STACKED 2-D kernels — ``nn.scan`` layer stacks
+    ``(L, in, out)`` or expert stacks ``(E, in, out)`` — and each leading
+    slice is quantized independently: per-channel scales come out
+    ``(L, 1, out)`` and per-tensor scales ``(L,)``, exactly the shapes a
+    scan/vmap over the quantized layer declares (each per-layer scale param
+    gains the stacked leading axis)."""
+    import dataclasses as _dc
+
     if select is None:
         def select(path, leaf):
             return path and path[-1] == "kernel" and leaf.ndim >= 2
@@ -89,7 +98,23 @@ def quantize_param_tree(
                     f"param dict at {'/'.join(keys[:-1])} already has a "
                     "'scale' entry; cannot attach the quantization scale"
                 )
-            q, s = direct_cast_quantize(leaf, cfg)
+            if leaf.ndim > 2:
+                eff = _dc.replace(cfg, channel_dim=leaf.ndim - 1, batch_dim=0)
+                if cfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC:
+                    # per-slice scalars, stored (L,) — the stacked form of a
+                    # per-layer () scale param
+                    amax = jnp.abs(leaf.astype(jnp.float32)).max(
+                        axis=tuple(range(1, leaf.ndim))
+                    )
+                    s = jnp.maximum(amax, 1e-12) / cfg.quantized_dtype.max_value
+                    q, _ = direct_cast_quantize(
+                        leaf, eff,
+                        scale=s.reshape((-1,) + (1,) * (leaf.ndim - 1)),
+                    )
+                else:
+                    q, s = direct_cast_quantize(leaf, eff)
+            else:
+                q, s = direct_cast_quantize(leaf, cfg)
             node[keys[-1]] = q
             node["scale"] = s
         else:

@@ -86,6 +86,16 @@ def test_inference_example_trace(infer_mod, tmp_path):
     assert (tmp_path / "traced" / "manifest.json").exists()
 
 
+def test_inference_example_quantized(infer_mod):
+    """Weight-only int8 serving through the example (reference: the runner's
+    quantized-checkpoint flow)."""
+    out = infer_mod.main([
+        "--model", "tiny", "--mode", "generate", "--quantize", "int8",
+        "--prompt-len", "8", "--max-new-tokens", "4",
+    ])
+    assert out["tokens"].shape == (1, 4)
+
+
 def test_inference_example_medusa(infer_mod):
     out = infer_mod.main([
         "--model", "tiny", "--mode", "medusa", "--prompt-len", "8",
