@@ -118,6 +118,13 @@ def build_model(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.quantize and args.mode not in ("generate", "benchmark"):
+        # fail BEFORE any model init — silent float serving while the user
+        # believes int8 is active would invalidate whatever they measure next
+        raise SystemExit(
+            f"--quantize is not supported in --mode {args.mode} "
+            "(generate/benchmark only)"
+        )
     if args.force_cpu_devices:
         from neuronx_distributed_tpu.utils.platform import force_cpu_devices
 
@@ -151,13 +158,6 @@ def main(argv=None):
     params = (None if args.mode == "medusa"
               else meta.unbox(jax.jit(model.init)(key, prompt)))
 
-    if args.quantize and args.mode not in ("generate", "benchmark"):
-        # silent float serving while the user believes int8 is active would
-        # invalidate whatever they measure next
-        raise SystemExit(
-            f"--quantize is not supported in --mode {args.mode} "
-            "(generate/benchmark only)"
-        )
     if args.quantize:
         # weight-only serving quantization: quantize the float checkpoint
         # tree and serve it through the quantized model (HBM holds 1-byte

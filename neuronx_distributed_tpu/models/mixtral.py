@@ -59,6 +59,9 @@ class MixtralConfig:
     sequence_parallel: bool = False
     remat: bool = True
     scan_layers: bool = True
+    # weight-only serving quantization: attention/lm_head linears AND the
+    # 3-D expert weights (per-expert per-channel scales); router stays float
+    quantization: Optional[Any] = None
 
     @property
     def head_dim_(self) -> int:
@@ -82,6 +85,7 @@ class MixtralConfig:
             sequence_parallel=self.sequence_parallel,
             remat=self.remat,
             scan_layers=self.scan_layers,
+            quantization=self.quantization,
         )
 
 
@@ -137,6 +141,7 @@ class MixtralDecoderLayer(nn.Module):
             token_shuffle=cfg.token_shuffle,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
+            quantization_config=cfg.quantization,
             name="moe",
         )(h, deterministic=self.deterministic)
         x = x + moe_out
@@ -237,7 +242,8 @@ class MixtralForCausalLM(nn.Module):
             x = constrain(x, P(UNC, None, None))
         logits = ColumnParallelLinear(
             cfg.hidden_size, cfg.vocab_size, use_bias=False,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            quantization_config=cfg.quantization, name="lm_head",
         )(x)
         return logits, aux
 

@@ -186,6 +186,48 @@ class ExpertMLPs(nn.Module):
     selective_threshold: int = 8
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
+    # weight-only serving quantization: expert weights stored int8/fp8 with
+    # per-expert per-channel scales (reference QuantizedExpertFused* layers,
+    # quantization_layers.py:867,:979 — the quantized-MoE serving case where
+    # 1-byte expert weights are the HBM win)
+    quantization_config: Optional[Any] = None
+
+    def _one_param(self, name, shape, partition, init):
+        qcfg = self.quantization_config
+        if qcfg is None:
+            return self.param(
+                name, nn.with_partitioning(init, partition), shape,
+                self.param_dtype,
+            )
+        q = self.param(
+            name,
+            nn.with_partitioning(lambda key, s, dt: jnp.zeros(s, dt), partition),
+            shape,
+            qcfg.quantized_dtype.jnp_dtype,
+        )
+        from neuronx_distributed_tpu.quantization.layers import _scale_shape
+        import dataclasses as _dc
+
+        eff = _dc.replace(qcfg, channel_dim=len(shape) - 1, batch_dim=0)
+        sshape = _scale_shape(eff, shape, channel_dim=len(shape) - 1)
+        spart = (
+            (partition[0], None, partition[2])
+            if len(sshape) == len(shape)
+            else (None,)  # per-tensor: per-expert scalars (E,)
+        )
+        if len(sshape) == 0:  # per-tensor on stacked weights → (E,)
+            sshape = (shape[0],)
+        scale = self.param(
+            name + "_scale",
+            nn.with_partitioning(nn.initializers.ones_init(), spart),
+            sshape,
+            jnp.float32,
+        )
+        if scale.ndim == 1:
+            scale = scale.reshape((-1,) + (1,) * (len(shape) - 1))
+        from neuronx_distributed_tpu.quantization.utils import dequantize
+
+        return dequantize(q, scale, self.dtype)
 
     def _params(self):
         from neuronx_distributed_tpu.modules.moe.moe_parallel_layers import (
@@ -195,26 +237,13 @@ class ExpertMLPs(nn.Module):
 
         E, H, I = self.num_experts, self.hidden_size, self.intermediate_size
         init = nn.initializers.lecun_normal(batch_axis=(0,))
-        up = self.param(
-            "up_proj",
-            nn.with_partitioning(init, COLUMN_KERNEL_PARTITION),
-            (E, H, I),
-            self.param_dtype,
-        )
+        up = self._one_param("up_proj", (E, H, I), COLUMN_KERNEL_PARTITION, init)
         gate = None
         if self.glu_mlp:
-            gate = self.param(
-                "gate_proj",
-                nn.with_partitioning(init, COLUMN_KERNEL_PARTITION),
-                (E, H, I),
-                self.param_dtype,
+            gate = self._one_param(
+                "gate_proj", (E, H, I), COLUMN_KERNEL_PARTITION, init
             )
-        down = self.param(
-            "down_proj",
-            nn.with_partitioning(init, ROW_KERNEL_PARTITION),
-            (E, I, H),
-            self.param_dtype,
-        )
+        down = self._one_param("down_proj", (E, I, H), ROW_KERNEL_PARTITION, init)
         return gate, up, down
 
     def _resolve_strategy(self, n_tokens: Optional[int] = None) -> str:

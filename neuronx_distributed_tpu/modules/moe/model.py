@@ -56,6 +56,9 @@ class MoE(nn.Module):
     token_shuffle: bool = False
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
+    # weight-only serving quantization of the EXPERT weights (the router
+    # stays float — reference keeps router math in fp32)
+    quantization_config: Optional[Any] = None
 
     @nn.compact
     def __call__(
@@ -96,6 +99,7 @@ class MoE(nn.Module):
             strategy=self.expert_strategy,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
+            quantization_config=self.quantization_config,
             name="experts",
         )(tokens, route.top_e, route.top_w)
 

@@ -45,7 +45,7 @@ Initializer = Callable[..., jax.Array]
 default_kernel_init = nn.initializers.lecun_normal()
 
 
-def _declare_kernel(module, shape, partition, kernel_init, param_dtype, dtype,
+def _declare_kernel(module, shape, partition, kernel_init, dtype,
                     scale_partition):
     """Kernel declaration shared by the parallel linears: float by default; a
     ``quantization_config`` on the module declares the weight-only serving
@@ -84,7 +84,9 @@ def _declare_kernel(module, shape, partition, kernel_init, param_dtype, dtype,
         sshape,
         jnp.float32,
     )
-    return (kernel.astype(jnp.float32) * scale).astype(dtype)
+    from neuronx_distributed_tpu.quantization.utils import dequantize
+
+    return dequantize(kernel, scale, dtype)
 
 
 class ColumnParallelLinear(nn.Module):
@@ -117,7 +119,6 @@ class ColumnParallelLinear(nn.Module):
             (self.input_size, self.output_size),
             (None, self.axis),
             self.kernel_init,
-            self.param_dtype,
             self.dtype,
             scale_partition=(None, self.axis),
         )
@@ -174,7 +175,6 @@ class RowParallelLinear(nn.Module):
             (self.input_size, self.output_size),
             (self.axis, None),
             self.kernel_init,
-            self.param_dtype,
             self.dtype,
             # per-channel scales live on the (unsharded) out dim
             scale_partition=(None, None),

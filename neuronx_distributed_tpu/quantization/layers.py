@@ -72,7 +72,6 @@ class QuantizedColumnParallel(nn.Module):
             (self.input_size, self.output_size),
             (None, self.axis),
             default_kernel_init,
-            self.param_dtype,
             self.dtype,
             scale_partition=(None, self.axis),
         )
@@ -223,7 +222,6 @@ class QuantizedRowParallel(nn.Module):
             (self.input_size, self.output_size),
             (self.axis, None),
             default_kernel_init,
-            self.param_dtype,
             self.dtype,
             scale_partition=(None, None),
         )

@@ -62,21 +62,33 @@ def quantize_param_tree(
     select: Callable[[Tuple[str, ...], jax.Array], bool] = None,
 ) -> Any:
     """Convert a float param pytree into a quantized one: every kernel leaf
-    selected by ``select`` (default: name == "kernel" and ndim >= 2) becomes
-    ``{"kernel": q, "scale": s}`` (reference ``from_float`` converters +
-    state-dict adaptor, quantization_layers.py:286).
+    selected by ``select`` becomes ``{"kernel": q, "scale": s}`` (reference
+    ``from_float`` converters + state-dict adaptor,
+    quantization_layers.py:286). The default select takes ``kernel`` leaves
+    (ndim >= 2) AND the raw stacked expert weights
+    ``gate_proj``/``up_proj``/``down_proj`` (ndim >= 3, ExpertMLPs).
 
     Kernels with ndim > 2 are STACKED 2-D kernels — ``nn.scan`` layer stacks
-    ``(L, in, out)`` or expert stacks ``(E, in, out)`` — and each leading
-    slice is quantized independently: per-channel scales come out
-    ``(L, 1, out)`` and per-tensor scales ``(L,)``, exactly the shapes a
-    scan/vmap over the quantized layer declares (each per-layer scale param
-    gains the stacked leading axis)."""
-    import dataclasses as _dc
+    ``(L, in, out)``, expert stacks ``(E, in, out)``, or both
+    ``(L, E, in, out)`` — and every leading slice is quantized
+    independently: per-channel scales reduce ONLY the contraction dim
+    (``ndim-2``), e.g. ``(L, 1, out)`` / ``(L, E, 1, out)``; per-tensor
+    scales reduce the trailing matmul dims, e.g. ``(L,)`` / ``(L, E)`` —
+    exactly the shapes a scan/vmap over the quantized layer declares (each
+    per-slice scale param gains the stacked leading axes).
 
+    Scale naming: a leaf named ``kernel`` gets a ``scale`` sibling (its own
+    module dict); any other selected leaf (the expert weights share one
+    dict) gets ``<name>_scale`` so siblings cannot collide."""
     if select is None:
+        expert_leaves = ("gate_proj", "up_proj", "down_proj")
+
         def select(path, leaf):
-            return path and path[-1] == "kernel" and leaf.ndim >= 2
+            if not path:
+                return False
+            if path[-1] == "kernel" and leaf.ndim >= 2:
+                return True
+            return path[-1] in expert_leaves and leaf.ndim >= 3
 
     from flax.core import meta
 
@@ -93,30 +105,38 @@ def quantize_param_tree(
         for k in keys[:-1]:
             node = node.setdefault(k, {})
         if select(keys, leaf):
-            if "scale" in node:
+            scale_name = "scale" if keys[-1] == "kernel" else keys[-1] + "_scale"
+            # check the ORIGINAL tree for the sibling: the flatten walk visits
+            # 'kernel' before 'scale', so checking the partially-rebuilt node
+            # would never fire and the stale scale would silently overwrite
+            # the computed one (e.g. re-quantizing an already-quantized tree)
+            orig_parent = params
+            for k in keys[:-1]:
+                orig_parent = orig_parent[k]
+            if scale_name in orig_parent:
                 raise ValueError(
                     f"param dict at {'/'.join(keys[:-1])} already has a "
-                    "'scale' entry; cannot attach the quantization scale"
+                    f"{scale_name!r} entry (already quantized?); cannot "
+                    "attach the quantization scale"
                 )
             if leaf.ndim > 2:
-                eff = _dc.replace(cfg, channel_dim=leaf.ndim - 1, batch_dim=0)
+                w = jnp.abs(leaf.astype(jnp.float32))
+                qmax = cfg.quantized_dtype.max_value
                 if cfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC:
-                    # per-slice scalars, stored (L,) — the stacked form of a
-                    # per-layer () scale param
-                    amax = jnp.abs(leaf.astype(jnp.float32)).max(
-                        axis=tuple(range(1, leaf.ndim))
-                    )
-                    s = jnp.maximum(amax, 1e-12) / cfg.quantized_dtype.max_value
-                    q, _ = direct_cast_quantize(
-                        leaf, eff,
-                        scale=s.reshape((-1,) + (1,) * (leaf.ndim - 1)),
-                    )
+                    # per-slice scalars over the leading stack axes
+                    amax = w.max(axis=(-2, -1))
+                    s = jnp.maximum(amax, 1e-12) / qmax
+                    s_b = s.reshape(s.shape + (1, 1))
                 else:
-                    q, s = direct_cast_quantize(leaf, eff)
+                    # per-channel: reduce ONLY the contraction dim
+                    amax = w.max(axis=leaf.ndim - 2, keepdims=True)
+                    s = jnp.maximum(amax, 1e-12) / qmax
+                    s_b = s
+                q, _ = direct_cast_quantize(leaf, cfg, scale=s_b)
             else:
                 q, s = direct_cast_quantize(leaf, cfg)
             node[keys[-1]] = q
-            node["scale"] = s
+            node[scale_name] = s
         else:
             node[keys[-1]] = leaf
     return rebuilt

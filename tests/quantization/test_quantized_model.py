@@ -129,6 +129,97 @@ def test_quantized_model_generates_with_cache():
     assert agree >= 0.5, agree
 
 
+def test_requantizing_a_quantized_tree_raises():
+    """Feeding an already-quantized tree back through quantize_param_tree
+    must raise — the sibling-scale guard checks the ORIGINAL tree (the
+    flatten walk visits 'kernel' before 'scale', so a rebuilt-node check
+    would silently pair the new kernel with the stale scale)."""
+    qcfg = QuantizationConfig()
+    cfg, fmodel, fparams, qmodel, qparams, ids = _setup(qcfg)
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_param_tree(qparams, qcfg)
+
+
+def test_quantized_mixtral_expert_weights(tp=1):
+    """Quantized MoE serving (reference QuantizedExpertFused*,
+    quantization_layers.py:867): MixtralConfig(quantization=...) stores the
+    3-D expert weights int8 with per-expert per-channel scales, the router
+    stays float, and logits track the float model."""
+    from neuronx_distributed_tpu.models.mixtral import (
+        MixtralForCausalLM,
+        tiny_mixtral,
+    )
+
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=tp)
+    qcfg = QuantizationConfig()
+    cfg = tiny_mixtral()
+    fmodel = MixtralForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+    fparams = meta.unbox(jax.jit(fmodel.init)(jax.random.PRNGKey(1), ids))
+    qmodel = MixtralForCausalLM(
+        dataclasses.replace(cfg, quantization=qcfg), attention_impl="xla"
+    )
+    qparams = quantize_param_tree(fparams, qcfg)
+
+    # structure == quantized model's own init
+    want = meta.unbox(jax.eval_shape(qmodel.init, jax.random.PRNGKey(1), ids))
+    want_flat = {jax.tree_util.keystr(p): v for p, v in
+                 jax.tree_util.tree_flatten_with_path(want)[0]}
+    got_flat = {jax.tree_util.keystr(p): v for p, v in
+                jax.tree_util.tree_flatten_with_path(qparams)[0]}
+    assert set(got_flat) == set(want_flat)
+    for k, v in got_flat.items():
+        assert (v.shape, v.dtype) == (want_flat[k].shape, want_flat[k].dtype), k
+
+    experts = qparams["params"]["model"]["layers_0"]["moe"]["experts"]
+    E, H, I = cfg.num_experts, cfg.hidden_size, cfg.intermediate_size
+    assert experts["gate_proj"].dtype == jnp.int8
+    assert experts["gate_proj_scale"].shape == (E, 1, I)
+    assert experts["down_proj_scale"].shape == (E, 1, H)
+    router = qparams["params"]["model"]["layers_0"]["moe"]["router"]
+    assert router["weight"].dtype != jnp.int8  # router stays float
+
+    ref, _aux = jax.jit(fmodel.apply)(fparams, ids)
+    got, _aux = jax.jit(qmodel.apply)(qparams, ids)
+    ref, got = np.asarray(ref, np.float32), np.asarray(got, np.float32)
+    # routing decisions can flip near ties under weight quantization; the
+    # bulk of positions must still track closely
+    rel = np.abs(got - ref) / np.abs(ref).max()
+    assert np.median(rel) < 0.02 and (rel < 0.1).mean() > 0.95, rel.max()
+
+
+def test_quantized_mixtral_scan_layers_structure():
+    """scan_layers=True Mixtral: expert weights stack to (L, E, in, out) and
+    scales to (L, E, 1, out) — the per-slice rule generalizes to both
+    leading axes."""
+    from neuronx_distributed_tpu.models.mixtral import (
+        MixtralForCausalLM,
+        tiny_mixtral,
+    )
+
+    mesh_lib.initialize_model_parallel()
+    qcfg = QuantizationConfig()
+    cfg = tiny_mixtral(scan_layers=True)
+    fmodel = MixtralForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+    fparams = meta.unbox(jax.jit(fmodel.init)(jax.random.PRNGKey(1), ids))
+    qmodel = MixtralForCausalLM(
+        dataclasses.replace(cfg, quantization=qcfg), attention_impl="xla"
+    )
+    qparams = quantize_param_tree(fparams, qcfg)
+    experts = qparams["params"]["model"]["layers"]["layer"]["moe"]["experts"]
+    L, E, H, I = (cfg.num_layers, cfg.num_experts, cfg.hidden_size,
+                  cfg.intermediate_size)
+    assert experts["gate_proj"].shape == (L, E, H, I)
+    assert experts["gate_proj"].dtype == jnp.int8
+    assert experts["gate_proj_scale"].shape == (L, E, 1, I)
+    got, _ = jax.jit(qmodel.apply)(qparams, ids)
+    ref, _ = jax.jit(fmodel.apply)(fparams, ids)
+    rel = np.abs(np.asarray(got, np.float32) - np.asarray(ref, np.float32))
+    rel = rel / np.abs(np.asarray(ref, np.float32)).max()
+    assert np.median(rel) < 0.02, np.median(rel)
+
+
 def test_quantized_model_sharded_matches_unsharded():
     """tp=4: the quantized kernels/scales shard like the float layers and the
     logits equal the tp=1 quantized model's."""
