@@ -303,6 +303,48 @@ def _measure(devs, tiny: bool) -> None:
         except Exception as e:
             payload["extras"]["gqa"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
         _emit(payload)
+        # flash block-size sweep: raw kernel fwd+bwd time at block 256/512/
+        # 1024 so the next round can pin the best tile without hardware in
+        # hand (each line re-emits the headline payload augmented further —
+        # a relay hang mid-sweep costs nothing already measured)
+        try:
+            payload["extras"]["flash_block_sweep"] = _flash_block_sweep(batch, seq)
+        except Exception as e:
+            payload["extras"]["flash_block_sweep"] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"
+            }
+        _emit(payload)
+
+
+def _flash_block_sweep(batch, seq):
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.kernels.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    h, d = 32, 128
+    q = jax.random.normal(ks[0], (batch, seq, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (batch, seq, h, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (batch, seq, h, d), jnp.bfloat16)
+    out = {}
+    for blk in (256, 512, 1024):
+        if seq % blk != 0:
+            out[f"block_{blk}"] = f"skipped: seq {seq} not divisible"
+            continue
+        fn = jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=blk, block_k=blk
+            ).astype(jnp.float32).sum()
+        ))
+        g = fn(q, k, v)  # compile
+        _ = float(jnp.sum(g))
+        t0 = time.perf_counter()
+        for _i in range(5):
+            g = fn(q, k, v)
+        _ = float(jnp.sum(g))
+        out[f"block_{blk}"] = round((time.perf_counter() - t0) / 5, 4)
+    return out
 
 
 def _measure_gqa(base_cfg, batch, seq, attention_impl):

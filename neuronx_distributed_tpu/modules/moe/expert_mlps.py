@@ -193,41 +193,15 @@ class ExpertMLPs(nn.Module):
     quantization_config: Optional[Any] = None
 
     def _one_param(self, name, shape, partition, init):
-        qcfg = self.quantization_config
-        if qcfg is None:
-            return self.param(
-                name, nn.with_partitioning(init, partition), shape,
-                self.param_dtype,
-            )
-        q = self.param(
-            name,
-            nn.with_partitioning(lambda key, s, dt: jnp.zeros(s, dt), partition),
-            shape,
-            qcfg.quantized_dtype.jnp_dtype,
-        )
-        from neuronx_distributed_tpu.quantization.layers import _scale_shape
-        import dataclasses as _dc
+        from neuronx_distributed_tpu.parallel.layers import _declare_kernel
 
-        eff = _dc.replace(qcfg, channel_dim=len(shape) - 1, batch_dim=0)
-        sshape = _scale_shape(eff, shape, channel_dim=len(shape) - 1)
-        spart = (
-            (partition[0], None, partition[2])
-            if len(sshape) == len(shape)
-            else (None,)  # per-tensor: per-expert scalars (E,)
+        # (E, in, out) scales per expert per out-channel: (E, 1, out); the
+        # declaration + scale-shape contract lives in ONE place
+        return _declare_kernel(
+            self, shape, partition, init, self.dtype,
+            scale_partition=(partition[0], None, partition[2]),
+            name=name, channel_dim=len(shape) - 1, batch_dim=0,
         )
-        if len(sshape) == 0:  # per-tensor on stacked weights → (E,)
-            sshape = (shape[0],)
-        scale = self.param(
-            name + "_scale",
-            nn.with_partitioning(nn.initializers.ones_init(), spart),
-            sshape,
-            jnp.float32,
-        )
-        if scale.ndim == 1:
-            scale = scale.reshape((-1,) + (1,) * (len(shape) - 1))
-        from neuronx_distributed_tpu.quantization.utils import dequantize
-
-        return dequantize(q, scale, self.dtype)
 
     def _params(self):
         from neuronx_distributed_tpu.modules.moe.moe_parallel_layers import (

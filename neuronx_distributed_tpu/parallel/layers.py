@@ -46,46 +46,64 @@ default_kernel_init = nn.initializers.lecun_normal()
 
 
 def _declare_kernel(module, shape, partition, kernel_init, dtype,
-                    scale_partition):
-    """Kernel declaration shared by the parallel linears: float by default; a
+                    scale_partition, name="kernel", channel_dim=1,
+                    batch_dim=None):
+    """Kernel declaration shared by every quantizable weight (the parallel
+    linears AND the 3-D expert stacks of ExpertMLPs): float by default; a
     ``quantization_config`` on the module declares the weight-only serving
-    form instead — a quantized-dtype kernel plus a float ``scale`` sibling
-    (the exact tree ``quantization.utils.quantize_param_tree`` produces from
-    a trained float checkpoint — reference ``from_float`` converters +
+    form instead — a quantized-dtype kernel plus a float scale sibling
+    (``scale`` for a leaf named ``kernel``, ``<name>_scale`` otherwise — the
+    exact tree ``quantization.utils.quantize_param_tree`` produces from a
+    trained float checkpoint; reference ``from_float`` converters +
     module-swap ``convert``, quantization/quantize.py:18). Forward
     dequantizes; XLA fuses the scale multiply into the matmul epilogue, so
-    HBM holds 1-byte weights while the MXU sees a dense GEMM."""
+    HBM holds 1-byte weights while the MXU sees a dense GEMM.
+
+    ``channel_dim``/``batch_dim`` pick the per-channel scale layout (stacked
+    weights use channel_dim = ndim-1, batch_dim = 0 → ``(E, 1, out)``
+    scales; per-tensor with a batch dim yields per-slice scalars ``(E,)``).
+    This is the ONE copy of the scale-shape contract on the model side."""
     qcfg = module.quantization_config
     if qcfg is None:
         kernel = module.param(
-            "kernel",
+            name,
             nn.with_partitioning(kernel_init, partition),
             shape,
             module.param_dtype,
         )
         return kernel.astype(dtype)
+    import dataclasses as _dc
+
+    from neuronx_distributed_tpu.quantization.config import QuantizationType
     from neuronx_distributed_tpu.quantization.layers import _scale_shape
+    from neuronx_distributed_tpu.quantization.utils import dequantize
 
     kernel = module.param(
-        "kernel",
+        name,
         nn.with_partitioning(
             lambda key, shp, dt: jnp.zeros(shp, dt), partition
         ),
         shape,
         qcfg.quantized_dtype.jnp_dtype,
     )
-    sshape = _scale_shape(qcfg, shape, channel_dim=1)
+    per_tensor = qcfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC
+    if per_tensor and batch_dim is not None:
+        sshape = (shape[batch_dim],)  # per-slice scalars, e.g. (E,)
+        spart = (partition[batch_dim],)
+    else:
+        eff = qcfg if qcfg.batch_dim == batch_dim else _dc.replace(
+            qcfg, batch_dim=batch_dim
+        )
+        sshape = _scale_shape(eff, shape, channel_dim)
+        spart = scale_partition if len(sshape) == len(shape) else ()
     scale = module.param(
-        "scale",
-        nn.with_partitioning(
-            nn.initializers.ones_init(),
-            scale_partition if len(sshape) == len(shape) else (),
-        ),
+        ("scale" if name == "kernel" else name + "_scale"),
+        nn.with_partitioning(nn.initializers.ones_init(), spart),
         sshape,
         jnp.float32,
     )
-    from neuronx_distributed_tpu.quantization.utils import dequantize
-
+    if scale.ndim == 1 and len(shape) > 2:  # broadcast per-slice scalars
+        scale = scale.reshape((-1,) + (1,) * (len(shape) - 1))
     return dequantize(kernel, scale, dtype)
 
 
