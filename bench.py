@@ -332,17 +332,20 @@ def _flash_block_sweep(batch, seq):
         if seq % blk != 0:
             out[f"block_{blk}"] = f"skipped: seq {seq} not divisible"
             continue
+        # grad wrt ALL inputs so neither backward kernel (dq, dk/dv) is
+        # dead-code-eliminated — the sweep must time the full fwd+bwd
         fn = jax.jit(jax.grad(
             lambda q, k, v: flash_attention(
                 q, k, v, causal=True, block_q=blk, block_k=blk
-            ).astype(jnp.float32).sum()
+            ).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
         ))
         g = fn(q, k, v)  # compile
-        _ = float(jnp.sum(g))
+        _ = float(jnp.sum(g[0]))
         t0 = time.perf_counter()
         for _i in range(5):
             g = fn(q, k, v)
-        _ = float(jnp.sum(g))
+        _ = float(jnp.sum(g[0]))
         out[f"block_{blk}"] = round((time.perf_counter() - t0) / 5, 4)
     return out
 

@@ -44,6 +44,8 @@ class DbrxConfig:
     param_dtype: Any = jnp.float32
     sequence_parallel: bool = False
     remat: bool = False
+    # weight-only serving quantization (same contract as Mixtral/Llama)
+    quantization: Optional[Any] = None
 
     @property
     def head_dim_(self) -> int:
@@ -57,7 +59,7 @@ class DbrxConfig:
             max_seq_len=self.max_seq_len, rope_theta=self.rope_theta,
             dtype=self.dtype, param_dtype=self.param_dtype,
             sequence_parallel=self.sequence_parallel, remat=self.remat,
-            scan_layers=False,
+            scan_layers=False, quantization=self.quantization,
         )
 
 
@@ -100,6 +102,7 @@ class DbrxBlock(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
+            quantization_config=cfg.quantization,
             name="moe",
         )(h, deterministic=self.deterministic)
         x = x + moe_out
@@ -134,7 +137,8 @@ class DbrxForCausalLM(nn.Module):
                       name="final_norm")(x)
         logits = ColumnParallelLinear(
             cfg.hidden_size, cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype, name="lm_head",
+            param_dtype=cfg.param_dtype,
+            quantization_config=cfg.quantization, name="lm_head",
         )(x)
         return logits, {
             "load_balancing_loss": aux_sum[0], "router_z_loss": aux_sum[1]

@@ -119,22 +119,24 @@ def quantize_param_tree(
                     f"{scale_name!r} entry (already quantized?); cannot "
                     "attach the quantization scale"
                 )
-            if leaf.ndim > 2:
-                w = jnp.abs(leaf.astype(jnp.float32))
-                qmax = cfg.quantized_dtype.max_value
-                if cfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC:
-                    # per-slice scalars over the leading stack axes
-                    amax = w.max(axis=(-2, -1))
-                    s = jnp.maximum(amax, 1e-12) / qmax
-                    s_b = s.reshape(s.shape + (1, 1))
-                else:
-                    # per-channel: reduce ONLY the contraction dim
-                    amax = w.max(axis=leaf.ndim - 2, keepdims=True)
-                    s = jnp.maximum(amax, 1e-12) / qmax
-                    s_b = s
-                q, _ = direct_cast_quantize(leaf, cfg, scale=s_b)
+            # Every selected kernel is (..., in, out); the scale rule is
+            # uniform and ignores cfg.channel_dim/batch_dim (those belong to
+            # the standalone Quantized* layer modules): per-channel reduces
+            # ONLY the contraction dim (ndim-2); per-tensor reduces the
+            # trailing matmul dims, keeping any stack axes — EXACTLY what
+            # _declare_kernel declares on the model side for each case.
+            w = jnp.abs(leaf.astype(jnp.float32))
+            qmax = cfg.quantized_dtype.max_value
+            if cfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC:
+                amax = w.max(axis=(-2, -1)) if leaf.ndim > 2 else w.max()
+                s = jnp.maximum(amax, 1e-12) / qmax
+                s_b = s.reshape(s.shape + (1, 1)) if leaf.ndim > 2 else s
             else:
-                q, s = direct_cast_quantize(leaf, cfg)
+                s = jnp.maximum(
+                    w.max(axis=leaf.ndim - 2, keepdims=True), 1e-12
+                ) / qmax
+                s_b = s
+            q, _ = direct_cast_quantize(leaf, cfg, scale=s_b)
             node[keys[-1]] = q
             node[scale_name] = s
         else:

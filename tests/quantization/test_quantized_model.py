@@ -129,6 +129,22 @@ def test_quantized_model_generates_with_cache():
     assert agree >= 0.5, agree
 
 
+def test_expert_style_config_on_dense_model_still_matches():
+    """QuantizationConfig(batch_dim=0) — the documented setting for the
+    standalone expert-fused layers — must not desync quantize_param_tree
+    from the model's 2-D scale declarations (the tree-side rule is uniform:
+    reduce only the contraction dim, whatever channel_dim/batch_dim say)."""
+    qcfg = QuantizationConfig(batch_dim=0)
+    cfg, fmodel, fparams, qmodel, qparams, ids = _setup(qcfg)
+    want = meta.unbox(jax.eval_shape(qmodel.init, jax.random.PRNGKey(1), ids))
+    got = {jax.tree_util.keystr(p): v.shape for p, v in
+           jax.tree_util.tree_flatten_with_path(qparams)[0]}
+    wantd = {jax.tree_util.keystr(p): v.shape for p, v in
+             jax.tree_util.tree_flatten_with_path(want)[0]}
+    assert got == wantd
+    jax.jit(qmodel.apply)(qparams, ids)  # applies without shape mismatch
+
+
 def test_requantizing_a_quantized_tree_raises():
     """Feeding an already-quantized tree back through quantize_param_tree
     must raise — the sibling-scale guard checks the ORIGINAL tree (the
@@ -218,6 +234,36 @@ def test_quantized_mixtral_scan_layers_structure():
     rel = np.abs(np.asarray(got, np.float32) - np.asarray(ref, np.float32))
     rel = rel / np.abs(np.asarray(ref, np.float32)).max()
     assert np.median(rel) < 0.02, np.median(rel)
+
+
+def test_quantized_dbrx_structure_and_logits():
+    """DbrxConfig(quantization=...): fused-GQA attention linears, expert
+    stacks, and lm_head quantize with the same contract as Mixtral."""
+    from neuronx_distributed_tpu.models.dbrx import DbrxForCausalLM, tiny_dbrx
+
+    mesh_lib.initialize_model_parallel()
+    qcfg = QuantizationConfig()
+    cfg = tiny_dbrx()
+    fmodel = DbrxForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+    fparams = meta.unbox(jax.jit(fmodel.init)(jax.random.PRNGKey(1), ids))
+    qmodel = DbrxForCausalLM(
+        dataclasses.replace(cfg, quantization=qcfg), attention_impl="xla"
+    )
+    qparams = quantize_param_tree(fparams, qcfg)
+    want = meta.unbox(jax.eval_shape(qmodel.init, jax.random.PRNGKey(1), ids))
+    want_flat = {jax.tree_util.keystr(p): v for p, v in
+                 jax.tree_util.tree_flatten_with_path(want)[0]}
+    got_flat = {jax.tree_util.keystr(p): v for p, v in
+                jax.tree_util.tree_flatten_with_path(qparams)[0]}
+    assert set(got_flat) == set(want_flat)
+    for k, v in got_flat.items():
+        assert (v.shape, v.dtype) == (want_flat[k].shape, want_flat[k].dtype), k
+    ref, _ = jax.jit(fmodel.apply)(fparams, ids)
+    got, _ = jax.jit(qmodel.apply)(qparams, ids)
+    rel = np.abs(np.asarray(got, np.float32) - np.asarray(ref, np.float32))
+    rel = rel / np.abs(np.asarray(ref, np.float32)).max()
+    assert np.median(rel) < 0.02 and (rel < 0.1).mean() > 0.95
 
 
 def test_quantized_model_sharded_matches_unsharded():
