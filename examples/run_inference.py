@@ -69,6 +69,9 @@ def parse_args(argv=None):
                    help="weight-only serving quantization: every linear "
                         "kernel stored int8/fp8e4m3 + per-channel scale "
                         "(generate/benchmark modes)")
+    p.add_argument("--report-file", default=None,
+                   help="benchmark mode: also write the report JSON here "
+                        "(reference BENCHMARK_REPORT_FILENAME)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--force-cpu-devices", type=int, default=None)
     return p.parse_args(argv)
@@ -224,6 +227,10 @@ def main(argv=None):
         import json as _json
 
         print(_json.dumps(report, indent=2))
+        if args.report_file:
+            with open(args.report_file, "w") as f:
+                _json.dump(report, f, indent=2)
+            print(f"benchmark report -> {args.report_file}")
         return report
 
     if args.mode == "trace":
