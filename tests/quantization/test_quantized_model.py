@@ -236,6 +236,37 @@ def test_quantized_mixtral_scan_layers_structure():
     assert np.median(rel) < 0.02, np.median(rel)
 
 
+@pytest.mark.parametrize("qdtype", [QuantizedDtype.INT8, QuantizedDtype.FP8E4M3])
+def test_quantized_tree_checkpoint_roundtrip(qdtype, tmp_path):
+    """The offline serving flow: quantize → save_checkpoint → load → serve.
+    int8 AND float8_e4m3fn leaves must survive orbax/tensorstore exactly,
+    dtypes included (serving from a resharded checkpoint is the whole
+    point of storing 1-byte weights)."""
+    from neuronx_distributed_tpu.trainer.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    qcfg = QuantizationConfig(quantized_dtype=qdtype)
+    cfg, fmodel, fparams, qmodel, qparams, ids = _setup(qcfg)
+    save_checkpoint(str(tmp_path), "q", items={"model": qparams})
+    items, _, _ = load_checkpoint(str(tmp_path), None, items_target={"model": None})
+    back = items["model"]
+    got = jax.tree_util.tree_flatten_with_path(back)[0]
+    want = jax.tree_util.tree_flatten_with_path(qparams)[0]
+    assert len(got) == len(want)
+    for (p, a), (_, b) in zip(want, got):
+        assert np.asarray(b).dtype == np.asarray(a).dtype, jax.tree_util.keystr(p)
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            err_msg=jax.tree_util.keystr(p),
+        )
+    # and the loaded tree serves (host-side first: a target-less restore
+    # places arrays on one device; real loads pass items_target shardings)
+    out = jax.jit(qmodel.apply)(jax.device_get(back), ids)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+
+
 def test_quantized_dbrx_structure_and_logits():
     """DbrxConfig(quantization=...): fused-GQA attention linears, expert
     stacks, and lm_head quantize with the same contract as Mixtral."""
