@@ -15,6 +15,10 @@ Modes:
   medusa     — Medusa tree decoding with freshly-initialized heads
                (reference examples/inference/run_llama_medusa.py), reports
                mean accepted tokens/round
+  check      — serving-path accuracy check: greedy KV-cache generation must
+               EXACTLY equal the model's full-recompute greedy golden
+               (reference check_accuracy; always greedy — sampling flags
+               are ignored)
 
 Examples (development host, virtual CPU devices):
 
@@ -45,7 +49,7 @@ def parse_args(argv=None):
     p.add_argument("--model", default="tiny", choices=["tiny", "7b", "llama3-8b"])
     p.add_argument("--mode", default="generate",
                    choices=["generate", "benchmark", "trace", "speculative",
-                            "medusa"])
+                            "medusa", "check"])
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--prompt-len", type=int, default=16)
@@ -68,7 +72,7 @@ def parse_args(argv=None):
     p.add_argument("--quantize", default=None, choices=["int8", "fp8"],
                    help="weight-only serving quantization: every linear "
                         "kernel stored int8/fp8e4m3 + per-channel scale "
-                        "(generate/benchmark modes)")
+                        "(generate/benchmark/check modes)")
     p.add_argument("--report-file", default=None,
                    help="benchmark mode: also write the report JSON here "
                         "(reference BENCHMARK_REPORT_FILENAME)")
@@ -121,12 +125,12 @@ def build_model(args):
 
 def main(argv=None):
     args = parse_args(argv)
-    if args.quantize and args.mode not in ("generate", "benchmark"):
+    if args.quantize and args.mode not in ("generate", "benchmark", "check"):
         # fail BEFORE any model init — silent float serving while the user
         # believes int8 is active would invalidate whatever they measure next
         raise SystemExit(
             f"--quantize is not supported in --mode {args.mode} "
-            "(generate/benchmark only)"
+            "(generate/benchmark/check only)"
         )
     if args.force_cpu_devices:
         from neuronx_distributed_tpu.utils.platform import force_cpu_devices
@@ -192,6 +196,29 @@ def main(argv=None):
         top_k=args.top_k,
         top_p=args.top_p,
     )
+
+    if args.mode == "check":
+        # serving-path accuracy check (reference check_accuracy,
+        # runner.py:348): greedy KV-cache generation must EXACTLY equal the
+        # model's own full-recompute greedy continuation — one teacher-forced
+        # apply over [prompt, generated] is that golden (each token must be
+        # the argmax given its prefix). Works with --quantize: the quantized
+        # serving path is checked against the quantized model's own golden.
+        import numpy as np
+
+        greedy = dataclasses.replace(gen_cfg, temperature=0.0)
+        toks = generate(model, params, prompt, key, greedy)
+        full = jnp.concatenate([prompt, toks], axis=1)
+        logits = jax.jit(model.apply)(params, full)
+        s0 = prompt.shape[1]
+        preds = jnp.argmax(logits[:, s0 - 1 : -1], -1).astype(jnp.int32)
+        match = bool(jnp.array_equal(toks, preds))
+        agreement = float((np.asarray(toks) == np.asarray(preds)).mean())
+        print(f"serving path vs full-recompute golden: "
+              f"{'EXACT MATCH' if match else f'MISMATCH (agreement {agreement:.3f})'}")
+        if not match:
+            raise SystemExit(1)
+        return {"match": match, "agreement": agreement}
 
     if args.mode == "generate":
         toks = generate(model, params, prompt, key, gen_cfg)

@@ -86,6 +86,16 @@ def test_inference_example_trace(infer_mod, tmp_path):
     assert (tmp_path / "traced" / "manifest.json").exists()
 
 
+def test_inference_example_check_mode(infer_mod):
+    """Accuracy-check mode (reference check_accuracy, runner.py:348): the
+    serving path must exactly reproduce the full-recompute greedy golden."""
+    out = infer_mod.main([
+        "--model", "tiny", "--mode", "check", "--prompt-len", "8",
+        "--max-new-tokens", "6",
+    ])
+    assert out["match"] is True and out["agreement"] == 1.0
+
+
 def test_inference_example_quantized(infer_mod):
     """Weight-only int8 serving through the example (reference: the runner's
     quantized-checkpoint flow)."""
