@@ -93,7 +93,6 @@ def benchmark_generate(
     does, so the sum of submodule times exceeding the e2e time measures the
     scan fusion win."""
     import jax
-    import jax.numpy as jnp
 
     from neuronx_distributed_tpu.inference.generate import generate
     from neuronx_distributed_tpu.inference.utils import unwrap_logits as _logits

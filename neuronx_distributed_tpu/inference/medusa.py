@@ -19,7 +19,7 @@ Each round (one jitted function, greedy):
 
 from __future__ import annotations
 
-from typing import Dict, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -31,7 +31,7 @@ contributions — there is no second copy to reconcile.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

@@ -5,7 +5,6 @@ mesh with tiny shapes)."""
 
 import importlib.util
 import os
-import sys
 
 import pytest
 

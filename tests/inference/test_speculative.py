@@ -3,7 +3,6 @@ own greedy decode, regardless of the draft (reference analogue:
 examples/inference/run_llama_speculative.py accuracy check)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

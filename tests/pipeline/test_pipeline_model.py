@@ -7,12 +7,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from flax.core import meta
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
 from neuronx_distributed_tpu.parallel import mesh as mesh_lib
 from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
-from neuronx_distributed_tpu.parallel.sharding import param_shardings
 from neuronx_distributed_tpu.pipeline.llama import (
     llama_pipeline_engine,
     llama_params_to_pipeline,
