@@ -116,9 +116,15 @@ def _child_setup_jax():
         jax.config.update("jax_platforms", forced)
 
     # Persistent compilation cache: a retried attempt (or a rerun in the same
-    # round) skips the 20-40 s first compile.
+    # round) skips the 20-40 s first compile. Namespaced per host CPU — a
+    # cache that moved hosts with the container loads foreign AOT entries
+    # that can SIGILL/abort mid-run (see utils/platform.host_cache_dir).
     try:
-        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        from neuronx_distributed_tpu.utils.platform import host_cache_dir
+
+        cache_dir = host_cache_dir(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        )
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:

@@ -27,3 +27,44 @@ def force_cpu_devices(n_devices: int) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass  # backend already initialized; caller sees whatever platform is up
+
+
+def host_cache_dir(base_dir: str) -> str:
+    """Persistent-compile-cache directory namespaced by a host-CPU
+    fingerprint.
+
+    XLA:CPU AOT cache entries embed the COMPILE machine's CPU features;
+    loading one on a host missing those features only logs a warning
+    (cpu_aot_loader.cc: "could lead to execution errors such as SIGILL")
+    before executing — observed as nondeterministic mid-run SIGABRTs when a
+    shared cache survived a host change between build rounds. Namespacing by
+    the feature set makes a moved cache cold instead of lethal."""
+    import hashlib
+
+    try:
+        fp = "noflags"
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 exposes "flags", aarch64 "Features"
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    fp = hashlib.sha256(feats.encode()).hexdigest()[:10]
+                    break
+    except Exception:
+        fp = "nocpuinfo"
+    path = os.path.join(base_dir, f"host-{fp}")
+    os.makedirs(path, exist_ok=True)
+    # prune what can never load again: legacy pre-namespacing entries at the
+    # root and namespaces of hosts this volume migrated away from
+    try:
+        for entry in os.listdir(base_dir):
+            full = os.path.join(base_dir, entry)
+            if os.path.isfile(full):
+                os.unlink(full)
+            elif entry.startswith("host-") and entry != f"host-{fp}":
+                import shutil
+
+                shutil.rmtree(full, ignore_errors=True)
+    except OSError:
+        pass
+    return path
