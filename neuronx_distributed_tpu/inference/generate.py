@@ -35,10 +35,18 @@ def generate(
     prompt_ids: jax.Array,
     key: jax.Array,
     config: GenerationConfig = GenerationConfig(),
+    attention_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate ``(B, max_new_tokens)`` token ids continuing ``prompt_ids``
     (B, S). ``model`` is a mode-capable module (e.g. ``LlamaForCausalLM``);
-    clones with ``mode="prefill"`` / ``mode="decode"`` share its params."""
+    clones with ``mode="prefill"`` / ``mode="decode"`` share its params.
+
+    ``attention_mask`` (B, S), True at valid tokens, serves variable-length
+    batches with LEFT padding (the continuous-batching layout: every row's
+    last prompt token sits at index -1, so the first sampled token reads the
+    right logits). The mask persists in the KV cache (``kv_valid``) and RoPE
+    positions restart at each row's first valid token — no per-row offset
+    bookkeeping in this loop."""
     cfg = config
     model_cfg = getattr(model, "config", None)
     max_len = getattr(model_cfg, "max_seq_len", None)
@@ -66,7 +74,12 @@ def generate(
 
     @jax.jit
     def _prefill(params, ids, key):
-        out, variables = prefill.apply(params, ids, mutable=["cache"])
+        if attention_mask is not None:
+            out, variables = prefill.apply(
+                params, ids, padding_mask=attention_mask, mutable=["cache"]
+            )
+        else:
+            out, variables = prefill.apply(params, ids, mutable=["cache"])
         tok = _sample(_logits(out)[:, -1], key)
         return tok, variables["cache"]
 

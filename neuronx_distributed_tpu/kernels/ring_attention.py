@@ -55,10 +55,12 @@ logger = get_logger(__name__)
 _NEG_INF = -1e30
 
 
-def _block_attn(qt, kt, vt, q_pos, k_pos, causal, mask=None):
+def _block_attn(qt, kt, vt, q_pos, k_pos, causal, mask=None, kv_valid=None):
     """One blockwise attention partial: qt (B, Hkv, G, Sq, D) × kt/vt
     (B, Hkv, Sk, D) → unnormalized (num, m, l) accumulator pieces.
-    ``mask`` (Sq, Sk) overrides the positional causal mask (tree attention)."""
+    ``mask`` (Sq, Sk) overrides the positional causal mask (tree attention);
+    ``kv_valid`` (B, Sk) bool additionally masks per-batch invalid keys
+    (padded-prompt serving)."""
     d = qt.shape[-1]
     scores = jnp.einsum(
         "bhgqd,bhkd->bhgqk", qt.astype(jnp.float32), kt.astype(jnp.float32)
@@ -68,6 +70,8 @@ def _block_attn(qt, kt, vt, q_pos, k_pos, causal, mask=None):
     elif causal:
         mask = q_pos[:, None] >= k_pos[None, :]
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    if kv_valid is not None:
+        scores = jnp.where(kv_valid[:, None, None, None, :], scores, _NEG_INF)
     m = scores.max(-1)  # (B, Hkv, G, Sq)
     safe_m = jnp.where(m > _NEG_INF / 2, m, 0.0)
     p = jnp.exp(scores - safe_m[..., None])

@@ -70,7 +70,7 @@ def ulysses_attention(
         interpret = jax.devices()[0].platform != "tpu"
         out = _flash_attention_bhsd(
             jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-            jnp.swapaxes(v, 1, 2), causal, bq, bk, interpret,
+            jnp.swapaxes(v, 1, 2), None, None, causal, bq, bk, interpret,
         )
         out = jnp.swapaxes(out, 1, 2)
     else:

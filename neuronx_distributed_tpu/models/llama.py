@@ -127,7 +127,13 @@ class LlamaAttention(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions=None, attn_mask=None):
+    def __call__(self, x, freqs, positions=None, attn_mask=None,
+                 segment_ids=None, padding_mask=None):
+        """``attn_mask`` (S, cache_len): Medusa tree mask (decode only).
+        ``segment_ids`` (B, S): packed-document isolation (train; rides the
+        flash kernel's segment path). ``padding_mask`` (B, S) True at valid
+        positions: padded-batch serving — persisted in the cache so decode
+        steps keep prompt padding masked."""
         cfg = self.config
         d = cfg.head_dim_
         q, k, v = GQAQKVColumnParallelLinear(
@@ -154,9 +160,14 @@ class LlamaAttention(nn.Module):
         if self.mode == "train":
             q = apply_rope(q, freqs, positions)
             k = apply_rope(k, freqs, positions)
-            out = attention_op(q, k, v, causal=True, impl=self.attention_impl)
+            out = attention_op(
+                q, k, v, causal=True, impl=self.attention_impl,
+                mask=padding_mask, segment_ids=segment_ids,
+            )
         else:
-            out = self._cached_attention(q, k, v, freqs, positions, attn_mask)
+            out = self._cached_attention(
+                q, k, v, freqs, positions, attn_mask, padding_mask
+            )
         out = out.reshape(b, s, cfg.num_heads * d)
         return RowParallelLinear(
             cfg.num_heads * d,
@@ -169,7 +180,8 @@ class LlamaAttention(nn.Module):
             name="o_proj",
         )(out)
 
-    def _cached_attention(self, q, k, v, freqs, positions, attn_mask=None):
+    def _cached_attention(self, q, k, v, freqs, positions, attn_mask=None,
+                          padding_mask=None):
         cfg = self.config
         b, s = q.shape[0], q.shape[1]
         hkv, d = cfg.num_kv_heads, cfg.head_dim_
@@ -177,17 +189,38 @@ class LlamaAttention(nn.Module):
         ck = self.variable("cache", "k", jnp.zeros, cache_shape, q.dtype)
         cv = self.variable("cache", "v", jnp.zeros, cache_shape, q.dtype)
         cidx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        # per-batch cache-slot validity: prefill records the padding mask,
+        # decode appends True — padded prompt slots stay masked for the whole
+        # generation without re-supplying the mask
+        cvalid = self.variable(
+            "cache", "kv_valid", jnp.zeros, (b, cfg.max_seq_len), jnp.bool_
+        )
         if s > cfg.max_seq_len:
             raise ValueError(
                 f"prompt length {s} exceeds max_seq_len={cfg.max_seq_len}"
             )
         if self.mode == "prefill":
+            if positions is None and padding_mask is not None:
+                from neuronx_distributed_tpu.modules.attention import (
+                    prefill_positions,
+                )
+
+                positions = prefill_positions(padding_mask)
             q = apply_rope(q, freqs, positions)
             k = apply_rope(k, freqs, positions)
             ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
             cidx.value = jnp.asarray(s, jnp.int32)
-            return attention_op(q, k, v, causal=True, impl=self.attention_impl)
+            valid = (
+                padding_mask.astype(jnp.bool_)
+                if padding_mask is not None
+                else jnp.ones((b, s), jnp.bool_)
+            )
+            cvalid.value = jax.lax.dynamic_update_slice(cvalid.value, valid, (0, 0))
+            return attention_op(
+                q, k, v, causal=True, impl=self.attention_impl,
+                mask=padding_mask,
+            )
         if self.mode != "decode":
             raise ValueError(f"unknown attention mode {self.mode!r}")
         # decode accepts s >= 1: a 1-token step, an s-token speculative verify
@@ -198,14 +231,39 @@ class LlamaAttention(nn.Module):
         cur = cidx.value  # position of the first incoming token
         if positions is not None:
             pos = positions.astype(jnp.int32)  # (s,) absolute
+            rope_pos = jnp.broadcast_to(pos[None], (b, s))
         else:
             pos = cur + jnp.arange(s, dtype=jnp.int32)
-        q = apply_rope(q, freqs, jnp.broadcast_to(pos[None], (b, s)))
-        k = apply_rope(k, freqs, jnp.broadcast_to(pos[None], (b, s)))
+            # RoPE continues each row's TRUE sequence, not its cache slot
+            # (rollback-safe: see valid_count_below)
+            from neuronx_distributed_tpu.modules.attention import (
+                valid_count_below,
+            )
+
+            nvalid = valid_count_below(cvalid.value, cur)
+            rope_pos = nvalid[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        q = apply_rope(q, freqs, rope_pos)
+        k = apply_rope(k, freqs, rope_pos)
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
         cidx.value = cur + s
-        return _decode_attention(q, ck.value, cv.value, pos, mask=attn_mask)
+        if padding_mask is not None:
+            # mask for the INCOMING step tokens (ragged batched decode:
+            # finished rows pass False so their filler tokens never become
+            # attendable keys)
+            if padding_mask.shape != (b, s):
+                raise ValueError(
+                    f"decode padding_mask must cover the incoming step "
+                    f"tokens (shape {(b, s)}), got {padding_mask.shape} — "
+                    "prompt padding is already persisted from prefill"
+                )
+            new_valid = padding_mask.astype(jnp.bool_)
+        else:
+            new_valid = jnp.ones((b, s), jnp.bool_)
+        cvalid.value = jax.lax.dynamic_update_slice(cvalid.value, new_valid, (0, cur))
+        return _decode_attention(
+            q, ck.value, cv.value, pos, mask=attn_mask, kv_valid=cvalid.value
+        )
 
     def _kv_heads_shardable(self) -> bool:
         if not mesh_lib.model_parallel_is_initialized():
@@ -239,7 +297,8 @@ class LlamaDecoderLayer(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions=None, attn_mask=None):
+    def __call__(self, x, freqs, positions=None, attn_mask=None,
+                 segment_ids=None, padding_mask=None):
         cfg = self.config
         norm = dict(
             eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -247,7 +306,7 @@ class LlamaDecoderLayer(nn.Module):
         )
         h = RMSNorm(cfg.hidden_size, name="input_norm", **norm)(x)
         x = x + LlamaAttention(cfg, self.attention_impl, self.mode, name="attn")(
-            h, freqs, positions, attn_mask
+            h, freqs, positions, attn_mask, segment_ids, padding_mask
         )
         h = RMSNorm(cfg.hidden_size, name="post_attn_norm", **norm)(x)
         x = x + LlamaMLP(cfg, name="mlp")(h)
@@ -262,10 +321,10 @@ class _ScanLayerAdapter(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions, attn_mask):
+    def __call__(self, x, freqs, positions, attn_mask, segment_ids, padding_mask):
         layer_cls = nn.remat(LlamaDecoderLayer) if self.config.remat else LlamaDecoderLayer
         x = layer_cls(self.config, self.attention_impl, self.mode, name="layer")(
-            x, freqs, positions, attn_mask
+            x, freqs, positions, attn_mask, segment_ids, padding_mask
         )
         return x, None
 
@@ -278,7 +337,8 @@ class LlamaModel(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, attn_mask=None):
+    def __call__(self, input_ids, positions=None, attn_mask=None,
+                 segment_ids=None, padding_mask=None):
         cfg = self.config
         x = ParallelEmbedding(
             num_embeddings=cfg.vocab_size,
@@ -296,15 +356,16 @@ class LlamaModel(nn.Module):
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast, nn.broadcast),
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, self.attention_impl, self.mode, name="layers")
-            x, _ = scanned(x, freqs, positions, attn_mask)
+            x, _ = scanned(x, freqs, positions, attn_mask, segment_ids, padding_mask)
         else:
             layer_cls = nn.remat(LlamaDecoderLayer) if cfg.remat else LlamaDecoderLayer
             for i in range(cfg.num_layers):
                 x = layer_cls(cfg, self.attention_impl, self.mode, name=f"layers_{i}")(
-                    x, freqs, positions, attn_mask
+                    x, freqs, positions, attn_mask, segment_ids, padding_mask
                 )
         x = RMSNorm(
             cfg.hidden_size, eps=cfg.rms_eps, dtype=cfg.dtype,
@@ -320,10 +381,11 @@ class LlamaForCausalLM(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, attn_mask=None):
+    def __call__(self, input_ids, positions=None, attn_mask=None,
+                 segment_ids=None, padding_mask=None):
         cfg = self.config
         x = LlamaModel(cfg, self.attention_impl, self.mode, name="model")(
-            input_ids, positions, attn_mask
+            input_ids, positions, attn_mask, segment_ids, padding_mask
         )
         if cfg.sequence_parallel and x.ndim >= 3:
             # leave SP for the logits: gather the sequence back

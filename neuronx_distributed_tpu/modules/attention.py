@@ -46,10 +46,14 @@ def apply_rope(x: jax.Array, freqs: jax.Array, positions: Optional[jax.Array] = 
 
 # --- attention dispatch -------------------------------------------------------
 
-def xla_attention(q, k, v, causal: bool = True, mask: Optional[jax.Array] = None):
+def xla_attention(q, k, v, causal: bool = True, mask: Optional[jax.Array] = None,
+                  segment_ids: Optional[jax.Array] = None,
+                  kv_segment_ids: Optional[jax.Array] = None):
     """Reference einsum attention (golden path; CPU meshes; masked inputs).
     q:(B,S,H,D), k/v:(B,S,Hkv,D) with Hkv | H (GQA broadcast); ``mask``
-    (B, Sk) True at VALID key positions (padding mask)."""
+    (B, Sk) True at VALID key positions (padding mask); ``segment_ids``
+    (B, Sq) int restricts attention to equal-segment pairs (packed
+    documents — the numerics golden for the flash kernel's segment path)."""
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     group = h // hkv
@@ -62,24 +66,62 @@ def xla_attention(q, k, v, causal: bool = True, mask: Optional[jax.Array] = None
         scores = jnp.where(cmask[None, None, None], scores, -1e30)
     if mask is not None:
         scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
+    if segment_ids is not None:
+        ks = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        smask = segment_ids[:, :, None] == ks[:, None, :]  # (B, Sq, Sk)
+        scores = jnp.where(smask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
     return out.reshape(b, sq, h, d).astype(q.dtype)
 
 
 def attention_op(q, k, v, causal: bool = True, impl: str = "auto",
-                 mask: Optional[jax.Array] = None):
+                 mask: Optional[jax.Array] = None,
+                 segment_ids: Optional[jax.Array] = None,
+                 kv_segment_ids: Optional[jax.Array] = None):
     """Dispatch: ring when cp > 1, Pallas flash on TPU, XLA einsum golden
-    elsewhere. A padding ``mask`` forces the XLA path (the flash/ring kernels
-    take no arbitrary mask — pad-free batches are the fast path)."""
-    if mask is not None:
-        return xla_attention(q, k, v, causal=causal, mask=mask)
-    if impl == "auto":
-        cp = (
-            mesh_lib.get_context_parallel_size()
-            if mesh_lib.model_parallel_is_initialized()
-            else 1
+    elsewhere.
+
+    ``segment_ids`` (B, S) int (packed-document isolation) and ``mask``
+    (B, Sk) bool (True at valid keys — padding) both ride the flash kernel's
+    segment path on TPU (padding becomes segment ``-1``); under cp > 1 the
+    ring kernel takes no segments yet, so masked/packed long-context inputs
+    fall back to the fp32 einsum (see PARITY.md)."""
+    if kv_segment_ids is not None and segment_ids is None:
+        raise ValueError(
+            "kv_segment_ids requires segment_ids (query-side ids) — "
+            "got only the key side, which would silently drop the mask"
         )
+    q_seg = segment_ids
+    k_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+    cp = (
+        mesh_lib.get_context_parallel_size()
+        if mesh_lib.model_parallel_is_initialized()
+        else 1
+    )
+    if mask is not None:
+        # fold the padding mask into segment ids: padding = segment -1
+        if k_seg is None and q.shape[1] == k.shape[1]:
+            q_seg = k_seg = jnp.where(mask, 0, -1)
+        elif k_seg is not None:
+            k_seg = jnp.where(mask, k_seg, -1)
+        else:  # cross-length mask with no segments: einsum path handles it
+            return xla_attention(q, k, v, causal=causal, mask=mask)
+    if q_seg is not None:
+        if cp == 1 and (
+            impl == "flash"  # explicit: interpret-mode on CPU (kernel tests)
+            or (impl == "auto" and jax.devices()[0].platform == "tpu")
+        ):
+            from neuronx_distributed_tpu.kernels.flash_attention import flash_attention
+
+            return flash_attention(
+                q, k, v, causal=causal,
+                segment_ids=q_seg, kv_segment_ids=k_seg,
+            )
+        return xla_attention(
+            q, k, v, causal=causal, segment_ids=q_seg, kv_segment_ids=k_seg
+        )
+    if impl == "auto":
         if cp > 1:
             # sequence sharded over cp → ring attention (reference long-seq
             # path: CP groups + NKI ring kernel, parallel_state.py:678,
@@ -106,11 +148,32 @@ def attention_op(q, k, v, causal: bool = True, impl: str = "auto",
     return xla_attention(q, k, v, causal=causal)
 
 
-def decode_attention(q, k_cache, v_cache, q_pos, mask=None):
+def prefill_positions(padding_mask: jax.Array) -> jax.Array:
+    """RoPE positions for a (possibly left-)padded prompt (B, S): restart at
+    each row's first VALID token, so padded slots never shift the rotary
+    phase. Padding positions clamp to 0 (they are attention-masked anyway)."""
+    return jnp.maximum(
+        jnp.cumsum(padding_mask.astype(jnp.int32), axis=1) - 1, 0
+    )
+
+
+def valid_count_below(kv_valid: jax.Array, cur: jax.Array) -> jax.Array:
+    """Per-row count of valid cache slots strictly below write index ``cur``
+    — each row's TRUE sequence length, which differs from the slot index when
+    the prompt was padded. Counting only below ``cur`` keeps speculative
+    cache rollbacks (which reset just the index leaf) from seeing stale
+    validity entries."""
+    below = jnp.arange(kv_valid.shape[1], dtype=jnp.int32)[None] < cur
+    return jnp.sum((kv_valid & below).astype(jnp.int32), axis=1)
+
+
+def decode_attention(q, k_cache, v_cache, q_pos, mask=None, kv_valid=None):
     """Attention of q (B, S, H, D) rows at positions ``q_pos`` (S,) against
     the full cache (B, L, Hkv, D), each row masked at its own position — the
     single-block special case of the ring kernel's block primitive.
-    ``mask`` (S, L) overrides the positional mask (Medusa tree attention)."""
+    ``mask`` (S, L) overrides the positional mask (Medusa tree attention);
+    ``kv_valid`` (B, L) bool masks per-batch padding slots in the cache
+    (padded-prompt serving)."""
     from neuronx_distributed_tpu.kernels.ring_attention import _block_attn
 
     b, s, h, d = q.shape
@@ -120,7 +183,9 @@ def decode_attention(q, k_cache, v_cache, q_pos, mask=None):
     vt = jnp.swapaxes(v_cache, 1, 2)
     q_pos = q_pos[None] if q_pos.ndim == 0 else q_pos
     k_pos = jnp.arange(k_cache.shape[1])
-    num, _, l = _block_attn(qt, kt, vt, q_pos, k_pos, causal=True, mask=mask)
+    num, _, l = _block_attn(
+        qt, kt, vt, q_pos, k_pos, causal=True, mask=mask, kv_valid=kv_valid
+    )
     out = num / jnp.maximum(l, 1e-20)[..., None]
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2).astype(q.dtype)
 
@@ -165,9 +230,13 @@ class ParallelSelfAttention(nn.Module):
         return q, k
 
     @nn.compact
-    def __call__(self, x, positions=None, attention_mask: Optional[jax.Array] = None):
-        """``attention_mask`` (B, S): True at valid (non-padding) positions;
-        forces the masked XLA attention path."""
+    def __call__(self, x, positions=None, attention_mask: Optional[jax.Array] = None,
+                 segment_ids: Optional[jax.Array] = None):
+        """``attention_mask`` (B, S): True at valid (non-padding) positions.
+        ``segment_ids`` (B, S): packed-document isolation (train mode). Both
+        ride the flash kernel's segment path on TPU; in KV-cache modes the
+        mask persists in the cache (``kv_valid``) so later decode steps keep
+        padded slots masked."""
         h = self.num_heads
         hkv = self.num_kv_heads or h
         d = self.hidden_size // h
@@ -191,15 +260,10 @@ class ParallelSelfAttention(nn.Module):
             q, k = self._rope(q, k, positions)
             out = attention_op(
                 q, k, v, causal=self.causal, impl=self.attention_impl,
-                mask=attention_mask,
+                mask=attention_mask, segment_ids=segment_ids,
             )
         else:
-            if attention_mask is not None:
-                raise NotImplementedError(
-                    "KV-cache modes do not support padding masks yet — "
-                    "left-strip the prompt padding before prefill"
-                )
-            out = self._cached_attention(q, k, v, positions)
+            out = self._cached_attention(q, k, v, positions, attention_mask)
         out = out.reshape(b, s, h * d)
         return RowParallelLinear(
             h * d,
@@ -211,7 +275,7 @@ class ParallelSelfAttention(nn.Module):
             name="o_proj",
         )(out)
 
-    def _cached_attention(self, q, k, v, positions):
+    def _cached_attention(self, q, k, v, positions, attention_mask=None):
         if not self.causal:
             raise ValueError("KV-cache modes require causal attention")
         b, s = q.shape[0], q.shape[1]
@@ -221,25 +285,62 @@ class ParallelSelfAttention(nn.Module):
         ck = self.variable("cache", "k", jnp.zeros, cache_shape, q.dtype)
         cv = self.variable("cache", "v", jnp.zeros, cache_shape, q.dtype)
         cidx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+        # per-batch key validity: prefill records the padding mask, decode
+        # appends True — later steps keep padded prompt slots masked without
+        # the caller re-supplying the mask (left- OR right-padded prompts)
+        cvalid = self.variable(
+            "cache", "kv_valid", jnp.zeros, (b, self.max_seq_len), jnp.bool_
+        )
         if self.mode == "prefill":
+            if positions is None and attention_mask is not None:
+                positions = prefill_positions(attention_mask)
             q, k = self._rope(q, k, positions)
             ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
             cidx.value = jnp.asarray(s, jnp.int32)
-            return attention_op(q, k, v, causal=True, impl=self.attention_impl)
+            valid = (
+                attention_mask.astype(jnp.bool_)
+                if attention_mask is not None
+                else jnp.ones((b, s), jnp.bool_)
+            )
+            cvalid.value = jax.lax.dynamic_update_slice(
+                cvalid.value, valid, (0, 0)
+            )
+            return attention_op(
+                q, k, v, causal=True, impl=self.attention_impl,
+                mask=attention_mask,
+            )
         if self.mode != "decode":
             raise ValueError(f"unknown attention mode {self.mode!r}")
         cur = cidx.value
         if positions is not None:
             # caller-supplied absolute positions (e.g. tree-step decoding)
             pos = jnp.reshape(positions, (-1,)).astype(jnp.int32)
+            rope_pos = jnp.broadcast_to(pos[None], (b, s))
         else:
             pos = cur + jnp.arange(s, dtype=jnp.int32)
-        q, k = self._rope(q, k, jnp.broadcast_to(pos[None], (b, s)))
+            # RoPE continues each row's TRUE sequence, not its cache slot
+            nvalid = valid_count_below(cvalid.value, cur)
+            rope_pos = nvalid[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        q, k = self._rope(q, k, rope_pos)
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
         cidx.value = cur + s
-        return decode_attention(q, ck.value, cv.value, pos)
+        if attention_mask is not None:
+            # mask for the INCOMING step tokens (ragged batched decode:
+            # finished rows pass False so their filler tokens never become
+            # attendable keys)
+            if attention_mask.shape != (b, s):
+                raise ValueError(
+                    f"decode attention_mask must cover the incoming step "
+                    f"tokens (shape {(b, s)}), got {attention_mask.shape} — "
+                    "prompt padding is already persisted from prefill"
+                )
+            new_valid = attention_mask.astype(jnp.bool_)
+        else:
+            new_valid = jnp.ones((b, s), jnp.bool_)
+        cvalid.value = jax.lax.dynamic_update_slice(cvalid.value, new_valid, (0, cur))
+        return decode_attention(q, ck.value, cv.value, pos, kv_valid=cvalid.value)
 
 
 class ParallelMLP(nn.Module):

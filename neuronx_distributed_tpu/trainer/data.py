@@ -41,18 +41,29 @@ import numpy as np
 
 
 def pack_documents(
-    docs, seq_len: int, eos_token_id: Optional[int] = None
-) -> np.ndarray:
+    docs, seq_len: int, eos_token_id: Optional[int] = None,
+    return_segments: bool = False,
+):
     """Concatenate ``docs`` (list of 1-D int arrays), optionally separated by
     ``eos_token_id``, and chop into ``(N, seq_len + 1)`` windows (the
     reference's chunk(); the tail remainder shorter than a window is
-    dropped)."""
-    parts = []
-    for d in docs:
-        parts.append(np.asarray(d, np.int32).reshape(-1))
+    dropped).
+
+    With ``return_segments`` also returns a parallel ``(N, seq_len + 1)``
+    int32 array of per-token document ids (the EOS separator belongs to the
+    document it ends). Fed to the model as ``segment_ids``, these make packed
+    training attend WITHIN documents only — the flash kernel's equal-segment
+    block mask — instead of leaking across every document boundary."""
+    parts, seg_parts = [], []
+    for i, d in enumerate(docs):
+        arr = np.asarray(d, np.int32).reshape(-1)
+        n_tok = len(arr) + (1 if eos_token_id is not None else 0)
+        parts.append(arr)
         if eos_token_id is not None:
             parts.append(np.asarray([eos_token_id], np.int32))
+        seg_parts.append(np.full((n_tok,), i, np.int32))
     stream = np.concatenate(parts) if parts else np.zeros((0,), np.int32)
+    segs = np.concatenate(seg_parts) if seg_parts else np.zeros((0,), np.int32)
     w = seq_len + 1
     n = len(stream) // w
     if n == 0:
@@ -60,7 +71,10 @@ def pack_documents(
             f"corpus has {len(stream)} tokens — not enough for one "
             f"{w}-token window"
         )
-    return stream[: n * w].reshape(n, w)
+    windows = stream[: n * w].reshape(n, w)
+    if not return_segments:
+        return windows
+    return windows, segs[: n * w].reshape(n, w)
 
 
 class PackedCorpus:
@@ -79,12 +93,17 @@ class PackedCorpus:
         seed: int = 0,
         shuffle: bool = True,
         eos_token_id: Optional[int] = None,
+        emit_segments: bool = True,
     ) -> None:
         self.seq_len = int(seq_len)
         self.batch_size = int(batch_size)
         self.seed = int(seed)
         self.shuffle = shuffle
         w = self.seq_len + 1
+        # per-window document ids, emitted as segment_ids + loss_mask when
+        # document boundaries are known (npz offsets) — without them packed
+        # windows attend across documents and train on boundary labels
+        self.segments = None
 
         if path.endswith(".npz"):
             archive = np.load(path)
@@ -93,7 +112,12 @@ class PackedCorpus:
                 if "offsets" in archive.files:
                     off = archive["offsets"]
                     docs = [tokens[off[i] : off[i + 1]] for i in range(len(off) - 1)]
-                    self.windows = pack_documents(docs, seq_len, eos_token_id)
+                    if emit_segments:
+                        self.windows, self.segments = pack_documents(
+                            docs, seq_len, eos_token_id, return_segments=True
+                        )
+                    else:
+                        self.windows = pack_documents(docs, seq_len, eos_token_id)
                 else:
                     self.windows = pack_documents([tokens], seq_len, None)
             else:
@@ -144,5 +168,15 @@ class PackedCorpus:
                 sort = np.argsort(idx)
                 rows = np.asarray(self.windows[idx[sort]], np.int32)
                 rows = rows[np.argsort(sort)]
-                yield {"input_ids": rows[:, :-1], "labels": rows[:, 1:]}
+                batch = {"input_ids": rows[:, :-1], "labels": rows[:, 1:]}
+                if self.segments is not None:
+                    seg = np.asarray(self.segments[idx[sort]], np.int32)
+                    seg = seg[np.argsort(sort)]
+                    batch["segment_ids"] = seg[:, :-1]
+                    # a label drawn from the NEXT document (the token after a
+                    # boundary) is noise — mask it from the loss
+                    batch["loss_mask"] = (
+                        seg[:, :-1] == seg[:, 1:]
+                    ).astype(np.float32)
+                yield batch
             epoch += 1

