@@ -57,6 +57,22 @@ def generate(
             f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
             f"({cfg.max_new_tokens}) exceeds the model's max_seq_len ({max_len})"
         )
+    if attention_mask is not None:
+        import numpy as np
+
+        if attention_mask.shape != prompt_ids.shape:
+            raise ValueError(
+                f"attention_mask shape {attention_mask.shape} != prompt_ids "
+                f"shape {prompt_ids.shape}"
+            )
+        if not bool(np.asarray(attention_mask)[:, -1].all()):
+            # right padding would make _logits[:, -1] a pad-slot query and
+            # silently corrupt the whole continuation
+            raise ValueError(
+                "attention_mask has invalid tokens in the LAST column — "
+                "generate() requires LEFT padding (every row's final prompt "
+                "token at index -1)"
+            )
     prefill = model.clone(mode="prefill")
     decode = model.clone(mode="decode")
     b = prompt_ids.shape[0]

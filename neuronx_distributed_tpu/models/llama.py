@@ -182,41 +182,25 @@ class LlamaAttention(nn.Module):
 
     def _cached_attention(self, q, k, v, freqs, positions, attn_mask=None,
                           padding_mask=None):
+        from neuronx_distributed_tpu.modules.attention import (
+            KVCache,
+            prefill_positions,
+        )
+
         cfg = self.config
         b, s = q.shape[0], q.shape[1]
-        hkv, d = cfg.num_kv_heads, cfg.head_dim_
-        cache_shape = (b, cfg.max_seq_len, hkv, d)
-        ck = self.variable("cache", "k", jnp.zeros, cache_shape, q.dtype)
-        cv = self.variable("cache", "v", jnp.zeros, cache_shape, q.dtype)
-        cidx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
-        # per-batch cache-slot validity: prefill records the padding mask,
-        # decode appends True — padded prompt slots stay masked for the whole
-        # generation without re-supplying the mask
-        cvalid = self.variable(
-            "cache", "kv_valid", jnp.zeros, (b, cfg.max_seq_len), jnp.bool_
-        )
+        cache = KVCache(self, b, cfg.max_seq_len, cfg.num_kv_heads,
+                        cfg.head_dim_, q.dtype)
         if s > cfg.max_seq_len:
             raise ValueError(
                 f"prompt length {s} exceeds max_seq_len={cfg.max_seq_len}"
             )
         if self.mode == "prefill":
             if positions is None and padding_mask is not None:
-                from neuronx_distributed_tpu.modules.attention import (
-                    prefill_positions,
-                )
-
                 positions = prefill_positions(padding_mask)
             q = apply_rope(q, freqs, positions)
             k = apply_rope(k, freqs, positions)
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
-            cidx.value = jnp.asarray(s, jnp.int32)
-            valid = (
-                padding_mask.astype(jnp.bool_)
-                if padding_mask is not None
-                else jnp.ones((b, s), jnp.bool_)
-            )
-            cvalid.value = jax.lax.dynamic_update_slice(cvalid.value, valid, (0, 0))
+            cache.prefill_write(k, v, padding_mask)
             return attention_op(
                 q, k, v, causal=True, impl=self.attention_impl,
                 mask=padding_mask,
@@ -228,41 +212,13 @@ class LlamaAttention(nn.Module):
         # TREE step — explicit per-node ``positions`` (depth offsets) plus an
         # ``attn_mask`` (S, cache_len) replacing the positional mask so each
         # node attends the prefix + its ancestors only
-        cur = cidx.value  # position of the first incoming token
-        if positions is not None:
-            pos = positions.astype(jnp.int32)  # (s,) absolute
-            rope_pos = jnp.broadcast_to(pos[None], (b, s))
-        else:
-            pos = cur + jnp.arange(s, dtype=jnp.int32)
-            # RoPE continues each row's TRUE sequence, not its cache slot
-            # (rollback-safe: see valid_count_below)
-            from neuronx_distributed_tpu.modules.attention import (
-                valid_count_below,
-            )
-
-            nvalid = valid_count_below(cvalid.value, cur)
-            rope_pos = nvalid[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        pos, rope_pos = cache.decode_positions(s, positions)
         q = apply_rope(q, freqs, rope_pos)
         k = apply_rope(k, freqs, rope_pos)
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
-        cidx.value = cur + s
-        if padding_mask is not None:
-            # mask for the INCOMING step tokens (ragged batched decode:
-            # finished rows pass False so their filler tokens never become
-            # attendable keys)
-            if padding_mask.shape != (b, s):
-                raise ValueError(
-                    f"decode padding_mask must cover the incoming step "
-                    f"tokens (shape {(b, s)}), got {padding_mask.shape} — "
-                    "prompt padding is already persisted from prefill"
-                )
-            new_valid = padding_mask.astype(jnp.bool_)
-        else:
-            new_valid = jnp.ones((b, s), jnp.bool_)
-        cvalid.value = jax.lax.dynamic_update_slice(cvalid.value, new_valid, (0, cur))
+        cache.decode_write(k, v, padding_mask)
         return _decode_attention(
-            q, ck.value, cv.value, pos, mask=attn_mask, kv_valid=cvalid.value
+            q, cache.k.value, cache.v.value, pos, mask=attn_mask,
+            kv_valid=cache.valid.value,
         )
 
     def _kv_heads_shardable(self) -> bool:

@@ -167,6 +167,84 @@ def valid_count_below(kv_valid: jax.Array, cur: jax.Array) -> jax.Array:
     return jnp.sum((kv_valid & below).astype(jnp.int32), axis=1)
 
 
+class KVCache:
+    """The flax ``cache`` collection variables + validity bookkeeping shared
+    by every cached-attention implementation (LlamaAttention and
+    ParallelSelfAttention hold the rope/mask specifics; the cache writes,
+    padding persistence, and rollback-safe position accounting live here
+    exactly once).
+
+    Variables: ``k``/``v`` (B, L, Hkv, D), ``index`` () int32 write cursor,
+    ``kv_valid`` (B, L) bool — prefill records the padding mask, decode
+    appends per-step validity, so padded prompt slots stay masked for the
+    whole generation without the caller re-supplying the mask."""
+
+    def __init__(self, module, b, max_seq_len, hkv, d, dtype):
+        self.max_seq_len = max_seq_len
+        self.b = b
+        self.k = module.variable(
+            "cache", "k", jnp.zeros, (b, max_seq_len, hkv, d), dtype
+        )
+        self.v = module.variable(
+            "cache", "v", jnp.zeros, (b, max_seq_len, hkv, d), dtype
+        )
+        self.index = module.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+        )
+        self.valid = module.variable(
+            "cache", "kv_valid", jnp.zeros, (b, max_seq_len), jnp.bool_
+        )
+
+    def prefill_write(self, k, v, padding_mask=None):
+        """Write the prompt K/V at slot 0 and record its validity."""
+        b, s = k.shape[0], k.shape[1]
+        self.k.value = jax.lax.dynamic_update_slice(self.k.value, k, (0, 0, 0, 0))
+        self.v.value = jax.lax.dynamic_update_slice(self.v.value, v, (0, 0, 0, 0))
+        self.index.value = jnp.asarray(s, jnp.int32)
+        valid = (
+            padding_mask.astype(jnp.bool_)
+            if padding_mask is not None
+            else jnp.ones((b, s), jnp.bool_)
+        )
+        self.valid.value = jax.lax.dynamic_update_slice(self.valid.value, valid, (0, 0))
+
+    def decode_positions(self, s, positions):
+        """(slot positions (s,), rope positions (B, s)) for a decode step.
+        With explicit ``positions`` (tree/speculative decoding) both follow
+        the caller; otherwise slots continue at the write cursor while RoPE
+        continues each row's TRUE sequence (rollback-safe, see
+        ``valid_count_below``)."""
+        cur = self.index.value
+        if positions is not None:
+            pos = jnp.reshape(positions, (-1,)).astype(jnp.int32)
+            return pos, jnp.broadcast_to(pos[None], (self.b, s))
+        pos = cur + jnp.arange(s, dtype=jnp.int32)
+        nvalid = valid_count_below(self.valid.value, cur)
+        rope_pos = nvalid[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        return pos, rope_pos
+
+    def decode_write(self, k, v, padding_mask=None):
+        """Append a decode step's K/V at the cursor; ``padding_mask`` (B, s)
+        marks the INCOMING tokens' validity (ragged batched decode: finished
+        rows pass False so their filler tokens never become attendable)."""
+        b, s = k.shape[0], k.shape[1]
+        cur = self.index.value
+        self.k.value = jax.lax.dynamic_update_slice(self.k.value, k, (0, cur, 0, 0))
+        self.v.value = jax.lax.dynamic_update_slice(self.v.value, v, (0, cur, 0, 0))
+        self.index.value = cur + s
+        if padding_mask is not None:
+            if padding_mask.shape != (b, s):
+                raise ValueError(
+                    f"decode padding_mask must cover the incoming step "
+                    f"tokens (shape {(b, s)}), got {padding_mask.shape} — "
+                    "prompt padding is already persisted from prefill"
+                )
+            new_valid = padding_mask.astype(jnp.bool_)
+        else:
+            new_valid = jnp.ones((b, s), jnp.bool_)
+        self.valid.value = jax.lax.dynamic_update_slice(self.valid.value, new_valid, (0, cur))
+
+
 def decode_attention(q, k_cache, v_cache, q_pos, mask=None, kv_valid=None):
     """Attention of q (B, S, H, D) rows at positions ``q_pos`` (S,) against
     the full cache (B, L, Hkv, D), each row masked at its own position — the
@@ -281,66 +359,24 @@ class ParallelSelfAttention(nn.Module):
         b, s = q.shape[0], q.shape[1]
         hkv = self.num_kv_heads or self.num_heads
         d = self.hidden_size // self.num_heads
-        cache_shape = (b, self.max_seq_len, hkv, d)
-        ck = self.variable("cache", "k", jnp.zeros, cache_shape, q.dtype)
-        cv = self.variable("cache", "v", jnp.zeros, cache_shape, q.dtype)
-        cidx = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
-        # per-batch key validity: prefill records the padding mask, decode
-        # appends True — later steps keep padded prompt slots masked without
-        # the caller re-supplying the mask (left- OR right-padded prompts)
-        cvalid = self.variable(
-            "cache", "kv_valid", jnp.zeros, (b, self.max_seq_len), jnp.bool_
-        )
+        cache = KVCache(self, b, self.max_seq_len, hkv, d, q.dtype)
         if self.mode == "prefill":
             if positions is None and attention_mask is not None:
                 positions = prefill_positions(attention_mask)
             q, k = self._rope(q, k, positions)
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
-            cidx.value = jnp.asarray(s, jnp.int32)
-            valid = (
-                attention_mask.astype(jnp.bool_)
-                if attention_mask is not None
-                else jnp.ones((b, s), jnp.bool_)
-            )
-            cvalid.value = jax.lax.dynamic_update_slice(
-                cvalid.value, valid, (0, 0)
-            )
+            cache.prefill_write(k, v, attention_mask)
             return attention_op(
                 q, k, v, causal=True, impl=self.attention_impl,
                 mask=attention_mask,
             )
         if self.mode != "decode":
             raise ValueError(f"unknown attention mode {self.mode!r}")
-        cur = cidx.value
-        if positions is not None:
-            # caller-supplied absolute positions (e.g. tree-step decoding)
-            pos = jnp.reshape(positions, (-1,)).astype(jnp.int32)
-            rope_pos = jnp.broadcast_to(pos[None], (b, s))
-        else:
-            pos = cur + jnp.arange(s, dtype=jnp.int32)
-            # RoPE continues each row's TRUE sequence, not its cache slot
-            nvalid = valid_count_below(cvalid.value, cur)
-            rope_pos = nvalid[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        pos, rope_pos = cache.decode_positions(s, positions)
         q, k = self._rope(q, k, rope_pos)
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
-        cidx.value = cur + s
-        if attention_mask is not None:
-            # mask for the INCOMING step tokens (ragged batched decode:
-            # finished rows pass False so their filler tokens never become
-            # attendable keys)
-            if attention_mask.shape != (b, s):
-                raise ValueError(
-                    f"decode attention_mask must cover the incoming step "
-                    f"tokens (shape {(b, s)}), got {attention_mask.shape} — "
-                    "prompt padding is already persisted from prefill"
-                )
-            new_valid = attention_mask.astype(jnp.bool_)
-        else:
-            new_valid = jnp.ones((b, s), jnp.bool_)
-        cvalid.value = jax.lax.dynamic_update_slice(cvalid.value, new_valid, (0, cur))
-        return decode_attention(q, ck.value, cv.value, pos, kv_valid=cvalid.value)
+        cache.decode_write(k, v, attention_mask)
+        return decode_attention(
+            q, cache.k.value, cache.v.value, pos, kv_valid=cache.valid.value
+        )
 
 
 class ParallelMLP(nn.Module):

@@ -394,6 +394,12 @@ class ParallelEmbedding(nn.Module):
             return jnp.take(table, ids, axis=0)
         mesh = mesh_lib.get_mesh()
         ctx_mesh = jax.sharding.get_abstract_mesh()
+        # gather the feature dim BEFORE entering the partial-manual region:
+        # under ZeRO-1 the table arrives with H sharded over (edp, ep, cp),
+        # and inside the region that sharding collides with the (B, S)-
+        # sharded mask of the where() — the SPMD partitioner resolved it by
+        # involuntary full rematerialization (MULTICHIP_r04.json CP phase)
+        table = constrain(table, P(self.axis, None))
         return _vocab_parallel_lookup(
             mesh if ctx_mesh.empty else ctx_mesh, self.axis
         )(table, ids)

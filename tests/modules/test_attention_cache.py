@@ -1,0 +1,71 @@
+"""ParallelSelfAttention KV-cache path (the non-Llama families' attention):
+padded prefill + decode must equal the per-row pad-free run — covers the
+KVCache helper through the second of its two call sites."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.modules.attention import ParallelSelfAttention
+
+B, S, H, D = 1, 8, 4, 8
+HID = H * D
+
+
+def _mod(mode):
+    return ParallelSelfAttention(
+        hidden_size=HID, num_heads=H, causal=True, rotary_pct=1.0,
+        max_seq_len=32, use_bias=False, attention_impl="xla", mode=mode,
+    )
+
+
+def _run(x, mask, steps=3):
+    """Prefill on x (B, S, HID) then `steps` decode steps with fixed inputs;
+    returns the stacked decode outputs."""
+    prefill, decode = _mod("prefill"), _mod("decode")
+    params = prefill.init(jax.random.PRNGKey(0), x)
+    out, vars = prefill.apply(
+        params, x, attention_mask=mask, mutable=["cache"]
+    )
+    cache = vars["cache"]
+    outs = []
+    step_x = jnp.full((x.shape[0], 1, HID), 0.37, x.dtype)
+    for _ in range(steps):
+        o, vars = decode.apply(
+            {**params, "cache": cache}, step_x, mutable=["cache"]
+        )
+        cache = vars["cache"]
+        outs.append(o)
+    return jnp.concatenate(outs, axis=1), out
+
+
+def test_left_padded_cache_matches_pad_free():
+    key = jax.random.PRNGKey(1)
+    x_short = jax.random.normal(key, (B, S - 3, HID), jnp.float32)
+    ref_dec, _ = _run(x_short, None)
+
+    pad = jnp.zeros((B, 3, HID), jnp.float32)
+    x_pad = jnp.concatenate([pad, x_short], axis=1)
+    mask = jnp.asarray(
+        np.concatenate([np.zeros((B, 3), bool), np.ones((B, S - 3), bool)], 1)
+    )
+    dec, _ = _run(x_pad, mask)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(ref_dec), atol=1e-5)
+
+
+def test_decode_mask_shape_guard():
+    prefill, decode = _mod("prefill"), _mod("decode")
+    x = jax.random.normal(jax.random.PRNGKey(2), (B, S, HID), jnp.float32)
+    params = prefill.init(jax.random.PRNGKey(0), x)
+    _, vars = prefill.apply(params, x, mutable=["cache"])
+    step_x = jnp.zeros((B, 1, HID), jnp.float32)
+    bad_mask = jnp.ones((B, S), bool)  # full-prompt mask, not the step's
+    try:
+        decode.apply(
+            {**params, "cache": vars["cache"]}, step_x,
+            attention_mask=bad_mask, mutable=["cache"],
+        )
+    except ValueError as e:
+        assert "incoming step" in str(e)
+    else:
+        raise AssertionError("decode accepted a wrong-shaped mask")
