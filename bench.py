@@ -320,6 +320,135 @@ def _measure(devs, tiny: bool) -> None:
                 "error": f"{type(e).__name__}: {str(e)[:200]}"
             }
         _emit(payload)
+        # flash-decode vs einsum at 8k context (VERDICT r4 next #5)
+        try:
+            payload["extras"]["flash_decode_8k"] = _measure_flash_decode(devs)
+        except Exception as e:
+            payload["extras"]["flash_decode_8k"] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"
+            }
+        _emit(payload)
+        # quantized serving: dequant vs native int8 MXU (VERDICT r4 next #6)
+        try:
+            payload["extras"]["int8_serving"] = _measure_int8_serving(devs)
+        except Exception as e:
+            payload["extras"]["int8_serving"] = {
+                "error": f"{type(e).__name__}: {str(e)[:200]}"
+            }
+        _emit(payload)
+
+
+def _measure_flash_decode(devs):
+    """Decode attention at 8k context: einsum path vs the Pallas flash-decode
+    kernel (kernels/flash_decode.py), p50 over 20 steps. Llama-3-8B head
+    geometry (32 q / 8 kv heads, d=128)."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.kernels.flash_decode import (
+        flash_decode_attention,
+    )
+    from neuronx_distributed_tpu.modules.attention import decode_attention
+
+    b, L, h, hkv, d = 1, 8192, 32, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, 1, h, d), jnp.bfloat16)
+    kc = jax.random.normal(ks[1], (b, L, hkv, d), jnp.bfloat16)
+    vc = jax.random.normal(ks[2], (b, L, hkv, d), jnp.bfloat16)
+    pos = jnp.asarray([L - 1], jnp.int32)
+
+    # einsum golden path (what decode_attention does below the threshold)
+    from neuronx_distributed_tpu.kernels.ring_attention import _block_attn
+
+    def einsum_decode(q, kc, vc):
+        qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, h // hkv, 1, d)
+        num, _, l = _block_attn(
+            qt, jnp.swapaxes(kc, 1, 2), jnp.swapaxes(vc, 1, 2),
+            pos, jnp.arange(L), causal=True,
+        )
+        return num / jnp.maximum(l, 1e-20)[..., None]
+
+    out = {}
+    for name, fn in (
+        ("einsum", jax.jit(einsum_decode)),
+        ("flash", jax.jit(lambda q, kc, vc: flash_decode_attention(q, kc, vc, pos))),
+    ):
+        r = fn(q, kc, vc)  # compile
+        _ = float(jnp.sum(r.astype(jnp.float32)))
+        times = []
+        for _i in range(20):
+            t0 = time.perf_counter()
+            r = fn(q, kc, vc)
+            _ = float(jnp.sum(r.astype(jnp.float32)))
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        out[name + "_p50_ms"] = round(times[len(times) // 2] * 1e3, 3)
+    out["speedup"] = round(
+        out["einsum_p50_ms"] / max(out["flash_p50_ms"], 1e-9), 3
+    )
+    out["shape"] = f"b={b} L={L} h={h} hkv={hkv} d={d} s=1"
+    return out
+
+
+def _measure_int8_serving(devs):
+    """Quantized-serving decode step time: dequant-then-matmul vs the native
+    int8 MXU path (VERDICT r4 next #6 'Done = serving step-time comparison
+    recorded'). 1-layer full-width Llama, greedy decode steps."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.quantization.config import QuantizationConfig
+    from neuronx_distributed_tpu.quantization.utils import quantize_param_tree
+    from flax.core import meta
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_layers=1, num_heads=32, num_kv_heads=32, max_seq_len=2048,
+        dtype=jnp.bfloat16, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    fmodel = LlamaForCausalLM(cfg, attention_impl="flash")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 1024), 0, cfg.vocab_size)
+    fparams = meta.unbox(jax.jit(fmodel.init)(jax.random.PRNGKey(1), ids))
+    qcfg = QuantizationConfig()
+    qparams = quantize_param_tree(fparams, qcfg)
+    out = {}
+    for name, q in (
+        ("dequant", qcfg),
+        ("int8_mxu", dataclasses.replace(qcfg, use_int8_matmul=True)),
+    ):
+        model = LlamaForCausalLM(
+            dataclasses.replace(cfg, quantization=q), attention_impl="flash"
+        )
+        prefill = model.clone(mode="prefill")
+        decode = model.clone(mode="decode")
+
+        @jax.jit
+        def step(params, cache, tok):
+            o, v = decode.apply(
+                {**params, "cache": cache}, tok, mutable=["cache"]
+            )
+            return o[:, -1].argmax(-1).astype(jnp.int32)[:, None], v["cache"]
+
+        _, v = jax.jit(lambda p, i: prefill.apply(p, i, mutable=["cache"]))(
+            qparams, ids
+        )
+        cache = v["cache"]
+        tok = jnp.zeros((1, 1), jnp.int32)
+        tok, cache = step(qparams, cache, tok)  # compile
+        _ = int(tok[0, 0])
+        t0 = time.perf_counter()
+        for _i in range(30):
+            tok, cache = step(qparams, cache, tok)
+        _ = int(tok[0, 0])
+        out[name + "_decode_ms"] = round((time.perf_counter() - t0) / 30 * 1e3, 3)
+    out["int8_speedup"] = round(
+        out["dequant_decode_ms"] / max(out["int8_mxu_decode_ms"], 1e-9), 3
+    )
+    return out
 
 
 def _flash_block_sweep(batch, seq):
@@ -396,6 +525,118 @@ def _measure_gqa(base_cfg, batch, seq, attention_impl):
     }
 
 
+def child_sweep() -> None:
+    """Remat-policy × batch MFU sweep on the real chip (VERDICT r4 next #1b):
+    the r2 record (MFU 0.492) ran full per-layer remat; this measures the
+    curve across (no remat, dots-saveable remat, full remat) × batch so the
+    committed artifact carries the knee. Emits one JSON line per completed
+    row (the parent salvages the last line on timeout)."""
+    jax = _child_setup_jax()
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+    from neuronx_distributed_tpu.trainer import (
+        OptimizerConfig,
+        build_train_step,
+        create_train_state,
+        make_optimizer,
+        shard_batch,
+    )
+
+    devs = jax.devices()
+    on_tpu = devs[0].platform == "tpu"
+    mesh_lib.destroy_model_parallel()
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=1)
+    seq = 2048 if on_tpu else 128
+    if on_tpu:
+        base = LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_layers=2, num_heads=32, num_kv_heads=32,
+            max_seq_len=seq, dtype=jnp.bfloat16, param_dtype=jnp.float32,
+            remat=False, scan_layers=False,
+        )
+    else:  # smoke geometry: the sweep is a TPU measurement
+        base = LlamaConfig(
+            vocab_size=512, hidden_size=256, intermediate_size=704,
+            num_layers=1, num_heads=8, num_kv_heads=8,
+            max_seq_len=seq, dtype=jnp.float32, param_dtype=jnp.float32,
+            remat=False, scan_layers=False,
+        )
+    rows = [
+        {"remat": False, "policy": None, "batch": 4},
+        {"remat": False, "policy": None, "batch": 8},
+        {"remat": True, "policy": "dots", "batch": 4},
+        {"remat": True, "policy": "dots", "batch": 8},
+        {"remat": True, "policy": None, "batch": 8},
+    ]
+    peak = peak_flops_per_chip(devs[0])
+    results = []
+    payload = {"metric": "mfu_sweep", "seq": seq, "layers": base.num_layers,
+               "device_kind": getattr(devs[0], "device_kind", "?"),
+               "rows": results}
+    for row in rows:
+        try:
+            cfg = dataclasses.replace(
+                base, remat=row["remat"], remat_policy=row["policy"]
+            )
+            model = LlamaForCausalLM(
+                cfg, attention_impl="flash" if on_tpu else "xla"
+            )
+            optimizer = make_optimizer(OptimizerConfig(zero1=False))
+            key = jax.random.PRNGKey(0)
+            batch = row["batch"] if on_tpu else 1
+            ids = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
+            state, p_sh, s_sh = create_train_state(
+                model, optimizer, key, ids, zero1=False
+            )
+            step = build_train_step(model, optimizer, p_sh, s_sh)
+            data = shard_batch(
+                {"input_ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+            )
+            n_params = sum(p.size for p in jax.tree.leaves(state.params))
+            for _ in range(2):
+                state, metrics = step(state, data)
+            _ = float(metrics["loss"])
+            # two-point slope: cancels the fixed host-readback RTT (the relay
+            # needs a float() readback as the only reliable sync — memory:
+            # block_until_ready does not wait on axon)
+            n1, n2 = (2, 8) if on_tpu else (1, 3)
+            t0 = time.perf_counter()
+            for _ in range(n1):
+                state, m = step(state, data)
+            _ = float(m["loss"])
+            t_a = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(n2):
+                state, m = step(state, data)
+            _ = float(m["loss"])
+            t_b = time.perf_counter() - t0
+            dt = (t_b - t_a) / (n2 - n1)
+            if dt <= 0:
+                dt = t_b / n2
+            tokens = batch * seq
+            flops = (
+                6.0 * (n_params - cfg.vocab_size * cfg.hidden_size) * tokens
+                + 6.0 * cfg.num_layers * batch * seq * seq * cfg.hidden_size
+            )
+            results.append({
+                **row,
+                "step_time_s": round(dt, 4),
+                "tokens_per_sec": round(tokens / dt, 1),
+                "mfu": round((flops / dt) / peak, 4),
+            })
+        except Exception as e:
+            results.append({**row, "error": f"{type(e).__name__}: {str(e)[:200]}"})
+        _emit(payload)
+        # free per-row state before the next compile (rows that failed before
+        # binding these simply have nothing to free)
+        state = step = data = None
+    _emit(payload)
+
+
 def child_parallel() -> None:
     """Parallelism proxy on an 8-device virtual CPU mesh: step time + XLA
     temp-allocation of the explicit-1F1B engine vs the GPipe scan engine at
@@ -446,14 +687,25 @@ def child_parallel() -> None:
         microbatch({"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}, M)
     )
 
+    import dataclasses as _dc
+
+    cfg8 = _dc.replace(cfg, num_layers=8)
+    model8 = LlamaForCausalLM(cfg8, attention_impl="xla")
     out = {}
-    for sched in ("1f1b", "interleaved", "gpipe"):
+    # engine shoot-out (VERDICT r4 next #7): gpipe vs sync-1F1B vs
+    # interleaved at C=2 and C=4 (the C=4 row runs 8 layers so each of the
+    # pp·C virtual stages holds one layer)
+    for sched, chunks in (
+        ("1f1b", 1), ("interleaved", 2), ("gpipe", 1), ("interleaved_c4", 4),
+    ):
+        row_cfg, row_model = (cfg8, model8) if chunks == 4 else (cfg, model)
         adapter = LlamaPipelineAdapter(
-            config=cfg, num_microbatches=M, attention_impl="xla", schedule=sched,
-            num_chunks=2 if sched == "interleaved" else 1,
+            config=row_cfg, num_microbatches=M, attention_impl="xla",
+            schedule="interleaved" if sched.startswith("interleaved") else sched,
+            num_chunks=chunks if chunks > 1 else 1,
         )
         state, step, _engine = adapter.build_state_and_step(
-            model, make_optimizer(OptimizerConfig()), key, ids
+            row_model, make_optimizer(OptimizerConfig()), key, ids
         )
         # temp-allocation evidence via compiled memory analysis
         lowered = step.lower(state, batch)
@@ -483,6 +735,8 @@ def child_parallel() -> None:
         "mesh": "cpu pp=2 tp=2 dp=2 sp=on zero1=on",
         "microbatches": M,
         "schedules": out,
+        "note": "interleaved_c4 runs 8 layers (1 per virtual stage) — 2x the"
+                " compute of the 4-layer rows; compare its step time per layer",
     }
     _emit(payload)
     payload["blockwise_ep"] = _blockwise_ep_comparison()
@@ -499,6 +753,7 @@ def _blockwise_ep_comparison():
 
     from neuronx_distributed_tpu.modules.moe.expert_mlps import (
         _sharded_blockwise_mlp,
+        _sharded_blockwise_mlp_manual,
         _sharded_blockwise_mlp_rolled,
     )
     from neuronx_distributed_tpu.parallel import mesh as mesh_lib
@@ -509,7 +764,7 @@ def _blockwise_ep_comparison():
             tensor_model_parallel_size=2, expert_model_parallel_size=2
         )
         mesh = mesh_lib.get_mesh()
-        T, H, I, E, k = 2048, 256, 512, 8, 2
+        T, H, I, E, k = 4096, 512, 1024, 8, 2
         key = jax.random.PRNGKey(0)
         ks = jax.random.split(key, 5)
         x = jax.random.normal(ks[0], (T, H), jnp.float32)
@@ -529,6 +784,11 @@ def _blockwise_ep_comparison():
             mesh, mesh_lib.EP_AXIS, mesh_lib.TP_AXIS, E // 2, 2, True, "silu")
         rolled = _sharded_blockwise_mlp_rolled(
             mesh, mesh_lib.EP_AXIS, mesh_lib.TP_AXIS, E // 2, 2, True, "silu")
+        # round-5 production path: fully-manual, routing in-region, combine
+        # as an IN-REGION psum (no stacked (ep, tp, T, H) buffer at all)
+        manual = _sharded_blockwise_mlp_manual(
+            mesh, mesh_lib.EDP_AXIS, mesh_lib.EP_AXIS, mesh_lib.TP_AXIS,
+            E, E // 2, 2, k, True, "silu")
 
         def loss_gather(g, u, d):
             return gathered(x, token_idx, ws, sizes, g, u, d).sum(
@@ -540,24 +800,36 @@ def _blockwise_ep_comparison():
                 jnp.zeros((T, H)).at[token_idx].add(ys * ws[:, None]).sum()
             )
 
+        def loss_manual(g, u, d):
+            return manual(x, top_e, top_w, g, u, d).sum()
+
         results = {}
         vals = {}
-        for name, fn in (("gather", loss_gather), ("rolled", loss_rolled)):
+        for name, fn in (
+            ("gather", loss_gather), ("rolled", loss_rolled),
+            ("manual_psum", loss_manual),
+        ):
             step = jax.jit(jax.value_and_grad(fn, argnums=(0, 1, 2)))
             v, g = step(gate, up, down)  # compile + correctness sample
             jax.block_until_ready(g)
             vals[name] = float(v)
             t0 = time.perf_counter()
-            iters = 5
+            iters = 3
             for _ in range(iters):
                 v, g = step(gate, up, down)
             jax.block_until_ready(g)
             results[name + "_step_s"] = round(
                 (time.perf_counter() - t0) / iters, 4
             )
-        results["loss_match"] = abs(vals["gather"] - vals["rolled"]) < 1e-3
+        results["loss_match"] = (
+            abs(vals["gather"] - vals["rolled"]) < 1e-2
+            and abs(vals["gather"] - vals["manual_psum"]) < 1e-2
+        )
         results["gather_speedup"] = round(
             results["rolled_step_s"] / max(results["gather_step_s"], 1e-9), 3
+        )
+        results["manual_psum_speedup_vs_stacked"] = round(
+            results["gather_step_s"] / max(results["manual_psum_step_s"], 1e-9), 3
         )
         results["shape"] = f"T={T} H={H} I={I} E={E} k={k} ep=2 tp=2 fwd+bwd"
         return results
@@ -615,6 +887,56 @@ def _run_child(flag: str, timeout_s: float):
     return result, None
 
 
+def builder_main() -> None:
+    """In-session capture (VERDICT r4 next #1a): run the probe and, if the
+    relay is alive, the tiny + full + sweep measurements, then WRITE
+    ``BENCH_BUILDER.json`` next to this file — raw timings, config, seed,
+    device kind, timestamp — so a driver-time relay flake can never again
+    erase the round's perf signal. Run by the builder whenever the relay
+    responds; committed to the repo; merged into every later bench run's
+    extras as attested history."""
+    import datetime
+
+    artifact = {
+        "captured_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "seed": 0,
+        "attempts": [],
+    }
+    probe, err = _run_child("--probe", PROBE_TIMEOUT_S)
+    artifact["probe"] = probe if probe is not None else {"error": err}
+    relay_ok = bool(probe and probe.get("ok"))
+    if relay_ok:
+        artifact["device_kind"] = probe.get("device_kind")
+        tiny, err = _run_child("--child-tiny", TINY_TIMEOUT_S)
+        artifact["tiny"] = tiny if tiny is not None else {"error": err}
+        full, err = _run_child("--child", FULL_TIMEOUT_S)
+        artifact["full"] = full if full is not None else {"error": err}
+        sweep, err = _run_child("--child-sweep", FULL_TIMEOUT_S)
+        artifact["mfu_sweep"] = sweep if sweep is not None else {"error": err}
+    else:
+        artifact["relay"] = "dead at capture time"
+    # the CPU engine/blockwise proxy is relay-independent evidence — always
+    # captured into the committed artifact
+    proxy, err = _run_child("--child-parallel", PROXY_TIMEOUT_S)
+    artifact["cpu_proxy"] = proxy if proxy is not None else {"error": err}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_BUILDER.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    _emit({"metric": "builder_capture", "relay_ok": relay_ok, "path": path})
+
+
+def _load_builder_artifact():
+    """Committed in-session capture, merged into extras as attested history."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_BUILDER.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
 def main() -> None:
     errors = []
     # Best result so far — a driver SIGTERM at any point emits this plus
@@ -638,6 +960,9 @@ def main() -> None:
             proxy_result if proxy_result is not None else {"error": "proxy did not finish"}
         )
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
+        builder = _load_builder_artifact()
+        if builder is not None:
+            extras["builder_attested"] = builder
         _emit(result)
 
     def _on_term(signum, frame):
@@ -736,9 +1061,13 @@ if __name__ == "__main__":
         child_parallel()
     elif "--child-tiny" in sys.argv:
         child(tiny=True)
+    elif "--child-sweep" in sys.argv:
+        child_sweep()
     elif "--child" in sys.argv:
         child(tiny=False)
     elif "--probe" in sys.argv:
         child_probe()
+    elif "--builder" in sys.argv:
+        builder_main()
     else:
         main()

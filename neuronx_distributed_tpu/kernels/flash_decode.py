@@ -1,0 +1,271 @@
+"""Pallas flash-decode: cached attention for serving (reference: the
+flash-decoding machinery behind KV-replication groups + ``num_cores_per_group``
+— ``parallel_state.py:1368``, ``arrange_kv_groups:1500``,
+``trace/model_builder.py:219``).
+
+Decode attends a handful of query rows (1 token, a speculative verify window,
+or a Medusa tree) against a LONG KV cache. The einsum path materializes the
+(B, H, s, L) fp32 score tensor in HBM and walks the cache in two passes
+(QK^T, then PV); at 8k-32k context that tensor and the second pass dominate
+decode latency. This kernel is the decode analogue of the flash kernel: grid
+``(B, Hkv, nL)`` with the cache-length dim innermost and sequential, carrying
+the online-softmax state (m, l, acc) for all of a kv-head's query rows
+(GQA group × s — a few dozen) in VMEM scratch, one fused pass, nothing
+written to HBM but the (B, Hkv, R, D) output and its LSE.
+
+Masking: each query row carries its cache-slot position (rows attend slots
+``<= pos``), and an optional ``kv_valid`` (B, L) mask drops padded prompt
+slots (the serving stack's persisted padding, modules/attention.py KVCache).
+Cache blocks entirely beyond every row's position are skipped via an SMEM
+bound.
+
+TP layout (the reference's KV-group design, re-derived for GSPMD): kv heads
+shard over tp when ``hkv % tp == 0``; when ``tp > hkv`` the excess factor
+``tp // hkv`` SPLITS THE CACHE LENGTH instead — each rank scans its L-slice
+and the partials merge with an exp-weighted psum over (max-shifted) LSE.
+That is exactly ``num_cores_per_group``: more cores than kv heads cooperate
+on one head's cache scan instead of idling (or replicating KV in HBM).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from neuronx_distributed_tpu.kernels.flash_attention import (
+    _SMEM_SPEC,
+    _pick_block,
+)
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(pos_ref, bound_ref, valid_ref, q_ref, k_ref, v_ref,
+                   o_ref, lse_ref, m_scr, l_scr, acc_scr, *, block_l,
+                   num_l_blocks, l_off, use_valid):
+    j = pl.program_id(2)  # cache-length block (sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # skip blocks whose first slot is beyond every row's position (the SMEM
+    # bound is max(pos) + 1, computed outside)
+    run = l_off + j * block_l < bound_ref[0]
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)            # (R, D)
+        k = k_ref[0, 0].astype(jnp.float32)            # (BL, D)
+        v = v_ref[0, 0].astype(jnp.float32)            # (BL, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * (1.0 / (q.shape[-1] ** 0.5))               # (R, BL)
+        rows = pos_ref[0, :][:, None]                  # (R, 1) slot positions
+        cols = (
+            jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], block_l), 1)
+            + j * block_l + l_off
+        )
+        s = jnp.where(rows >= cols, s, NEG_INF)
+        if use_valid:
+            ok = valid_ref[0, :][None, :] != 0          # (1, BL)
+            s = jnp.where(ok, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        ref = jnp.where(m_new > NEG_INF / 2, m_new, 0.0)
+        p = jnp.exp(s - ref)
+        alpha = jnp.exp(m_prev - ref)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    @pl.when(j == num_l_blocks - 1)
+    def _finish():
+        l = l_scr[:]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            l > 0, m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF
+        )
+
+
+def _flash_decode_call(q, k, v, pos, kv_valid, l_off, interpret, block_l):
+    """q (B, Hkv, R, D) rows; k/v (B, Hkv, L, D) cache slice starting at
+    global slot ``l_off``; pos (R,) global slot positions. Returns
+    (out (B, Hkv, R, D), lse (B, Hkv, R, 1))."""
+    b, hkv, r, d = q.shape
+    l = k.shape[2]
+    bl = _pick_block(l, block_l)
+    nl = l // bl
+    use_valid = kv_valid is not None
+    if kv_valid is None:
+        kv_valid = jnp.zeros((1, 1), jnp.int32)
+        vspec = _SMEM_SPEC
+    else:
+        kv_valid = kv_valid.astype(jnp.int32)
+        vspec = pl.BlockSpec((1, bl), lambda b_, h_, j: (b_, j))
+    bound = jnp.max(pos) + 1 - l_off
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, block_l=bl, num_l_blocks=nl, l_off=0,
+            use_valid=use_valid,
+        ),
+        grid=(b, hkv, nl),
+        in_specs=[
+            pl.BlockSpec((1, r), lambda b_, h_, j: (0, 0)),  # pos (SMEM-ish)
+            _SMEM_SPEC,                                       # bound
+            vspec,                                            # kv_valid
+            pl.BlockSpec((1, 1, r, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, bl, d), lambda b_, h_, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bl, d), lambda b_, h_, j: (b_, h_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, r, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, r, 1), lambda b_, h_, j: (b_, h_, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, r, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv, r, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((r, 1), jnp.float32),
+            pltpu.VMEM((r, 1), jnp.float32),
+            pltpu.VMEM((r, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        (pos - l_off).reshape(1, r).astype(jnp.int32),
+        jnp.asarray(bound, jnp.int32).reshape((1,)),
+        kv_valid,
+        q, k, v,
+    )
+    return out, lse
+
+
+def flash_decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    q_pos: jax.Array,
+    kv_valid: Optional[jax.Array] = None,
+    block_l: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Cached decode attention: q (B, S, H, D) rows at slot positions
+    ``q_pos`` (S,) against the cache (B, L, Hkv, D); each row attends slots
+    ``<= `` its own position, minus invalid (padded) slots. Drop-in for the
+    einsum ``decode_attention`` (modules/attention.py) minus the Medusa tree
+    mask (tree steps keep the einsum path — their cache is short-lived).
+
+    Sharding: batch over the data axes; kv heads over tp when divisible.
+    When ``tp > hkv`` the excess splits the CACHE LENGTH across ranks and
+    merges partials by exp-weighted psum over lse — the reference's
+    ``num_cores_per_group`` flash-decode groups (parallel_state.py:1368)
+    without replicating KV in HBM."""
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    b, s, h, d = q.shape
+    hkv = k_cache.shape[2]
+    group = h // hkv
+    L = k_cache.shape[1]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    # (B, S, H, D) → (B, Hkv, R=G·S, D): fold the GQA group into rows so one
+    # kernel invocation serves every q head of a kv head
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, group, s, d).reshape(
+        b, hkv, group * s, d
+    )
+    kt = jnp.swapaxes(k_cache, 1, 2)
+    vt = jnp.swapaxes(v_cache, 1, 2)
+    q_pos = q_pos[None] if q_pos.ndim == 0 else q_pos
+    rows_pos = jnp.tile(q_pos.astype(jnp.int32), (group,))  # (R,)
+
+    def unfold(out):
+        return jnp.swapaxes(
+            out.reshape(b, hkv, group, s, d).reshape(b, h, s, d), 1, 2
+        ).astype(q.dtype)
+
+    if not mesh_lib.model_parallel_is_initialized():
+        out, _ = _flash_decode_call(
+            qt, kt, vt, rows_pos, kv_valid, 0, interpret, block_l
+        )
+        return unfold(out)
+
+    mesh = mesh_lib.get_mesh()
+    dp = mesh.shape[mesh_lib.EDP_AXIS] * mesh.shape[mesh_lib.EP_AXIS]
+    tp = mesh.shape[mesh_lib.TP_AXIS]
+    from jax.sharding import PartitionSpec as P
+
+    bspec = mesh_lib.DATA_AXES if (dp > 1 and b % dp == 0) else None
+    if tp <= 1 or h % tp != 0:
+        spec = P(bspec, None, None, None)
+        fn = mesh_lib.manual_shard_map(
+            lambda a, b_, c, p_, kv: _flash_decode_call(
+                a, b_, c, p_, kv, 0, interpret, block_l
+            )[0],
+            in_specs=(spec, spec, spec, P(None), P(bspec, None)),
+            out_specs=spec,
+        )
+        out = fn(qt, kt, vt, rows_pos,
+                 kv_valid if kv_valid is not None else jnp.ones((b, L), jnp.int32))
+        return unfold(out)
+
+    if hkv % tp == 0:
+        # kv heads shard cleanly over tp
+        spec = P(bspec, mesh_lib.TP_AXIS, None, None)
+        fn = mesh_lib.manual_shard_map(
+            lambda a, b_, c, p_, kv: _flash_decode_call(
+                a, b_, c, p_, kv, 0, interpret, block_l
+            )[0],
+            in_specs=(spec, spec, spec, P(None),
+                      P(bspec, None)),
+            out_specs=spec,
+        )
+        out = fn(qt, kt, vt, rows_pos,
+                 kv_valid if kv_valid is not None else jnp.ones((b, L), jnp.int32))
+        return unfold(out)
+
+    # tp > hkv (or hkv % tp != 0): split the cache length over tp and merge
+    # the partials — every core scans L/tp slots of every kv head
+    if L % tp != 0:
+        # irregular: fall back to the unsharded kernel (replicated over tp)
+        out, _ = _flash_decode_call(
+            qt, kt, vt, rows_pos, kv_valid, 0, interpret, block_l
+        )
+        return unfold(out)
+
+    def per_rank(a, k_, v_, p_, kv):
+        rank = jax.lax.axis_index(mesh_lib.TP_AXIS)
+        l_off = rank * (L // tp)
+        o, lse = _flash_decode_call(a, k_, v_, p_, kv, l_off, interpret, block_l)
+        # exp-weighted merge over the tp axis: partials with lse≈-inf (rows
+        # whose slots all live on other ranks) contribute zero
+        m = jax.lax.pmax(lse, mesh_lib.TP_AXIS)
+        safe = jnp.where(m > NEG_INF / 2, m, 0.0)
+        w = jnp.where(lse > NEG_INF / 2, jnp.exp(lse - safe), 0.0)
+        num = jax.lax.psum(o.astype(jnp.float32) * w, mesh_lib.TP_AXIS)
+        den = jax.lax.psum(w, mesh_lib.TP_AXIS)
+        return (num / jnp.maximum(den, 1e-30)).astype(a.dtype)
+
+    qs = P(bspec, None, None, None)
+    ls = P(bspec, None, mesh_lib.TP_AXIS, None)  # cache length over tp
+    fn = mesh_lib.manual_shard_map(
+        per_rank,
+        in_specs=(qs, ls, ls, P(None), P(bspec, mesh_lib.TP_AXIS)),
+        out_specs=qs,
+    )
+    out = fn(qt, kt, vt, rows_pos,
+             kv_valid if kv_valid is not None else jnp.ones((b, L), jnp.int32))
+    return unfold(out)

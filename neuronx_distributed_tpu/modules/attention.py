@@ -245,13 +245,35 @@ class KVCache:
         self.valid.value = jax.lax.dynamic_update_slice(self.valid.value, new_valid, (0, cur))
 
 
+# cache length at which decode switches from the fused einsum to the Pallas
+# flash-decode kernel on TPU: below this the (s, L) score tensor is small and
+# the einsum path's simplicity wins; above it the kernel's single streaming
+# pass over the cache (and its slot-bound block skipping) pays for itself
+FLASH_DECODE_MIN_CONTEXT = 1024
+
+
 def decode_attention(q, k_cache, v_cache, q_pos, mask=None, kv_valid=None):
     """Attention of q (B, S, H, D) rows at positions ``q_pos`` (S,) against
     the full cache (B, L, Hkv, D), each row masked at its own position — the
     single-block special case of the ring kernel's block primitive.
     ``mask`` (S, L) overrides the positional mask (Medusa tree attention);
     ``kv_valid`` (B, L) bool masks per-batch padding slots in the cache
-    (padded-prompt serving)."""
+    (padded-prompt serving).
+
+    Long caches on TPU route to the Pallas flash-decode kernel
+    (kernels/flash_decode.py — the reference's flash-decoding KV groups,
+    parallel_state.py:1368); Medusa tree steps keep the einsum (their
+    ``mask`` replaces the positional mask the kernel implements)."""
+    if (
+        mask is None
+        and k_cache.shape[1] >= FLASH_DECODE_MIN_CONTEXT
+        and jax.devices()[0].platform == "tpu"
+    ):
+        from neuronx_distributed_tpu.kernels.flash_decode import (
+            flash_decode_attention,
+        )
+
+        return flash_decode_attention(q, k_cache, v_cache, q_pos, kv_valid)
     from neuronx_distributed_tpu.kernels.ring_attention import _block_attn
 
     b, s, h, d = q.shape

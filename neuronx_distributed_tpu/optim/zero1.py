@@ -177,6 +177,8 @@ def build_explicit_zero1_update(optimizer, params_shardings, opt_state_shardings
     param_specs = jax.tree.map(lambda s: s.spec, params_shardings)
     opt_specs = jax.tree.map(lambda s: s.spec, opt_state_shardings)
 
+    axis_order = list(mesh.axis_names)
+
     def update_fn(grads, opt_state, params):
         # Per-leaf zero-1 spec, recomputed with the same policy that built
         # opt_state_shardings — shapes come from the (global) tracers, so this
@@ -188,7 +190,18 @@ def build_explicit_zero1_update(optimizer, params_shardings, opt_state_shardings
             is_leaf=_is_spec,
         )
 
-        def _slice(spec, z1, leaf):
+        # Per-axis rank, WITHOUT lax.axis_index: an iota sharded over axis
+        # ``a`` arrives in-region as the 1-element slice holding this rank's
+        # index. axis_index inside this shard_map lowers into a nested
+        # manual_computation that re-binds already-manual axes ("operates on
+        # axis 'pp' which is already bound" — cp>1 × pp>1, round 5).
+        rank_arrays = tuple(
+            jax.numpy.arange(mesh.shape[a], dtype=jax.numpy.int32)
+            for a in axis_order
+        )
+        rank_specs = tuple(P(a) for a in axis_order)
+
+        def _slice(ranks, spec, z1, leaf):
             info = _zero1_added_dim(spec, z1)
             if info is None:
                 return leaf
@@ -196,7 +209,7 @@ def build_explicit_zero1_update(optimizer, params_shardings, opt_state_shardings
             n = int(np.prod([mesh.shape[a] for a in axes]))
             idx = jax.numpy.zeros((), jax.numpy.int32)
             for a in axes:  # row-major over axes, matching all_gather order
-                idx = idx * mesh.shape[a] + lax.axis_index(a)
+                idx = idx * mesh.shape[a] + ranks[axis_order.index(a)][0]
             size = leaf.shape[dim] // n
             return lax.dynamic_slice_in_dim(leaf, idx * size, size, dim)
 
@@ -207,9 +220,12 @@ def build_explicit_zero1_update(optimizer, params_shardings, opt_state_shardings
             dim, axes = info
             return lax.all_gather(leaf, axes, axis=dim, tiled=True)
 
-        def inner(g, o, p):
-            g_shard = jax.tree.map(_slice, param_specs, z1_specs, g, is_leaf=_is_spec)
-            p_shard = jax.tree.map(_slice, param_specs, z1_specs, p, is_leaf=_is_spec)
+        def inner(ranks, g, o, p):
+            from functools import partial as _partial
+
+            sl = _partial(_slice, ranks)
+            g_shard = jax.tree.map(sl, param_specs, z1_specs, g, is_leaf=_is_spec)
+            p_shard = jax.tree.map(sl, param_specs, z1_specs, p, is_leaf=_is_spec)
             import optax
 
             updates, new_o = optimizer.update(g_shard, o, p_shard)
@@ -219,13 +235,19 @@ def build_explicit_zero1_update(optimizer, params_shardings, opt_state_shardings
             )
             return new_p, new_o
 
-        fn = jax.shard_map(
+        # jit wrapper: the eager shard_map impl cannot execute fully-manual
+        # specs over the concrete mesh, and under the outer jitted step_fn
+        # this traces once and inlines (same rule as mesh.manual_shard_map).
+        # Rank indices enter as sharded iotas — NOT lax.axis_index, whose
+        # in-region lowering re-binds already-manual axes (see rank_arrays).
+        ctx_mesh = jax.sharding.get_abstract_mesh()
+        fn = jax.jit(jax.shard_map(
             inner,
-            mesh=mesh,
-            in_specs=(param_specs, opt_specs, param_specs),
+            mesh=mesh if ctx_mesh.empty else ctx_mesh,
+            in_specs=(rank_specs, param_specs, opt_specs, param_specs),
             out_specs=(param_specs, opt_specs),
             check_vma=False,
-        )
-        return fn(grads, opt_state, params)
+        ))
+        return fn(rank_arrays, grads, opt_state, params)
 
     return update_fn

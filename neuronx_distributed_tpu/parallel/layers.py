@@ -72,11 +72,27 @@ def _declare_kernel(module, shape, partition, kernel_init, dtype,
             module.param_dtype,
         )
         return kernel.astype(dtype)
+    from neuronx_distributed_tpu.quantization.utils import dequantize
+
+    kernel, scale = _declare_quantized(
+        module, qcfg, shape, partition, scale_partition, name, channel_dim,
+        batch_dim,
+    )
+    if scale.ndim == 1 and len(shape) > 2:  # broadcast per-slice scalars
+        scale = scale.reshape((-1,) + (1,) * (len(shape) - 1))
+    return dequantize(kernel, scale, dtype)
+
+
+def _declare_quantized(module, qcfg, shape, partition, scale_partition, name,
+                       channel_dim, batch_dim):
+    """The ONE copy of the quantized kernel+scale declaration (scale naming,
+    zeros-init placeholder kernel, scale-shape contract) — shared by the
+    dequant path and the raw int8-MXU path so both always produce the exact
+    tree ``quantize_param_tree`` emits."""
     import dataclasses as _dc
 
     from neuronx_distributed_tpu.quantization.config import QuantizationType
     from neuronx_distributed_tpu.quantization.layers import _scale_shape
-    from neuronx_distributed_tpu.quantization.utils import dequantize
 
     kernel = module.param(
         name,
@@ -102,9 +118,40 @@ def _declare_kernel(module, shape, partition, kernel_init, dtype,
         sshape,
         jnp.float32,
     )
-    if scale.ndim == 1 and len(shape) > 2:  # broadcast per-slice scalars
-        scale = scale.reshape((-1,) + (1,) * (len(shape) - 1))
-    return dequantize(kernel, scale, dtype)
+    return kernel, scale
+
+
+def _declare_kernel_q(module, shape, partition, kernel_init, dtype,
+                      scale_partition, name="kernel", channel_dim=1,
+                      batch_dim=None):
+    """Like :func:`_declare_kernel`, but when the module's config requests
+    the native int8 MXU path (``use_int8_matmul``) returns the RAW
+    ``(int8_kernel, fp32_scale)`` pair for the caller to feed
+    ``quantization.utils.int8_matmul``; otherwise ``(weight, None)`` with
+    the usual (possibly dequantized) float weight. Same param tree either
+    way — only the forward differs."""
+    qcfg = module.quantization_config
+    use_int8 = (
+        qcfg is not None
+        and getattr(qcfg, "use_int8_matmul", False)
+        and batch_dim is None
+        and len(shape) == 2
+    )
+    if use_int8:
+        from neuronx_distributed_tpu.quantization.config import QuantizedDtype
+
+        use_int8 = qcfg.quantized_dtype == QuantizedDtype.INT8
+    if not use_int8:
+        return (
+            _declare_kernel(module, shape, partition, kernel_init, dtype,
+                            scale_partition, name=name,
+                            channel_dim=channel_dim, batch_dim=batch_dim),
+            None,
+        )
+    return _declare_quantized(
+        module, qcfg, shape, partition, scale_partition, name, channel_dim,
+        batch_dim,
+    )
 
 
 class ColumnParallelLinear(nn.Module):
@@ -132,7 +179,7 @@ class ColumnParallelLinear(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        kernel = _declare_kernel(
+        kernel, qscale = _declare_kernel_q(
             self,
             (self.input_size, self.output_size),
             (None, self.axis),
@@ -153,9 +200,14 @@ class ColumnParallelLinear(nn.Module):
             # all-gather seq right here (reference fwd all-gather,
             # layers_utils.py:16).
             x = constrain(x, P(*([UNC] * (x.ndim - 2)), self.axis, None))
-        y = jax.lax.dot_general(
-            x, kernel, (((x.ndim - 1,), (0,)), ((), ())), precision=None
-        )
+        if qscale is not None:
+            from neuronx_distributed_tpu.quantization.utils import int8_matmul
+
+            y = int8_matmul(x, kernel, qscale, self.dtype)
+        else:
+            y = jax.lax.dot_general(
+                x, kernel, (((x.ndim - 1,), (0,)), ((), ())), precision=None
+            )
         if self.use_bias:
             y = y + bias.astype(self.dtype)
         if self.gather_output:
@@ -188,7 +240,7 @@ class RowParallelLinear(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        kernel = _declare_kernel(
+        kernel, qscale = _declare_kernel_q(
             self,
             (self.input_size, self.output_size),
             (self.axis, None),
@@ -210,9 +262,14 @@ class RowParallelLinear(nn.Module):
         x = x.astype(self.dtype)
         if self.input_is_parallel:
             x = constrain(x, P(*([UNC] * (x.ndim - 1)), self.axis))
-        y = jax.lax.dot_general(
-            x, kernel, (((x.ndim - 1,), (0,)), ((), ())), precision=None
-        )
+        if qscale is not None:
+            from neuronx_distributed_tpu.quantization.utils import int8_matmul
+
+            y = int8_matmul(x, kernel, qscale, self.dtype)
+        else:
+            y = jax.lax.dot_general(
+                x, kernel, (((x.ndim - 1,), (0,)), ((), ())), precision=None
+            )
         if self.sequence_parallel_enabled and y.ndim >= 3:
             # partial sums → reduce-scatter over the sequence dim
             # (reference mappings.py:320 path)

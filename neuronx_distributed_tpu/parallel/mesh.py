@@ -385,11 +385,19 @@ def manual_shard_map(fn, in_specs, out_specs):
     ctx_mesh = _jax.sharding.get_abstract_mesh()
     target = mesh if ctx_mesh.empty else ctx_mesh
     already_manual = set() if ctx_mesh.empty else set(ctx_mesh.manual_axes)
-    return _jax.shard_map(
-        fn,
-        mesh=target,
-        in_specs=in_specs,
-        out_specs=out_specs,
-        axis_names=set(target.axis_names) - already_manual,
-        check_vma=False,
+    # The jit wrapper is load-bearing twice over: (a) the eager shard_map
+    # impl cannot execute partial-manual specs, and (b) when NESTED inside
+    # another manual region (pipeline pp), an un-jitted shard_map body's
+    # ``lax.axis_index`` lowers into a manual_computation that re-binds the
+    # PARENT's axes — "operates on axis 'pp' which is already bound" (hit by
+    # cp×pp ring attention, round 5). Under an outer jit this inlines.
+    return _jax.jit(
+        _jax.shard_map(
+            fn,
+            mesh=target,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            axis_names=set(target.axis_names) - already_manual,
+            check_vma=False,
+        )
     )

@@ -44,3 +44,11 @@ class QuantizationConfig:
     # kernels (E, in, out) so every expert gets its own scales (reference
     # quantizes each expert's matrix independently, quantization_layers.py:867)
     batch_dim: int | None = None
+    # serve dense linears with a NATIVE int8×int8 MXU matmul (dynamic
+    # per-token activation quantization + fp32 scale epilogue) instead of
+    # dequant-then-bf16-matmul. Same param tree; only the forward changes.
+    # int8 kernels only; 3-D expert stacks and the fused QKV keep the
+    # dequant path (see PARITY.md). Approximate: adds activation-quant
+    # error (~1e-2 relative) on top of the weight quant the dequant path
+    # already has — gate on your accuracy-check mode before enabling.
+    use_int8_matmul: bool = False

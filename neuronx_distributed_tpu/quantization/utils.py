@@ -142,3 +142,31 @@ def quantize_param_tree(
         else:
             node[keys[-1]] = leaf
     return rebuilt
+
+
+def int8_matmul(x: jax.Array, kernel_q: jax.Array, scale: jax.Array,
+                out_dtype: Any) -> jax.Array:
+    """Native int8 MXU matmul (VERDICT r4 next #6; reference forward is
+    dequant-then-matmul, quantization_layers.py:376): dynamically quantize
+    the activations per token (symmetric absmax → int8), run the GEMM as
+    int8×int8 → int32 on the MXU (``preferred_element_type``), and apply the
+    fp32 scale epilogue (per-token activation scale × per-channel weight
+    scale). HBM traffic AND MXU throughput both see 1-byte operands; the
+    dequant path only saves HBM.
+
+    ``kernel_q`` (in, out) int8; ``scale`` () per-tensor or (1, out)
+    per-channel. Under tp the contracted-dim absmax lowers to a max
+    collective for row-parallel inputs (exact — all shards quantize with the
+    same per-token scale)."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    sx = jnp.maximum(absmax, 1e-8) / 127.0
+    qx = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        qx, kernel_q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    s = scale if scale.ndim == 0 else scale.reshape(
+        (1,) * (acc.ndim - 1) + (-1,)
+    )
+    return (acc.astype(jnp.float32) * sx * s).astype(out_dtype)

@@ -41,30 +41,49 @@ def host_cache_dir(base_dir: str) -> str:
     the feature set makes a moved cache cold instead of lethal."""
     import hashlib
 
+    # The fingerprint must cover everything that changes XLA's target
+    # features. cpuinfo flags alone are NOT enough: XLA adds tuning features
+    # like +prefer-no-gather/+prefer-no-scatter based on microcode-level
+    # erratum detection (Intel GDS/downfall), so two hosts with identical
+    # flag lists can still produce incompatible AOT entries (observed round
+    # 5: "Target machine feature +prefer-no-scatter is not supported on the
+    # host machine" served from a same-fingerprint cache). Fold in the
+    # microcode revision, model, and kernel release.
+    parts = []
     try:
-        fp = "noflags"
         with open("/proc/cpuinfo") as f:
             for line in f:
-                # x86 exposes "flags", aarch64 "Features"
-                if line.startswith(("flags", "Features")):
-                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
-                    fp = hashlib.sha256(feats.encode()).hexdigest()[:10]
-                    break
+                key = line.split(":", 1)[0].strip()
+                # x86: flags/microcode/model name; aarch64: Features
+                if key in ("flags", "Features", "microcode", "model name"):
+                    parts.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    if len(parts) >= 3:
+                        break
     except Exception:
-        fp = "nocpuinfo"
+        parts.append("nocpuinfo")
+    try:
+        parts.append(os.uname().release)
+    except Exception:
+        pass
+    fp = (
+        hashlib.sha256("|".join(parts).encode()).hexdigest()[:10]
+        if parts
+        else "noinfo"
+    )
     path = os.path.join(base_dir, f"host-{fp}")
     os.makedirs(path, exist_ok=True)
-    # prune what can never load again: legacy pre-namespacing entries at the
-    # root and namespaces of hosts this volume migrated away from
+    # Prune only what is provably dead (ADVICE r4: an unconditional prune on
+    # a cache volume shared by hosts with different CPU features evicted
+    # each other's LIVE caches on every process start, and deleted unrelated
+    # user files kept in base_dir): root-level files are removed only when
+    # they look like legacy pre-namespacing XLA cache entries; sibling
+    # host-* namespaces are NEVER deleted — they are small, and no cheap
+    # liveness signal exists (read-only warm hits don't bump mtime).
     try:
         for entry in os.listdir(base_dir):
             full = os.path.join(base_dir, entry)
-            if os.path.isfile(full):
+            if os.path.isfile(full) and entry.startswith(("jit_", "xla_", "cache_")):
                 os.unlink(full)
-            elif entry.startswith("host-") and entry != f"host-{fp}":
-                import shutil
-
-                shutil.rmtree(full, ignore_errors=True)
     except OSError:
         pass
     return path

@@ -307,3 +307,46 @@ def test_quantized_model_sharded_matches_unsharded():
     mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
     got = np.asarray(jax.jit(qmodel.apply)(qparams, ids), np.float32)
     np.testing.assert_allclose(got, base, atol=2e-3)
+
+
+def test_int8_mxu_matmul_matches_dequant_path():
+    """use_int8_matmul serves the SAME quantized tree through native
+    int8x int8 GEMMs with a fp32 scale epilogue; vs the dequant path it adds
+    only per-token activation-quant error (VERDICT r4 next #6)."""
+    qcfg = QuantizationConfig(quantized_dtype=QuantizedDtype.INT8)
+    cfg, fmodel, fparams, qmodel, qparams, ids = _setup(qcfg)
+    try:
+        q8model = LlamaForCausalLM(
+            dataclasses.replace(
+                cfg,
+                quantization=dataclasses.replace(qcfg, use_int8_matmul=True),
+            ),
+            attention_impl="xla",
+        )
+        # identical param tree serves both forwards
+        want = meta.unbox(
+            jax.eval_shape(q8model.init, jax.random.PRNGKey(1), ids)
+        )
+        got_paths = {
+            jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(qparams)[0]
+        }
+        want_paths = {
+            jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(want)[0]
+        }
+        assert got_paths == want_paths
+
+        deq = np.asarray(qmodel.apply(qparams, ids), np.float32)
+        i8 = np.asarray(q8model.apply(qparams, ids), np.float32)
+        # activation quantization error budget: small relative to the logit
+        # scale, and the two paths must agree on the argmax almost everywhere
+        denom = max(np.abs(deq).max(), 1e-6)
+        rel = np.abs(i8 - deq).max() / denom
+        assert rel < 0.08, f"int8-matmul path diverges: rel={rel:.4f}"
+        # random-init tiny model → near-uniform logits, so argmax flips on
+        # tiny perturbations; the rel-error bound above is the tight check
+        agree = (deq.argmax(-1) == i8.argmax(-1)).mean()
+        assert agree > 0.9, f"argmax agreement {agree:.3f}"
+    finally:
+        mesh_lib.destroy_model_parallel()
