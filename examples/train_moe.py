@@ -205,14 +205,19 @@ def main(argv=None):
 
     def moe_loss(params, batch):
         # CE + router aux/z losses (MixtralForCausalLM.loss — the trainer's
-        # default loss fn only handles bare-logits models)
+        # default loss fn only handles bare-logits models); packed-corpus
+        # batches carry segment_ids/loss_mask and .loss forwards them
+        extras = dict(
+            segment_ids=batch.get("segment_ids"),
+            loss_mask=batch.get("loss_mask"),
+        )
         if stochastic:
             k = jax.random.fold_in(rng_base, batch["step"])
             rngs = {"token_shuffle": jax.random.fold_in(k, 0),
                     "jitter": jax.random.fold_in(k, 1)}
             return model.loss(params, batch["input_ids"], batch["labels"],
-                              deterministic=False, rngs=rngs)
-        return model.loss(params, batch["input_ids"], batch["labels"])
+                              deterministic=False, rngs=rngs, **extras)
+        return model.loss(params, batch["input_ids"], batch["labels"], **extras)
 
     pipeline = None
     if args.pp > 1:

@@ -61,7 +61,7 @@ class CodeGenBlock(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, segment_ids=None, padding_mask=None):
         cfg = self.config
         common = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                       sequence_parallel_enabled=cfg.sequence_parallel)
@@ -73,7 +73,7 @@ class CodeGenBlock(nn.Module):
             use_bias=False, rotary_pct=cfg.rotary_dim / cfg.head_dim_,
             rope_theta=cfg.rope_theta, max_seq_len=cfg.max_seq_len,
             mode=self.mode, name="attn", **common,
-        )(h, positions)
+        )(h, positions, padding_mask, segment_ids)
         mlp = ParallelMLP(
             hidden_size=cfg.hidden_size, intermediate_size=cfg.intermediate_size,
             activation="gelu_new", use_bias=True, name="mlp", **common,
@@ -86,7 +86,8 @@ class CodeGenForCausalLM(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, segment_ids=None,
+                 padding_mask=None):
         cfg = self.config
         x = ParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -94,7 +95,9 @@ class CodeGenForCausalLM(nn.Module):
         )(input_ids)
         block_cls = nn.remat(CodeGenBlock) if cfg.remat else CodeGenBlock
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, self.mode, name=f"blocks_{i}")(x, positions)
+            x = block_cls(cfg, self.mode, name=f"blocks_{i}")(
+                x, positions, segment_ids, padding_mask
+            )
         x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
                       param_dtype=cfg.param_dtype, name="final_norm")(x)
         return ColumnParallelLinear(

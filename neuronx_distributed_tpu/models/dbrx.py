@@ -82,7 +82,8 @@ class DbrxBlock(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions=None):
+    def __call__(self, x, freqs, positions=None, segment_ids=None,
+                 padding_mask=None):
         cfg = self.config
         # bias-free LayerNorm — DBRX's norms carry no bias (HF modeling_dbrx),
         # and a native-only bias would be silently dropped on HF export
@@ -91,7 +92,7 @@ class DbrxBlock(nn.Module):
         h = LayerNorm(cfg.hidden_size, name="norm_1", **norm)(x)
         x = x + LlamaAttention(
             cfg.as_llama(), self.attention_impl, self.mode, name="attn"
-        )(h, freqs, positions)
+        )(h, freqs, positions, None, segment_ids, padding_mask)
         h = LayerNorm(cfg.hidden_size, name="norm_2", **norm)(x)
         moe_out, aux = MoE(
             num_experts=cfg.num_experts,
@@ -116,7 +117,8 @@ class DbrxForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(
-        self, input_ids, positions=None, deterministic: bool = True
+        self, input_ids, positions=None, deterministic: bool = True,
+        segment_ids=None, padding_mask=None,
     ) -> Tuple[jax.Array, dict]:
         cfg = self.config
         x = ParallelEmbedding(
@@ -130,7 +132,7 @@ class DbrxForCausalLM(nn.Module):
             x, aux = block_cls(
                 cfg, self.attention_impl, deterministic, self.mode,
                 name=f"blocks_{i}",
-            )(x, freqs, positions)
+            )(x, freqs, positions, segment_ids, padding_mask)
             aux_sum = aux_sum + aux
         x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, use_bias=False,
                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -144,9 +146,26 @@ class DbrxForCausalLM(nn.Module):
             "load_balancing_loss": aux_sum[0], "router_z_loss": aux_sum[1]
         }
 
-    def loss(self, params, input_ids, labels, deterministic: bool = True):
-        logits, aux = self.apply(params, input_ids, deterministic=deterministic)
-        ce = parallel_cross_entropy(logits, labels).mean()
+    def loss(self, params, input_ids, labels, deterministic: bool = True,
+             segment_ids=None, loss_mask=None):
+        """``segment_ids``/``loss_mask``: packed-document training (see
+        MixtralForCausalLM.loss)."""
+        positions = None
+        if segment_ids is not None:
+            from neuronx_distributed_tpu.trainer.trainer import (
+                segment_positions,
+            )
+
+            positions = segment_positions(segment_ids)
+        logits, aux = self.apply(
+            params, input_ids, positions=positions,
+            deterministic=deterministic, segment_ids=segment_ids,
+        )
+        tok = parallel_cross_entropy(logits, labels)
+        if loss_mask is not None:
+            ce = (tok * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1)
+        else:
+            ce = tok.mean()
         return (
             ce
             + self.config.router_aux_loss_coef * aux["load_balancing_loss"]

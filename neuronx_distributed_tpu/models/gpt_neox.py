@@ -57,7 +57,7 @@ class GPTNeoXLayer(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, segment_ids=None, padding_mask=None):
         cfg = self.config
         norm = dict(eps=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         common = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -67,7 +67,7 @@ class GPTNeoXLayer(nn.Module):
             hidden_size=cfg.hidden_size, num_heads=cfg.num_heads, causal=True,
             use_bias=True, rotary_pct=cfg.rotary_pct, rope_theta=cfg.rope_theta,
             max_seq_len=cfg.max_seq_len, mode=self.mode, name="attn", **common,
-        )(attn_in, positions)
+        )(attn_in, positions, padding_mask, segment_ids)
         if cfg.use_parallel_residual:
             # x + attn(ln1(x)) + mlp(ln2(x)) — NeoX's parallel formulation
             mlp_in = LayerNorm(cfg.hidden_size, name="post_attn_norm", **norm)(x)
@@ -89,7 +89,8 @@ class GPTNeoXForCausalLM(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, segment_ids=None,
+                 padding_mask=None):
         cfg = self.config
         x = ParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -97,7 +98,9 @@ class GPTNeoXForCausalLM(nn.Module):
         )(input_ids)
         layer_cls = nn.remat(GPTNeoXLayer) if cfg.remat else GPTNeoXLayer
         for i in range(cfg.num_layers):
-            x = layer_cls(cfg, self.mode, name=f"layers_{i}")(x, positions)
+            x = layer_cls(cfg, self.mode, name=f"layers_{i}")(
+                x, positions, segment_ids, padding_mask
+            )
         x = LayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps, dtype=cfg.dtype,
                       param_dtype=cfg.param_dtype, name="final_norm")(x)
         return ColumnParallelLinear(

@@ -118,7 +118,8 @@ class MixtralDecoderLayer(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions=None):
+    def __call__(self, x, freqs, positions=None, segment_ids=None,
+                 padding_mask=None):
         cfg = self.config
         norm = dict(
             eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -127,7 +128,7 @@ class MixtralDecoderLayer(nn.Module):
         h = RMSNorm(cfg.hidden_size, name="input_norm", **norm)(x)
         x = x + LlamaAttention(
             cfg.as_llama(), self.attention_impl, self.mode, name="attn"
-        )(h, freqs, positions)
+        )(h, freqs, positions, None, segment_ids, padding_mask)
         h = RMSNorm(cfg.hidden_size, name="post_attn_norm", **norm)(x)
         moe_out, aux = MoE(
             num_experts=cfg.num_experts,
@@ -158,14 +159,14 @@ class _ScanLayerAdapter(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, x, freqs, positions):
+    def __call__(self, x, freqs, positions, segment_ids, padding_mask):
         layer_cls = (
             nn.remat(MixtralDecoderLayer) if self.config.remat else MixtralDecoderLayer
         )
         x, aux = layer_cls(
             self.config, self.attention_impl, self.deterministic, self.mode,
             name="layer",
-        )(x, freqs, positions)
+        )(x, freqs, positions, segment_ids, padding_mask)
         return x, aux
 
 
@@ -179,7 +180,8 @@ class MixtralModel(nn.Module):
     mode: str = "train"
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, deterministic: bool = True):
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 segment_ids=None, padding_mask=None):
         cfg = self.config
         x = ParallelEmbedding(
             num_embeddings=cfg.vocab_size,
@@ -200,10 +202,11 @@ class MixtralModel(nn.Module):
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "jitter": True, "token_shuffle": True},
                 length=cfg.num_layers,
-                in_axes=(nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast),
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, self.attention_impl, deterministic, self.mode, name="layers")
-            x, aux_stack = scanned(x, freqs, positions)
+            x, aux_stack = scanned(x, freqs, positions, segment_ids, padding_mask)
             aux_sum = aux_stack.sum(0)  # (2,)
         else:
             aux_sum = jnp.zeros((2,), jnp.float32)
@@ -214,7 +217,7 @@ class MixtralModel(nn.Module):
                 x, aux = layer_cls(
                     cfg, self.attention_impl, deterministic, self.mode,
                     name=f"layers_{i}",
-                )(x, freqs, positions)
+                )(x, freqs, positions, segment_ids, padding_mask)
                 aux_sum = aux_sum + aux
         x = RMSNorm(
             cfg.hidden_size, eps=cfg.rms_eps, dtype=cfg.dtype,
@@ -232,11 +235,12 @@ class MixtralForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(
-        self, input_ids, positions=None, deterministic: bool = True
+        self, input_ids, positions=None, deterministic: bool = True,
+        segment_ids=None, padding_mask=None,
     ) -> Tuple[jax.Array, dict]:
         cfg = self.config
         x, aux = MixtralModel(cfg, self.attention_impl, self.mode, name="model")(
-            input_ids, positions, deterministic
+            input_ids, positions, deterministic, segment_ids, padding_mask
         )
         if cfg.sequence_parallel and x.ndim >= 3:
             x = constrain(x, P(UNC, None, None))
@@ -247,14 +251,31 @@ class MixtralForCausalLM(nn.Module):
         )(x)
         return logits, aux
 
-    def loss(self, params, input_ids, labels, deterministic: bool = True, rngs=None):
+    def loss(self, params, input_ids, labels, deterministic: bool = True,
+             rngs=None, segment_ids=None, loss_mask=None):
         """Cross entropy + weighted router aux losses (the trainer-facing
-        objective; reference wires aux via returned router logits)."""
+        objective; reference wires aux via returned router logits).
+
+        ``segment_ids``/``loss_mask``: packed-document training — per-doc
+        attention isolation + RoPE restart + boundary-label masking (the
+        batch keys PackedCorpus emits)."""
         cfg = self.config
+        positions = None
+        if segment_ids is not None:
+            from neuronx_distributed_tpu.trainer.trainer import (
+                segment_positions,
+            )
+
+            positions = segment_positions(segment_ids)
         logits, aux = self.apply(
-            params, input_ids, deterministic=deterministic, rngs=rngs
+            params, input_ids, positions=positions,
+            deterministic=deterministic, segment_ids=segment_ids, rngs=rngs,
         )
-        ce = parallel_cross_entropy(logits, labels).mean()
+        tok = parallel_cross_entropy(logits, labels)
+        if loss_mask is not None:
+            ce = (tok * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1)
+        else:
+            ce = tok.mean()
         return (
             ce
             + cfg.router_aux_loss_coef * aux["load_balancing_loss"]

@@ -204,12 +204,13 @@ def _accepts_segment_ids(model) -> bool:
 def default_loss_fn(model, params, batch):
     seg = batch.get("segment_ids")
     if seg is not None and not _accepts_segment_ids(model):
-        # model family has no packed-segment plumbing (only the flagship
-        # Llama does — PARITY.md): train concat-and-chunk style, but keep the
-        # boundary-label loss_mask, which needs no model support
+        # every causal family threads segment_ids (round 5); this branch now
+        # covers only models without the plumbing (e.g. bidirectional
+        # BERT/ViT heads, external modules): train concat-and-chunk style,
+        # keeping the model-independent boundary-label loss_mask
         logger.warning(
             "%s takes no segment_ids — packed documents will attend across "
-            "boundaries for this family (loss_mask still applies)",
+            "boundaries for this model (loss_mask still applies)",
             type(model).__name__,
         )
         seg = None
@@ -222,6 +223,14 @@ def default_loss_fn(model, params, batch):
         )
     else:
         logits = model.apply(params, batch["input_ids"])
+    if isinstance(logits, tuple):
+        raise TypeError(
+            f"{type(model).__name__}.apply returns (logits, aux) — MoE "
+            "objectives need the aux losses: pass "
+            "loss_fn=lambda p, b: model.loss(p, b['input_ids'], "
+            "b['labels'], segment_ids=b.get('segment_ids'), "
+            "loss_mask=b.get('loss_mask')) to build_train_step"
+        )
     losses = parallel_cross_entropy(logits, batch["labels"])
     mask = batch.get("loss_mask")
     if mask is not None:
