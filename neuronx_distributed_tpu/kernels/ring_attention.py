@@ -41,6 +41,7 @@ Two per-block engines:
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -55,12 +56,14 @@ logger = get_logger(__name__)
 _NEG_INF = -1e30
 
 
-def _block_attn(qt, kt, vt, q_pos, k_pos, causal, mask=None, kv_valid=None):
+def _block_attn(qt, kt, vt, q_pos, k_pos, causal, mask=None, kv_valid=None,
+                q_seg=None, k_seg=None):
     """One blockwise attention partial: qt (B, Hkv, G, Sq, D) × kt/vt
     (B, Hkv, Sk, D) → unnormalized (num, m, l) accumulator pieces.
     ``mask`` (Sq, Sk) overrides the positional causal mask (tree attention);
     ``kv_valid`` (B, Sk) bool additionally masks per-batch invalid keys
-    (padded-prompt serving)."""
+    (padded-prompt serving); ``q_seg``/``k_seg`` (B, Sq)/(B, Sk) restrict
+    attention to equal segment ids (packed documents over the ring)."""
     d = qt.shape[-1]
     scores = jnp.einsum(
         "bhgqd,bhkd->bhgqk", qt.astype(jnp.float32), kt.astype(jnp.float32)
@@ -72,6 +75,9 @@ def _block_attn(qt, kt, vt, q_pos, k_pos, causal, mask=None, kv_valid=None):
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     if kv_valid is not None:
         scores = jnp.where(kv_valid[:, None, None, None, :], scores, _NEG_INF)
+    if q_seg is not None:
+        smask = q_seg[:, :, None] == k_seg[:, None, :]  # (B, Sq, Sk)
+        scores = jnp.where(smask[:, None, None], scores, _NEG_INF)
     m = scores.max(-1)  # (B, Hkv, G, Sq)
     safe_m = jnp.where(m > _NEG_INF / 2, m, 0.0)
     p = jnp.exp(scores - safe_m[..., None])
@@ -111,15 +117,19 @@ def _merge_lse(out, lse, o_j, lse_j):
     return out_new, lse_new
 
 
-def _ring_flash_fwd_pass(q, k, v, axis_name, bq, bk, interpret):
+def _ring_flash_fwd_pass(q, k, v, q_seg, k_seg, axis_name, bq, bk, interpret):
     """Forward ring with the Pallas kernel per step. q (B, S, H, D) local,
-    k/v (B, S, Hkv, D) local. Returns (out (B,S,H,D), lse (B,H,S,1))."""
+    k/v (B, S, Hkv, D) local; ``q_seg``/``k_seg`` (B, S) local segment-id
+    shards or None — the key segments rotate WITH K/V and feed the kernel's
+    equal-segment mask. Returns (out (B,S,H,D), lse (B,H,S,1))."""
     from neuronx_distributed_tpu.kernels.flash_attention import _flash_fwd
 
     cp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     qt = jnp.swapaxes(q, 1, 2)  # (B, H, S, D)
+    segs = q_seg is not None
+    ks0 = k_seg if segs else jnp.zeros((b, s_loc), jnp.int32)
 
     def kv_t(x):
         # (B, S, Hkv, D) → (B, Hkv, S, D); the kernel serves GQA natively so
@@ -130,38 +140,44 @@ def _ring_flash_fwd_pass(q, k, v, axis_name, bq, bk, interpret):
     out, lse = _flash_fwd(
         qt, kv_t(k), kv_t(v), True, bq, bk, interpret,
         q_off=q_off, k_off=q_off,
+        q_seg=q_seg if segs else None, k_seg=ks0 if segs else None,
     )
     out = out.astype(jnp.float32)
     if cp > 1:
         perm = [(i, (i + 1) % cp) for i in range(cp)]
 
         def step(carry, t):
-            k_c, v_c, out, lse = carry
+            k_c, v_c, ks, out, lse = carry
             k_c = lax.ppermute(k_c, axis_name, perm)
             v_c = lax.ppermute(v_c, axis_name, perm)
+            if segs:
+                ks = lax.ppermute(ks, axis_name, perm)
             j = (rank - t) % cp
             o_j, lse_j = _flash_fwd(
                 qt, kv_t(k_c), kv_t(v_c), True, bq, bk, interpret,
                 q_off=q_off, k_off=j * s_loc,
+                q_seg=q_seg if segs else None, k_seg=ks if segs else None,
             )
             out, lse = _merge_lse(out, lse, o_j, lse_j)
-            return (k_c, v_c, out, lse), None
+            return (k_c, v_c, ks, out, lse), None
 
-        (_, _, out, lse), _ = lax.scan(
-            step, (k, v, out, lse), jnp.arange(1, cp)
+        (_, _, _, out, lse), _ = lax.scan(
+            step, (k, v, ks0, out, lse), jnp.arange(1, cp)
         )
     return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash(q, k, v, axis_name, bq, bk, interpret):
-    out, _ = _ring_flash_fwd_pass(q, k, v, axis_name, bq, bk, interpret)
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _ring_flash(q, k, v, q_seg, k_seg, axis_name, bq, bk, interpret):
+    out, _ = _ring_flash_fwd_pass(q, k, v, q_seg, k_seg, axis_name, bq, bk,
+                                  interpret)
     return out
 
 
-def _ring_flash_fwd_rule(q, k, v, axis_name, bq, bk, interpret):
-    out, lse = _ring_flash_fwd_pass(q, k, v, axis_name, bq, bk, interpret)
-    return out, (q, k, v, out, lse)
+def _ring_flash_fwd_rule(q, k, v, q_seg, k_seg, axis_name, bq, bk, interpret):
+    out, lse = _ring_flash_fwd_pass(q, k, v, q_seg, k_seg, axis_name, bq, bk,
+                                    interpret)
+    return out, (q, k, v, q_seg, k_seg, out, lse)
 
 
 def _ring_flash_bwd_rule(axis_name, bq, bk, interpret, res, g):
@@ -173,10 +189,12 @@ def _ring_flash_bwd_rule(axis_name, bq, bk, interpret, res, g):
         _flash_dq,
     )
 
-    q, k, v, out, lse = res
+    q, k, v, q_seg, k_seg, out, lse = res
     cp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
+    segs = q_seg is not None
+    ks0 = k_seg if segs else jnp.zeros((b, s_loc), jnp.int32)
     qt = jnp.swapaxes(q, 1, 2)
     gt = jnp.swapaxes(g, 1, 2)
     ot = jnp.swapaxes(out, 1, 2)
@@ -195,16 +213,19 @@ def _ring_flash_bwd_rule(axis_name, bq, bk, interpret, res, g):
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
     def step(carry, t):
-        k_c, v_c, dk_c, dv_c, dq = carry
+        k_c, v_c, ks, dk_c, dv_c, dq = carry
         j = (rank - t) % cp
         k_rep, v_rep = kv_t(k_c), kv_t(v_c)
+        seg_kw = dict(
+            q_seg=q_seg if segs else None, k_seg=ks if segs else None
+        )
         dq_j = _flash_dq(
             qt, k_rep, v_rep, gt, lse, delta, True, bq, bk, interpret,
-            q_off=q_off, k_off=j * s_loc,
+            q_off=q_off, k_off=j * s_loc, **seg_kw,
         )
         dk_j, dv_j = _flash_dkdv(
             qt, k_rep, v_rep, gt, lse, delta, True, bq, bk, interpret,
-            q_off=q_off, k_off=j * s_loc,
+            q_off=q_off, k_off=j * s_loc, **seg_kw,
         )
         dq = dq + dq_j.astype(jnp.float32)
         dk_c = dk_c + fold_kv(dk_j.astype(jnp.float32))
@@ -212,20 +233,26 @@ def _ring_flash_bwd_rule(axis_name, bq, bk, interpret, res, g):
         if cp > 1:
             k_c = lax.ppermute(k_c, axis_name, perm)
             v_c = lax.ppermute(v_c, axis_name, perm)
+            if segs:
+                ks = lax.ppermute(ks, axis_name, perm)
             dk_c = lax.ppermute(dk_c, axis_name, perm)
             dv_c = lax.ppermute(dv_c, axis_name, perm)
-        return (k_c, v_c, dk_c, dv_c, dq), None
+        return (k_c, v_c, ks, dk_c, dv_c, dq), None
 
     init = (
         k,
         v,
+        ks0,
         jnp.zeros(k.shape, jnp.float32),
         jnp.zeros(v.shape, jnp.float32),
         jnp.zeros(qt.shape, jnp.float32),
     )
-    (_, _, dk, dv, dq), _ = lax.scan(step, init, jnp.arange(cp))
+    (_, _, _, dk, dv, dq), _ = lax.scan(step, init, jnp.arange(cp))
     dq = jnp.swapaxes(dq, 1, 2)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (
+        dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+        None, None,
+    )
 
 
 _ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
@@ -237,17 +264,20 @@ def ring_flash_attention(
     v: jax.Array,
     axis_name: str = mesh_lib.CP_AXIS,
     interpret: bool | None = None,
+    q_seg: Optional[jax.Array] = None,
+    k_seg: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Causal ring attention with the Pallas flash kernel per ring step —
     call inside ``shard_map`` with seq sharded over ``axis_name``
-    (the kernel path of :func:`ring_attention_sharded`)."""
+    (the kernel path of :func:`ring_attention_sharded`). ``q_seg``/``k_seg``
+    (B, S_local): packed-document isolation, key segments ride the ring."""
     from neuronx_distributed_tpu.kernels.flash_attention import _pick_block
 
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     s_loc = q.shape[1]
     bq = bk = _pick_block(s_loc, 256)
-    return _ring_flash(q, k, v, axis_name, bq, bk, interpret)
+    return _ring_flash(q, k, v, q_seg, k_seg, axis_name, bq, bk, interpret)
 
 
 def ring_attention(
@@ -256,13 +286,18 @@ def ring_attention(
     v: jax.Array,
     causal: bool = True,
     axis_name: str = mesh_lib.CP_AXIS,
+    q_seg: Optional[jax.Array] = None,
+    k_seg: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ring attention on LOCAL sequence shards — call inside ``shard_map``
     with the sequence dim sharded over ``axis_name``.
 
     ``q``: (B, S_local, H, D); ``k, v``: (B, S_local, Hkv, D) with Hkv | H
-    (GQA broadcast happens per block). Returns (B, S_local, H, D).
-    """
+    (GQA broadcast happens per block). ``q_seg``/``k_seg`` (B, S_local)
+    local segment-id shards: the key segments travel the ring WITH K/V (a
+    negligible int32 alongside the (B, S, Hkv, D) payload), giving packed
+    documents per-document isolation at ring scale. Returns
+    (B, S_local, H, D)."""
     cp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
@@ -272,33 +307,40 @@ def ring_attention(
     qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, g, s_loc, d)
     kt0 = jnp.swapaxes(k, 1, 2)  # (B, Hkv, S, D)
     vt0 = jnp.swapaxes(v, 1, 2)
+    segs = q_seg is not None
+    ks0 = k_seg if segs else jnp.zeros((b, s_loc), jnp.int32)
     q_pos = rank * s_loc + jnp.arange(s_loc)
     # receive the previous rank's K/V each step (reference ring direction:
     # ascending ring over the CP src/tgt pairs, parallel_state.py:688)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
-    def block(kt, vt, j):
+    def block(kt, vt, ks, j):
         k_pos = j * s_loc + jnp.arange(s_loc)
-        return _block_attn(qt, kt, vt, q_pos, k_pos, causal)
+        return _block_attn(
+            qt, kt, vt, q_pos, k_pos, causal,
+            q_seg=q_seg if segs else None, k_seg=ks if segs else None,
+        )
 
     # step 0: the local block — no exchange needed
-    acc, m_run, l_run = block(kt0, vt0, rank)
+    acc, m_run, l_run = block(kt0, vt0, ks0, rank)
 
     @jax.checkpoint
     def step(carry, step_idx):
-        kt, vt, acc, m_run, l_run = carry
+        kt, vt, ks, acc, m_run, l_run = carry
         # permute FIRST so exactly cp-1 exchanges happen (the last block's
         # K/V are not rotated onward to be discarded)
         kt = lax.ppermute(kt, axis_name, perm)
         vt = lax.ppermute(vt, axis_name, perm)
+        if segs:
+            ks = lax.ppermute(ks, axis_name, perm)
         j = (rank - step_idx) % cp  # whose K/V block we hold this step
-        num, m_blk, l_blk = block(kt, vt, j)
+        num, m_blk, l_blk = block(kt, vt, ks, j)
         acc, m_run, l_run = _combine(acc, m_run, l_run, num, m_blk, l_blk)
-        return (kt, vt, acc, m_run, l_run), None
+        return (kt, vt, ks, acc, m_run, l_run), None
 
     if cp > 1:
-        (_, _, acc, m_run, l_run), _ = lax.scan(
-            step, (kt0, vt0, acc, m_run, l_run), jnp.arange(1, cp)
+        (_, _, _, acc, m_run, l_run), _ = lax.scan(
+            step, (kt0, vt0, ks0, acc, m_run, l_run), jnp.arange(1, cp)
         )
     out = acc / jnp.maximum(l_run, 1e-20)[..., None]
     out = out.reshape(b, h, s_loc, d)
@@ -311,6 +353,7 @@ def ring_attention_sharded(
     v: jax.Array,
     causal: bool = True,
     impl: str = "auto",
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ring attention on GLOBAL (B, S, H, D) arrays: wraps the shard_map with
     sequence over cp, batch over the data axes, heads over tp (the layout the
@@ -321,10 +364,14 @@ def ring_attention_sharded(
     are right-PADDED to the next multiple — padded keys sit at positions
     after every real query, so the causal mask already excludes them (the
     round-2 fallback replicated the whole sequence instead, an OOM at the
-    context lengths cp exists for)."""
+    context lengths cp exists for).
+
+    ``segment_ids`` (B, S): packed-document isolation at ring scale — the
+    key-side segment shard rotates with K/V (round 5; closes PARITY #5's
+    einsum fallback). Padding positions get segment ``-1``."""
     if not mesh_lib.model_parallel_is_initialized():
         # no mesh: single block, plain attention
-        return ring_attention_reference(q, k, v, causal)
+        return ring_attention_reference(q, k, v, causal, segment_ids)
     mesh = mesh_lib.get_mesh()
     b, s, h, _ = q.shape
     hkv = k.shape[2]
@@ -343,10 +390,14 @@ def ring_attention_sharded(
             "ring attention: non-causal seq len %d not divisible by cp=%d; "
             "falling back to unsharded attention", s, cp,
         )
-        return ring_attention_reference(q, k, v, causal)
+        return ring_attention_reference(q, k, v, causal, segment_ids)
     if pad:
         cfg = [(0, 0), (0, pad), (0, 0), (0, 0)]
         q, k, v = jnp.pad(q, cfg), jnp.pad(k, cfg), jnp.pad(v, cfg)
+        if segment_ids is not None:
+            segment_ids = jnp.pad(
+                segment_ids, [(0, 0), (0, pad)], constant_values=-1
+            )
     bspec = mesh_lib.DATA_AXES if (dp > 1 and b % dp == 0) else None
     # q and kv heads shard over tp only when BOTH divide: the per-block GQA
     # grouping requires each shard's q-head slice to align with its kv slice
@@ -355,20 +406,42 @@ def ring_attention_sharded(
     sspec = mesh_lib.CP_AXIS if cp > 1 else None
     qspec = P(bspec, sspec, hspec, None)
     kvspec = P(bspec, sspec, hspec, None)
+    if segment_ids is None:
+        # no dummy segment operand for the common unpacked case
+        if impl == "flash":
+            local_fn = partial(ring_flash_attention, axis_name=mesh_lib.CP_AXIS)
+        else:
+            local_fn = partial(
+                ring_attention, causal=causal, axis_name=mesh_lib.CP_AXIS
+            )
+        fn = mesh_lib.manual_shard_map(
+            local_fn, in_specs=(qspec, kvspec, kvspec), out_specs=qspec
+        )
+        out = fn(q, k, v)
+        return out[:, :s] if pad else out
+
+    segspec = P(bspec, sspec)
     if impl == "flash":
-        local_fn = partial(ring_flash_attention, axis_name=mesh_lib.CP_AXIS)
+        def local_fn(q_, k_, v_, seg_):
+            return ring_flash_attention(
+                q_, k_, v_, axis_name=mesh_lib.CP_AXIS, q_seg=seg_, k_seg=seg_
+            )
     else:
-        local_fn = partial(ring_attention, causal=causal, axis_name=mesh_lib.CP_AXIS)
+        def local_fn(q_, k_, v_, seg_):
+            return ring_attention(
+                q_, k_, v_, causal=causal, axis_name=mesh_lib.CP_AXIS,
+                q_seg=seg_, k_seg=seg_,
+            )
     fn = mesh_lib.manual_shard_map(
         local_fn,
-        in_specs=(qspec, kvspec, kvspec),
+        in_specs=(qspec, kvspec, kvspec, segspec),
         out_specs=qspec,
     )
-    out = fn(q, k, v)
+    out = fn(q, k, v, segment_ids.astype(jnp.int32))
     return out[:, :s] if pad else out
 
 
-def ring_attention_reference(q, k, v, causal=True):
+def ring_attention_reference(q, k, v, causal=True, segment_ids=None):
     """Single-device golden: same math, no ring (tests compare against it).
     GQA handled by the same grouped einsum."""
     b, s, h, d = q.shape
@@ -377,6 +450,8 @@ def ring_attention_reference(q, k, v, causal=True):
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     pos = jnp.arange(s)
-    num, m, l = _block_attn(qt, kt, vt, pos, pos, causal)
+    num, m, l = _block_attn(
+        qt, kt, vt, pos, pos, causal, q_seg=segment_ids, k_seg=segment_ids
+    )
     out = num / jnp.maximum(l, 1e-20)[..., None]
     return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2).astype(q.dtype)

@@ -84,9 +84,10 @@ def attention_op(q, k, v, causal: bool = True, impl: str = "auto",
 
     ``segment_ids`` (B, S) int (packed-document isolation) and ``mask``
     (B, Sk) bool (True at valid keys — padding) both ride the flash kernel's
-    segment path on TPU (padding becomes segment ``-1``); under cp > 1 the
-    ring kernel takes no segments yet, so masked/packed long-context inputs
-    fall back to the fp32 einsum (see PARITY.md)."""
+    segment path on TPU (padding becomes segment ``-1``); under cp > 1
+    packed/masked SELF-attention rides the ring engines (key segments
+    rotate with K/V). Only a kv-side mask with cross-length shapes keeps
+    the fp32 einsum fallback (see PARITY.md)."""
     if kv_segment_ids is not None and segment_ids is None:
         raise ValueError(
             "kv_segment_ids requires segment_ids (query-side ids) — "
@@ -103,11 +104,28 @@ def attention_op(q, k, v, causal: bool = True, impl: str = "auto",
         # fold the padding mask into segment ids: padding = segment -1
         if k_seg is None and q.shape[1] == k.shape[1]:
             q_seg = k_seg = jnp.where(mask, 0, -1)
+        elif k_seg is not None and k_seg is q_seg and q.shape[1] == k.shape[1]:
+            # self-attention: fold symmetrically into ONE shared array so the
+            # packed+masked case keeps the cp ring route (masked q rows'
+            # outputs are dropped by the caller's loss/valid masks anyway)
+            q_seg = k_seg = jnp.where(mask, q_seg, -1)
         elif k_seg is not None:
             k_seg = jnp.where(mask, k_seg, -1)
         else:  # cross-length mask with no segments: einsum path handles it
             return xla_attention(q, k, v, causal=causal, mask=mask)
     if q_seg is not None:
+        if cp > 1 and causal and q.shape[1] == k.shape[1] and (k_seg is q_seg):
+            # packed documents at ring scale: key segments rotate with K/V
+            # (round 5 — the S×S einsum fallback is gone). Self-attention
+            # with ONE segment array only (a separate kv mask folded into
+            # k_seg keeps the exact einsum fallback below)
+            from neuronx_distributed_tpu.kernels.ring_attention import (
+                ring_attention_sharded,
+            )
+
+            return ring_attention_sharded(
+                q, k, v, causal=causal, impl=impl, segment_ids=q_seg
+            )
         if cp == 1 and (
             impl == "flash"  # explicit: interpret-mode on CPU (kernel tests)
             or (impl == "auto" and jax.devices()[0].platform == "tpu")

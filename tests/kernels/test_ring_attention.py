@@ -203,3 +203,147 @@ def test_ring_flash_long_seq_cp4():
         lambda a, b_, c: ring_attention_sharded(a, b_, c, True, impl="flash")
     )(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+# --- segments over the ring (round 5: packed documents at cp scale) ----------
+
+
+def _doc_segs(lengths, b=B):
+    seg = np.concatenate(
+        [np.full((n,), i, np.int32) for i, n in enumerate(lengths)]
+    )
+    return jnp.asarray(np.tile(seg[None], (b, 1)))
+
+
+def test_ring_segments_forward_cp4():
+    """Packed documents over cp=4: key segments ride the ring; result equals
+    the unsharded segment-masked golden — including documents that span
+    shard boundaries (len 24 crosses the 16-token shard width)."""
+    q, k, v = _qkv(seed=4)
+    seg = _doc_segs([24, 8, 32])
+    ref = ring_attention_reference(q, k, v, True, segment_ids=seg)
+    mesh_lib.initialize_model_parallel(
+        context_parallel_size=4, tensor_model_parallel_size=2
+    )
+    try:
+        out = jax.jit(
+            lambda a, b_, c: ring_attention_sharded(
+                a, b_, c, True, segment_ids=seg
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_ring_segments_backward_cp2():
+    q, k, v = _qkv(seed=5)
+    seg = _doc_segs([40, 24])
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            ring_attention_reference(q, k, v, True, segment_ids=seg) ** 2
+        )
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    mesh_lib.initialize_model_parallel(context_parallel_size=2)
+    try:
+        def loss_ring(q, k, v):
+            return jnp.sum(
+                ring_attention_sharded(q, k, v, True, segment_ids=seg) ** 2
+            )
+
+        g = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        for a, b_, name in zip(g, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=5e-4, err_msg=f"d{name}"
+            )
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_ring_segments_flash_engine_cp2():
+    """The Pallas-kernel ring engine (interpret mode on CPU) with segments:
+    key segment shards rotate with K/V through the custom_vjp fwd AND bwd."""
+    q, k, v = _qkv(seed=6)
+    seg = _doc_segs([40, 24])
+    ref = ring_attention_reference(q, k, v, True, segment_ids=seg)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            ring_attention_reference(q, k, v, True, segment_ids=seg) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    mesh_lib.initialize_model_parallel(context_parallel_size=2)
+    try:
+        out = jax.jit(
+            lambda a, b_, c: ring_attention_sharded(
+                a, b_, c, True, impl="flash", segment_ids=seg
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                ring_attention_sharded(
+                    q, k, v, True, impl="flash", segment_ids=seg
+                ) ** 2
+            ),
+            argnums=(0, 1, 2),
+        ))(q, k, v)
+        for a, b_, name in zip(g, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=5e-4, err_msg=f"d{name}"
+            )
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_ring_segments_with_padding_cp4():
+    """Sequence not divisible by cp: the pad tail gets segment -1 and drops
+    out exactly."""
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    s = 60  # not divisible by cp=4 → right-padded to 64, pad segment -1
+    q = jax.random.normal(ks[0], (B, s, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, s, H, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, s, H, D), jnp.float32)
+    seg = _doc_segs([30, 30])
+    ref = ring_attention_reference(q, k, v, True, segment_ids=seg)
+    mesh_lib.initialize_model_parallel(context_parallel_size=4)
+    try:
+        out = jax.jit(
+            lambda a, b_, c: ring_attention_sharded(
+                a, b_, c, True, segment_ids=seg
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    finally:
+        mesh_lib.destroy_model_parallel()
+
+
+def test_ring_segments_plus_padding_mask_stays_on_ring_cp2():
+    """Packed segments AND a padding mask together (the LlamaAttention train
+    path with both) must keep the ring route under cp — the mask folds
+    symmetrically into the shared segment array (round-5 review fix)."""
+    from neuronx_distributed_tpu.modules.attention import (
+        attention_op,
+        xla_attention,
+    )
+
+    q, k, v = _qkv(seed=8)
+    seg = _doc_segs([40, 24])
+    valid = np.ones((B, S), bool)
+    valid[1, 48:] = False  # row 1's tail is padding
+    mask = jnp.asarray(valid)
+    # golden: symmetric fold on the unsharded einsum
+    folded = jnp.where(mask, seg, -1)
+    ref = xla_attention(q, k, v, causal=True, segment_ids=folded)
+    mesh_lib.initialize_model_parallel(context_parallel_size=2)
+    try:
+        out = jax.jit(
+            lambda a, b_, c: attention_op(
+                a, b_, c, causal=True, mask=mask, segment_ids=seg
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    finally:
+        mesh_lib.destroy_model_parallel()
