@@ -69,7 +69,8 @@ def parse_args(argv=None):
     p.add_argument("--save-dir", default=None,
                    help="serialize traced executables here (trace mode)")
     p.add_argument("--attention", default="auto", choices=["auto", "flash", "xla"])
-    p.add_argument("--quantize", default=None, choices=["int8", "fp8"],
+    p.add_argument("--quantize", default=None,
+                   choices=["int8", "fp8", "int8-mxu"],
                    help="weight-only serving quantization: every linear "
                         "kernel stored int8/fp8e4m3 + per-channel scale "
                         "(generate/benchmark/check modes)")
@@ -179,7 +180,12 @@ def main(argv=None):
 
         qcfg = QuantizationConfig(
             quantized_dtype={"int8": QuantizedDtype.INT8,
-                             "fp8": QuantizedDtype.FP8E4M3}[args.quantize]
+                             "fp8": QuantizedDtype.FP8E4M3,
+                             # native int8 MXU GEMMs + dynamic activation
+                             # quant (adds ~1e-2 rel error over dequant —
+                             # verify with --mode check)
+                             "int8-mxu": QuantizedDtype.INT8}[args.quantize],
+            use_int8_matmul=args.quantize == "int8-mxu",
         )
         params = quantize_param_tree(params, qcfg)
         cfg = dataclasses.replace(cfg, quantization=qcfg)

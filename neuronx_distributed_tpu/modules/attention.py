@@ -123,8 +123,13 @@ def attention_op(q, k, v, causal: bool = True, impl: str = "auto",
                 ring_attention_sharded,
             )
 
+            # ring_attention_sharded's engine choice is flash|xla|auto;
+            # impl="ring"/"ulysses" here mean "the cp path" — let it pick
+            # the engine (flash on TPU) instead of falling into the
+            # einsum-block branch
+            ring_impl = impl if impl in ("flash", "xla") else "auto"
             return ring_attention_sharded(
-                q, k, v, causal=causal, impl=impl, segment_ids=q_seg
+                q, k, v, causal=causal, impl=ring_impl, segment_ids=q_seg
             )
         if cp == 1 and (
             impl == "flash"  # explicit: interpret-mode on CPU (kernel tests)

@@ -338,6 +338,18 @@ def test_ring_segments_plus_padding_mask_stays_on_ring_cp2():
     folded = jnp.where(mask, seg, -1)
     ref = xla_attention(q, k, v, causal=True, segment_ids=folded)
     mesh_lib.initialize_model_parallel(context_parallel_size=2)
+    # pin the ROUTE, not just the numerics: the unsharded einsum fallback
+    # would produce the same numbers, so fail loudly if it is reached
+    import neuronx_distributed_tpu.modules.attention as attn_mod
+
+    def _trap(*a, **kw):
+        raise AssertionError(
+            "packed+masked cp input fell off the ring route onto the "
+            "unsharded einsum"
+        )
+
+    orig = attn_mod.xla_attention
+    attn_mod.xla_attention = _trap
     try:
         out = jax.jit(
             lambda a, b_, c: attention_op(
@@ -346,4 +358,5 @@ def test_ring_segments_plus_padding_mask_stays_on_ring_cp2():
         )(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
     finally:
+        attn_mod.xla_attention = orig
         mesh_lib.destroy_model_parallel()
