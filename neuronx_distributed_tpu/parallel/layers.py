@@ -124,12 +124,13 @@ def _declare_quantized(module, qcfg, shape, partition, scale_partition, name,
 def _declare_kernel_q(module, shape, partition, kernel_init, dtype,
                       scale_partition, name="kernel", channel_dim=1,
                       batch_dim=None):
-    """Like :func:`_declare_kernel`, but when the module's config requests
-    the native int8 MXU path (``use_int8_matmul``) returns the RAW
-    ``(int8_kernel, fp32_scale)`` pair for the caller to feed
-    ``quantization.utils.int8_matmul``; otherwise ``(weight, None)`` with
-    the usual (possibly dequantized) float weight. Same param tree either
-    way — only the forward differs."""
+    """Like :func:`_declare_kernel`, but returns a 3-tuple
+    ``(weight, qscale, act_scale)``: when the module's config requests the
+    native int8 MXU path (``use_int8_matmul``) the RAW int8 kernel + fp32
+    weight scale (+ the scalar ``act_scale`` param iff
+    ``use_static_act_scale``, for ``int8_matmul``'s static activation
+    quantization); otherwise ``(dequantized_weight, None, None)``.
+    ``quantize_param_tree`` with the same config emits exactly this tree."""
     qcfg = module.quantization_config
     use_int8 = (
         qcfg is not None
@@ -147,11 +148,24 @@ def _declare_kernel_q(module, shape, partition, kernel_init, dtype,
                             scale_partition, name=name,
                             channel_dim=channel_dim, batch_dim=batch_dim),
             None,
+            None,
         )
-    return _declare_quantized(
+    kernel, scale = _declare_quantized(
         module, qcfg, shape, partition, scale_partition, name, channel_dim,
         batch_dim,
     )
+    act_scale = None
+    if getattr(qcfg, "use_static_act_scale", False):
+        # scalar static activation scale, filled by a calibration pass
+        # (observer.calibrate_activation_scale); init 1.0 keeps an
+        # uncalibrated model runnable (clips at |x| > 127)
+        act_scale = module.param(
+            ("act_scale" if name == "kernel" else name + "_act_scale"),
+            nn.with_partitioning(nn.initializers.ones_init(), ()),
+            (),
+            jnp.float32,
+        )
+    return kernel, scale, act_scale
 
 
 class ColumnParallelLinear(nn.Module):
@@ -179,7 +193,7 @@ class ColumnParallelLinear(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        kernel, qscale = _declare_kernel_q(
+        kernel, qscale, act_scale = _declare_kernel_q(
             self,
             (self.input_size, self.output_size),
             (None, self.axis),
@@ -203,7 +217,7 @@ class ColumnParallelLinear(nn.Module):
         if qscale is not None:
             from neuronx_distributed_tpu.quantization.utils import int8_matmul
 
-            y = int8_matmul(x, kernel, qscale, self.dtype)
+            y = int8_matmul(x, kernel, qscale, self.dtype, act_scale=act_scale)
         else:
             y = jax.lax.dot_general(
                 x, kernel, (((x.ndim - 1,), (0,)), ((), ())), precision=None
@@ -240,7 +254,7 @@ class RowParallelLinear(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        kernel, qscale = _declare_kernel_q(
+        kernel, qscale, act_scale = _declare_kernel_q(
             self,
             (self.input_size, self.output_size),
             (self.axis, None),
@@ -265,7 +279,7 @@ class RowParallelLinear(nn.Module):
         if qscale is not None:
             from neuronx_distributed_tpu.quantization.utils import int8_matmul
 
-            y = int8_matmul(x, kernel, qscale, self.dtype)
+            y = int8_matmul(x, kernel, qscale, self.dtype, act_scale=act_scale)
         else:
             y = jax.lax.dot_general(
                 x, kernel, (((x.ndim - 1,), (0,)), ((), ())), precision=None

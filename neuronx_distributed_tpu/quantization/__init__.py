@@ -17,6 +17,11 @@ from neuronx_distributed_tpu.quantization.layers import (
     QuantizedExpertFusedRowParallel,
     QuantizedRowParallel,
 )
+from neuronx_distributed_tpu.quantization.observer import (
+    PerChannelAbsMaxObserver,
+    PerTensorAbsMaxObserver,
+    calibrate_activation_scale,
+)
 from neuronx_distributed_tpu.quantization.utils import (
     dequantize,
     direct_cast_quantize,
@@ -27,11 +32,14 @@ __all__ = [
     "QuantizationConfig",
     "QuantizationType",
     "QuantizedDtype",
+    "PerChannelAbsMaxObserver",
+    "PerTensorAbsMaxObserver",
     "QuantizedColumnParallel",
     "QuantizedExpertFusedColumnParallel",
     "QuantizedExpertFusedRowParallel",
     "QuantizedRowParallel",
     "direct_cast_quantize",
+    "calibrate_activation_scale",
     "dequantize",
     "quantize_param_tree",
 ]

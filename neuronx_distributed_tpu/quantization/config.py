@@ -52,3 +52,9 @@ class QuantizationConfig:
     # error (~1e-2 relative) on top of the weight quant the dequant path
     # already has — gate on your accuracy-check mode before enabling.
     use_int8_matmul: bool = False
+    # with use_int8_matmul: declare a per-linear scalar ``act_scale`` param
+    # (init 1.0) used as a STATIC activation scale instead of the per-token
+    # dynamic absmax. Fill the leaves from a calibration pass
+    # (observer.calibrate_activation_scale on each linear's input); the
+    # dynamic path needs no calibration and is the default.
+    use_static_act_scale: bool = False

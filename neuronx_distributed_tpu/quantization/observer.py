@@ -1,0 +1,95 @@
+"""Calibration observers (reference: ``quantization/observer.py``
+``PerChannelAbsMaxObserver:12`` — a torch.ao observer recording running
+per-channel abs-max and deriving symmetric scales).
+
+The TPU-native formulation is functional: an observer is (init, observe,
+scale) over an explicit state array — jit/scan friendly, no module state.
+Weight-only serving quantization doesn't need calibration (absmax over a
+trained checkpoint IS the converged observer — ``quantize_param_tree``), so
+these exist for the flows that do:
+
+* **static activation quantization** for the int8 MXU path: run a
+  calibration set through the float model, observe each linear's input,
+  and serve with ``int8_matmul(..., act_scale=...)`` — removing the
+  per-token dynamic absmax (one less reduction per matmul, exact
+  reproducibility across batches);
+* QAT-style running statistics, where scales must aggregate over steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.quantization.config import QuantizedDtype
+
+# floor the ABSMAX (not the scale) at the same value quantize_param_tree
+# uses, so a scale derived by calibration equals one derived by the offline
+# converter bit-for-bit — including dead/pruned all-zero channels
+_ABSMAX_FLOOR = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class PerChannelAbsMaxObserver:
+    """Running per-channel abs-max → symmetric per-channel scales
+    (reference observer.py:12 semantics: running max of ``|x|`` per channel,
+    ``scale = max_val / quant_max``).
+
+    ``ch_axis`` indexes the CHANNEL dim of observed tensors; all other dims
+    reduce. State is a (channels,) fp32 array. Used for WEIGHT-range
+    statistics (where per-out-channel scales are servable); activation
+    calibration for ``int8_matmul`` is per-tensor — see
+    :func:`calibrate_activation_scale`."""
+
+    ch_axis: int = 0
+    quantized_dtype: QuantizedDtype = QuantizedDtype.INT8
+
+    def init(self, num_channels: int) -> jax.Array:
+        return jnp.zeros((num_channels,), jnp.float32)
+
+    def observe(self, state: jax.Array, x: jax.Array) -> jax.Array:
+        axes = tuple(i for i in range(x.ndim) if i != self.ch_axis % x.ndim)
+        batch_max = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axes)
+        return jnp.maximum(state, batch_max)
+
+    def scale(self, state: jax.Array) -> jax.Array:
+        return jnp.maximum(state, _ABSMAX_FLOOR) / self.quantized_dtype.max_value
+
+
+@dataclasses.dataclass(frozen=True)
+class PerTensorAbsMaxObserver:
+    """Running whole-tensor abs-max → one symmetric scale (the per-tensor
+    qscheme of the reference's qconfig dicts, quantization_config.py:39)."""
+
+    quantized_dtype: QuantizedDtype = QuantizedDtype.INT8
+
+    def init(self) -> jax.Array:
+        return jnp.zeros((), jnp.float32)
+
+    def observe(self, state: jax.Array, x: jax.Array) -> jax.Array:
+        return jnp.maximum(state, jnp.max(jnp.abs(x.astype(jnp.float32))))
+
+    def scale(self, state: jax.Array) -> jax.Array:
+        return jnp.maximum(state, _ABSMAX_FLOOR) / self.quantized_dtype.max_value
+
+
+def calibrate_activation_scale(batches) -> jax.Array:
+    """Fold a calibration iterable of activations into ONE static per-tensor
+    int8 scale for ``quantization.utils.int8_matmul(act_scale=...)`` (or the
+    ``act_scale`` param leaf declared by
+    ``QuantizationConfig(use_static_act_scale=True)``).
+
+    Per-tensor and int8 by construction: ``int8_matmul`` quantizes to the
+    ±127 grid, and a per-CONTRACTION-channel activation scale has no valid
+    scalar epilogue in its ``acc · sx · w_scale`` factorization (the sum
+    over the contraction dim mixes channels)."""
+    obs = PerTensorAbsMaxObserver(QuantizedDtype.INT8)
+    state = None
+    for x in batches:
+        if state is None:
+            state = obs.init()
+        state = obs.observe(state, x)
+    if state is None:
+        raise ValueError("empty calibration iterable")
+    return obs.scale(state)

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from neuronx_distributed_tpu.quantization.config import (
     QuantizationConfig,
     QuantizationType,
+    QuantizedDtype,
 )
 
 
@@ -139,13 +140,29 @@ def quantize_param_tree(
             q, _ = direct_cast_quantize(leaf, cfg, scale=s_b)
             node[keys[-1]] = q
             node[scale_name] = s
+            # static-activation serving (use_static_act_scale): the model
+            # declares a scalar act_scale sibling per int8-MXU-eligible
+            # kernel (2-D, int8) — seed it at 1.0 so the converted tree
+            # matches the declaration; a calibration pass overwrites it
+            # (observer.calibrate_activation_scale on each linear's input)
+            if (
+                getattr(cfg, "use_static_act_scale", False)
+                and getattr(cfg, "use_int8_matmul", False)
+                and leaf.ndim == 2
+                and cfg.quantized_dtype == QuantizedDtype.INT8
+            ):
+                act_name = (
+                    "act_scale" if keys[-1] == "kernel"
+                    else keys[-1] + "_act_scale"
+                )
+                node[act_name] = jnp.ones((), jnp.float32)
         else:
             node[keys[-1]] = leaf
     return rebuilt
 
 
 def int8_matmul(x: jax.Array, kernel_q: jax.Array, scale: jax.Array,
-                out_dtype: Any) -> jax.Array:
+                out_dtype: Any, act_scale: Optional[jax.Array] = None) -> jax.Array:
     """Native int8 MXU matmul (VERDICT r4 next #6; reference forward is
     dequant-then-matmul, quantization_layers.py:376): dynamically quantize
     the activations per token (symmetric absmax → int8), run the GEMM as
@@ -157,10 +174,18 @@ def int8_matmul(x: jax.Array, kernel_q: jax.Array, scale: jax.Array,
     ``kernel_q`` (in, out) int8; ``scale`` () per-tensor or (1, out)
     per-channel. Under tp the contracted-dim absmax lowers to a max
     collective for row-parallel inputs (exact — all shards quantize with the
-    same per-token scale)."""
+    same per-token scale).
+
+    ``act_scale``: a STATIC activation scale (scalar, from
+    ``observer.calibrate_activation_scale`` on a calibration set) replaces
+    the dynamic per-token absmax — one less reduction per matmul and
+    batch-independent numerics, at the cost of calibration coverage."""
     xf = x.astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-    sx = jnp.maximum(absmax, 1e-8) / 127.0
+    if act_scale is not None:
+        sx = jnp.maximum(jnp.asarray(act_scale, jnp.float32), 1e-8)
+    else:
+        absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        sx = jnp.maximum(absmax, 1e-8) / 127.0
     qx = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
     acc = jax.lax.dot_general(
         qx, kernel_q, (((x.ndim - 1,), (0,)), ((), ())),

@@ -171,3 +171,145 @@ def test_per_expert_scales_beat_shared_scales():
     err_pe = np.abs(np.asarray(dequantize(*direct_cast_quantize(w, per_expert))) - np.asarray(w))
     err_sh = np.abs(np.asarray(dequantize(*direct_cast_quantize(w, shared))) - np.asarray(w))
     assert err_pe[1:].max() < err_sh[1:].max() / 10
+
+
+# --- observers (reference observer.py PerChannelAbsMaxObserver) --------------
+
+
+def test_per_channel_observer_running_absmax():
+    from neuronx_distributed_tpu.quantization.observer import (
+        PerChannelAbsMaxObserver,
+    )
+
+    obs = PerChannelAbsMaxObserver(ch_axis=1)
+    state = obs.init(3)
+    b1 = jnp.asarray([[1.0, -2.0, 0.5], [0.1, 1.0, -4.0]])
+    b2 = jnp.asarray([[-3.0, 0.5, 0.5], [0.0, 0.5, 1.0]])
+    state = obs.observe(obs.observe(state, b1), b2)
+    np.testing.assert_allclose(np.asarray(state), [3.0, 2.0, 4.0])
+    np.testing.assert_allclose(
+        np.asarray(obs.scale(state)), np.asarray([3.0, 2.0, 4.0]) / 127.0
+    )
+
+
+def test_observer_scale_matches_quantize_param_tree():
+    """The converged observer over a tensor equals quantize_param_tree's
+    direct absmax scale — the contract that makes calibration and offline
+    conversion interchangeable."""
+    from neuronx_distributed_tpu.quantization.observer import (
+        PerChannelAbsMaxObserver,
+    )
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    qcfg = QuantizationConfig()
+    tree = quantize_param_tree({"params": {"lin": {"kernel": w}}}, qcfg)
+    direct_scale = np.asarray(tree["params"]["lin"]["scale"]).reshape(-1)
+    obs = PerChannelAbsMaxObserver(ch_axis=1)
+    obs_scale = np.asarray(obs.scale(obs.observe(obs.init(8), w)))
+    np.testing.assert_allclose(obs_scale, direct_scale, rtol=1e-6)
+
+
+def test_static_activation_scale_int8_matmul():
+    from neuronx_distributed_tpu.quantization.observer import (
+        calibrate_activation_scale,
+    )
+    from neuronx_distributed_tpu.quantization.utils import int8_matmul
+
+    key = jax.random.PRNGKey(1)
+    xs = [jax.random.normal(jax.random.fold_in(key, i), (4, 32)) for i in range(3)]
+    act_scale = calibrate_activation_scale(xs)
+    w = jax.random.normal(jax.random.PRNGKey(2), (32, 16))
+    wq = jnp.clip(jnp.round(w / (jnp.abs(w).max(0) / 127.0)), -127, 127).astype(jnp.int8)
+    wscale = (jnp.abs(w).max(0) / 127.0)[None]
+    golden = xs[0] @ (wq.astype(jnp.float32) * wscale)
+    out = int8_matmul(xs[0], wq, wscale, jnp.float32, act_scale=act_scale)
+    # static-scale path stays within int8 activation-quant error of the
+    # dequant product
+    rel = np.abs(np.asarray(out) - np.asarray(golden)).max() / np.abs(
+        np.asarray(golden)
+    ).max()
+    assert rel < 0.05, rel
+
+
+
+def test_observer_floor_matches_converter_on_dead_channels():
+    """All-zero (pruned) channels: observer scale == quantize_param_tree scale
+    bit-for-bit — the interchangeability contract includes the floor."""
+    from neuronx_distributed_tpu.quantization.observer import (
+        PerChannelAbsMaxObserver,
+    )
+
+    w = jnp.zeros((16, 4)).at[:, 1].set(2.0)  # channels 0/2/3 dead
+    qcfg = QuantizationConfig()
+    tree = quantize_param_tree({"params": {"lin": {"kernel": w}}}, qcfg)
+    direct = np.asarray(tree["params"]["lin"]["scale"]).reshape(-1)
+    obs = PerChannelAbsMaxObserver(ch_axis=1)
+    got = np.asarray(obs.scale(obs.observe(obs.init(4), w)))
+    np.testing.assert_array_equal(got, direct)
+
+
+def test_static_act_scale_layer_path():
+    """use_static_act_scale declares the act_scale leaf and the linear uses
+    it: with a calibrated scale the output matches the dynamic path closely;
+    with the 1.0 default it differs (proving the leaf is live)."""
+    import dataclasses
+
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.quantization.observer import (
+        calibrate_activation_scale,
+    )
+
+    mesh_lib.destroy_model_parallel()
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+    qdyn = QuantizationConfig(use_int8_matmul=True)
+    qstat = dataclasses.replace(qdyn, use_static_act_scale=True)
+    lin_dyn = ColumnParallelLinear(
+        16, 8, use_bias=False, quantization_config=qdyn, dtype=jnp.float32
+    )
+    lin_stat = ColumnParallelLinear(
+        16, 8, use_bias=False, quantization_config=qstat, dtype=jnp.float32
+    )
+    params = meta.unbox(lin_stat.init(jax.random.PRNGKey(1), x))
+    assert params["params"]["act_scale"].shape == ()
+    # fill the kernel with real quantized weights + the act_scale leaf
+    w = jax.random.normal(jax.random.PRNGKey(2), (16, 8))
+    qtree = quantize_param_tree({"params": {"kernel": w}}, qdyn)
+    params["params"]["kernel"] = qtree["params"]["kernel"]
+    params["params"]["scale"] = qtree["params"]["scale"].reshape(
+        params["params"]["scale"].shape
+    )
+    params["params"]["act_scale"] = calibrate_activation_scale([x])
+    dyn_params = {"params": {k: v for k, v in params["params"].items()
+                             if k != "act_scale"}}
+    y_dyn = np.asarray(lin_dyn.apply(dyn_params, x))
+    y_stat = np.asarray(lin_stat.apply(params, x))
+    denom = np.abs(y_dyn).max()
+    assert np.abs(y_stat - y_dyn).max() / denom < 0.02
+    # the default (uncalibrated) scale gives a different answer — leaf is live
+    params["params"]["act_scale"] = jnp.asarray(1.0)
+    y_default = np.asarray(lin_stat.apply(params, x))
+    assert np.abs(y_default - y_stat).max() / denom > 1e-4
+
+
+def test_quantize_param_tree_seeds_act_scale_leaves():
+    """With use_static_act_scale the converter emits act_scale siblings, so
+    the converted tree applies to the declaring model directly."""
+    import dataclasses
+
+    from flax.core import meta
+
+    mesh_lib.destroy_model_parallel()
+    qcfg = QuantizationConfig(use_int8_matmul=True, use_static_act_scale=True)
+    lin = ColumnParallelLinear(
+        16, 8, use_bias=False, quantization_config=qcfg, dtype=jnp.float32
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+    w = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    tree = quantize_param_tree({"params": {"kernel": w}}, qcfg)
+    assert tree["params"]["act_scale"].shape == ()
+    # structure equals the model declaration — applies without surgery
+    want = meta.unbox(jax.eval_shape(lin.init, jax.random.PRNGKey(2), x))
+    assert set(tree["params"]) == set(want["params"])
+    y = lin.apply(tree, x)
+    assert np.isfinite(np.asarray(y)).all()
