@@ -155,12 +155,17 @@ def _declare_kernel_q(module, shape, partition, kernel_init, dtype,
         batch_dim,
     )
     act_scale = None
-    if getattr(qcfg, "use_static_act_scale", False):
+    from neuronx_distributed_tpu.quantization.utils import (
+        act_scale_leaf_name,
+        wants_static_act_scale,
+    )
+
+    if wants_static_act_scale(qcfg):
         # scalar static activation scale, filled by a calibration pass
         # (observer.calibrate_activation_scale); init 1.0 keeps an
         # uncalibrated model runnable (clips at |x| > 127)
         act_scale = module.param(
-            ("act_scale" if name == "kernel" else name + "_act_scale"),
+            act_scale_leaf_name(name),
             nn.with_partitioning(nn.initializers.ones_init(), ()),
             (),
             jnp.float32,

@@ -17,6 +17,23 @@ from neuronx_distributed_tpu.quantization.config import (
 )
 
 
+def wants_static_act_scale(cfg) -> bool:
+    """ONE copy of the static-activation-scale eligibility predicate, shared
+    by the model-side declaration (parallel/layers._declare_kernel_q) and
+    the converter (quantize_param_tree): int8 MXU path + the static flag."""
+    return (
+        getattr(cfg, "use_int8_matmul", False)
+        and getattr(cfg, "use_static_act_scale", False)
+        and cfg.quantized_dtype == QuantizedDtype.INT8
+    )
+
+
+def act_scale_leaf_name(kernel_name: str) -> str:
+    """ONE copy of the act_scale sibling-naming rule (mirrors the weight
+    scale's ``scale`` / ``<name>_scale`` convention)."""
+    return "act_scale" if kernel_name == "kernel" else kernel_name + "_act_scale"
+
+
 def absmax_scale(w: jax.Array, cfg: QuantizationConfig) -> jax.Array:
     """Symmetric abs-max scale (reference PerChannelAbsMaxObserver,
     observer.py:12): per-tensor scalar or per-channel vector on
@@ -141,21 +158,17 @@ def quantize_param_tree(
             node[keys[-1]] = q
             node[scale_name] = s
             # static-activation serving (use_static_act_scale): the model
-            # declares a scalar act_scale sibling per int8-MXU-eligible
-            # kernel (2-D, int8) — seed it at 1.0 so the converted tree
-            # matches the declaration; a calibration pass overwrites it
-            # (observer.calibrate_activation_scale on each linear's input)
-            if (
-                getattr(cfg, "use_static_act_scale", False)
-                and getattr(cfg, "use_int8_matmul", False)
-                and leaf.ndim == 2
-                and cfg.quantized_dtype == QuantizedDtype.INT8
-            ):
-                act_name = (
-                    "act_scale" if keys[-1] == "kernel"
-                    else keys[-1] + "_act_scale"
+            # declares a scalar act_scale sibling per int8-MXU linear —
+            # which nn.scan stacks to (L,) — so seed leaf.shape[:-2] ones
+            # for every ``kernel`` leaf; a calibration pass overwrites them
+            # (observer.calibrate_activation_scale on each linear's input).
+            # Leaves the dequant paths ignore (e.g. the fused QKV) get a
+            # harmless extra sibling; expert stacks (named *_proj) are
+            # excluded like the model side excludes batch_dim kernels.
+            if wants_static_act_scale(cfg) and keys[-1] == "kernel":
+                node[act_scale_leaf_name(keys[-1])] = jnp.ones(
+                    leaf.shape[:-2], jnp.float32
                 )
-                node[act_name] = jnp.ones((), jnp.float32)
         else:
             node[keys[-1]] = leaf
     return rebuilt

@@ -313,3 +313,33 @@ def test_quantize_param_tree_seeds_act_scale_leaves():
     assert set(tree["params"]) == set(want["params"])
     y = lin.apply(tree, x)
     assert np.isfinite(np.asarray(y)).all()
+
+
+def test_scanned_model_static_act_scale_tree_applies():
+    """nn.scan stacks the per-layer act_scale to (L,): the converter seeds
+    matching leaves so a scanned static-act-scale model applies the
+    converted tree directly (round-5 review regression)."""
+    import dataclasses
+
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+
+    mesh_lib.destroy_model_parallel()
+    qcfg = QuantizationConfig(use_int8_matmul=True, use_static_act_scale=True)
+    cfg = tiny_llama(scan_layers=True)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+    fmodel = LlamaForCausalLM(cfg, attention_impl="xla")
+    fparams = meta.unbox(jax.jit(fmodel.init)(jax.random.PRNGKey(1), ids))
+    qparams = quantize_param_tree(fparams, qcfg)
+    # stacked (L,) act_scale leaves exist on the scanned MLP linears
+    mlp = qparams["params"]["model"]["layers"]["layer"]["mlp"]["gate_proj"]
+    assert mlp["act_scale"].shape == (cfg.num_layers,)
+    qmodel = LlamaForCausalLM(
+        dataclasses.replace(cfg, quantization=qcfg), attention_impl="xla"
+    )
+    logits = qmodel.apply(qparams, ids)
+    assert np.isfinite(np.asarray(logits)).all()
