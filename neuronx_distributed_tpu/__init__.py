@@ -41,6 +41,7 @@ def __getattr__(name):
         "inference",
         "optim",
         "pipeline",
+        "serving",
         "trainer",
         "scripts",
     ):

@@ -7,6 +7,12 @@ and yields the first sampled token; then a single jitted ``lax.scan`` runs all
 decode steps on device — cache, sampling keys, and the EOS done-mask stay in
 the carry, so there is no host round-trip per token (the reference's async
 SPMDModel forward serves the same purpose).
+
+The building blocks (mode clones, validation, the decode write mask, the
+unwrap/sample plumbing) are shared with the request-level continuous-batching
+engine in :mod:`neuronx_distributed_tpu.serving` — `generate` is the one-shot
+batch view, the engine the slot-based streaming view, over the same prefill
+and decode-step math.
 """
 
 from __future__ import annotations
@@ -29,6 +35,57 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
 
 
+def serving_clones(model):
+    """``(prefill, decode)`` mode clones sharing the caller's params — the
+    pair every serving loop (batch `generate`, the continuous-batching
+    engine, speculative verify) builds its steps from."""
+    return model.clone(mode="prefill"), model.clone(mode="decode")
+
+
+def decode_write_mask(done: jax.Array) -> jax.Array:
+    """Validity (B, 1) of the INCOMING decode-step token: rows that already
+    finished feed filler tokens whose K/V must not become attendable context
+    for the rest of their generation (KVCache.decode_write persists this via
+    ``kv_valid``; ADVICE round 5)."""
+    return jnp.logical_not(done)[:, None]
+
+
+def validate_generate_args(model, prompt_ids, max_new_tokens, attention_mask):
+    """Host-side checks shared by `generate` and the serving engine's
+    admission path: capacity (prompt + new tokens within the cache) and the
+    LEFT-padding contract of ``attention_mask``. Tracer masks skip the
+    padding check — it needs host values, and forcing a device sync (or a
+    TracerError under jit/vmap wrapping) for validation is worse than
+    trusting a caller that is already inside a traced context."""
+    model_cfg = getattr(model, "config", None)
+    max_len = getattr(model_cfg, "max_seq_len", None)
+    if max_len is not None and prompt_ids.shape[1] + max_new_tokens > max_len:
+        # past max_seq_len the cache write index and RoPE positions would
+        # clamp and silently corrupt generation
+        raise ValueError(
+            f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) exceeds the model's max_seq_len ({max_len})"
+        )
+    if attention_mask is not None:
+        if attention_mask.shape != prompt_ids.shape:
+            raise ValueError(
+                f"attention_mask shape {attention_mask.shape} != prompt_ids "
+                f"shape {prompt_ids.shape}"
+            )
+        if isinstance(attention_mask, jax.core.Tracer):
+            return
+        import numpy as np
+
+        if not bool(np.asarray(attention_mask)[:, -1].all()):
+            # right padding would make _logits[:, -1] a pad-slot query and
+            # silently corrupt the whole continuation
+            raise ValueError(
+                "attention_mask has invalid tokens in the LAST column — "
+                "generate() requires LEFT padding (every row's final prompt "
+                "token at index -1)"
+            )
+
+
 def generate(
     model,
     params,
@@ -48,33 +105,8 @@ def generate(
     positions restart at each row's first valid token — no per-row offset
     bookkeeping in this loop."""
     cfg = config
-    model_cfg = getattr(model, "config", None)
-    max_len = getattr(model_cfg, "max_seq_len", None)
-    if max_len is not None and prompt_ids.shape[1] + cfg.max_new_tokens > max_len:
-        # past max_seq_len the cache write index and RoPE positions would
-        # clamp and silently corrupt generation
-        raise ValueError(
-            f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
-            f"({cfg.max_new_tokens}) exceeds the model's max_seq_len ({max_len})"
-        )
-    if attention_mask is not None:
-        import numpy as np
-
-        if attention_mask.shape != prompt_ids.shape:
-            raise ValueError(
-                f"attention_mask shape {attention_mask.shape} != prompt_ids "
-                f"shape {prompt_ids.shape}"
-            )
-        if not bool(np.asarray(attention_mask)[:, -1].all()):
-            # right padding would make _logits[:, -1] a pad-slot query and
-            # silently corrupt the whole continuation
-            raise ValueError(
-                "attention_mask has invalid tokens in the LAST column — "
-                "generate() requires LEFT padding (every row's final prompt "
-                "token at index -1)"
-            )
-    prefill = model.clone(mode="prefill")
-    decode = model.clone(mode="decode")
+    validate_generate_args(model, prompt_ids, cfg.max_new_tokens, attention_mask)
+    prefill, decode = serving_clones(model)
     b = prompt_ids.shape[0]
 
     def _sample(logits, k):
@@ -104,8 +136,12 @@ def generate(
         def step(carry, _):
             cache, tok, key, done = carry
             key, sub = jax.random.split(key)
+            # post-EOS filler tokens write masked-invalid K/V: they must not
+            # extend still-running rows' bookkeeping (valid_count_below) nor
+            # this row's attendable context (ADVICE round 5)
             out, variables = decode.apply(
-                {**params, "cache": cache}, tok[:, None], mutable=["cache"]
+                {**params, "cache": cache}, tok[:, None],
+                padding_mask=decode_write_mask(done), mutable=["cache"]
             )
             nxt = _sample(_logits(out)[:, -1], sub)
             if cfg.eos_token_id is not None:

@@ -209,7 +209,13 @@ def flash_decode_attention(
     from jax.sharding import PartitionSpec as P
 
     bspec = mesh_lib.DATA_AXES if (dp > 1 and b % dp == 0) else None
-    if tp <= 1 or h % tp != 0:
+
+    def replicated_over_tp():
+        # batch over dp, heads/length replicated over tp. Also the fallback
+        # for irregular geometries below: a bare _flash_decode_call on
+        # global arrays under an active mesh would ask GSPMD to partition a
+        # Mosaic custom call, which it cannot (ADVICE round 5) — every
+        # kernel launch under a mesh must go through manual_shard_map.
         spec = P(bspec, None, None, None)
         fn = mesh_lib.manual_shard_map(
             lambda a, b_, c, p_, kv: _flash_decode_call(
@@ -221,6 +227,9 @@ def flash_decode_attention(
         out = fn(qt, kt, vt, rows_pos,
                  kv_valid if kv_valid is not None else jnp.ones((b, L), jnp.int32))
         return unfold(out)
+
+    if tp <= 1 or h % tp != 0:
+        return replicated_over_tp()
 
     if hkv % tp == 0:
         # kv heads shard cleanly over tp
@@ -240,11 +249,10 @@ def flash_decode_attention(
     # tp > hkv (or hkv % tp != 0): split the cache length over tp and merge
     # the partials — every core scans L/tp slots of every kv head
     if L % tp != 0:
-        # irregular: fall back to the unsharded kernel (replicated over tp)
-        out, _ = _flash_decode_call(
-            qt, kt, vt, rows_pos, kv_valid, 0, interpret, block_l
-        )
-        return unfold(out)
+        # irregular: replicate over tp through the SAME manual region as the
+        # tp<=1 branch (the bare kernel call would fail to compile on
+        # tp-sharded inputs — Mosaic calls can't be auto-partitioned)
+        return replicated_over_tp()
 
     def per_rank(a, k_, v_, p_, kv):
         rank = jax.lax.axis_index(mesh_lib.TP_AXIS)

@@ -268,6 +268,60 @@ class KVCache:
         self.valid.value = jax.lax.dynamic_update_slice(self.valid.value, new_valid, (0, cur))
 
 
+# --- cache-collection slot helpers (serving) ----------------------------------
+#
+# The continuous-batching engine (serving/) owns ONE cache collection whose
+# batch rows are request SLOTS. These helpers operate on the raw collection
+# tree (outside a flax apply), classified by leaf name — the same contract
+# KVCache declares: k/v (..., B, L, Hkv, D), kv_valid (..., B, L), index
+# scalar cursor (nn.scan stacks a leading layer axis on each).
+
+def cache_leaf_name(path) -> str:
+    """Terminal key of a cache-collection tree path (DictKey or str)."""
+    last = path[-1]
+    return last.key if hasattr(last, "key") else str(last)
+
+
+def cache_batch_axis(name: str, ndim: int):
+    """Batch(slot)-axis index of a cache leaf, or None for the shared
+    ``index`` cursor. Leading layer axes from nn.scan stacking shift the
+    batch axis right, so classify from the TRAILING dims."""
+    if name in ("k", "v"):
+        return ndim - 4
+    if name == "kv_valid":
+        return ndim - 2
+    return None
+
+
+def reset_cache_slot(cache, slot):
+    """Free one batch row of a cache collection: clear its ``kv_valid`` so
+    nothing in the row stays attendable (per-slot reset on request free —
+    no full-cache reallocation). K/V storage is left in place; the next
+    admission overwrites the whole row."""
+    def fn(path, leaf):
+        name = cache_leaf_name(path)
+        if name != "kv_valid":
+            return leaf
+        ax = cache_batch_axis(name, leaf.ndim)
+        zero = jnp.zeros_like(jax.lax.index_in_dim(leaf, 0, ax, keepdims=True))
+        return jax.lax.dynamic_update_slice_in_dim(leaf, zero, slot, ax)
+
+    return jax.tree_util.tree_map_with_path(fn, cache)
+
+
+def reset_cache(cache):
+    """Clear every slot's validity AND rewind the shared write cursor —
+    the serving engine's drain/preemption reset (the storage itself is
+    reused, never reallocated)."""
+    def fn(path, leaf):
+        name = cache_leaf_name(path)
+        if name in ("kv_valid", "index"):
+            return jnp.zeros_like(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fn, cache)
+
+
 # cache length at which decode switches from the fused einsum to the Pallas
 # flash-decode kernel on TPU: below this the (s, L) score tensor is small and
 # the einsum path's simplicity wins; above it the kernel's single streaming

@@ -34,6 +34,19 @@ def act_scale_leaf_name(kernel_name: str) -> str:
     return "act_scale" if kernel_name == "kernel" else kernel_name + "_act_scale"
 
 
+def kernel_act_scale_eligible(keys, leaf) -> bool:
+    """Tree-side mirror of ``_declare_kernel_q``'s STRUCTURAL eligibility
+    (``batch_dim is None and len(shape) == 2``): only ``kernel`` leaves
+    declared as plain 2-D matmuls ever get an ``act_scale`` sibling on the
+    model side. ``nn.scan`` stacks ONE leading layer axis onto such a
+    kernel (ndim 3, act_scale stacked to ``(L,)``); anything else — expert
+    stacks (named ``*_proj``, declared with ``batch_dim=0``), higher-rank
+    stacks — keeps the dequant path, and seeding a sibling for it would
+    make the converted tree's STRUCTURE diverge from ``model.init`` in
+    checkpoint round-trips and optimizer-state mapping."""
+    return keys[-1] == "kernel" and leaf.ndim in (2, 3)
+
+
 def absmax_scale(w: jax.Array, cfg: QuantizationConfig) -> jax.Array:
     """Symmetric abs-max scale (reference PerChannelAbsMaxObserver,
     observer.py:12): per-tensor scalar or per-channel vector on
@@ -160,12 +173,11 @@ def quantize_param_tree(
             # static-activation serving (use_static_act_scale): the model
             # declares a scalar act_scale sibling per int8-MXU linear —
             # which nn.scan stacks to (L,) — so seed leaf.shape[:-2] ones
-            # for every ``kernel`` leaf; a calibration pass overwrites them
+            # for exactly the kernels the model side declares one for
+            # (kernel_act_scale_eligible mirrors _declare_kernel_q's 2-D,
+            # non-batch_dim rule); a calibration pass overwrites them
             # (observer.calibrate_activation_scale on each linear's input).
-            # Leaves the dequant paths ignore (e.g. the fused QKV) get a
-            # harmless extra sibling; expert stacks (named *_proj) are
-            # excluded like the model side excludes batch_dim kernels.
-            if wants_static_act_scale(cfg) and keys[-1] == "kernel":
+            if wants_static_act_scale(cfg) and kernel_act_scale_eligible(keys, leaf):
                 node[act_scale_leaf_name(keys[-1])] = jnp.ones(
                     leaf.shape[:-2], jnp.float32
                 )

@@ -1,0 +1,39 @@
+"""Request-level serving engine: continuous batching over a fixed-shape
+decode step (ROADMAP north star — serving heavy traffic needs an engine that
+admits/retires REQUESTS, not a batch `generate()` call; cf. the TPU serving
+stacks in PAPERS.md, which all converge on slot-based continuous batching so
+XLA compiles the decode step once and requests flow through slots).
+
+Layers:
+
+* :mod:`engine` — ``ServingEngine``: the host-side loop interleaving prefill
+  of admitted requests with ONE jitted fixed-shape decode step over all
+  active slots.
+* :mod:`scheduler` — FIFO + longest-prefill-first admission with a
+  token-budget guard and the request lifecycle
+  (QUEUED→PREFILL→DECODE→DONE/CANCELLED).
+* :mod:`cache_manager` — slot allocation/roll-in/reset on top of the
+  ``modules/attention.KVCache`` collection layout (no reallocation between
+  requests).
+* :mod:`metrics` — TTFT / decode throughput / queue wait / occupancy /
+  preemption counters, exported as a plain dict snapshot and (optionally)
+  onto a ``utils.timeline.Timeline``.
+"""
+
+from neuronx_distributed_tpu.serving.cache_manager import SlotCacheManager
+from neuronx_distributed_tpu.serving.engine import ServingEngine
+from neuronx_distributed_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+
+__all__ = [
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "ServingEngine",
+    "ServingMetrics",
+    "SlotCacheManager",
+]

@@ -1,0 +1,148 @@
+"""Slot-level KV-cache management for the serving engine.
+
+The engine owns ONE cache collection shaped ``(num_slots, max_seq_len)``
+(per layer), allocated once and never reallocated between requests. Batch
+rows are request SLOTS; the column layout is the shared-cursor scheme the
+repo's KV cache already speaks for left-padded batches:
+
+* ``index`` is a SINGLE write cursor shared by every slot (the KVCache
+  contract). All active slots write their decode K/V at the same column.
+* A newly admitted request's prompt is placed so its LAST token sits at
+  column ``cursor - 1`` — exactly the left-padded layout, produced by
+  rolling the batch-1 prefill cache row right by ``cursor - P`` (P = the
+  padded prefill length whose index the row carries).
+* Columns a slot does not cover are ``kv_valid=False``; per-row attention
+  masking and RoPE positions already run off validity counts
+  (``valid_count_below``), so gap columns and cursor jumps are invisible to
+  the math. Raising the cursor past a slot's last write merely leaves
+  invalid gap columns behind — which is how a LONG prompt can be admitted
+  next to slots that joined earlier.
+* Freeing a slot clears its ``kv_valid`` row (``reset_cache_slot``);
+  draining the engine rewinds the cursor (``reset_cache``). Storage is
+  reused in place.
+
+The cursor therefore advances monotonically while any slot is active; the
+engine preempts-and-rewinds when it would run off ``max_seq_len`` (see
+``ServingEngine``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_tpu.modules.attention import (
+    cache_batch_axis,
+    cache_leaf_name,
+    reset_cache,
+    reset_cache_slot,
+)
+
+
+def _admit_row(big, row, slot, padded_len, cursor):
+    """Merge a batch-1 prefill cache ``row`` into ``big`` at batch index
+    ``slot``, rolled so the prompt's last token lands at column
+    ``cursor - 1``. ``index`` leaves are set to ``cursor`` (the shared
+    cursor may jump forward to fit a long prompt; gap columns stay
+    invalid for every row)."""
+    shift = cursor - padded_len
+
+    def fn(path, b_leaf, r_leaf):
+        name = cache_leaf_name(path)
+        ax = cache_batch_axis(name, b_leaf.ndim)
+        if ax is None:  # shared write cursor
+            return jnp.full_like(b_leaf, cursor)
+        # k/v (..., B, L, Hkv, D) and kv_valid (..., B, L): the cache-length
+        # axis sits right after the batch axis in both layouts
+        r = jnp.roll(r_leaf, shift, axis=ax + 1)
+        return jax.lax.dynamic_update_slice_in_dim(b_leaf, r, slot, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(fn, big, row)
+
+
+class SlotCacheManager:
+    """Host-side owner of the engine's cache collection + slot free list.
+
+    All device work is three jitted programs compiled once each:
+    admission roll-in, per-slot free, and full reset."""
+
+    def __init__(self, num_slots: int):
+        self.num_slots = num_slots
+        self.cache = None  # allocated lazily from the first prefill row
+        self.cursor = 0  # host mirror of the shared `index` cursor
+        self._free = list(range(num_slots))
+        self._admit_fn = jax.jit(_admit_row)
+        self._free_fn = jax.jit(reset_cache_slot)
+        self._reset_fn = jax.jit(reset_cache)
+
+    # --- slot accounting ---------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def acquire(self) -> int:
+        return self._free.pop(0)
+
+    # --- device-state transitions ------------------------------------------
+
+    def allocate_from(self, row_cache) -> None:
+        """Build the (num_slots, …) cache collection from a batch-1 prefill
+        row's structure — zeros everywhere; happens exactly once."""
+
+        def fn(path, r_leaf):
+            name = cache_leaf_name(path)
+            ax = cache_batch_axis(name, r_leaf.ndim)
+            if ax is None:
+                return jnp.zeros_like(r_leaf)
+            shape = list(r_leaf.shape)
+            shape[ax] = self.num_slots
+            return jnp.zeros(tuple(shape), r_leaf.dtype)
+
+        self.cache = jax.tree_util.tree_map_with_path(fn, row_cache)
+
+    def admit(self, row_cache, slot: int, padded_len: int,
+              cursor: Optional[int] = None) -> None:
+        """Roll a prefill row into ``slot``. ``cursor`` (default: keep, but
+        never below ``padded_len``) becomes the new shared write cursor."""
+        if self.cache is None:
+            self.allocate_from(row_cache)
+        target = max(self.cursor, padded_len) if cursor is None else cursor
+        if target < padded_len:
+            raise ValueError(
+                f"cursor {target} < padded prefill length {padded_len}: the "
+                "prompt's last token cannot land left of its own start"
+            )
+        self.cache = self._admit_fn(
+            self.cache, row_cache,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(padded_len, jnp.int32),
+            jnp.asarray(target, jnp.int32),
+        )
+        self.cursor = target
+
+    def free(self, slot: int) -> None:
+        """Clear the slot's ``kv_valid`` row and return it to the free list
+        — immediately re-admittable, no reallocation."""
+        if self.cache is not None:
+            self.cache = self._free_fn(self.cache, jnp.asarray(slot, jnp.int32))
+        self._free.append(slot)
+        self._free.sort()
+
+    def update_after_decode(self, new_cache) -> None:
+        """Adopt the cache returned by a decode step (cursor advanced 1)."""
+        self.cache = new_cache
+        self.cursor += 1
+
+    def reset(self) -> None:
+        """Rewind the cursor and invalidate every slot's context (engine
+        drain / preemption). Slot ownership is the engine's to clear."""
+        self.cursor = 0
+        if self.cache is not None:
+            self.cache = self._reset_fn(self.cache)

@@ -1,0 +1,445 @@
+"""Continuous-batching serving engine.
+
+``ServingEngine`` turns the repo's batch ``generate()`` math into a
+request-level server: a host-side loop interleaves prefill of admitted
+requests with ONE jitted fixed-shape decode step over all ``num_slots``
+slots. The decode step's shapes never change — cache ``(num_slots,
+max_seq_len)``, per-slot token/key/config arrays — so XLA compiles it exactly
+once and every request, whatever its arrival time, length, or sampling
+config, flows through the same program (``decode_compilations`` asserts
+this). Prefill compiles once per padded-length bucket (powers of two), the
+standard serving trade.
+
+Token-stream fidelity: a request served through the engine produces EXACTLY
+the tokens of a solo ``generate(prompt, key)`` call — same prefill math
+(left-padded prompts are already proven token-identical to unpadded ones),
+same per-step key evolution (``split`` then sample with the sub-key), and a
+per-row sampler that is bit-identical to ``sample`` (utils/sampling.py). The
+engine is a scheduler around the same program, not a different generator.
+
+Cache capacity: all slots share one write cursor (see
+``serving/cache_manager.py``), which advances every decode step while ANY
+slot is active. Admission guards against running past ``max_seq_len``:
+
+* ``admission="conservative"`` (default) — admit only when the request's
+  whole remaining generation fits under the cursor; requests queue
+  otherwise, and the cursor rewinds whenever the engine drains.
+* ``admission="eager"`` — admit whenever the prefill itself fits; when the
+  cursor hits the wall the engine preempts every active request (their
+  progress is kept), rewinds the cache, and resumes them by re-prefilling
+  their context — trading re-prefill compute for slot utilization.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.inference.generate import (
+    GenerationConfig,
+    serving_clones,
+)
+from neuronx_distributed_tpu.inference.utils import unwrap_logits
+from neuronx_distributed_tpu.serving.cache_manager import SlotCacheManager
+from neuronx_distributed_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+from neuronx_distributed_tpu.utils.sampling import sample_per_row, sample_row
+
+
+def _key_data(key) -> np.ndarray:
+    """Raw (2,) uint32 view of a PRNG key (typed or legacy)."""
+    dt = getattr(key, "dtype", None)
+    if dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)
+    return np.asarray(key, np.uint32)
+
+
+def _config_sentinels(cfg: GenerationConfig):
+    """(temperature, top_k, top_p) with the traced-sampler sentinels:
+    top_k<=0 and top_p>=1 disable the filters (sample_row's contract)."""
+    return (
+        np.float32(cfg.temperature),
+        np.int32(cfg.top_k if cfg.top_k is not None else 0),
+        np.float32(cfg.top_p if cfg.top_p is not None else 1.0),
+    )
+
+
+def _bucket(p: int, max_seq_len: int, remaining: int, floor: int = 8) -> int:
+    """Padded prefill length for a p-token context: next power of two
+    (compile-count control), clamped so the padded prompt still leaves room
+    for the remaining generation — falling back to the exact length keeps
+    every feasible request admittable at the cost of a per-length compile."""
+    b = max(floor, 1 << max(p - 1, 0).bit_length())
+    b = min(b, max_seq_len)
+    if b < p or b + remaining > max_seq_len:
+        b = p
+    return b
+
+
+class ServingEngine:
+    """Slot-based continuous batching over a mode-capable causal LM."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        num_slots: int,
+        max_tokens_in_flight: Optional[int] = None,
+        admission: str = "conservative",
+        timeline=None,
+        time_fn: Callable[[], float] = time.monotonic,
+    ):
+        if admission not in ("conservative", "eager"):
+            raise ValueError(f"unknown admission policy {admission!r}")
+        max_seq_len = getattr(getattr(model, "config", None), "max_seq_len", None)
+        if max_seq_len is None:
+            raise ValueError(
+                "ServingEngine needs model.config.max_seq_len (the fixed "
+                "slot cache length)"
+            )
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len
+        self.admission = admission
+        self.timeline = timeline
+        self._clock = time_fn
+        self._prefill_model, self._decode_model = serving_clones(model)
+        self.scheduler = Scheduler(max_tokens_in_flight)
+        self.cache = SlotCacheManager(num_slots)
+        self.metrics = ServingMetrics(num_slots)
+        # per-slot device-step state (host numpy mirrors; fixed shapes)
+        self._tok = np.zeros((num_slots,), np.int32)
+        self._keys = np.zeros((num_slots, 2), np.uint32)
+        self._active = np.zeros((num_slots,), bool)
+        self._temp = np.ones((num_slots,), np.float32)
+        self._topk = np.zeros((num_slots,), np.int32)
+        self._topp = np.ones((num_slots,), np.float32)
+        self._slot_req: List[Optional[Request]] = [None] * num_slots
+        self._on_token: Dict[int, Callable[[Request, int], None]] = {}
+        self._next_rid = 0
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._decode_step = jax.jit(self._decode_step_impl)
+        self._first_token = jax.jit(sample_row)
+
+    # --- public API ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_ids,
+        config: GenerationConfig = GenerationConfig(),
+        key=None,
+        on_token: Optional[Callable[[Request, int], None]] = None,
+    ) -> Request:
+        """Enqueue one request; returns its live ``Request`` (``tokens``
+        fills in as the engine steps). ``key`` defaults to a per-request
+        PRNGKey; pass the key you would give ``generate`` to reproduce its
+        stream exactly."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if config.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + config.max_new_tokens > self.max_seq_len:
+            # same contract as generate(): past max_seq_len the cache write
+            # index and RoPE positions would clamp and corrupt output
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({config.max_new_tokens}) exceeds max_seq_len "
+                f"({self.max_seq_len})"
+            )
+        budget = self.scheduler.max_tokens_in_flight
+        if budget is not None and prompt.size + config.max_new_tokens > budget:
+            # a footprint over the whole budget can NEVER be admitted —
+            # queueing it would livelock run() behind a permanent FIFO head
+            raise ValueError(
+                f"request footprint ({prompt.size + config.max_new_tokens}) "
+                f"exceeds max_tokens_in_flight ({budget}); it could never "
+                "be admitted"
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        if key is None:
+            key = jax.random.PRNGKey(rid)
+        req = Request(
+            rid=rid, prompt=prompt, config=config, key=_key_data(key)
+        )
+        req.submit_time = self._clock()
+        if on_token is not None:
+            self._on_token[rid] = on_token
+        self.scheduler.submit(req)
+        self.metrics.record_submit(req, req.submit_time)
+        if self.timeline is not None:
+            self.timeline.instant(f"submit r{rid}", "serving")
+        return req
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request. Queued: dropped immediately; running: its slot
+        is reaped at the next step."""
+        req = self.scheduler.get(rid)
+        if req is None or req.finished:
+            return False
+        was_queued = req.slot is None
+        ok = self.scheduler.cancel(rid)
+        if ok and was_queued:
+            self.metrics.record_cancel(req, self._clock())
+        return ok
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.queued > 0 or any(self._active)
+
+    @property
+    def decode_compilations(self) -> int:
+        """How many distinct decode-step programs XLA compiled. Stays 1
+        across arbitrary slot churn — the continuous-batching invariant."""
+        return int(self._decode_step._cache_size())
+
+    def step(self) -> bool:
+        """One engine iteration: reap cancellations → preempt/rewind if the
+        cursor is out of room → admit+prefill → one decode step → retire
+        finished slots. Returns whether work remains."""
+        now = self._clock()
+        self._reap_cancelled(now)
+        if any(self._active) and self.cache.cursor >= self.max_seq_len:
+            self._preempt_all()
+        if not any(self._active) and self.cache.cursor > 0:
+            # drained: rewind the shared cursor so the next wave starts at
+            # column 0 (storage reused, nothing reallocated)
+            self.cache.reset()
+        self._admit(now)
+        if any(self._active):
+            self._decode(now)
+        if self.timeline is not None:
+            self.timeline.counter("slots_active", int(self._active.sum()), "serving")
+            self.timeline.counter("queue_depth", self.scheduler.queued, "serving")
+        return self.has_work
+
+    def run(self, max_steps: int = 1_000_000) -> Dict[int, Request]:
+        """Step until idle; returns every request this engine has seen."""
+        steps = 0
+        while self.has_work and steps < max_steps:
+            self.step()
+            steps += 1
+        return {r.rid: r for r in self.scheduler.requests.values()}
+
+    # --- admission ----------------------------------------------------------
+
+    def _in_flight_tokens(self) -> int:
+        return sum(
+            r.token_footprint for r in self._slot_req if r is not None
+        )
+
+    def _admit(self, now: float) -> None:
+        if self.cache.free_slots == 0 or self.scheduler.queued == 0:
+            return
+        proj = self.cache.cursor
+        maxrem = max(
+            (r.remaining_new_tokens for r in self._slot_req if r is not None),
+            default=0,
+        )
+
+        def fits(req: Request) -> bool:
+            nonlocal proj, maxrem
+            p = len(req.context_ids)
+            bucket = _bucket(p, self.max_seq_len, req.remaining_new_tokens)
+            target = max(proj, bucket)
+            if self.admission == "conservative":
+                # all slots step together, so the cursor's final resting
+                # place is the admission cursor plus the LONGEST remaining
+                # generation in flight — a long prompt's cursor jump must
+                # not strand the slots already running (they'd hit the
+                # preemption wall conservative mode promises to avoid)
+                if (
+                    target + max(maxrem, req.remaining_new_tokens)
+                    > self.max_seq_len
+                ):
+                    return False
+            elif target + 1 > self.max_seq_len:
+                # eager: just the prefill + one decode step must fit; the
+                # preemption path recovers the rest
+                return False
+            proj = target
+            maxrem = max(maxrem, req.remaining_new_tokens)
+            return True
+
+        selected = self.scheduler.select(
+            self.cache.free_slots, self._in_flight_tokens(), fits
+        )
+        for req in selected:  # longest-prefill-first
+            self._prefill_into_slot(req, self.cache.acquire(), now)
+
+    def _prefill_fn(self, padded_len: int):
+        fn = self._prefill_fns.get(padded_len)
+        if fn is None:
+            prefill = self._prefill_model
+
+            @jax.jit
+            def fn(params, ids, mask):
+                out, variables = prefill.apply(
+                    params, ids, padding_mask=mask, mutable=["cache"]
+                )
+                return unwrap_logits(out)[0, -1], variables["cache"]
+
+            self._prefill_fns[padded_len] = fn
+        return fn
+
+    def _prefill_into_slot(self, req: Request, slot: int, now: float) -> None:
+        ctx = req.context_ids
+        p = len(ctx)
+        padded = _bucket(p, self.max_seq_len, req.remaining_new_tokens)
+        ids = np.zeros((1, padded), np.int32)
+        mask = np.zeros((1, padded), bool)
+        ids[0, padded - p:] = ctx  # LEFT padding: last token at index -1
+        mask[0, padded - p:] = True
+        if self.timeline is not None:
+            self.timeline.mark_event_start("prefill", "serving")
+        logits, row_cache = self._prefill_fn(padded)(
+            self.params, jnp.asarray(ids), jnp.asarray(mask)
+        )
+        if self.timeline is not None:
+            self.timeline.mark_event_end("prefill", "serving")
+        self.cache.admit(row_cache, slot, padded)
+        self.metrics.record_admit(req, now)
+        if req.admit_time is None:
+            req.admit_time = now
+        if not req.tokens:
+            # fresh request: sample the first token exactly as generate()
+            # does — split the request key, sample with the sub-key
+            carry, sub = jax.random.split(jnp.asarray(req.key))
+            temp, topk, topp = _config_sentinels(req.config)
+            tok0 = int(self._first_token(logits, sub, temp, topk, topp))
+            req.key = np.asarray(carry, np.uint32)
+            self._emit_token(req, tok0, now, first=True)
+        req.state = RequestState.DECODE
+        req.slot = slot
+        self._slot_req[slot] = req
+        self._tok[slot] = req.tokens[-1]
+        self._keys[slot] = req.key
+        self._temp[slot], self._topk[slot], self._topp[slot] = (
+            _config_sentinels(req.config)
+        )
+        self._active[slot] = True
+        # a request can be born finished (max_new_tokens == 1, or EOS as
+        # its very first token) — retire before it ever decodes
+        self._maybe_finish(req, now)
+
+    # --- decode -------------------------------------------------------------
+
+    def _decode_step_impl(self, params, cache, tok, keys, active,
+                          temp, topk, topp):
+        """THE fixed-shape decode step: one token for every slot, per-slot
+        sampling config, per-slot key split. Inactive slots still compute
+        (fixed shapes are the point) but their K/V writes are masked
+        invalid so freed slots never pollute attendable context."""
+        split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
+        carry_keys, subs = split[:, 0], split[:, 1]
+        out, variables = self._decode_model.apply(
+            {**params, "cache": cache}, tok[:, None],
+            padding_mask=active[:, None], mutable=["cache"],
+        )
+        logits = unwrap_logits(out)[:, -1]
+        nxt = sample_per_row(logits, subs, temp, topk, topp)
+        return variables["cache"], carry_keys, nxt
+
+    def _decode(self, now: float) -> None:
+        if self.timeline is not None:
+            self.timeline.mark_event_start("decode_step", "serving")
+        new_cache, new_keys, nxt = self._decode_step(
+            dict(self.params), self.cache.cache,
+            jnp.asarray(self._tok), jnp.asarray(self._keys),
+            jnp.asarray(self._active), jnp.asarray(self._temp),
+            jnp.asarray(self._topk), jnp.asarray(self._topp),
+        )
+        self.cache.update_after_decode(new_cache)
+        self.metrics.record_decode_step(
+            int(self._active.sum()), self.cache.cursor
+        )
+        nxt = np.asarray(nxt)
+        # np.array (not asarray): device arrays view as read-only, but the
+        # admission path writes per-slot keys into this mirror
+        self._keys = np.array(new_keys)
+        if self.timeline is not None:
+            self.timeline.mark_event_end("decode_step", "serving")
+        for slot in np.flatnonzero(self._active):
+            req = self._slot_req[slot]
+            tok = int(nxt[slot])
+            self._tok[slot] = tok
+            # copy, not view: a view would alias the mirror row, and a later
+            # admission writing another request's key into this slot would
+            # silently corrupt THIS request's key stream after preemption
+            req.key = self._keys[slot].copy()
+            self._emit_token(req, tok, now)
+            self._maybe_finish(req, now)
+
+    # --- lifecycle helpers --------------------------------------------------
+
+    def _emit_token(self, req: Request, tok: int, now: float,
+                    first: bool = False) -> None:
+        req.tokens.append(tok)
+        if first:
+            req.first_token_time = now
+            self.metrics.record_first_token(req, now)
+        cb = self._on_token.get(req.rid)
+        if cb is not None:
+            cb(req, tok)
+
+    def _maybe_finish(self, req: Request, now: float) -> None:
+        if req.state is RequestState.CANCELLED:
+            # e.g. an on_token callback cancelled it this very step: the
+            # cancellation wins; _reap_cancelled retires the slot next step
+            return
+        eos = req.config.eos_token_id
+        hit_eos = eos is not None and req.tokens and req.tokens[-1] == eos
+        if hit_eos or len(req.tokens) >= req.config.max_new_tokens:
+            req.state = RequestState.DONE
+            req.finish_time = now
+            self.metrics.record_finish(req, now)
+            self._release_slot(req)
+            if self.timeline is not None:
+                self.timeline.instant(f"done r{req.rid}", "serving")
+
+    def _release_slot(self, req: Request) -> None:
+        slot = req.slot
+        if slot is None:
+            return
+        req.slot = None
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self.cache.free(slot)
+        self._on_token.pop(req.rid, None)
+
+    def _reap_cancelled(self, now: float) -> None:
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.state is RequestState.CANCELLED:
+                self.metrics.record_cancel(req, now)
+                req.finish_time = now
+                self._release_slot(req)
+
+    def _preempt_all(self) -> None:
+        """Out of cache columns: push every active request back to the queue
+        (keeping its generated tokens and current key), rewind the cache,
+        and let admission re-prefill their contexts. Token streams are
+        unaffected — resume replays the exact context the request had."""
+        preempted = [r for r in self._slot_req if r is not None]
+        for req in preempted:
+            req.preemptions += 1
+            self.metrics.record_preemption(req)
+            slot, req.slot = req.slot, None
+            self._slot_req[slot] = None
+            self._active[slot] = False
+            self.cache.free(slot)
+        self.scheduler.requeue_front(preempted)
+        self.cache.reset()
+        if self.timeline is not None:
+            self.timeline.instant(
+                f"preempt x{len(preempted)}", "serving"
+            )

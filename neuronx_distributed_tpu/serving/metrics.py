@@ -1,0 +1,125 @@
+"""Serving metrics: per-request latency breakdown + engine counters.
+
+Everything is plain host-side accounting (the engine clock is injectable for
+deterministic tests) exported as a dict ``snapshot()``; when the engine holds
+a ``utils.timeline.Timeline``, per-step occupancy and queue depth also land
+on counter tracks next to the prefill/decode duration events, so one Perfetto
+view shows the whole scheduling story.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+def _mean(xs):
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+class ServingMetrics:
+    """Aggregates the engine's request lifecycle events."""
+
+    def __init__(self, num_slots: int = 0):
+        self.num_slots = num_slots
+        # engine counters
+        self.steps = 0  # decode steps executed
+        self.prefills = 0
+        self.decode_tokens = 0  # tokens produced by decode steps
+        self.completed = 0
+        self.cancelled = 0
+        self.preemptions = 0
+        self.cursor_high_water = 0
+        self.occupied_slot_steps = 0  # Σ active slots over decode steps
+        # per-request
+        self._requests: Dict[int, dict] = {}
+
+    # --- request lifecycle --------------------------------------------------
+
+    def record_submit(self, req, now: float) -> None:
+        self._requests[req.rid] = {
+            "rid": req.rid,
+            "prompt_len": int(len(req.prompt)),
+            "submit_time": now,
+        }
+
+    def record_admit(self, req, now: float) -> None:
+        r = self._requests[req.rid]
+        # first admission sets the queue wait; re-admissions after preemption
+        # keep the original (the request never left the engine's care)
+        r.setdefault("admit_time", now)
+        r.setdefault("queue_wait", now - r["submit_time"])
+        self.prefills += 1
+
+    def record_first_token(self, req, now: float) -> None:
+        r = self._requests[req.rid]
+        r["first_token_time"] = now
+        r["ttft"] = now - r["submit_time"]
+
+    def record_finish(self, req, now: float) -> None:
+        r = self._requests[req.rid]
+        r["finish_time"] = now
+        r["latency"] = now - r["submit_time"]
+        r["tokens"] = len(req.tokens)
+        decode_span = now - r.get("first_token_time", now)
+        # tokens after the first are decode-step products
+        r["decode_tokens_per_sec"] = (
+            (len(req.tokens) - 1) / decode_span if decode_span > 0 else 0.0
+        )
+        r["preemptions"] = req.preemptions
+        self.completed += 1
+
+    def record_cancel(self, req, now: float) -> None:
+        r = self._requests.get(req.rid)
+        if r is not None:
+            r["finish_time"] = now
+            r["cancelled"] = True
+        self.cancelled += 1
+
+    def record_preemption(self, req) -> None:
+        self.preemptions += 1
+
+    # --- engine step --------------------------------------------------------
+
+    def record_decode_step(self, active_slots: int, cursor: int) -> None:
+        self.steps += 1
+        self.decode_tokens += active_slots
+        self.occupied_slot_steps += active_slots
+        self.cursor_high_water = max(self.cursor_high_water, cursor)
+
+    # --- export -------------------------------------------------------------
+
+    @property
+    def mean_occupancy(self) -> float:
+        """Mean active slots per decode step (≤ num_slots)."""
+        return self.occupied_slot_steps / self.steps if self.steps else 0.0
+
+    def request_snapshot(self, rid: int) -> Optional[dict]:
+        r = self._requests.get(rid)
+        return dict(r) if r is not None else None
+
+    def snapshot(self) -> dict:
+        """Plain-dict export (log lines, tests, dashboards)."""
+        done = [r for r in self._requests.values() if "latency" in r]
+        ttfts = [r["ttft"] for r in self._requests.values() if "ttft" in r]
+        waits = [
+            r["queue_wait"] for r in self._requests.values()
+            if "queue_wait" in r
+        ]
+        return {
+            "num_slots": self.num_slots,
+            "steps": self.steps,
+            "prefills": self.prefills,
+            "decode_tokens": self.decode_tokens,
+            "completed": self.completed,
+            "cancelled": self.cancelled,
+            "preemptions": self.preemptions,
+            "cursor_high_water": self.cursor_high_water,
+            "mean_occupancy": self.mean_occupancy,
+            "mean_ttft": _mean(ttfts),
+            "max_ttft": max(ttfts) if ttfts else 0.0,
+            "mean_queue_wait": _mean(waits),
+            "mean_latency": _mean([r["latency"] for r in done]),
+            "mean_decode_tokens_per_sec": _mean(
+                [r["decode_tokens_per_sec"] for r in done]
+            ),
+        }

@@ -1,0 +1,170 @@
+"""Request lifecycle + admission policy for the serving engine.
+
+Admission is FIFO with a token-budget guard: the queue is scanned in arrival
+order and stops at the first request that does not fit (no overtaking — a
+large request at the head cannot starve behind a stream of small ones). The
+requests selected in one round are handed back LONGEST-PREFILL-FIRST: the
+longest prompt sets the shared cache cursor, so prefilling it first lets the
+shorter prompts roll in under the same cursor without gap columns, and its
+(slowest) prefill compile/run overlaps the least work.
+
+The token budget (``max_tokens_in_flight``) bounds Σ over in-flight requests
+of (context + remaining new tokens) — the engine's worst-case claim on cache
+columns — so a burst of long-generation requests queues instead of thrashing
+the preemption path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+import numpy as np
+
+from neuronx_distributed_tpu.inference.generate import GenerationConfig
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    DONE = "done"
+    CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request flowing through the engine.
+
+    ``tokens`` accumulates generated ids as they stream out (the last entry
+    is the pending decode input, not yet fed to the model). ``key`` is the
+    request's CURRENT sampling key — it advances exactly like `generate`'s
+    carry key, so a request's token stream is identical to a solo
+    ``generate(prompt, key0)`` call with its original key."""
+
+    rid: int
+    prompt: np.ndarray  # (S,) int32
+    config: GenerationConfig
+    key: Any  # jax PRNG key data, advances as tokens are sampled
+    state: RequestState = RequestState.QUEUED
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    preemptions: int = 0
+    error: Optional[str] = None
+    # timestamps (engine clock) for metrics
+    submit_time: Optional[float] = None
+    admit_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def context_ids(self) -> np.ndarray:
+        """Tokens already FED to the model (prompt + all generated but the
+        pending last one) — what a resume-after-preemption must prefill."""
+        if not self.tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens[:-1], np.int32)]
+        )
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        return self.config.max_new_tokens - len(self.tokens)
+
+    @property
+    def token_footprint(self) -> int:
+        """Worst-case cache-column claim: full context + all tokens still
+        to generate (the budget-guard unit)."""
+        return len(self.prompt) + len(self.tokens) + self.remaining_new_tokens
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (RequestState.DONE, RequestState.CANCELLED)
+
+
+class Scheduler:
+    """FIFO + longest-prefill-first admission with a token-budget guard."""
+
+    def __init__(self, max_tokens_in_flight: Optional[int] = None):
+        self.max_tokens_in_flight = max_tokens_in_flight
+        self._queue: Deque[Request] = deque()
+        self._requests: Dict[int, Request] = {}
+
+    # --- intake -------------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        request.state = RequestState.QUEUED
+        self._requests[request.rid] = request
+        self._queue.append(request)
+
+    def requeue_front(self, requests: List[Request]) -> None:
+        """Preempted requests rejoin at the FRONT, original arrival order
+        preserved — they were admitted first, they resume first."""
+        for req in sorted(requests, key=lambda r: r.rid, reverse=True):
+            req.state = RequestState.QUEUED
+            self._queue.appendleft(req)
+
+    def cancel(self, rid: int) -> bool:
+        """Mark a request cancelled. Queued requests drop immediately;
+        running ones are reaped by the engine at its next step."""
+        req = self._requests.get(rid)
+        if req is None or req.finished:
+            return False
+        req.state = RequestState.CANCELLED
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            pass  # already admitted; the engine frees its slot
+        return True
+
+    # --- admission ----------------------------------------------------------
+
+    def select(
+        self,
+        free_slots: int,
+        in_flight_tokens: int,
+        fits: Optional[Callable[[Request], bool]] = None,
+    ) -> List[Request]:
+        """Pick the FIFO prefix that fits ``free_slots``, the token budget,
+        and the engine's capacity predicate ``fits`` (checked in queue
+        order, so ``fits`` may accumulate a projected cursor). Selected
+        requests leave the queue in state PREFILL, returned
+        longest-prefill-first."""
+        selected: List[Request] = []
+        budget = in_flight_tokens
+        while self._queue and len(selected) < free_slots:
+            req = self._queue[0]
+            if req.state is RequestState.CANCELLED:
+                self._queue.popleft()
+                continue
+            if (
+                self.max_tokens_in_flight is not None
+                and budget + req.token_footprint > self.max_tokens_in_flight
+            ):
+                break  # strict FIFO: nothing overtakes the blocked head
+            if fits is not None and not fits(req):
+                break
+            self._queue.popleft()
+            req.state = RequestState.PREFILL
+            budget += req.token_footprint
+            selected.append(req)
+        selected.sort(key=lambda r: len(r.context_ids), reverse=True)
+        return selected
+
+    # --- introspection ------------------------------------------------------
+
+    @property
+    def requests(self) -> Dict[int, Request]:
+        """Every request this scheduler has seen, by rid."""
+        return self._requests
+
+    @property
+    def queued(self) -> int:
+        return sum(
+            1 for r in self._queue if r.state is not RequestState.CANCELLED
+        )
+
+    def get(self, rid: int) -> Optional[Request]:
+        return self._requests.get(rid)

@@ -53,3 +53,63 @@ def sample(
         logits = _filter_top_p(logits, top_p)
     gumbel = jax.random.gumbel(key, logits.shape, jnp.float32)
     return jnp.argmax(logits + gumbel, axis=-1).astype(jnp.int32)
+
+
+# --- per-row sampling (continuous-batching serving) ---------------------------
+#
+# The serving engine runs ONE jitted decode step over all slots, so the
+# sampling config (temperature/top-k/top-p) must be TRACED per-row data, not
+# python constants. Sentinels replace None: top_k <= 0 and top_p >= 1.0
+# disable the respective filter, temperature == 0.0 is greedy — exactly the
+# conditions `sample` checks in python.
+
+def sample_row(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """One row's token from ``logits`` (V,) with traced scalar config.
+
+    Numerically identical to :func:`sample` on the same (logits, key,
+    config): the filters apply the same thresholds (k-th largest value /
+    smallest top-p prefix) and the Gumbel draw over (V,) consumes the same
+    bits as `sample`'s over (1, V), so a request served through the engine's
+    per-slot path reproduces its solo `generate()` tokens bit-for-bit."""
+    v = logits.shape[-1]
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.asarray(temperature, jnp.float32)
+    x = logits.astype(jnp.float32) / jnp.where(temp == 0.0, 1.0, temp)
+    # top-k: threshold at the k-th largest (== lax.top_k(x, k)[0][-1])
+    k = jnp.asarray(top_k, jnp.int32)
+    desc = jnp.sort(x, axis=-1)[..., ::-1]
+    kth = desc[jnp.clip(k, 1, v) - 1]
+    x = jnp.where((k > 0) & (x < kth), -jnp.inf, x)
+    # top-p: smallest prefix with cumulative prob >= p (mirrors _filter_top_p).
+    # The filtered x sorted descending == the filter applied to `desc`
+    # elementwise (the filter maps a down-set to -inf, preserving order), so
+    # the second O(V log V) sort is free
+    p = jnp.asarray(top_p, jnp.float32)
+    sorted_logits = jnp.where((k > 0) & (desc < kth), -jnp.inf, desc)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_mask = cum - probs < p
+    thresh = jnp.where(cutoff_mask, sorted_logits, jnp.inf).min(-1)
+    x = jnp.where((p < 1.0) & (x < thresh), -jnp.inf, x)
+    gumbel = jax.random.gumbel(key, x.shape, jnp.float32)
+    tok = jnp.argmax(x + gumbel, axis=-1).astype(jnp.int32)
+    return jnp.where(temp == 0.0, greedy_tok, tok)
+
+
+def sample_per_row(
+    logits: jax.Array,
+    keys: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Vectorized :func:`sample_row`: logits (B, V), keys (B, 2), per-row
+    (B,) config arrays → (B,) int32 tokens. The serving engine's shared
+    decode step samples every slot with its own request's config here."""
+    return jax.vmap(sample_row)(logits, keys, temperature, top_k, top_p)

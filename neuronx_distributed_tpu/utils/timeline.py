@@ -75,6 +75,18 @@ class Timeline:
 
         return _Ctx()
 
+    def counter(self, name: str, value: float, category: str = "host") -> None:
+        """Chrome trace counter track ('ph':'C') — e.g. the serving engine's
+        slot occupancy and queue depth over time."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                {"name": name, "cat": category, "ph": "C",
+                 "ts": self._now_us(), "pid": self.rank,
+                 "args": {name: value}}
+            )
+
     def instant(self, name: str, category: str = "host") -> None:
         if not self.enabled:
             return

@@ -145,3 +145,42 @@ def test_left_padded_batch_matches_per_row():
     )
     np.testing.assert_array_equal(np.asarray(toks[0:1]), np.asarray(ref_long))
     np.testing.assert_array_equal(np.asarray(toks[1:2]), np.asarray(ref_short))
+
+
+def test_generate_survives_jit_wrapping_with_mask():
+    """Regression (ADVICE r5): the left-padding check used np.asarray on the
+    mask, which raised TracerError when generate() was wrapped in jit (and
+    forced a device sync per call otherwise). Tracer masks skip the host
+    check; results must match the unwrapped call."""
+    cfg, model, ids, params = _setup()
+    mask = jnp.ones(ids.shape, bool)
+    gen_cfg = GenerationConfig(max_new_tokens=NEW, temperature=0.0)
+    ref = generate(
+        model, params, ids, jax.random.PRNGKey(2), gen_cfg,
+        attention_mask=mask,
+    )
+    wrapped = jax.jit(
+        lambda ids, mask: generate(
+            model, params, ids, jax.random.PRNGKey(2), gen_cfg,
+            attention_mask=mask,
+        )
+    )
+    np.testing.assert_array_equal(
+        np.asarray(wrapped(ids, mask)), np.asarray(ref)
+    )
+
+
+def test_right_padding_still_rejected_on_host_path():
+    """The host-side left-padding contract keeps raising for concrete
+    masks (the tracer skip must not drop validation where it CAN run)."""
+    import pytest
+
+    cfg, model, ids, params = _setup()
+    bad = np.ones(ids.shape, bool)
+    bad[:, -1] = False  # right padding
+    with pytest.raises(ValueError, match="LEFT padding"):
+        generate(
+            model, params, ids, jax.random.PRNGKey(2),
+            GenerationConfig(max_new_tokens=NEW, temperature=0.0),
+            attention_mask=jnp.asarray(bad),
+        )

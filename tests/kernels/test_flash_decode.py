@@ -75,6 +75,39 @@ def test_tp_splits_cache_length():
         mesh_lib.destroy_model_parallel()
 
 
+def test_irregular_geometry_routes_through_manual_shard_map(monkeypatch):
+    """tp=4 > hkv=2 with L % tp != 0 (ADVICE round 5): the irregular
+    fallback must enter the SAME replicated manual region as the tp<=1
+    branch — a bare kernel call under an active mesh asks GSPMD to
+    partition a Mosaic custom call, which it cannot. The manual_shard_map
+    spy proves the routing; running its body unsharded proves the numerics
+    are still the exact einsum result."""
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    L_irr = 250  # 250 % 4 != 0 → length-split unavailable
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (B, 1, 8, D), jnp.float32)
+    kc = jax.random.normal(ks[1], (B, L_irr, 2, D), jnp.float32)
+    vc = jax.random.normal(ks[2], (B, L_irr, 2, D), jnp.float32)
+    pos = jnp.asarray([200], jnp.int32)
+    ref = decode_attention(q, kc, vc, pos)
+
+    calls = []
+
+    def spy(fn, in_specs, out_specs):
+        calls.append({"in_specs": in_specs, "out_specs": out_specs})
+        return fn  # run the body unsharded: numerics must be unchanged
+
+    monkeypatch.setattr(mesh_lib, "manual_shard_map", spy)
+    mesh_lib.initialize_model_parallel(tensor_model_parallel_size=4)
+    try:
+        out = flash_decode_attention(q, kc, vc, pos, block_l=64)
+    finally:
+        mesh_lib.destroy_model_parallel()
+    assert len(calls) == 1, "fallback bypassed the manual region"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_tp_shards_kv_heads():
     from neuronx_distributed_tpu.parallel import mesh as mesh_lib
 

@@ -69,3 +69,58 @@ def test_decode_mask_shape_guard():
         assert "incoming step" in str(e)
     else:
         raise AssertionError("decode accepted a wrong-shaped mask")
+
+
+def test_finished_row_mask_keeps_filler_invalid():
+    """A decode step's per-row finished mask (False = filler token) must
+    leave the row's kv_valid untouched at the write column — post-EOS
+    filler never extends attendable context (ADVICE round 5; the serving
+    engine's freed slots depend on this)."""
+    prefill, decode = _mod("prefill"), _mod("decode")
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, S, HID), jnp.float32)
+    params = prefill.init(jax.random.PRNGKey(0), x)
+    _, vars = prefill.apply(params, x, mutable=["cache"])
+    cache = vars["cache"]
+    step_x = jnp.zeros((2, 1, HID), jnp.float32)
+    finished = jnp.asarray([[False], [True]])  # row 0 done, row 1 running
+    _, vars = decode.apply(
+        {**params, "cache": cache}, step_x,
+        attention_mask=finished, mutable=["cache"],
+    )
+    valid = np.asarray(vars["cache"]["kv_valid"])
+    assert not valid[0, S]  # filler column stays invalid for the done row
+    assert valid[1, S]  # running row's token is attendable
+    assert valid[:, :S].all()  # prompt validity untouched
+
+
+def test_reset_cache_slot_clears_one_row():
+    from neuronx_distributed_tpu.modules.attention import reset_cache_slot
+
+    prefill = _mod("prefill")
+    x = jax.random.normal(jax.random.PRNGKey(4), (3, S, HID), jnp.float32)
+    params = prefill.init(jax.random.PRNGKey(0), x)
+    _, vars = prefill.apply(params, x, mutable=["cache"])
+    cache = reset_cache_slot(vars["cache"], jnp.asarray(1, jnp.int32))
+    valid = np.asarray(cache["kv_valid"])
+    assert not valid[1].any()  # freed slot
+    assert valid[0, :S].all() and valid[2, :S].all()  # neighbours intact
+    # k/v storage and the shared cursor are untouched (reuse, not realloc)
+    np.testing.assert_array_equal(
+        np.asarray(cache["k"]), np.asarray(vars["cache"]["k"])
+    )
+    assert int(cache["index"]) == S
+
+
+def test_reset_cache_rewinds_cursor_and_validity():
+    from neuronx_distributed_tpu.modules.attention import reset_cache
+
+    prefill = _mod("prefill")
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, S, HID), jnp.float32)
+    params = prefill.init(jax.random.PRNGKey(0), x)
+    _, vars = prefill.apply(params, x, mutable=["cache"])
+    cache = reset_cache(vars["cache"])
+    assert not np.asarray(cache["kv_valid"]).any()
+    assert int(cache["index"]) == 0
+    np.testing.assert_array_equal(
+        np.asarray(cache["k"]), np.asarray(vars["cache"]["k"])
+    )

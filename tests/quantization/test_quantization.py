@@ -343,3 +343,70 @@ def test_scanned_model_static_act_scale_tree_applies():
     )
     logits = qmodel.apply(qparams, ids)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_act_scale_eligibility_mirrors_declaration():
+    """ADVICE r5: act_scale siblings are seeded ONLY for kernels the model
+    side declares via _declare_kernel_q (2-D, non-batch_dim; nn.scan may
+    stack one leading layer axis) — never for higher-rank stacks, non-kernel
+    names, or expert *_proj leaves, whose extra siblings would break strict
+    tree-structure comparisons against model.init."""
+    from neuronx_distributed_tpu.quantization.utils import (
+        kernel_act_scale_eligible,
+    )
+
+    w2 = jnp.ones((8, 4))
+    w3 = jnp.ones((2, 8, 4))  # scan-stacked 2-D
+    w4 = jnp.ones((2, 3, 8, 4))  # double-stacked: never declared
+    assert kernel_act_scale_eligible(("lin", "kernel"), w2)
+    assert kernel_act_scale_eligible(("layers", "mlp", "kernel"), w3)
+    assert not kernel_act_scale_eligible(("x", "kernel"), w4)
+    assert not kernel_act_scale_eligible(("moe", "gate_proj"), w3)
+
+    qcfg = QuantizationConfig(use_int8_matmul=True, use_static_act_scale=True)
+    tree = {
+        "params": {
+            "lin": {"kernel": w2},
+            "stacked": {"kernel": w4},
+            "experts": {"gate_proj": w3, "up_proj": w3, "down_proj": w3},
+        }
+    }
+    out = quantize_param_tree(tree, qcfg)
+    assert "act_scale" in out["params"]["lin"]
+    assert "act_scale" not in out["params"]["stacked"]
+    assert set(out["params"]["experts"]) == {
+        "gate_proj", "gate_proj_scale", "up_proj", "up_proj_scale",
+        "down_proj", "down_proj_scale",
+    }
+
+
+def test_static_act_scale_tree_structure_matches_init():
+    """Checkpoint round-trip contract: quantize_param_tree on a float llama
+    tree yields EXACTLY model.init's structure under a static-act-scale
+    config — no extra or missing leaves anywhere."""
+    import dataclasses
+
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+
+    mesh_lib.destroy_model_parallel()
+    qcfg = QuantizationConfig(use_int8_matmul=True, use_static_act_scale=True)
+    cfg = tiny_llama()
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+    fmodel = LlamaForCausalLM(cfg, attention_impl="xla")
+    fparams = meta.unbox(jax.jit(fmodel.init)(jax.random.PRNGKey(1), ids))
+    qparams = quantize_param_tree(fparams, qcfg)
+    qmodel = LlamaForCausalLM(
+        dataclasses.replace(cfg, quantization=qcfg), attention_impl="xla"
+    )
+    want = meta.unbox(
+        jax.eval_shape(qmodel.init, jax.random.PRNGKey(2), ids)
+    )
+    assert (
+        jax.tree_util.tree_structure(qparams)
+        == jax.tree_util.tree_structure(want)
+    )

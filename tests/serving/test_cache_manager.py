@@ -1,0 +1,136 @@
+"""SlotCacheManager against a hand-built cache collection: admission rolls
+the prompt to end at the cursor, frees clear exactly one slot's validity,
+reset rewinds the shared index — for both the per-layer-dict and the
+nn.scan-stacked cache layouts."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.serving.cache_manager import SlotCacheManager
+
+L, HKV, D = 16, 2, 4
+
+
+def _row_cache(p, scanned=False, layers=2):
+    """Batch-1 cache as the model's prefill emits: prompt K/V in columns
+    [0, p), index == p, kv_valid True on [0, p)."""
+    def one():
+        k = np.zeros((1, L, HKV, D), np.float32)
+        v = np.zeros((1, L, HKV, D), np.float32)
+        k[0, :p] = np.arange(1, p + 1)[:, None, None]
+        v[0, :p] = -np.arange(1, p + 1)[:, None, None]
+        valid = np.zeros((1, L), bool)
+        valid[0, :p] = True
+        return {
+            "k": jnp.asarray(k), "v": jnp.asarray(v),
+            "index": jnp.asarray(p, jnp.int32),
+            "kv_valid": jnp.asarray(valid),
+        }
+
+    if not scanned:
+        return {"layers_0": {"attn": one()}, "layers_1": {"attn": one()}}
+    base = one()
+    return {
+        "layers": {
+            "attn": {
+                name: jnp.stack([leaf] * layers)
+                for name, leaf in base.items()
+            }
+        }
+    }
+
+
+def _leaves(cache, scanned=False):
+    node = cache["layers"]["attn"] if scanned else cache["layers_0"]["attn"]
+    return node
+
+
+@pytest.mark.parametrize("scanned", [False, True])
+def test_admit_rolls_prompt_to_cursor(scanned):
+    mgr = SlotCacheManager(num_slots=3)
+    mgr.admit(_row_cache(5, scanned), slot=0, padded_len=5)
+    assert mgr.cursor == 5
+    # second admission at a later cursor: prompt (3 tokens, padded to 3)
+    # must land in columns [cursor-3, cursor)
+    mgr.cursor = 9
+    mgr.admit(_row_cache(3, scanned), slot=2, padded_len=3)
+    assert mgr.cursor == 9
+    leaves = _leaves(mgr.cache, scanned)
+    k = np.asarray(leaves["k"])
+    valid = np.asarray(leaves["kv_valid"])
+    index = np.asarray(leaves["index"])
+    if scanned:
+        k, valid, index = k[0], valid[0], index[0]
+    assert (index == 9).all()
+    # slot 0: prompt at [0, 5)
+    assert (k[0, :5, 0, 0] == np.arange(1, 6)).all()
+    assert valid[0, :5].all() and not valid[0, 5:].any()
+    # slot 2: rolled to [6, 9)
+    assert (k[2, 6:9, 0, 0] == np.arange(1, 4)).all()
+    assert valid[2, 6:9].all()
+    assert not valid[2, :6].any() and not valid[2, 9:].any()
+    # slot 1 untouched
+    assert not valid[1].any()
+
+
+def test_admit_raises_long_prompt_cursor_jump():
+    """A prompt LONGER than the current cursor jumps the cursor forward;
+    earlier slots just see invalid gap columns."""
+    mgr = SlotCacheManager(num_slots=2)
+    mgr.admit(_row_cache(3), slot=0, padded_len=3)
+    assert mgr.cursor == 3
+    mgr.admit(_row_cache(8), slot=1, padded_len=8)
+    assert mgr.cursor == 8
+    leaves = _leaves(mgr.cache)
+    valid = np.asarray(leaves["kv_valid"])
+    assert valid[1, :8].all()
+    assert valid[0, :3].all() and not valid[0, 3:].any()
+    assert (np.asarray(leaves["index"]) == 8).all()
+
+
+def test_cursor_below_prompt_rejected():
+    mgr = SlotCacheManager(num_slots=2)
+    with pytest.raises(ValueError, match="cursor"):
+        mgr.admit(_row_cache(6), slot=0, padded_len=6, cursor=4)
+
+
+@pytest.mark.parametrize("scanned", [False, True])
+def test_free_clears_one_slot_only(scanned):
+    mgr = SlotCacheManager(num_slots=2)
+    s0 = mgr.acquire()
+    s1 = mgr.acquire()
+    mgr.admit(_row_cache(4, scanned), slot=s0, padded_len=4)
+    mgr.admit(_row_cache(4, scanned), slot=s1, padded_len=4)
+    assert mgr.free_slots == 0 and mgr.used_slots == 2
+    mgr.free(s0)
+    leaves = _leaves(mgr.cache, scanned)
+    valid = np.asarray(leaves["kv_valid"])
+    k = np.asarray(leaves["k"])
+    if scanned:
+        valid, k = valid[0], k[0]
+    assert not valid[s0].any()  # freed slot fully invalid
+    assert valid[s1, :4].all()  # neighbour untouched
+    assert k[s0, :4, 0, 0].any()  # storage NOT cleared — reused, not freed
+    assert mgr.free_slots == 1
+    # immediately re-admittable
+    s_again = mgr.acquire()
+    assert s_again == s0
+    mgr.admit(_row_cache(2, scanned), slot=s_again, padded_len=2, cursor=6)
+    valid = np.asarray(_leaves(mgr.cache, scanned)["kv_valid"])
+    if scanned:
+        valid = valid[0]
+    assert valid[s0, 4:6].all() and not valid[s0, :4].any()
+
+
+def test_reset_rewinds_cursor_and_validity():
+    mgr = SlotCacheManager(num_slots=2)
+    mgr.admit(_row_cache(5), slot=0, padded_len=5)
+    mgr.reset()
+    assert mgr.cursor == 0
+    leaves = _leaves(mgr.cache)
+    assert not np.asarray(leaves["kv_valid"]).any()
+    assert (np.asarray(leaves["index"]) == 0).all()
+    # storage stays allocated — admission after reset reuses it
+    mgr.admit(_row_cache(3), slot=1, padded_len=3)
+    assert mgr.cursor == 3

@@ -1,0 +1,87 @@
+"""ServingMetrics accounting with a fake clock — the latency identities the
+snapshot must satisfy (queue_wait <= ttft <= latency, occupancy <= slots)."""
+
+import numpy as np
+
+from neuronx_distributed_tpu.inference import GenerationConfig
+from neuronx_distributed_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_tpu.serving.scheduler import Request
+
+
+def _req(rid, plen=4, max_new=8):
+    return Request(
+        rid=rid,
+        prompt=np.arange(plen, dtype=np.int32),
+        config=GenerationConfig(max_new_tokens=max_new),
+        key=np.zeros((2,), np.uint32),
+    )
+
+
+def test_request_latency_identities():
+    m = ServingMetrics(num_slots=4)
+    r = _req(0)
+    m.record_submit(r, 1.0)
+    m.record_admit(r, 3.0)
+    m.record_first_token(r, 3.5)
+    r.tokens.extend([1, 2, 3, 4, 5])
+    m.record_finish(r, 5.5)
+    snap = m.request_snapshot(0)
+    assert snap["queue_wait"] == 2.0
+    assert snap["ttft"] == 2.5
+    assert snap["latency"] == 4.5
+    assert snap["queue_wait"] <= snap["ttft"] <= snap["latency"]
+    # 4 decode tokens over the 2s decode span
+    assert snap["decode_tokens_per_sec"] == 2.0
+
+
+def test_readmission_keeps_original_queue_wait():
+    m = ServingMetrics()
+    r = _req(1)
+    m.record_submit(r, 0.0)
+    m.record_admit(r, 1.0)
+    m.record_preemption(r)
+    m.record_admit(r, 9.0)  # resume prefill — not a new queue wait
+    snap = m.request_snapshot(1)
+    assert snap["queue_wait"] == 1.0
+    assert m.preemptions == 1
+    assert m.prefills == 2
+
+
+def test_occupancy_bounded_by_slots():
+    m = ServingMetrics(num_slots=4)
+    for active in (1, 3, 4, 2):
+        m.record_decode_step(active, cursor=10)
+    assert m.steps == 4
+    assert m.decode_tokens == 10
+    assert 0 < m.mean_occupancy <= 4
+    assert m.snapshot()["mean_occupancy"] == 2.5
+
+
+def test_snapshot_aggregates():
+    m = ServingMetrics(num_slots=2)
+    for rid, (sub, adm, first, fin, ntok) in enumerate(
+        [(0.0, 0.1, 0.2, 1.2, 6), (0.5, 0.6, 0.9, 2.0, 4)]
+    ):
+        r = _req(rid)
+        m.record_submit(r, sub)
+        m.record_admit(r, adm)
+        m.record_first_token(r, first)
+        r.tokens.extend(range(ntok))
+        m.record_finish(r, fin)
+    m.record_decode_step(2, cursor=20)
+    snap = m.snapshot()
+    assert snap["completed"] == 2
+    assert abs(snap["mean_ttft"] - (0.2 + 0.4) / 2) < 1e-9
+    assert abs(snap["mean_queue_wait"] - 0.1) < 1e-9
+    assert snap["cursor_high_water"] == 20
+    assert snap["mean_decode_tokens_per_sec"] > 0
+    assert snap["mean_latency"] > snap["mean_ttft"]
+
+
+def test_cancel_counts():
+    m = ServingMetrics()
+    r = _req(3)
+    m.record_submit(r, 0.0)
+    m.record_cancel(r, 1.0)
+    assert m.cancelled == 1
+    assert m.request_snapshot(3)["cancelled"] is True

@@ -1,0 +1,111 @@
+"""Scheduler policy unit tests: FIFO prefix selection, longest-prefill-first
+ordering, the token-budget guard, requeue-on-preemption, and cancellation —
+all host-side, no model in the loop."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig
+from neuronx_distributed_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+
+
+def _req(rid, plen, max_new=8):
+    return Request(
+        rid=rid,
+        prompt=np.arange(1, plen + 1, dtype=np.int32),
+        config=GenerationConfig(max_new_tokens=max_new),
+        key=np.zeros((2,), np.uint32),
+    )
+
+
+def test_fifo_prefix_selection_no_overtaking():
+    sched = Scheduler(max_tokens_in_flight=30)
+    a, b, c = _req(0, 10, 8), _req(1, 20, 8), _req(2, 2, 2)
+    for r in (a, b, c):
+        sched.submit(r)
+    # a fits (18), b would blow the budget (18+28=46>30) — and c must NOT
+    # overtake it even though it would fit
+    picked = sched.select(free_slots=3, in_flight_tokens=0)
+    assert [r.rid for r in picked] == [0]
+    assert a.state is RequestState.PREFILL
+    assert b.state is RequestState.QUEUED
+    assert sched.queued == 2
+
+
+def test_longest_prefill_first_ordering():
+    sched = Scheduler()
+    rs = [_req(0, 4), _req(1, 12), _req(2, 7)]
+    for r in rs:
+        sched.submit(r)
+    picked = sched.select(free_slots=3, in_flight_tokens=0)
+    assert [r.rid for r in picked] == [1, 2, 0]  # longest context first
+
+
+def test_free_slot_limit():
+    sched = Scheduler()
+    for i in range(5):
+        sched.submit(_req(i, 4))
+    picked = sched.select(free_slots=2, in_flight_tokens=0)
+    assert len(picked) == 2
+    assert sched.queued == 3
+
+
+def test_fits_predicate_stops_scan():
+    sched = Scheduler()
+    for i in range(3):
+        sched.submit(_req(i, 4))
+    picked = sched.select(
+        free_slots=3, in_flight_tokens=0, fits=lambda r: r.rid < 1
+    )
+    assert [r.rid for r in picked] == [0]
+    # head blocked → nothing admitted behind it
+    assert sched.queued == 2
+
+
+def test_requeue_front_preserves_arrival_order():
+    sched = Scheduler()
+    for i in range(4):
+        sched.submit(_req(i, 4))
+    picked = sched.select(free_slots=2, in_flight_tokens=0)
+    assert sorted(r.rid for r in picked) == [0, 1]
+    sched.requeue_front([r for r in picked])  # preempted
+    nxt = sched.select(free_slots=4, in_flight_tokens=0)
+    # preempted requests resume FIRST, then the untouched queue tail
+    assert sorted(r.rid for r in nxt[:2]) == [0, 1]
+    assert sorted(r.rid for r in nxt) == [0, 1, 2, 3]
+
+
+def test_cancel_queued_removes():
+    sched = Scheduler()
+    a, b = _req(0, 4), _req(1, 4)
+    sched.submit(a)
+    sched.submit(b)
+    assert sched.cancel(0)
+    assert a.state is RequestState.CANCELLED
+    picked = sched.select(free_slots=2, in_flight_tokens=0)
+    assert [r.rid for r in picked] == [1]
+    assert not sched.cancel(0)  # already cancelled
+
+
+def test_token_footprint_constant_across_progress():
+    r = _req(0, 10, max_new=6)
+    base = r.token_footprint
+    r.tokens.extend([5, 6, 7])
+    assert r.token_footprint == base == 16
+    assert r.remaining_new_tokens == 3
+    # context for resume: prompt + generated minus the pending last token
+    assert r.context_ids.tolist() == list(range(1, 11)) + [5, 6]
+
+
+def test_request_lifecycle_states():
+    r = _req(0, 4)
+    assert r.state is RequestState.QUEUED and not r.finished
+    r.state = RequestState.PREFILL
+    r.state = RequestState.DECODE
+    assert not r.finished
+    r.state = RequestState.DONE
+    assert r.finished
