@@ -52,6 +52,7 @@ PROBE_TIMEOUT_S = 90
 TINY_TIMEOUT_S = 300
 FULL_TIMEOUT_S = 600
 PROXY_TIMEOUT_S = 420
+SERVING_TIMEOUT_S = 420
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -451,6 +452,75 @@ def _measure_int8_serving(devs):
     return out
 
 
+def _measure_serving_chunk(devs):
+    """Serving decode-throughput: the continuous-batching engine's fused
+    multi-token decode chunks (donated cache, device-resident slot state,
+    one host sync per chunk) vs the per-token chunk=1 loop on the SAME
+    request workload. decode_tok_s reads the engine's dispatch+readback
+    hot-path counters (prefill/compile excluded); e2e_tok_s is whole-run
+    wall including prefills."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.serving import ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=int(rng.randint(6, 18))).astype(np.int32)
+        for _ in range(8)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=64, temperature=0.8, top_k=20)
+    out = {}
+    for chunk in (1, 8):
+        engine = ServingEngine(
+            model, params, num_slots=4, decode_chunk_size=chunk
+        )
+        # warmup wave: compiles the prefill buckets + the one decode program
+        for i, p in enumerate(prompts[:4]):
+            engine.submit(
+                p,
+                GenerationConfig(max_new_tokens=10, temperature=0.8, top_k=20),
+                key=jax.random.PRNGKey(i),
+            )
+        engine.run()
+        m = engine.metrics
+        base_tok = m.decode_tokens
+        base_wall = m.decode_dispatch_s + m.decode_readback_s
+        base_chunks = m.chunks
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            engine.submit(p, gcfg, key=jax.random.PRNGKey(100 + i))
+        engine.run()
+        wall = time.perf_counter() - t0
+        dtok = m.decode_tokens - base_tok
+        dwall = (m.decode_dispatch_s + m.decode_readback_s) - base_wall
+        out[f"chunk{chunk}"] = {
+            "decode_tok_s": round(dtok / dwall, 2) if dwall > 0 else 0.0,
+            "e2e_tok_s": round(dtok / wall, 2) if wall > 0 else 0.0,
+            "decode_tokens": int(dtok),
+            "host_syncs": int(m.chunks - base_chunks),
+            "decode_compilations": engine.decode_compilations,
+        }
+    out["decode_speedup_chunk8"] = round(
+        out["chunk8"]["decode_tok_s"]
+        / max(out["chunk1"]["decode_tok_s"], 1e-9),
+        3,
+    )
+    return out
+
+
 def _flash_block_sweep(batch, seq):
     import jax
     import jax.numpy as jnp
@@ -635,6 +705,31 @@ def child_sweep() -> None:
         # binding these simply have nothing to free)
         state = step = data = None
     _emit(payload)
+
+
+def child_serving() -> None:
+    """Serving decode-throughput child (``--child-serving``): chunk=1 vs
+    chunk=8 through the continuous-batching engine on the same workload.
+    Prints one JSON line; also merged into the BENCH artifact by the
+    parallel proxy."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_chunk",
+                "unit": "decode tokens/s",
+                "platform": devs[0].platform,
+                **_measure_serving_chunk(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_chunk",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
 
 
 def child_parallel() -> None:
@@ -944,6 +1039,7 @@ def main() -> None:
     headline = {}
     probe_info = None
     proxy_result = None
+    serving_result = None
 
     import signal
 
@@ -958,6 +1054,11 @@ def main() -> None:
             extras["probe"] = probe_info
         extras["parallel_proxy"] = (
             proxy_result if proxy_result is not None else {"error": "proxy did not finish"}
+        )
+        extras["serving_chunk"] = (
+            serving_result
+            if serving_result is not None
+            else {"error": "serving child did not finish"}
         )
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
         builder = _load_builder_artifact()
@@ -1053,6 +1154,17 @@ def main() -> None:
         tail = ((stderr or stdout) or "").strip()[-300:]
         proxy_result = {"error": f"parallel proxy failed: {tail}"}
 
+    # 5. Serving decode-throughput child: mesh-free (immune to the proxy's
+    #    sharding-API environment failures) and run AFTER the proxy is
+    #    collected so the two wall-clock measurements never contend for the
+    #    same host cores.
+    serving, err = _run_child("--child-serving", SERVING_TIMEOUT_S)
+    if serving is not None:
+        serving.pop("metric", None)
+        serving_result = serving
+    else:
+        serving_result = {"error": f"serving child: {err}"}
+
     _finalize()
 
 
@@ -1063,6 +1175,8 @@ if __name__ == "__main__":
         child(tiny=True)
     elif "--child-sweep" in sys.argv:
         child_sweep()
+    elif "--child-serving" in sys.argv:
+        child_serving()
     elif "--child" in sys.argv:
         child(tiny=False)
     elif "--probe" in sys.argv:

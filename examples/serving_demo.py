@@ -11,10 +11,20 @@ snapshot: TTFT, queue wait, decode tokens/s, slot occupancy, preemptions,
 and the decode-step compile count (always 1 — the continuous-batching
 invariant).
 
+``--decode-chunk`` sets the engine's fused decode chunk size: that many
+tokens per slot decode as ONE jitted scan with a single host sync at the
+end (donated cache and slot state update in place). Bigger chunks buy
+decode throughput; the cost is latency granularity — admission, streaming
+callbacks, and cancellation all land at chunk boundaries, so TTFT for a
+request arriving mid-chunk grows by up to a chunk of decode steps.
+``--decode-chunk 1`` is the per-token loop. Streams are bit-identical
+either way.
+
 CPU-runnable out of the box:
 
   python examples/serving_demo.py
   python examples/serving_demo.py --requests 12 --slots 2 --admission eager
+  python examples/serving_demo.py --decode-chunk 1   # per-token stepping
   python examples/serving_demo.py --timeline /tmp/serving_trace.json
 """
 
@@ -37,6 +47,10 @@ def parse_args(argv=None):
     p.add_argument("--admission", default="conservative",
                    choices=["conservative", "eager"])
     p.add_argument("--max-tokens-in-flight", type=int, default=None)
+    p.add_argument("--decode-chunk", type=int, default=8,
+                   help="fused decode steps per host sync (1 = per-token "
+                        "loop; higher = more decode throughput, coarser "
+                        "TTFT/cancel granularity at chunk boundaries)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeline", default=None,
                    help="write a chrome://tracing JSON of the serving loop")
@@ -75,6 +89,7 @@ def main(argv=None):
         num_slots=args.slots,
         max_tokens_in_flight=args.max_tokens_in_flight,
         admission=args.admission,
+        decode_chunk_size=args.decode_chunk,
         timeline=timeline,
     )
 
@@ -102,7 +117,8 @@ def main(argv=None):
     engine.run()
 
     print(f"\n=== {len(reqs)} requests through {args.slots} slots "
-          f"({args.admission} admission) ===")
+          f"({args.admission} admission, decode chunk "
+          f"{args.decode_chunk}) ===")
     for req in reqs:
         r = engine.metrics.request_snapshot(req.rid)
         print(

@@ -9,9 +9,12 @@ the carry, so there is no host round-trip per token (the reference's async
 SPMDModel forward serves the same purpose).
 
 The building blocks (mode clones, validation, the decode write mask, the
-unwrap/sample plumbing) are shared with the request-level continuous-batching
-engine in :mod:`neuronx_distributed_tpu.serving` — `generate` is the one-shot
-batch view, the engine the slot-based streaming view, over the same prefill
+unwrap/sample plumbing, and the fused multi-token chunk builder
+:func:`chunked_decode_step`) are shared with the request-level
+continuous-batching engine in :mod:`neuronx_distributed_tpu.serving` —
+`generate` is the one-shot batch view (its scan runs the whole generation),
+the engine the slot-based streaming view (its scan runs one
+``decode_chunk_size`` chunk between admission points), over the same prefill
 and decode-step math.
 """
 
@@ -48,6 +51,107 @@ def decode_write_mask(done: jax.Array) -> jax.Array:
     for the rest of their generation (KVCache.decode_write persists this via
     ``kv_valid``; ADVICE round 5)."""
     return jnp.logical_not(done)[:, None]
+
+
+def chunked_decode_step(decode_model, chunk_size: int, max_seq_len: int):
+    """Build the fused multi-token decode step shared by the serving engine
+    (and any other slot-based consumer): ``chunk_size`` decode steps run as
+    ONE jitted ``lax.scan`` — the serving analogue of ``generate``'s
+    ``_decode_all`` loop, with per-slot sampling sentinels instead of one
+    python-constant config.
+
+    Returned callable::
+
+        fn(params, cache, state) -> (cache, state, toks, counts, used, keys)
+
+    ``state`` is the engine's device-resident per-slot dict — ``tok`` (B,)
+    int32 pending input tokens, ``keys`` (B, 2) uint32 sampling keys,
+    ``active`` (B,) bool, ``remaining`` (B,) int32 tokens left to emit,
+    ``temp``/``topk``/``topp`` per-slot sampling sentinels
+    (:func:`~neuronx_distributed_tpu.utils.sampling.sample_row` contract)
+    and ``eos`` (B,) int32 (-1 = no EOS). The output ``state`` has the same
+    structure/shapes, so a caller can jit with ``donate_argnums`` on both
+    ``cache`` and ``state`` and XLA updates every buffer in place.
+
+    Semantics, step by step, exactly mirroring the single-step engine path:
+    per-slot key split → decode apply with the write mask
+    (:func:`decode_write_mask`) hiding finished/inactive rows' K/V → per-row
+    sample → on-device EOS/budget freezing (a finished slot's ``tok``,
+    ``keys`` and ``remaining`` stop advancing, so the values a later
+    preemption/finish pulls are exactly the single-step ones). Steps whose
+    cursor would run past ``max_seq_len``, or where every slot is already
+    frozen, skip the model apply entirely (``lax.cond``) so the shared
+    write cursor lands at exactly ``start + used`` — bit-identical cursor
+    arithmetic to running ``used`` single steps.
+
+    ``toks`` is the (chunk_size, B) token block, ``counts`` (B,) how many of
+    each slot's tokens are real (a prefix — freezing is monotone), ``used``
+    the scalar number of executed steps, and ``keys`` a COPY of the
+    post-chunk per-slot key rows (so slots retiring this chunk hand their
+    frozen key to the host for free). One ``device_get`` of these four is
+    the only host synchronization a consumer needs per chunk — and it must
+    read the ``keys`` COPY, never the state leaf itself: ``device_get`` on
+    the leaf caches a host value on that array and silently turns the next
+    chunk's donation into a full copy."""
+    from neuronx_distributed_tpu.inference.utils import unwrap_logits
+    from neuronx_distributed_tpu.modules.attention import cache_cursor
+    from neuronx_distributed_tpu.utils.sampling import sample_per_row
+
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+
+    def chunk_fn(params, cache, state):
+        temp, topk, topp = state["temp"], state["topk"], state["topp"]
+        eos = state["eos"]
+        allowed = jnp.clip(max_seq_len - cache_cursor(cache), 0, chunk_size)
+
+        def live(carry):
+            cache, tok, keys, remaining, done = carry
+            split = jax.vmap(jax.random.split)(keys)
+            carry_keys, subs = split[:, 0], split[:, 1]
+            out, variables = decode_model.apply(
+                {**params, "cache": cache}, tok[:, None],
+                padding_mask=decode_write_mask(done), mutable=["cache"],
+            )
+            nxt = sample_per_row(
+                unwrap_logits(out)[:, -1], subs, temp, topk, topp
+            )
+            emit = jnp.logical_not(done)
+            remaining = remaining - emit.astype(jnp.int32)
+            finished = emit & (
+                ((eos >= 0) & (nxt == eos)) | (remaining <= 0)
+            )
+            # freeze finished slots: their pending token / key / budget stay
+            # at the values the single-step engine would have retired with
+            tok = jnp.where(emit, nxt, tok)
+            keys = jnp.where(emit[:, None], carry_keys, keys)
+            return (
+                (variables["cache"], tok, keys, remaining, done | finished),
+                (nxt, emit),
+            )
+
+        def frozen(carry):
+            tok, done = carry[1], carry[4]
+            return carry, (tok, jnp.zeros_like(done))
+
+        def step(carry, i):
+            done = carry[4]
+            run = (i < allowed) & jnp.logical_not(jnp.all(done))
+            return jax.lax.cond(run, live, frozen, carry)
+
+        done0 = jnp.logical_not(state["active"])
+        carry0 = (cache, state["tok"], state["keys"], state["remaining"], done0)
+        (cache, tok, keys, remaining, done), (toks, emits) = jax.lax.scan(
+            step, carry0, jnp.arange(chunk_size, dtype=jnp.int32)
+        )
+        counts = emits.astype(jnp.int32).sum(0)
+        new_state = dict(
+            state, tok=tok, keys=keys, remaining=remaining,
+            active=jnp.logical_not(done),
+        )
+        return cache, new_state, toks, counts, jnp.max(counts), keys.copy()
+
+    return chunk_fn
 
 
 def validate_generate_args(model, prompt_ids, max_new_tokens, attention_mask):

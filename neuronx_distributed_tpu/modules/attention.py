@@ -309,6 +309,19 @@ def reset_cache_slot(cache, slot):
     return jax.tree_util.tree_map_with_path(fn, cache)
 
 
+def cache_cursor(cache):
+    """Shared write cursor of a raw cache collection as a traced int32
+    scalar — the min over its ``index`` leaves (every attention module
+    carries the same value; nn.scan stacking makes a leaf ``(num_layers,)``).
+    Lets a jitted consumer (the serving engine's fused decode chunk) clamp
+    its own step count against ``max_seq_len`` without a host round-trip."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(cache)
+    vals = [jnp.min(leaf) for path, leaf in flat if cache_leaf_name(path) == "index"]
+    if not vals:
+        raise ValueError("cache collection has no 'index' leaf")
+    return jnp.stack(vals).min().astype(jnp.int32)
+
+
 def reset_cache(cache):
     """Clear every slot's validity AND rewind the shared write cursor —
     the serving engine's drain/preemption reset (the storage itself is

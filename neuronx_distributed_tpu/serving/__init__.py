@@ -7,8 +7,9 @@ XLA compiles the decode step once and requests flow through slots).
 Layers:
 
 * :mod:`engine` — ``ServingEngine``: the host-side loop interleaving prefill
-  of admitted requests with ONE jitted fixed-shape decode step over all
-  active slots.
+  of admitted requests with ONE jitted fixed-shape decode program over all
+  active slots — ``decode_chunk_size`` fused steps per dispatch, donated
+  device-resident cache/slot-state, one host sync per chunk.
 * :mod:`scheduler` — FIFO + longest-prefill-first admission with a
   token-budget guard and the request lifecycle
   (QUEUED→PREFILL→DECODE→DONE/CANCELLED).

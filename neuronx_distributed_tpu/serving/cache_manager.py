@@ -66,16 +66,20 @@ class SlotCacheManager:
     """Host-side owner of the engine's cache collection + slot free list.
 
     All device work is three jitted programs compiled once each:
-    admission roll-in, per-slot free, and full reset."""
+    admission roll-in, per-slot free, and full reset. Each DONATES the big
+    cache pytree — a slot event updates the ``(num_slots, max_seq_len)``
+    storage in place instead of materializing a copy, matching the decode
+    step's donation regime (the manager's reference is replaced by the
+    result, so the consumed buffer is never touched again)."""
 
     def __init__(self, num_slots: int):
         self.num_slots = num_slots
         self.cache = None  # allocated lazily from the first prefill row
         self.cursor = 0  # host mirror of the shared `index` cursor
         self._free = list(range(num_slots))
-        self._admit_fn = jax.jit(_admit_row)
-        self._free_fn = jax.jit(reset_cache_slot)
-        self._reset_fn = jax.jit(reset_cache)
+        self._admit_fn = jax.jit(_admit_row, donate_argnums=(0,))
+        self._free_fn = jax.jit(reset_cache_slot, donate_argnums=(0,))
+        self._reset_fn = jax.jit(reset_cache, donate_argnums=(0,))
 
     # --- slot accounting ---------------------------------------------------
 
@@ -112,6 +116,17 @@ class SlotCacheManager:
         """Roll a prefill row into ``slot``. ``cursor`` (default: keep, but
         never below ``padded_len``) becomes the new shared write cursor."""
         if self.cache is None:
+            if self.cursor > 0:
+                # a first-ever allocation always starts at cursor 0; a
+                # missing cache with an advanced cursor means a donating
+                # consumer lost it mid-flight (take() never paired with
+                # update_after_decode/restore) — reallocating zeros would
+                # silently corrupt every running slot's context
+                raise RuntimeError(
+                    "cache collection missing mid-flight (cursor "
+                    f"{self.cursor}): a take() was never paired with "
+                    "update_after_decode/restore"
+                )
             self.allocate_from(row_cache)
         target = max(self.cursor, padded_len) if cursor is None else cursor
         if target < padded_len:
@@ -135,10 +150,36 @@ class SlotCacheManager:
         self._free.append(slot)
         self._free.sort()
 
-    def update_after_decode(self, new_cache) -> None:
-        """Adopt the cache returned by a decode step (cursor advanced 1)."""
+    def take(self):
+        """Hand the cache to a donating consumer (the engine's decode
+        chunk). The manager's reference is dropped so nothing can touch the
+        donated buffers; the caller MUST give the successor back via
+        :meth:`update_after_decode`, or :meth:`restore` the original if the
+        dispatch raised."""
+        cache, self.cache = self.cache, None
+        return cache
+
+    def restore(self, cache) -> None:
+        """Re-adopt a cache whose donating dispatch FAILED (cursor
+        untouched). If the failure happened after XLA consumed the buffers,
+        the next device use raises jax's deleted-buffer error — loud, which
+        is the point: without the restore, admission would silently
+        reallocate a zeroed cache under still-active slots."""
+        self.cache = cache
+
+    def release_all_slots(self) -> None:
+        """Return every slot to the free list — HOST bookkeeping only, for
+        callers about to :meth:`reset` (which invalidates all rows in one
+        device program; per-slot :meth:`free` dispatches would be
+        redundant)."""
+        self._free = list(range(self.num_slots))
+
+    def update_after_decode(self, new_cache, steps: int = 1) -> None:
+        """Adopt the cache returned by a decode dispatch; ``steps`` is how
+        many write columns the fused chunk actually consumed (its on-device
+        clamp stops the cursor early when every slot froze)."""
         self.cache = new_cache
-        self.cursor += 1
+        self.cursor += steps
 
     def reset(self) -> None:
         """Rewind the cursor and invalidate every slot's context (engine

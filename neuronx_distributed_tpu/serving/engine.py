@@ -2,24 +2,50 @@
 
 ``ServingEngine`` turns the repo's batch ``generate()`` math into a
 request-level server: a host-side loop interleaves prefill of admitted
-requests with ONE jitted fixed-shape decode step over all ``num_slots``
-slots. The decode step's shapes never change — cache ``(num_slots,
+requests with ONE jitted fixed-shape decode program over all ``num_slots``
+slots. The decode program's shapes never change — cache ``(num_slots,
 max_seq_len)``, per-slot token/key/config arrays — so XLA compiles it exactly
 once and every request, whatever its arrival time, length, or sampling
 config, flows through the same program (``decode_compilations`` asserts
-this). Prefill compiles once per padded-length bucket (powers of two), the
-standard serving trade.
+this). Prefill compiles once per padded-length bucket (powers of two,
+``prefill_compilations`` / the bucket map bound it), the standard serving
+trade.
+
+Decode hot path — device-resident between admission events:
+
+* All per-slot state (pending token, PRNG key, active mask, sampling
+  sentinels, remaining-token budget, EOS id) lives ON DEVICE in
+  ``self._state``; the jitted step updates it in place. The host writes a
+  slot's row only when admission/free/cancel dirties it (one tiny jitted
+  scatter per event), and reads a key back only at preemption/finish — the
+  two places ``req.key`` is consumed.
+* The KV cache and the slot-state dict are DONATED into the decode jit
+  (``donate_argnums``): XLA aliases the buffers instead of copying the
+  ``(num_slots, max_seq_len)`` cache pytree every token.
+* ``decode_chunk_size`` (default 8; ``1`` reproduces a per-token loop)
+  decode steps fuse into one jitted ``lax.scan``
+  (:func:`~neuronx_distributed_tpu.inference.generate.chunked_decode_step`,
+  shared with the one-shot ``generate`` module): per-slot EOS/budget
+  freezing happens on device via the decode write mask, and the host pays
+  ONE synchronization per chunk — a ``(chunk, num_slots)`` token block plus
+  per-slot counts. Chunk boundaries are the admission/cancellation points,
+  so larger chunks trade a little TTFT/cancel latency for per-token host
+  overhead amortized ``chunk``-fold.
 
 Token-stream fidelity: a request served through the engine produces EXACTLY
 the tokens of a solo ``generate(prompt, key)`` call — same prefill math
 (left-padded prompts are already proven token-identical to unpadded ones),
 same per-step key evolution (``split`` then sample with the sub-key), and a
-per-row sampler that is bit-identical to ``sample`` (utils/sampling.py). The
+per-row sampler that is bit-identical to ``sample`` (utils/sampling.py) —
+for every ``decode_chunk_size``, including across preemption/resume. The
 engine is a scheduler around the same program, not a different generator.
 
 Cache capacity: all slots share one write cursor (see
 ``serving/cache_manager.py``), which advances every decode step while ANY
-slot is active. Admission guards against running past ``max_seq_len``:
+slot is active. The fused chunk clamps itself against ``max_seq_len`` on
+device and stops advancing once every slot froze, so the cursor lands
+exactly where ``used`` single steps would have left it. Admission guards
+against running past ``max_seq_len``:
 
 * ``admission="conservative"`` (default) — admit only when the request's
   whole remaining generation fits under the cursor; requests queue
@@ -41,7 +67,9 @@ import numpy as np
 
 from neuronx_distributed_tpu.inference.generate import (
     GenerationConfig,
+    chunked_decode_step,
     serving_clones,
+    validate_generate_args,
 )
 from neuronx_distributed_tpu.inference.utils import unwrap_logits
 from neuronx_distributed_tpu.serving.cache_manager import SlotCacheManager
@@ -51,7 +79,7 @@ from neuronx_distributed_tpu.serving.scheduler import (
     RequestState,
     Scheduler,
 )
-from neuronx_distributed_tpu.utils.sampling import sample_per_row, sample_row
+from neuronx_distributed_tpu.utils.sampling import sample_row
 
 
 def _key_data(key) -> np.ndarray:
@@ -84,6 +112,28 @@ def _bucket(p: int, max_seq_len: int, remaining: int, floor: int = 8) -> int:
     return b
 
 
+def _slot_write(state, slot, tok, key, temp, topk, topp, remaining, eos):
+    """One admission's device-side slot update. Every operand is a traced
+    scalar/row, so slot churn reuses a single compiled program; jitted with
+    the state donated — the update happens in place."""
+    return dict(
+        state,
+        tok=state["tok"].at[slot].set(tok),
+        keys=state["keys"].at[slot].set(key),
+        active=state["active"].at[slot].set(True),
+        temp=state["temp"].at[slot].set(temp),
+        topk=state["topk"].at[slot].set(topk),
+        topp=state["topp"].at[slot].set(topp),
+        remaining=state["remaining"].at[slot].set(remaining),
+        eos=state["eos"].at[slot].set(eos),
+    )
+
+
+def _slot_clear(state, slot):
+    """Deactivate one slot on device (free/cancel); state donated."""
+    return dict(state, active=state["active"].at[slot].set(False))
+
+
 class ServingEngine:
     """Slot-based continuous batching over a mode-capable causal LM."""
 
@@ -94,11 +144,16 @@ class ServingEngine:
         num_slots: int,
         max_tokens_in_flight: Optional[int] = None,
         admission: str = "conservative",
+        decode_chunk_size: int = 8,
         timeline=None,
         time_fn: Callable[[], float] = time.monotonic,
     ):
         if admission not in ("conservative", "eager"):
             raise ValueError(f"unknown admission policy {admission!r}")
+        if decode_chunk_size < 1:
+            raise ValueError(
+                f"decode_chunk_size must be >= 1, got {decode_chunk_size}"
+            )
         max_seq_len = getattr(getattr(model, "config", None), "max_seq_len", None)
         if max_seq_len is None:
             raise ValueError(
@@ -106,31 +161,68 @@ class ServingEngine:
                 "slot cache length)"
             )
         self.model = model
-        self.params = params
+        self.params = params  # property: binds self._params once per assign
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len
         self.admission = admission
+        self.decode_chunk_size = decode_chunk_size
         self.timeline = timeline
         self._clock = time_fn
         self._prefill_model, self._decode_model = serving_clones(model)
         self.scheduler = Scheduler(max_tokens_in_flight)
         self.cache = SlotCacheManager(num_slots)
         self.metrics = ServingMetrics(num_slots)
-        # per-slot device-step state (host numpy mirrors; fixed shapes)
-        self._tok = np.zeros((num_slots,), np.int32)
-        self._keys = np.zeros((num_slots, 2), np.uint32)
+        # host-side slot bookkeeping (scheduling only — the decode-visible
+        # per-slot state lives on device in self._state)
         self._active = np.zeros((num_slots,), bool)
-        self._temp = np.ones((num_slots,), np.float32)
-        self._topk = np.zeros((num_slots,), np.int32)
-        self._topp = np.ones((num_slots,), np.float32)
         self._slot_req: List[Optional[Request]] = [None] * num_slots
         self._on_token: Dict[int, Callable[[Request, int], None]] = {}
         self._next_rid = 0
         self._prefill_fns: Dict[int, Callable] = {}
-        self._decode_step = jax.jit(self._decode_step_impl)
+        self._state = self._fresh_slot_state()
+        # host snapshot of the per-slot keys from the CURRENT chunk readback
+        # (set only while unpacking a chunk): finishing requests take their
+        # key from here, so retirement costs no extra device sync
+        self._chunk_keys: Optional[np.ndarray] = None
+        # the fused decode chunk: cache AND slot state donated — XLA updates
+        # both in place instead of materializing a fresh cache pytree
+        self._decode_chunk = jax.jit(
+            chunked_decode_step(
+                self._decode_model, decode_chunk_size, max_seq_len
+            ),
+            donate_argnums=(1, 2),
+        )
+        self._slot_write = jax.jit(_slot_write, donate_argnums=(0,))
+        self._slot_clear = jax.jit(_slot_clear, donate_argnums=(0,))
         self._first_token = jax.jit(sample_row)
 
+    def _fresh_slot_state(self):
+        b = self.num_slots
+        return {
+            "tok": jnp.zeros((b,), jnp.int32),
+            "keys": jnp.zeros((b, 2), jnp.uint32),
+            "active": jnp.zeros((b,), jnp.bool_),
+            "temp": jnp.ones((b,), jnp.float32),
+            "topk": jnp.zeros((b,), jnp.int32),
+            "topp": jnp.ones((b,), jnp.float32),
+            "remaining": jnp.zeros((b,), jnp.int32),
+            "eos": jnp.full((b,), -1, jnp.int32),
+        }
+
     # --- public API ---------------------------------------------------------
+
+    @property
+    def params(self):
+        """The engine's weights. Assignment rebinds the params pytree the
+        jitted prefill/decode programs receive — ONCE per assignment, not
+        per step (the hot path never rebuilds it), so weight swaps still
+        take effect on the next dispatch."""
+        return self._params_src
+
+    @params.setter
+    def params(self, value):
+        self._params_src = value
+        self._params = dict(value)
 
     def submit(
         self,
@@ -148,14 +240,10 @@ class ServingEngine:
             raise ValueError("empty prompt")
         if config.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if prompt.size + config.max_new_tokens > self.max_seq_len:
-            # same contract as generate(): past max_seq_len the cache write
-            # index and RoPE positions would clamp and corrupt output
-            raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens "
-                f"({config.max_new_tokens}) exceeds max_seq_len "
-                f"({self.max_seq_len})"
-            )
+        # same capacity contract as generate(), checked by the shared helper
+        validate_generate_args(
+            self.model, prompt[None], config.max_new_tokens, None
+        )
         budget = self.scheduler.max_tokens_in_flight
         if budget is not None and prompt.size + config.max_new_tokens > budget:
             # a footprint over the whole budget can NEVER be admitted —
@@ -183,7 +271,7 @@ class ServingEngine:
 
     def cancel(self, rid: int) -> bool:
         """Cancel a request. Queued: dropped immediately; running: its slot
-        is reaped at the next step."""
+        is reaped at the next chunk boundary."""
         req = self.scheduler.get(rid)
         if req is None or req.finished:
             return False
@@ -191,6 +279,9 @@ class ServingEngine:
         ok = self.scheduler.cancel(rid)
         if ok and was_queued:
             self.metrics.record_cancel(req, self._clock())
+            # queued requests never reach _release_slot — drop the callback
+            # here or it leaks for the engine's lifetime
+            self._on_token.pop(rid, None)
         return ok
 
     @property
@@ -199,14 +290,23 @@ class ServingEngine:
 
     @property
     def decode_compilations(self) -> int:
-        """How many distinct decode-step programs XLA compiled. Stays 1
-        across arbitrary slot churn — the continuous-batching invariant."""
-        return int(self._decode_step._cache_size())
+        """How many distinct decode programs XLA compiled. Stays 1 across
+        arbitrary slot churn — the continuous-batching invariant (one
+        program per engine, whatever the chunk size)."""
+        return int(self._decode_chunk._cache_size())
+
+    @property
+    def prefill_compilations(self) -> int:
+        """How many distinct prefill programs XLA compiled — one per padded
+        bucket length actually used, so growth is bounded by the number of
+        distinct ``_bucket`` outputs (a handful of powers of two plus exact
+        fallbacks)."""
+        return sum(int(fn._cache_size()) for fn in self._prefill_fns.values())
 
     def step(self) -> bool:
         """One engine iteration: reap cancellations → preempt/rewind if the
-        cursor is out of room → admit+prefill → one decode step → retire
-        finished slots. Returns whether work remains."""
+        cursor is out of room → admit+prefill → one fused decode chunk →
+        retire finished slots. Returns whether work remains."""
         now = self._clock()
         self._reap_cancelled(now)
         if any(self._active) and self.cache.cursor >= self.max_seq_len:
@@ -217,7 +317,7 @@ class ServingEngine:
             self.cache.reset()
         self._admit(now)
         if any(self._active):
-            self._decode(now)
+            self._decode()
         if self.timeline is not None:
             self.timeline.counter("slots_active", int(self._active.sum()), "serving")
             self.timeline.counter("queue_depth", self.scheduler.queued, "serving")
@@ -303,10 +403,12 @@ class ServingEngine:
         if self.timeline is not None:
             self.timeline.mark_event_start("prefill", "serving")
         logits, row_cache = self._prefill_fn(padded)(
-            self.params, jnp.asarray(ids), jnp.asarray(mask)
+            self._params, jnp.asarray(ids), jnp.asarray(mask)
         )
         if self.timeline is not None:
-            self.timeline.mark_event_end("prefill", "serving")
+            self.timeline.mark_event_end(
+                "prefill", "serving", args={"rid": req.rid, "padded": padded}
+            )
         self.cache.admit(row_cache, slot, padded)
         self.metrics.record_admit(req, now)
         if req.admit_time is None:
@@ -319,13 +421,31 @@ class ServingEngine:
             tok0 = int(self._first_token(logits, sub, temp, topk, topp))
             req.key = np.asarray(carry, np.uint32)
             self._emit_token(req, tok0, now, first=True)
+            if req.state is RequestState.CANCELLED:
+                # the on_token callback cancelled on the FIRST token (while
+                # req.slot was still None, so cancel() already recorded it):
+                # the slot was acquired but never bound — free it and stop
+                # before the DECODE transition would erase the cancellation
+                req.finish_time = now
+                self.cache.free(slot)
+                self._on_token.pop(req.rid, None)
+                return
         req.state = RequestState.DECODE
         req.slot = slot
         self._slot_req[slot] = req
-        self._tok[slot] = req.tokens[-1]
-        self._keys[slot] = req.key
-        self._temp[slot], self._topk[slot], self._topp[slot] = (
-            _config_sentinels(req.config)
+        temp, topk, topp = _config_sentinels(req.config)
+        self._state = self._slot_write(
+            self._state,
+            np.int32(slot),
+            np.int32(req.tokens[-1]),
+            jnp.asarray(req.key),
+            temp, topk, topp,
+            np.int32(req.remaining_new_tokens),
+            np.int32(
+                req.config.eos_token_id
+                if req.config.eos_token_id is not None
+                else -1
+            ),
         )
         self._active[slot] = True
         # a request can be born finished (max_new_tokens == 1, or EOS as
@@ -334,53 +454,86 @@ class ServingEngine:
 
     # --- decode -------------------------------------------------------------
 
-    def _decode_step_impl(self, params, cache, tok, keys, active,
-                          temp, topk, topp):
-        """THE fixed-shape decode step: one token for every slot, per-slot
-        sampling config, per-slot key split. Inactive slots still compute
-        (fixed shapes are the point) but their K/V writes are masked
-        invalid so freed slots never pollute attendable context."""
-        split = jax.vmap(jax.random.split)(keys)  # (B, 2, 2)
-        carry_keys, subs = split[:, 0], split[:, 1]
-        out, variables = self._decode_model.apply(
-            {**params, "cache": cache}, tok[:, None],
-            padding_mask=active[:, None], mutable=["cache"],
+    def _decode(self) -> None:
+        """One fused decode chunk: dispatch the donated jitted scan, then a
+        SINGLE host synchronization for the whole token block. Between here
+        and the next admission/free event no per-slot host state moves."""
+        tl = self.timeline
+        active_at_dispatch = int(self._active.sum())
+        if tl is not None:
+            tl.mark_event_start("decode_dispatch", "serving")
+        t0 = self._clock()
+        cache_in = self.cache.take()
+        try:
+            (new_cache, self._state, toks, counts, used,
+             key_snap) = self._decode_chunk(
+                self._params, cache_in, self._state
+            )
+        except BaseException:
+            # a failed dispatch must not leave the manager cache-less: a
+            # later admission would silently reallocate zeros under
+            # still-active slots. Restored buffers that WERE consumed fail
+            # loudly (deleted-buffer error) on next use instead.
+            self.cache.restore(cache_in)
+            raise
+        t1 = self._clock()
+        if tl is not None:
+            tl.mark_event_end("decode_dispatch", "serving")
+            tl.mark_event_start("decode_readback", "serving")
+        # THE one host sync per chunk: the (chunk, slots) token block, the
+        # per-slot valid-prefix lengths, the executed step count — and the
+        # post-chunk key SNAPSHOT (frozen at each slot's finish step), so
+        # requests retiring this chunk need no per-slot key pull. The
+        # snapshot is a chunk OUTPUT, not the state leaf: device_get on the
+        # leaf would cache a host value on it and silently demote the next
+        # chunk's keys donation to a copy
+        toks, counts, used, chunk_keys = jax.device_get(
+            (toks, counts, used, key_snap)
         )
-        logits = unwrap_logits(out)[:, -1]
-        nxt = sample_per_row(logits, subs, temp, topk, topp)
-        return variables["cache"], carry_keys, nxt
-
-    def _decode(self, now: float) -> None:
-        if self.timeline is not None:
-            self.timeline.mark_event_start("decode_step", "serving")
-        new_cache, new_keys, nxt = self._decode_step(
-            dict(self.params), self.cache.cache,
-            jnp.asarray(self._tok), jnp.asarray(self._keys),
-            jnp.asarray(self._active), jnp.asarray(self._temp),
-            jnp.asarray(self._topk), jnp.asarray(self._topp),
+        t2 = self._clock()
+        used = int(used)
+        emitted = int(counts.sum())
+        self.cache.update_after_decode(new_cache, used)
+        if tl is not None:
+            tl.mark_event_end(
+                "decode_readback", "serving",
+                args={"tokens": emitted, "steps": used},
+            )
+        now = self._clock()
+        delivered = 0
+        self._chunk_keys = chunk_keys
+        try:
+            for slot in np.flatnonzero(self._active):
+                req = self._slot_req[slot]
+                for tok in toks[: int(counts[slot]), slot]:
+                    self._emit_token(req, int(tok), now)
+                    delivered += 1
+                    self._maybe_finish(req, now)
+                    if req.finished:
+                        # EOS/budget retired it, or an on_token callback
+                        # cancelled it: discard the rest of its block
+                        break
+        finally:
+            self._chunk_keys = None
+        # recorded after the unpack so a mid-chunk cancellation's discarded
+        # device tokens never inflate decode_tokens / chunk tok/s
+        if tl is not None:
+            tl.counter("chunk_tokens", delivered, "serving")
+        self.metrics.record_decode_chunk(
+            delivered, used, self.cache.cursor, active_at_dispatch,
+            dispatch_s=t1 - t0, readback_s=t2 - t1,
         )
-        self.cache.update_after_decode(new_cache)
-        self.metrics.record_decode_step(
-            int(self._active.sum()), self.cache.cursor
-        )
-        nxt = np.asarray(nxt)
-        # np.array (not asarray): device arrays view as read-only, but the
-        # admission path writes per-slot keys into this mirror
-        self._keys = np.array(new_keys)
-        if self.timeline is not None:
-            self.timeline.mark_event_end("decode_step", "serving")
-        for slot in np.flatnonzero(self._active):
-            req = self._slot_req[slot]
-            tok = int(nxt[slot])
-            self._tok[slot] = tok
-            # copy, not view: a view would alias the mirror row, and a later
-            # admission writing another request's key into this slot would
-            # silently corrupt THIS request's key stream after preemption
-            req.key = self._keys[slot].copy()
-            self._emit_token(req, tok, now)
-            self._maybe_finish(req, now)
 
     # --- lifecycle helpers --------------------------------------------------
+
+    def _pull_key(self, slot: int) -> np.ndarray:
+        """Per-slot device→host key fetch — used only at PREEMPTION (the
+        one place a key must leave the device outside a chunk readback;
+        finishing requests take theirs from the chunk's own sync via
+        ``_chunk_keys``). The chunked step freezes a finished slot's key at
+        its last sampled token, so both paths yield exactly the
+        single-step value."""
+        return np.array(jax.device_get(self._state["keys"][slot]), np.uint32)
 
     def _emit_token(self, req: Request, tok: int, now: float,
                     first: bool = False) -> None:
@@ -402,6 +555,11 @@ class ServingEngine:
         if hit_eos or len(req.tokens) >= req.config.max_new_tokens:
             req.state = RequestState.DONE
             req.finish_time = now
+            if req.slot is not None and self._chunk_keys is not None:
+                # retiring mid-unpack: the post-chunk key already rode the
+                # chunk's single readback (at prefill-time finishes req.key
+                # is current on the host and needs no update)
+                req.key = np.array(self._chunk_keys[req.slot], np.uint32)
             self.metrics.record_finish(req, now)
             self._release_slot(req)
             if self.timeline is not None:
@@ -414,6 +572,7 @@ class ServingEngine:
         req.slot = None
         self._slot_req[slot] = None
         self._active[slot] = False
+        self._state = self._slot_clear(self._state, np.int32(slot))
         self.cache.free(slot)
         self._on_token.pop(req.rid, None)
 
@@ -426,19 +585,26 @@ class ServingEngine:
 
     def _preempt_all(self) -> None:
         """Out of cache columns: push every active request back to the queue
-        (keeping its generated tokens and current key), rewind the cache,
-        and let admission re-prefill their contexts. Token streams are
-        unaffected — resume replays the exact context the request had."""
+        (keeping its generated tokens and its device-held key), rewind the
+        cache, and let admission re-prefill their contexts. Token streams
+        are unaffected — resume replays the exact context the request had."""
         preempted = [r for r in self._slot_req if r is not None]
         for req in preempted:
             req.preemptions += 1
             self.metrics.record_preemption(req)
+            req.key = self._pull_key(req.slot)
             slot, req.slot = req.slot, None
             self._slot_req[slot] = None
             self._active[slot] = False
-            self.cache.free(slot)
         self.scheduler.requeue_front(preempted)
+        # ONE device reset invalidates every row — per-slot free() dispatches
+        # here would be N redundant full-cache programs; only the host
+        # free-list needs per-slot bookkeeping
+        self.cache.release_all_slots()
         self.cache.reset()
+        # every slot is empty now; re-admission re-uploads each row, so a
+        # fresh zero state is cheaper than N per-slot clears
+        self._state = self._fresh_slot_state()
         if self.timeline is not None:
             self.timeline.instant(
                 f"preempt x{len(preempted)}", "serving"

@@ -22,7 +22,8 @@ class ServingMetrics:
     def __init__(self, num_slots: int = 0):
         self.num_slots = num_slots
         # engine counters
-        self.steps = 0  # decode steps executed
+        self.steps = 0  # decode steps executed (chunks × their used steps)
+        self.chunks = 0  # decode dispatches (== host syncs on the hot path)
         self.prefills = 0
         self.decode_tokens = 0  # tokens produced by decode steps
         self.completed = 0
@@ -30,6 +31,11 @@ class ServingMetrics:
         self.preemptions = 0
         self.cursor_high_water = 0
         self.occupied_slot_steps = 0  # Σ active slots over decode steps
+        # decode hot-path wall time, split at the host-sync boundary:
+        # dispatch = enqueue the jitted chunk (donated, async), readback =
+        # block on the chunk's token block (the ONE sync per chunk)
+        self.decode_dispatch_s = 0.0
+        self.decode_readback_s = 0.0
         # per-request
         self._requests: Dict[int, dict] = {}
 
@@ -81,10 +87,32 @@ class ServingMetrics:
     # --- engine step --------------------------------------------------------
 
     def record_decode_step(self, active_slots: int, cursor: int) -> None:
-        self.steps += 1
-        self.decode_tokens += active_slots
-        self.occupied_slot_steps += active_slots
+        """Single-step accounting — the chunk-size-1 special case."""
+        self.record_decode_chunk(active_slots, 1, cursor, active_slots)
+
+    def record_decode_chunk(
+        self,
+        tokens: int,
+        steps: int,
+        cursor: int,
+        active_slots: int,
+        dispatch_s: float = 0.0,
+        readback_s: float = 0.0,
+    ) -> None:
+        """One fused decode chunk: ``tokens`` DELIVERED to requests across
+        ``steps`` executed scan steps by ``active_slots`` slots held at
+        dispatch. Occupancy counts slots HELD, not tokens — a slot frozen
+        mid-chunk (early EOS) still owns its cache row until the chunk
+        boundary, so it occupies all ``steps``. ``dispatch_s``/
+        ``readback_s`` split the wall time around the chunk's single host
+        sync."""
+        self.chunks += 1
+        self.steps += steps
+        self.decode_tokens += tokens
+        self.occupied_slot_steps += active_slots * steps
         self.cursor_high_water = max(self.cursor_high_water, cursor)
+        self.decode_dispatch_s += dispatch_s
+        self.decode_readback_s += readback_s
 
     # --- export -------------------------------------------------------------
 
@@ -105,9 +133,16 @@ class ServingMetrics:
             r["queue_wait"] for r in self._requests.values()
             if "queue_wait" in r
         ]
+        decode_wall = self.decode_dispatch_s + self.decode_readback_s
         return {
             "num_slots": self.num_slots,
             "steps": self.steps,
+            "chunks": self.chunks,
+            "decode_dispatch_s": self.decode_dispatch_s,
+            "decode_readback_s": self.decode_readback_s,
+            "chunk_tokens_per_sec": (
+                self.decode_tokens / decode_wall if decode_wall > 0 else 0.0
+            ),
             "prefills": self.prefills,
             "decode_tokens": self.decode_tokens,
             "completed": self.completed,

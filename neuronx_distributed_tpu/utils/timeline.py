@@ -41,26 +41,33 @@ class Timeline:
         with self._lock:
             self._open[(name, category)] = self._now_us()
 
-    def mark_event_end(self, name: str, category: str = "host") -> None:
+    def mark_event_end(
+        self, name: str, category: str = "host", args: Optional[dict] = None
+    ) -> None:
+        """Close a duration event. ``args`` attaches a payload dict shown in
+        the Perfetto event pane — e.g. the serving engine's per-chunk token
+        count next to its decode_readback span, so dispatch-vs-readback time
+        AND per-chunk tok/s read off one trace."""
         if not self.enabled:
             return
         with self._lock:
             start = self._open.pop((name, category), None)
             if start is None:
                 return
-            self._events.append(
-                {
-                    "name": name,
-                    "cat": category,
-                    "ph": "X",
-                    "ts": start,
-                    "dur": self._now_us() - start,
-                    "pid": self.rank,
-                    "tid": threading.get_ident() % 10000,
-                }
-            )
+            ev = {
+                "name": name,
+                "cat": category,
+                "ph": "X",
+                "ts": start,
+                "dur": self._now_us() - start,
+                "pid": self.rank,
+                "tid": threading.get_ident() % 10000,
+            }
+            if args:
+                ev["args"] = dict(args)
+            self._events.append(ev)
 
-    def event(self, name: str, category: str = "host"):
+    def event(self, name: str, category: str = "host", args: Optional[dict] = None):
         """Context manager form."""
         timeline = self
 
@@ -70,7 +77,7 @@ class Timeline:
                 return self
 
             def __exit__(self, *exc):
-                timeline.mark_event_end(name, category)
+                timeline.mark_event_end(name, category, args=args)
                 return False
 
         return _Ctx()

@@ -244,6 +244,50 @@ def test_callback_cancel_wins_over_finish(setup):
     assert engine.cache.free_slots == 1
 
 
+def test_callback_cancel_on_first_token_wins(setup):
+    """Regression: a cancel() issued from the on_token callback on the
+    FIRST (prefill-sampled) token used to be erased by the DECODE state
+    transition — the request would decode its whole stream and count as
+    both cancelled AND completed."""
+    cfg, model, params = setup
+    engine = ServingEngine(model, params, num_slots=2)
+    req = engine.submit(
+        np.asarray([2, 3, 4], np.int32),
+        GenerationConfig(max_new_tokens=8, temperature=0.0),
+        key=jax.random.PRNGKey(8),
+        on_token=lambda r, t: engine.cancel(r.rid),
+    )
+    engine.run()
+    assert req.state is RequestState.CANCELLED
+    assert len(req.tokens) == 1  # nothing decoded past the cancel
+    assert engine.metrics.cancelled == 1
+    assert engine.metrics.completed == 0
+    assert engine.cache.free_slots == 2  # the acquired slot was returned
+
+
+def test_cancel_queued_drops_callback(setup):
+    """Regression: cancelling a still-queued request must drop its
+    on_token callback (queued requests never reach _release_slot, so the
+    entry used to leak for the engine's lifetime)."""
+    cfg, model, params = setup
+    engine = ServingEngine(model, params, num_slots=1)
+    blocker = engine.submit(
+        np.asarray([1, 2], np.int32),
+        GenerationConfig(max_new_tokens=6, temperature=0.0),
+    )
+    engine.step()  # blocker occupies the only slot
+    queued = engine.submit(
+        np.asarray([3, 4], np.int32),
+        GenerationConfig(max_new_tokens=6, temperature=0.0),
+        on_token=lambda r, t: None,
+    )
+    assert queued.rid in engine._on_token
+    assert engine.cancel(queued.rid)
+    assert queued.rid not in engine._on_token
+    engine.run()
+    assert blocker.state is RequestState.DONE
+
+
 def test_conservative_admission_never_preempts(setup):
     """Default policy defers admission instead of overrunning the cache —
     the preemption counter stays 0."""
@@ -355,8 +399,10 @@ def test_on_token_streaming_callback(setup):
 
 
 def test_timeline_wiring(setup, tmp_path):
-    """With a Timeline attached, the engine emits prefill/decode duration
-    events and occupancy counters into valid Chrome-trace JSON."""
+    """With a Timeline attached, the engine emits prefill plus
+    dispatch/readback decode duration events (readback carrying the
+    per-chunk token count as args) and occupancy counters into valid
+    Chrome-trace JSON."""
     import json
 
     from neuronx_distributed_tpu.utils.timeline import Timeline
@@ -373,5 +419,9 @@ def test_timeline_wiring(setup, tmp_path):
     tl.save()
     events = json.loads(trace.read_text())["traceEvents"]
     names = {e["name"] for e in events}
-    assert "decode_step" in names and "prefill" in names
+    assert "decode_dispatch" in names and "prefill" in names
     assert "slots_active" in names  # counter track
+    readbacks = [e for e in events if e["name"] == "decode_readback"]
+    assert readbacks  # the one host sync per chunk is a first-class span
+    assert sum(e["args"]["tokens"] for e in readbacks) == 3  # 4 - first
+    assert "chunk_tokens" in names  # per-chunk counter track

@@ -78,6 +78,26 @@ def test_snapshot_aggregates():
     assert snap["mean_latency"] > snap["mean_ttft"]
 
 
+def test_chunk_occupancy_counts_held_slots():
+    """A slot frozen mid-chunk (early EOS) still owns its cache row until
+    the chunk boundary: occupancy counts slots HELD × executed steps, not
+    emitted tokens."""
+    m = ServingMetrics(num_slots=4)
+    # 2 slots held through an 8-step chunk; one froze after 2 tokens
+    m.record_decode_chunk(
+        tokens=10, steps=8, cursor=16, active_slots=2,
+        dispatch_s=0.5, readback_s=0.1,
+    )
+    assert m.chunks == 1 and m.steps == 8
+    assert m.decode_tokens == 10
+    assert m.occupied_slot_steps == 16  # 2 slots × 8 steps, not 10 tokens
+    assert m.mean_occupancy == 2.0
+    snap = m.snapshot()
+    assert snap["decode_dispatch_s"] == 0.5
+    assert snap["decode_readback_s"] == 0.1
+    assert abs(snap["chunk_tokens_per_sec"] - 10 / 0.6) < 1e-9
+
+
 def test_cancel_counts():
     m = ServingMetrics()
     r = _req(3)
