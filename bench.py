@@ -53,6 +53,7 @@ TINY_TIMEOUT_S = 300
 FULL_TIMEOUT_S = 600
 PROXY_TIMEOUT_S = 420
 SERVING_TIMEOUT_S = 420
+FAULTS_TIMEOUT_S = 300
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -521,6 +522,98 @@ def _measure_serving_chunk(devs):
     return out
 
 
+def _measure_serving_faults(devs):
+    """Fault-tolerance recovery overhead (``--child-faults``): the SAME
+    request workload through the continuous-batching engine clean vs with
+    one injected mid-run dispatch failure (bounded-retry recovery requeues
+    the in-flight requests and resumes). Reports the recovery's wall-clock
+    overhead and proves zero token loss: every stream in the faulted run is
+    bit-identical to the clean run's."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.serving import FaultInjector, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=int(rng.randint(6, 18))).astype(np.int32)
+        for _ in range(6)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=48, temperature=0.8, top_k=20)
+
+    def run(injector):
+        engine = ServingEngine(
+            model, params, num_slots=4, decode_chunk_size=4,
+            fault_injector=injector,
+        )
+        # warmup wave compiles prefill buckets + the decode program so the
+        # fault run's overhead measures RECOVERY, not compilation
+        for i, p in enumerate(prompts[:4]):
+            engine.submit(
+                p,
+                GenerationConfig(max_new_tokens=8, temperature=0.8, top_k=20),
+                key=jax.random.PRNGKey(i),
+            )
+        engine.run()
+        t0 = _t.perf_counter()
+        reqs = [
+            engine.submit(p, gcfg, key=jax.random.PRNGKey(100 + i))
+            for i, p in enumerate(prompts)
+        ]
+        engine.run()
+        wall = _t.perf_counter() - t0
+        return engine, reqs, wall
+
+    _, clean_reqs, clean_wall = run(None)
+    inj = FaultInjector().fail_dispatch(at=6, times=1)  # mid-run, post-warmup
+    engine, fault_reqs, fault_wall = run(inj)
+
+    clean_streams = [r.tokens for r in clean_reqs]
+    fault_streams = [r.tokens for r in fault_reqs]
+
+    def _lost(clean, faulted):
+        # clean-run tokens NOT reproduced by the faulted run: everything
+        # past the first divergence point (the recovery contract is 0)
+        agree = 0
+        for a, b in zip(clean, faulted):
+            if a != b:
+                break
+            agree += 1
+        return len(clean) - agree
+
+    tokens_lost = sum(
+        _lost(c, f) for c, f in zip(clean_streams, fault_streams)
+    )
+    return {
+        "injected_dispatch_failures": inj.counters["dispatch_failures"],
+        "dispatch_retries": engine.metrics.dispatch_retries,
+        "recoveries": engine.metrics.recoveries,
+        "health_after": engine.metrics.snapshot()["health"],
+        "streams_bit_identical": clean_streams == fault_streams,
+        "tokens_lost": int(tokens_lost),
+        "clean_wall_s": round(clean_wall, 4),
+        "fault_wall_s": round(fault_wall, 4),
+        "recovery_overhead_s": round(fault_wall - clean_wall, 4),
+        "recovery_overhead_pct": round(
+            100.0 * (fault_wall - clean_wall) / clean_wall, 2
+        ) if clean_wall > 0 else 0.0,
+    }
+
+
 def _flash_block_sweep(batch, seq):
     import jax
     import jax.numpy as jnp
@@ -727,6 +820,31 @@ def child_serving() -> None:
         _emit(
             {
                 "metric": "serving_chunk",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
+def child_faults() -> None:
+    """Serving fault-tolerance child (``--child-faults``): recovery
+    overhead of an injected mid-run dispatch failure vs the clean run on
+    the same workload (tokens lost must be 0). Prints one JSON line;
+    merged into the BENCH artifact as ``extras.serving_faults``."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_faults",
+                "unit": "recovery overhead",
+                "platform": devs[0].platform,
+                **_measure_serving_faults(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_faults",
                 "error": f"{type(e).__name__}: {str(e)[:400]}",
             }
         )
@@ -1040,6 +1158,7 @@ def main() -> None:
     probe_info = None
     proxy_result = None
     serving_result = None
+    faults_result = None
 
     import signal
 
@@ -1059,6 +1178,11 @@ def main() -> None:
             serving_result
             if serving_result is not None
             else {"error": "serving child did not finish"}
+        )
+        extras["serving_faults"] = (
+            faults_result
+            if faults_result is not None
+            else {"error": "faults child did not finish"}
         )
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
         builder = _load_builder_artifact()
@@ -1165,6 +1289,16 @@ def main() -> None:
     else:
         serving_result = {"error": f"serving child: {err}"}
 
+    # 6. Fault-tolerance child: recovery overhead + zero-token-loss proof
+    #    on the same mesh-free CPU workload (after the serving child so the
+    #    wall-clock comparisons never contend for cores).
+    faults, err = _run_child("--child-faults", FAULTS_TIMEOUT_S)
+    if faults is not None:
+        faults.pop("metric", None)
+        faults_result = faults
+    else:
+        faults_result = {"error": f"faults child: {err}"}
+
     _finalize()
 
 
@@ -1177,6 +1311,8 @@ if __name__ == "__main__":
         child_sweep()
     elif "--child-serving" in sys.argv:
         child_serving()
+    elif "--child-faults" in sys.argv:
+        child_faults()
     elif "--child" in sys.argv:
         child(tiny=False)
     elif "--probe" in sys.argv:

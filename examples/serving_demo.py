@@ -20,11 +20,25 @@ request arriving mid-chunk grows by up to a chunk of decode steps.
 ``--decode-chunk 1`` is the per-token loop. Streams are bit-identical
 either way.
 
+``--inject-fault`` drives the fault-tolerance layer end to end through the
+deterministic ``FaultInjector`` harness: ``dispatch`` injects one decode
+dispatch failure mid-run (the engine requeues in-flight requests and
+recovers, streams intact), ``halt`` fails every dispatch until the engine
+lands in HALTED with the work requeued, ``poison`` corrupts one slot's
+readback (quarantined out of the rotation, victim resumes elsewhere),
+``prefill`` OOM-fails one admission (that request FAILS for cause, the
+loop survives). ``--deadline``/``--queue-timeout`` attach per-request
+deadlines so sheds show up in the summary (pair with ``--inject-fault
+skew`` to jump the engine clock past them without waiting).
+
 CPU-runnable out of the box:
 
   python examples/serving_demo.py
   python examples/serving_demo.py --requests 12 --slots 2 --admission eager
   python examples/serving_demo.py --decode-chunk 1   # per-token stepping
+  python examples/serving_demo.py --inject-fault dispatch
+  python examples/serving_demo.py --inject-fault poison --slots 4
+  python examples/serving_demo.py --deadline 0.5 --inject-fault skew
   python examples/serving_demo.py --timeline /tmp/serving_trace.json
 """
 
@@ -52,6 +66,21 @@ def parse_args(argv=None):
                         "loop; higher = more decode throughput, coarser "
                         "TTFT/cancel granularity at chunk boundaries)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--inject-fault", default="none",
+                   choices=["none", "dispatch", "halt", "poison", "prefill",
+                            "skew"],
+                   help="drive a recovery path through the FaultInjector: "
+                        "one dispatch failure (recover), all dispatches "
+                        "(HALTED), a poisoned readback (quarantine), a "
+                        "prefill OOM (fail one request), or clock skew "
+                        "(trip --deadline/--queue-timeout instantly)")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="per-request end-to-end deadline in seconds "
+                        "(missed → TIMED_OUT at the next chunk boundary, "
+                        "partial stream kept)")
+    p.add_argument("--queue-timeout", type=float, default=None,
+                   help="per-request admission timeout in seconds (missed "
+                        "→ shed before prefill)")
     p.add_argument("--timeline", default=None,
                    help="write a chrome://tracing JSON of the serving loop")
     p.add_argument("--force-cpu-devices", type=int, default=None)
@@ -74,7 +103,7 @@ def main(argv=None):
         LlamaForCausalLM,
         tiny_llama,
     )
-    from neuronx_distributed_tpu.serving import ServingEngine
+    from neuronx_distributed_tpu.serving import FaultInjector, ServingEngine
     from neuronx_distributed_tpu.utils.timeline import Timeline
 
     cfg = tiny_llama()
@@ -83,6 +112,24 @@ def main(argv=None):
     init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
     params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
 
+    injector = None
+    if args.inject_fault != "none":
+        injector = FaultInjector()
+        if args.inject_fault == "dispatch":
+            injector.fail_dispatch(at=2, times=1)  # one mid-run failure
+        elif args.inject_fault == "halt":
+            injector.fail_dispatch(at=2, times=None)  # fail until HALTED
+        elif args.inject_fault == "poison":
+            injector.poison_readback(at=2, slot=0, token=-1)
+        elif args.inject_fault == "prefill":
+            injector.fail_prefill(at=1, times=1)
+        elif args.inject_fault == "skew":
+            # kick in shortly AFTER the first submissions so their
+            # (unskewed) deadlines are already armed when the clock jumps
+            import time as _time
+
+            injector.skew_clock(by=3600.0, after=_time.monotonic() + 0.3)
+
     timeline = Timeline(args.timeline) if args.timeline else None
     engine = ServingEngine(
         model, params,
@@ -90,12 +137,18 @@ def main(argv=None):
         max_tokens_in_flight=args.max_tokens_in_flight,
         admission=args.admission,
         decode_chunk_size=args.decode_chunk,
+        fault_injector=injector,
         timeline=timeline,
     )
 
+    from neuronx_distributed_tpu.serving import RejectedError
+
     # staggered open-loop arrivals: a few upfront, the rest trickle in
     # while the engine is mid-flight (slots churn, decode program reused)
+    rejected = 0
+
     def make_request(i):
+        nonlocal rejected
         plen = int(rng.randint(3, 17))
         prompt = rng.randint(1, cfg.vocab_size, size=plen).astype(np.int32)
         gcfg = GenerationConfig(
@@ -104,34 +157,61 @@ def main(argv=None):
             top_k=int(rng.choice([0, 10, 40])) or None,
             eos_token_id=None,
         )
-        return engine.submit(prompt, gcfg, key=jax.random.PRNGKey(100 + i))
+        try:
+            return engine.submit(
+                prompt, gcfg, key=jax.random.PRNGKey(100 + i),
+                deadline_s=args.deadline,
+                queue_timeout_s=args.queue_timeout,
+            )
+        except RejectedError as e:
+            # backpressure/drain/halt is a demo-visible outcome, not a crash
+            rejected += 1
+            print(f"r{i} rejected: {e} (queue depth {e.queue_depth})")
+            return None
 
     upfront = min(args.slots, args.requests)
-    reqs = [make_request(i) for i in range(upfront)]
+    reqs = [r for i in range(upfront) if (r := make_request(i)) is not None]
     i = upfront
     while engine.has_work or i < args.requests:
         engine.step()
         if i < args.requests:
-            reqs.append(make_request(i))
+            req = make_request(i)
+            if req is not None:
+                reqs.append(req)
             i += 1
+        if not engine.has_work and i >= args.requests:
+            break
     engine.run()
 
     print(f"\n=== {len(reqs)} requests through {args.slots} slots "
           f"({args.admission} admission, decode chunk "
-          f"{args.decode_chunk}) ===")
+          f"{args.decode_chunk}, fault={args.inject_fault}) ===")
     for req in reqs:
         r = engine.metrics.request_snapshot(req.rid)
+        ttft = r.get("ttft")
+        wait = r.get("queue_wait")
+        ttft_s = f"{ttft * 1e3:7.1f}ms" if ttft is not None else "      - "
+        wait_s = f"{wait * 1e3:6.1f}ms" if wait is not None else "     - "
+        detail = (
+            f"error={req.error!r}" if req.error
+            else f"decode={r.get('decode_tokens_per_sec', 0.0):6.1f} tok/s "
+                 f"tokens={req.tokens}"
+        )
         print(
-            f"r{req.rid:<2d} prompt={r['prompt_len']:>2d} "
-            f"new={len(req.tokens):>2d} ttft={r['ttft'] * 1e3:7.1f}ms "
-            f"wait={r['queue_wait'] * 1e3:6.1f}ms "
-            f"decode={r['decode_tokens_per_sec']:6.1f} tok/s "
-            f"tokens={req.tokens}"
+            f"r{req.rid:<2d} {req.state.value:<9s} "
+            f"prompt={r['prompt_len']:>2d} new={len(req.tokens):>2d} "
+            f"ttft={ttft_s} wait={wait_s} {detail}"
         )
 
     snap = engine.metrics.snapshot()
     snap["decode_compilations"] = engine.decode_compilations
-    print("\n=== metrics snapshot ===")
+    snap["rejected_submits"] = rejected
+    if engine.halt_reason:
+        snap["halt_reason"] = engine.halt_reason
+    if injector is not None:
+        snap["injected_faults"] = dict(injector.counters)
+    print(f"\n=== engine health: {engine.health().value} ===")
+    print("=== metrics snapshot ===")
     for k, v in snap.items():
         print(f"  {k:>28s}: {v:.4f}" if isinstance(v, float) else
               f"  {k:>28s}: {v}")

@@ -17,12 +17,35 @@ Layers:
   ``modules/attention.KVCache`` collection layout (no reallocation between
   requests).
 * :mod:`metrics` — TTFT / decode throughput / queue wait / occupancy /
-  preemption counters, exported as a plain dict snapshot and (optionally)
-  onto a ``utils.timeline.Timeline``.
+  preemption counters plus the fault-tolerance counters (sheds, rejects,
+  quarantines, dispatch retries, health), exported as a plain dict snapshot
+  and (optionally) onto a ``utils.timeline.Timeline``.
+* :mod:`faults` — deterministic, schedule-driven fault injection
+  (``FaultInjector``) for the engine's chaos hooks: dispatch failures,
+  poisoned readbacks, prefill faults, clock skew.
+
+Robustness contract (chaos-tested in ``tests/serving/test_faults.py``):
+deadlines and queue timeouts shed to ``TIMED_OUT``; a failed donated decode
+dispatch recovers through the preemption machinery (streams bit-identical)
+with bounded consecutive retries before ``HALTED``; poisoned slots are
+quarantined out of the rotation without corrupting neighbors; a bounded
+queue rejects with :class:`~neuronx_distributed_tpu.serving.engine.
+RejectedError`; ``drain()`` finishes in-flight work while admitting nothing
+new; ``health()`` reports ``OK/DEGRADED/DRAINING/HALTED``.
 """
 
 from neuronx_distributed_tpu.serving.cache_manager import SlotCacheManager
-from neuronx_distributed_tpu.serving.engine import ServingEngine
+from neuronx_distributed_tpu.serving.engine import (
+    EngineHealth,
+    RejectedError,
+    ServingEngine,
+)
+from neuronx_distributed_tpu.serving.faults import (
+    FaultInjector,
+    InjectedDispatchError,
+    InjectedFault,
+    InjectedPrefillError,
+)
 from neuronx_distributed_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_tpu.serving.scheduler import (
     Request,
@@ -31,6 +54,12 @@ from neuronx_distributed_tpu.serving.scheduler import (
 )
 
 __all__ = [
+    "EngineHealth",
+    "FaultInjector",
+    "InjectedDispatchError",
+    "InjectedFault",
+    "InjectedPrefillError",
+    "RejectedError",
     "Request",
     "RequestState",
     "Scheduler",

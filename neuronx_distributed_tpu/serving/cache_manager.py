@@ -77,6 +77,7 @@ class SlotCacheManager:
         self.cache = None  # allocated lazily from the first prefill row
         self.cursor = 0  # host mirror of the shared `index` cursor
         self._free = list(range(num_slots))
+        self._quarantined: set = set()  # slots pulled from rotation for good
         self._admit_fn = jax.jit(_admit_row, donate_argnums=(0,))
         self._free_fn = jax.jit(reset_cache_slot, donate_argnums=(0,))
         self._reset_fn = jax.jit(reset_cache, donate_argnums=(0,))
@@ -89,10 +90,27 @@ class SlotCacheManager:
 
     @property
     def used_slots(self) -> int:
-        return self.num_slots - len(self._free)
+        return self.num_slots - len(self._free) - len(self._quarantined)
+
+    @property
+    def usable_slots(self) -> int:
+        """Slots still in the rotation (not quarantined)."""
+        return self.num_slots - len(self._quarantined)
+
+    @property
+    def quarantined_slots(self) -> list:
+        return sorted(self._quarantined)
 
     def acquire(self) -> int:
         return self._free.pop(0)
+
+    def quarantine(self, slot: int) -> None:
+        """Pull ``slot`` out of the rotation permanently (poisoned readback
+        — its cache row is suspect and must never host another request).
+        The caller owns clearing the engine-side slot bookkeeping."""
+        self._quarantined.add(slot)
+        if slot in self._free:
+            self._free.remove(slot)
 
     # --- device-state transitions ------------------------------------------
 
@@ -144,11 +162,13 @@ class SlotCacheManager:
 
     def free(self, slot: int) -> None:
         """Clear the slot's ``kv_valid`` row and return it to the free list
-        — immediately re-admittable, no reallocation."""
+        — immediately re-admittable, no reallocation. A quarantined slot is
+        cleared but never rejoins the rotation."""
         if self.cache is not None:
             self.cache = self._free_fn(self.cache, jnp.asarray(slot, jnp.int32))
-        self._free.append(slot)
-        self._free.sort()
+        if slot not in self._quarantined:
+            self._free.append(slot)
+            self._free.sort()
 
     def take(self):
         """Hand the cache to a donating consumer (the engine's decode
@@ -167,12 +187,35 @@ class SlotCacheManager:
         reallocate a zeroed cache under still-active slots."""
         self.cache = cache
 
+    def recover(self, cache) -> bool:
+        """Re-adopt storage after a FAILED donating dispatch whose requests
+        are being requeued (cursor rewinds to 0 either way). When the
+        failure left the buffers unconsumed, keep the allocation and
+        invalidate it in place (one device program); when XLA already
+        consumed them, drop to lazy reallocation — the next admission
+        rebuilds zeros, which is safe precisely because every slot has been
+        vacated. Returns whether the storage survived."""
+        consumed = any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree_util.tree_leaves(cache)
+        )
+        self.cursor = 0
+        if consumed:
+            self.cache = None
+            return False
+        self.cache = cache
+        if self.cache is not None:
+            self.cache = self._reset_fn(self.cache)
+        return True
+
     def release_all_slots(self) -> None:
-        """Return every slot to the free list — HOST bookkeeping only, for
-        callers about to :meth:`reset` (which invalidates all rows in one
-        device program; per-slot :meth:`free` dispatches would be
-        redundant)."""
-        self._free = list(range(self.num_slots))
+        """Return every non-quarantined slot to the free list — HOST
+        bookkeeping only, for callers about to :meth:`reset` (which
+        invalidates all rows in one device program; per-slot :meth:`free`
+        dispatches would be redundant)."""
+        self._free = [
+            s for s in range(self.num_slots) if s not in self._quarantined
+        ]
 
     def update_after_decode(self, new_cache, steps: int = 1) -> None:
         """Adopt the cache returned by a decode dispatch; ``steps`` is how

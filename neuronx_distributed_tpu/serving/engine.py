@@ -17,8 +17,12 @@ Decode hot path — device-resident between admission events:
   sentinels, remaining-token budget, EOS id) lives ON DEVICE in
   ``self._state``; the jitted step updates it in place. The host writes a
   slot's row only when admission/free/cancel dirties it (one tiny jitted
-  scatter per event), and reads a key back only at preemption/finish — the
-  two places ``req.key`` is consumed.
+  scatter per event). Each chunk's single readback carries a COPIED
+  post-chunk key snapshot, and the engine mirrors it into every active
+  request's ``req.key`` — so preemption, recovery, and retirement all run
+  off host state, with zero extra device syncs (``device_get`` on the state
+  leaf itself would cache a host value and silently demote the next chunk's
+  donation to a copy — regression-tested).
 * The KV cache and the slot-state dict are DONATED into the decode jit
   (``donate_argnums``): XLA aliases the buffers instead of copying the
   ``(num_slots, max_seq_len)`` cache pytree every token.
@@ -37,8 +41,43 @@ the tokens of a solo ``generate(prompt, key)`` call — same prefill math
 (left-padded prompts are already proven token-identical to unpadded ones),
 same per-step key evolution (``split`` then sample with the sub-key), and a
 per-row sampler that is bit-identical to ``sample`` (utils/sampling.py) —
-for every ``decode_chunk_size``, including across preemption/resume. The
-engine is a scheduler around the same program, not a different generator.
+for every ``decode_chunk_size``, including across preemption/resume AND
+across dispatch-failure recovery. The engine is a scheduler around the same
+program, not a different generator.
+
+Fault tolerance — the contract that makes the donated hot path safe to run
+unattended (chaos-tested in ``tests/serving/test_faults.py``):
+
+* **Deadlines & shedding** — ``submit(..., deadline_s=, queue_timeout_s=)``
+  attaches absolute deadlines; queue-expired requests are shed (terminal
+  ``TIMED_OUT``) before prefill ever runs, and in-flight deadlines are
+  enforced at chunk boundaries (the natural host-visibility points of the
+  fused decode path). A shed request keeps the tokens it already streamed.
+* **Dispatch recovery** — a failed donated decode dispatch no longer
+  crashes the host loop: the engine restores/salvages the cache, requeues
+  every in-flight request through the preemption machinery (their streams
+  resume bit-identically — tokens and keys are host-current at every chunk
+  boundary), waits per the shared decrementing-jitter
+  :class:`~neuronx_distributed_tpu.utils.retry.RetryPolicy`, and retries on
+  the next step. ``dispatch_retry.max_attempts`` CONSECUTIVE failures land
+  the engine in ``HALTED`` with the work requeued, not lost.
+* **Output validation & quarantine** — the per-chunk readback is validated
+  on host (vocab-range tokens, sane counts); a poisoned slot is quarantined
+  out of the rotation permanently, its request requeued from the last chunk
+  boundary (or failed, under ``quarantine_policy="fail"``), and its
+  neighbors' streams are untouched. Losing slots degrades capacity
+  (``DEGRADED``); losing all of them halts.
+* **Backpressure, drain & health** — ``max_queue`` bounds the queue with an
+  explicit :class:`RejectedError` (carrying the depth), ``drain()`` stops
+  admission while finishing in-flight work, and ``health()`` reports
+  ``OK/DEGRADED/DRAINING/HALTED``; every fault shows up in
+  ``metrics.snapshot()`` (sheds/rejects/quarantines/dispatch_retries/
+  recoveries/health) and as Timeline instant events.
+* **Fault injection** — every recovery path above is drivable
+  deterministically through a
+  :class:`~neuronx_distributed_tpu.serving.faults.FaultInjector` hook
+  (dispatch raise at attempt k, poisoned readback for slot s, prefill
+  OOM-like error, clock skew); with no injector the hooks are no-ops.
 
 Cache capacity: all slots share one write cursor (see
 ``serving/cache_manager.py``), which advances every decode step while ANY
@@ -58,6 +97,7 @@ against running past ``max_seq_len``:
 
 from __future__ import annotations
 
+import enum
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -79,7 +119,34 @@ from neuronx_distributed_tpu.serving.scheduler import (
     RequestState,
     Scheduler,
 )
+from neuronx_distributed_tpu.utils.retry import RetryPolicy
 from neuronx_distributed_tpu.utils.sampling import sample_row
+
+
+class EngineHealth(enum.Enum):
+    """Engine health snapshot (``ServingEngine.health()``).
+
+    ``OK`` — serving normally. ``DEGRADED`` — serving, but a recent
+    dispatch failure was recovered from or quarantines have shrunk slot
+    capacity. ``DRAINING`` — finishing in-flight work, admitting nothing
+    new. ``HALTED`` — consecutive dispatch failures (or total slot loss)
+    exhausted the retry budget; in-flight work is requeued and the loop
+    stops making progress until an operator intervenes."""
+
+    OK = "ok"
+    DEGRADED = "degraded"
+    DRAINING = "draining"
+    HALTED = "halted"
+
+
+class RejectedError(RuntimeError):
+    """A submission the engine refused (bounded queue backpressure, drain,
+    or halt). ``queue_depth`` is the queue occupancy at rejection time so
+    callers can implement load-aware retry/spillover."""
+
+    def __init__(self, message: str, queue_depth: int = 0):
+        super().__init__(message)
+        self.queue_depth = queue_depth
 
 
 def _key_data(key) -> np.ndarray:
@@ -110,6 +177,33 @@ def _bucket(p: int, max_seq_len: int, remaining: int, floor: int = 8) -> int:
     if b < p or b + remaining > max_seq_len:
         b = p
     return b
+
+
+def _validate_readback(toks, counts, chunk_size: int, vocab: Optional[int],
+                       slots) -> Dict[int, str]:
+    """Host-side sanity check of a chunk readback — the one-per-chunk sync
+    is the only place device output is visible, so it is where a poisoned
+    slot must be caught before its garbage reaches a stream. Returns
+    ``{slot: reason}`` for every active slot whose token column fails the
+    invariants (count within [0, chunk], token ids within [0, vocab))."""
+    bad: Dict[int, str] = {}
+    for slot in slots:
+        slot = int(slot)
+        c = int(counts[slot])
+        if c < 0 or c > chunk_size:
+            bad[slot] = f"token count {c} outside [0, {chunk_size}]"
+            continue
+        if c == 0:
+            continue
+        col = np.asarray(toks[:c, slot])
+        if (col < 0).any() or (vocab is not None and (col >= vocab).any()):
+            offender = col[
+                (col < 0) | ((col >= vocab) if vocab is not None else False)
+            ][0]
+            bad[slot] = (
+                f"token {int(offender)} outside vocab [0, {vocab})"
+            )
+    return bad
 
 
 def _slot_write(state, slot, tok, key, temp, topk, topp, remaining, eos):
@@ -145,14 +239,24 @@ class ServingEngine:
         max_tokens_in_flight: Optional[int] = None,
         admission: str = "conservative",
         decode_chunk_size: int = 8,
+        max_queue: Optional[int] = None,
+        dispatch_retry: Optional[RetryPolicy] = None,
+        degraded_cooldown_chunks: int = 8,
+        quarantine_policy: str = "requeue",
+        fault_injector=None,
         timeline=None,
         time_fn: Callable[[], float] = time.monotonic,
+        sleep_fn: Callable[[float], None] = time.sleep,
     ):
         if admission not in ("conservative", "eager"):
             raise ValueError(f"unknown admission policy {admission!r}")
         if decode_chunk_size < 1:
             raise ValueError(
                 f"decode_chunk_size must be >= 1, got {decode_chunk_size}"
+            )
+        if quarantine_policy not in ("requeue", "fail"):
+            raise ValueError(
+                f"unknown quarantine policy {quarantine_policy!r}"
             )
         max_seq_len = getattr(getattr(model, "config", None), "max_seq_len", None)
         if max_seq_len is None:
@@ -166,8 +270,20 @@ class ServingEngine:
         self.max_seq_len = max_seq_len
         self.admission = admission
         self.decode_chunk_size = decode_chunk_size
+        self.max_queue = max_queue
         self.timeline = timeline
         self._clock = time_fn
+        self._sleep = sleep_fn
+        self._vocab = getattr(getattr(model, "config", None), "vocab_size", None)
+        # dispatch-recovery policy: bounded consecutive failures, waits from
+        # the shared decrementing-jitter schedule (utils/retry.py — the same
+        # policy class the checkpoint object-store path rides)
+        self._dispatch_retry = dispatch_retry or RetryPolicy(
+            max_attempts=3, first_wait=0.05, min_wait=0.01
+        )
+        self._degraded_cooldown = degraded_cooldown_chunks
+        self._quarantine_policy = quarantine_policy
+        self._faults = fault_injector
         self._prefill_model, self._decode_model = serving_clones(model)
         self.scheduler = Scheduler(max_tokens_in_flight)
         self.cache = SlotCacheManager(num_slots)
@@ -180,10 +296,18 @@ class ServingEngine:
         self._next_rid = 0
         self._prefill_fns: Dict[int, Callable] = {}
         self._state = self._fresh_slot_state()
-        # host snapshot of the per-slot keys from the CURRENT chunk readback
-        # (set only while unpacking a chunk): finishing requests take their
-        # key from here, so retirement costs no extra device sync
-        self._chunk_keys: Optional[np.ndarray] = None
+        # fault-tolerance state machine
+        self._halted = False
+        self._halt_reason: Optional[str] = None
+        self._draining = False
+        self._consecutive_dispatch_failures = 0
+        self._had_dispatch_failure = False
+        self._chunks_since_failure = 0
+        self._dispatch_attempts = 0  # includes failed attempts (hook index)
+        self._readbacks = 0  # successful readbacks (poison-hook index)
+        self._prefill_calls = 0  # prefill attempts (prefill-hook index)
+        self._consecutive_prefill_failures = 0
+        self._last_health = EngineHealth.OK
         # the fused decode chunk: cache AND slot state donated — XLA updates
         # both in place instead of materializing a fresh cache pytree
         self._decode_chunk = jax.jit(
@@ -224,34 +348,89 @@ class ServingEngine:
         self._params_src = value
         self._params = dict(value)
 
+    def _now(self) -> float:
+        """The engine's scheduling clock — the injected ``time_fn``,
+        optionally skewed by the fault injector (chaos tests drive deadline
+        paths without sleeping)."""
+        now = self._clock()
+        if self._faults is not None:
+            now = self._faults.now(now)
+        return now
+
     def submit(
         self,
         prompt_ids,
         config: GenerationConfig = GenerationConfig(),
         key=None,
         on_token: Optional[Callable[[Request, int], None]] = None,
+        deadline_s: Optional[float] = None,
+        queue_timeout_s: Optional[float] = None,
     ) -> Request:
         """Enqueue one request; returns its live ``Request`` (``tokens``
         fills in as the engine steps). ``key`` defaults to a per-request
         PRNGKey; pass the key you would give ``generate`` to reproduce its
-        stream exactly."""
+        stream exactly.
+
+        ``deadline_s`` bounds the request end to end (sheds to ``TIMED_OUT``
+        at the next chunk boundary once exceeded, keeping any tokens already
+        streamed); ``queue_timeout_s`` sheds it if it has not been admitted
+        in time — both relative to submission on the engine clock. The
+        queue timeout governs FIRST admission only: once admitted, a
+        request requeued by preemption or dispatch recovery answers only to
+        ``deadline_s``.
+
+        Raises :class:`RejectedError` when the engine is draining/halted or
+        the bounded queue (``max_queue``) is full, and ``ValueError`` for
+        requests that could NEVER be placed (so an impossible request fails
+        at the door instead of livelocking ``run()`` at the queue head)."""
+        health = self.health()
+        if health in (EngineHealth.DRAINING, EngineHealth.HALTED):
+            depth = self.scheduler.queued
+            self.metrics.record_reject(depth, health.value)
+            raise RejectedError(
+                f"engine is {health.value}; not accepting new requests",
+                queue_depth=depth,
+            )
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
         if config.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        # same capacity contract as generate(), checked by the shared helper
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if queue_timeout_s is not None and queue_timeout_s <= 0:
+            raise ValueError(
+                f"queue_timeout_s must be > 0, got {queue_timeout_s}"
+            )
+        # permanently-unplaceable guards: queueing a request no admission
+        # round can ever select would livelock run() behind a FIFO head.
+        # The seq-len class (prompt + generation over max_seq_len) is the
+        # shared generate() contract below — it also subsumes the prefill
+        # bucket, because _bucket falls back to the exact prompt length
+        # whenever padding would not leave room for the generation; the
+        # token-budget class needs its own check against the scheduler
         validate_generate_args(
             self.model, prompt[None], config.max_new_tokens, None
         )
         budget = self.scheduler.max_tokens_in_flight
         if budget is not None and prompt.size + config.max_new_tokens > budget:
-            # a footprint over the whole budget can NEVER be admitted —
-            # queueing it would livelock run() behind a permanent FIFO head
             raise ValueError(
                 f"request footprint ({prompt.size + config.max_new_tokens}) "
                 f"exceeds max_tokens_in_flight ({budget}); it could never "
                 "be admitted"
+            )
+        # backpressure: a bounded queue rejects loudly instead of absorbing
+        # an unserviceable backlog
+        depth = self.scheduler.queued
+        if self.max_queue is not None and depth >= self.max_queue:
+            self.metrics.record_reject(depth, "queue full")
+            if self.timeline is not None:
+                self.timeline.instant(
+                    "reject", "serving", args={"queue_depth": depth}
+                )
+            raise RejectedError(
+                f"queue full ({depth} >= max_queue {self.max_queue})",
+                queue_depth=depth,
             )
         rid = self._next_rid
         self._next_rid += 1
@@ -260,7 +439,11 @@ class ServingEngine:
         req = Request(
             rid=rid, prompt=prompt, config=config, key=_key_data(key)
         )
-        req.submit_time = self._clock()
+        req.submit_time = self._now()
+        if deadline_s is not None:
+            req.deadline = req.submit_time + deadline_s
+        if queue_timeout_s is not None:
+            req.queue_deadline = req.submit_time + queue_timeout_s
         if on_token is not None:
             self._on_token[rid] = on_token
         self.scheduler.submit(req)
@@ -278,14 +461,84 @@ class ServingEngine:
         was_queued = req.slot is None
         ok = self.scheduler.cancel(rid)
         if ok and was_queued:
-            self.metrics.record_cancel(req, self._clock())
+            self.metrics.record_cancel(req, self._now())
             # queued requests never reach _release_slot — drop the callback
             # here or it leaks for the engine's lifetime
             self._on_token.pop(rid, None)
         return ok
 
+    # --- health / drain -----------------------------------------------------
+
+    def health(self) -> EngineHealth:
+        """Current health state (``OK/DEGRADED/DRAINING/HALTED``)."""
+        if self._halted:
+            return EngineHealth.HALTED
+        if self._draining:
+            return EngineHealth.DRAINING
+        if self.cache.usable_slots < self.num_slots or (
+            self._had_dispatch_failure
+            and self._chunks_since_failure < self._degraded_cooldown
+        ):
+            return EngineHealth.DEGRADED
+        return EngineHealth.OK
+
+    @property
+    def halt_reason(self) -> Optional[str]:
+        return self._halt_reason
+
+    def drain(self) -> None:
+        """Stop admitting NEW work: submissions are rejected, never-admitted
+        queued requests stay queued (and stop counting as work), requests
+        already admitted — active in a slot or preempted back to the queue —
+        run to completion. ``run()`` returns once in-flight work finishes."""
+        self._draining = True
+        if self.timeline is not None:
+            self.timeline.instant("drain", "serving")
+        self._sync_health()
+
+    def resume(self) -> None:
+        """Leave DRAINING and accept work again (no-op while HALTED)."""
+        self._draining = False
+        self._sync_health()
+
+    def _halt(self, reason: str) -> None:
+        # the HALTED contract: in-flight work is REQUEUED, never stranded.
+        # The dispatch-recovery and quarantine paths vacate their slots
+        # before halting (this is a no-op there); a prefill-failure halt
+        # reaches here with requests still actively decoding — push them
+        # back to the queue with their host-current tokens/keys so an
+        # operator handing off scheduler.requests loses nothing
+        requeued = self._vacate_active()
+        if requeued:
+            self.scheduler.requeue_front(requeued)
+            self.cache.release_all_slots()
+            self.cache.reset()
+            self._state = self._fresh_slot_state()
+        self._halted = True
+        self._halt_reason = reason
+        if self.timeline is not None:
+            self.timeline.instant("halted", "serving", args={"reason": reason})
+        self._sync_health()
+
+    def _sync_health(self) -> None:
+        h = self.health()
+        self.metrics.health = h.value
+        if h is not self._last_health:
+            if self.timeline is not None:
+                self.timeline.instant(f"health {h.value}", "serving")
+            self._last_health = h
+
     @property
     def has_work(self) -> bool:
+        if self._halted:
+            # requeued work survives in the queue for inspection/handoff,
+            # but a halted engine makes no progress — run() must exit
+            return False
+        if self._draining:
+            return any(self._active) or any(
+                r.admit_time is not None
+                for r in self.scheduler.queued_requests
+            )
         return self.scheduler.queued > 0 or any(self._active)
 
     @property
@@ -304,11 +557,15 @@ class ServingEngine:
         return sum(int(fn._cache_size()) for fn in self._prefill_fns.values())
 
     def step(self) -> bool:
-        """One engine iteration: reap cancellations → preempt/rewind if the
-        cursor is out of room → admit+prefill → one fused decode chunk →
-        retire finished slots. Returns whether work remains."""
-        now = self._clock()
+        """One engine iteration: reap cancellations → shed expired deadlines
+        → preempt/rewind if the cursor is out of room → admit+prefill → one
+        fused decode chunk (with recovery) → retire finished slots. Returns
+        whether work remains."""
+        if self._halted:
+            return self.has_work
+        now = self._now()
         self._reap_cancelled(now)
+        self._shed_expired(now)
         if any(self._active) and self.cache.cursor >= self.max_seq_len:
             self._preempt_all()
         if not any(self._active) and self.cache.cursor > 0:
@@ -316,20 +573,54 @@ class ServingEngine:
             # column 0 (storage reused, nothing reallocated)
             self.cache.reset()
         self._admit(now)
-        if any(self._active):
+        if not self._halted and any(self._active):
             self._decode()
         if self.timeline is not None:
             self.timeline.counter("slots_active", int(self._active.sum()), "serving")
             self.timeline.counter("queue_depth", self.scheduler.queued, "serving")
+        self._sync_health()
         return self.has_work
 
     def run(self, max_steps: int = 1_000_000) -> Dict[int, Request]:
-        """Step until idle; returns every request this engine has seen."""
+        """Step until idle (or HALTED); returns every request this engine
+        has seen."""
         steps = 0
         while self.has_work and steps < max_steps:
             self.step()
             steps += 1
         return {r.rid: r for r in self.scheduler.requests.values()}
+
+    # --- deadlines ----------------------------------------------------------
+
+    def _shed_expired(self, now: float) -> None:
+        """Queue timeouts shed BEFORE prefill (no compute wasted on a
+        request that already missed its window); in-flight deadlines are
+        enforced here, at the chunk boundary — the shed request keeps every
+        token it streamed."""
+        for req, reason in self.scheduler.expire(now):
+            req.state = RequestState.TIMED_OUT
+            req.error = reason
+            req.finish_time = now
+            self.metrics.record_shed(req, now, where="queue")
+            self._on_token.pop(req.rid, None)
+            if self.timeline is not None:
+                self.timeline.instant(
+                    f"shed r{req.rid}", "serving",
+                    args={"where": "queue", "reason": req.error},
+                )
+        for req in list(self._slot_req):
+            if req is None or req.deadline is None or now < req.deadline:
+                continue
+            req.state = RequestState.TIMED_OUT
+            req.error = "deadline exceeded mid-generation"
+            req.finish_time = now
+            self.metrics.record_shed(req, now, where="inflight")
+            if self.timeline is not None:
+                self.timeline.instant(
+                    f"shed r{req.rid}", "serving",
+                    args={"where": "inflight", "tokens": len(req.tokens)},
+                )
+            self._release_slot(req)
 
     # --- admission ----------------------------------------------------------
 
@@ -349,6 +640,11 @@ class ServingEngine:
 
         def fits(req: Request) -> bool:
             nonlocal proj, maxrem
+            if self._draining and req.admit_time is None:
+                # drain admits only work that was already in flight once
+                # (preempted/recovered requests rejoin at the queue FRONT,
+                # so fresh requests behind them cannot starve them)
+                return False
             p = len(req.context_ids)
             bucket = _bucket(p, self.max_seq_len, req.remaining_new_tokens)
             target = max(proj, bucket)
@@ -374,8 +670,15 @@ class ServingEngine:
         selected = self.scheduler.select(
             self.cache.free_slots, self._in_flight_tokens(), fits
         )
-        for req in selected:  # longest-prefill-first
+        for idx, req in enumerate(selected):  # longest-prefill-first
             self._prefill_into_slot(req, self.cache.acquire(), now)
+            if self._halted:
+                # a prefill-failure halt mid-batch: the rest of this round
+                # was already popped from the queue — put it back intact
+                rest = selected[idx + 1:]
+                if rest:
+                    self.scheduler.requeue_front(rest)
+                break
 
     def _prefill_fn(self, padded_len: int):
         fn = self._prefill_fns.get(padded_len)
@@ -402,9 +705,46 @@ class ServingEngine:
         mask[0, padded - p:] = True
         if self.timeline is not None:
             self.timeline.mark_event_start("prefill", "serving")
-        logits, row_cache = self._prefill_fn(padded)(
-            self._params, jnp.asarray(ids), jnp.asarray(mask)
-        )
+        call = self._prefill_calls
+        self._prefill_calls += 1
+        try:
+            if self._faults is not None:
+                self._faults.on_prefill(call)
+            logits, row_cache = self._prefill_fn(padded)(
+                self._params, jnp.asarray(ids), jnp.asarray(mask)
+            )
+        except Exception as e:
+            # an OOM-like prefill fault fails ONE request for cause instead
+            # of crashing the loop; the slot returns to the rotation.
+            # Consecutive failures across requests are bounded like the
+            # dispatch path: a persistently-failing prefill (bad weights
+            # after a hot swap, real OOM) must not silently fail 100% of
+            # traffic while health() reads OK
+            if self.timeline is not None:
+                self.timeline.mark_event_end(
+                    "prefill", "serving", args={"rid": req.rid, "error": str(e)}
+                )
+                self.timeline.instant(
+                    f"prefill_failure r{req.rid}", "serving",
+                    args={"error": str(e)[:200]},
+                )
+            self.cache.free(slot)
+            req.state = RequestState.FAILED
+            req.error = f"prefill failed: {e}"
+            req.finish_time = now
+            self.metrics.record_failed(req, now, kind="prefill")
+            self._on_token.pop(req.rid, None)
+            self._consecutive_prefill_failures += 1
+            if (
+                self._consecutive_prefill_failures
+                >= self._dispatch_retry.max_attempts
+            ):
+                self._halt(
+                    f"{self._consecutive_prefill_failures} consecutive "
+                    f"prefill failures (last: {type(e).__name__}: {e})"
+                )
+            return
+        self._consecutive_prefill_failures = 0
         if self.timeline is not None:
             self.timeline.mark_event_end(
                 "prefill", "serving", args={"rid": req.rid, "padded": padded}
@@ -457,33 +797,44 @@ class ServingEngine:
     def _decode(self) -> None:
         """One fused decode chunk: dispatch the donated jitted scan, then a
         SINGLE host synchronization for the whole token block. Between here
-        and the next admission/free event no per-slot host state moves."""
+        and the next admission/free event no per-slot host state moves. A
+        failed dispatch routes through the recovery state machine instead of
+        crashing the loop."""
         tl = self.timeline
         active_at_dispatch = int(self._active.sum())
         if tl is not None:
             tl.mark_event_start("decode_dispatch", "serving")
         t0 = self._clock()
         cache_in = self.cache.take()
+        attempt = self._dispatch_attempts
+        self._dispatch_attempts += 1
         try:
+            if self._faults is not None:
+                self._faults.on_dispatch(attempt)
             (new_cache, self._state, toks, counts, used,
              key_snap) = self._decode_chunk(
                 self._params, cache_in, self._state
             )
-        except BaseException:
-            # a failed dispatch must not leave the manager cache-less: a
-            # later admission would silently reallocate zeros under
-            # still-active slots. Restored buffers that WERE consumed fail
-            # loudly (deleted-buffer error) on next use instead.
-            self.cache.restore(cache_in)
-            raise
+        except BaseException as e:
+            if tl is not None:
+                tl.mark_event_end("decode_dispatch", "serving")
+            if not isinstance(e, Exception):
+                # KeyboardInterrupt/SystemExit are the operator's, not
+                # faults: restore the reference (a consumed buffer fails
+                # loudly on next use) and re-raise
+                self.cache.restore(cache_in)
+                raise
+            self._recover_dispatch(cache_in, e)
+            return
         t1 = self._clock()
+        self._consecutive_dispatch_failures = 0
+        self._chunks_since_failure += 1
         if tl is not None:
             tl.mark_event_end("decode_dispatch", "serving")
             tl.mark_event_start("decode_readback", "serving")
         # THE one host sync per chunk: the (chunk, slots) token block, the
         # per-slot valid-prefix lengths, the executed step count — and the
-        # post-chunk key SNAPSHOT (frozen at each slot's finish step), so
-        # requests retiring this chunk need no per-slot key pull. The
+        # post-chunk key SNAPSHOT (frozen at each slot's finish step). The
         # snapshot is a chunk OUTPUT, not the state leaf: device_get on the
         # leaf would cache a host value on it and silently demote the next
         # chunk's keys donation to a copy
@@ -491,30 +842,54 @@ class ServingEngine:
             (toks, counts, used, key_snap)
         )
         t2 = self._clock()
-        used = int(used)
-        emitted = int(counts.sum())
+        readback = self._readbacks
+        self._readbacks += 1
+        if self._faults is not None:
+            toks, counts = self._faults.on_readback(
+                readback, toks, counts, self._active
+            )
+        # the executed step count drives cursor arithmetic — clamp it to the
+        # chunk bound so corrupted output can never run the cursor away
+        used = max(0, min(int(used), self.decode_chunk_size))
         self.cache.update_after_decode(new_cache, used)
+        # validate the block BEFORE any token reaches a stream: a poisoned
+        # slot is quarantined and its chunk discarded; neighbors proceed
+        bad = _validate_readback(
+            toks, counts, self.decode_chunk_size, self._vocab,
+            np.flatnonzero(self._active),
+        )
+        emitted = int(
+            sum(
+                int(counts[s])
+                for s in np.flatnonzero(self._active)
+                if int(s) not in bad
+            )
+        )
         if tl is not None:
             tl.mark_event_end(
                 "decode_readback", "serving",
                 args={"tokens": emitted, "steps": used},
             )
-        now = self._clock()
+        now = self._now()
         delivered = 0
-        self._chunk_keys = chunk_keys
-        try:
-            for slot in np.flatnonzero(self._active):
-                req = self._slot_req[slot]
-                for tok in toks[: int(counts[slot]), slot]:
-                    self._emit_token(req, int(tok), now)
-                    delivered += 1
-                    self._maybe_finish(req, now)
-                    if req.finished:
-                        # EOS/budget retired it, or an on_token callback
-                        # cancelled it: discard the rest of its block
-                        break
-        finally:
-            self._chunk_keys = None
+        for slot in np.flatnonzero(self._active):
+            req = self._slot_req[slot]
+            if int(slot) in bad:
+                self._quarantine_slot(int(slot), req, bad[int(slot)], now)
+                continue
+            # mirror the post-chunk key onto the host request: preemption,
+            # dispatch recovery, and retirement all read req.key — keeping
+            # it current at every chunk boundary costs nothing (the snapshot
+            # already rode the chunk's single sync)
+            req.key = np.array(chunk_keys[slot], np.uint32)
+            for tok in toks[: int(counts[slot]), slot]:
+                self._emit_token(req, int(tok), now)
+                delivered += 1
+                self._maybe_finish(req, now)
+                if req.finished:
+                    # EOS/budget retired it, or an on_token callback
+                    # cancelled it: discard the rest of its block
+                    break
         # recorded after the unpack so a mid-chunk cancellation's discarded
         # device tokens never inflate decode_tokens / chunk tok/s
         if tl is not None:
@@ -524,16 +899,79 @@ class ServingEngine:
             dispatch_s=t1 - t0, readback_s=t2 - t1,
         )
 
-    # --- lifecycle helpers --------------------------------------------------
+    def _recover_dispatch(self, cache_in, exc: Exception) -> None:
+        """A decode dispatch FAILED. Recovery = the preemption machinery:
+        every in-flight request goes back to the queue front with its
+        host-current tokens and key (both exact as of the last chunk
+        boundary), the cache storage is salvaged when the donated buffers
+        survived (or dropped for lazy reallocation when XLA consumed them),
+        and the next step re-prefills and retries. After
+        ``dispatch_retry.max_attempts`` CONSECUTIVE failures the engine
+        HALTS with the work requeued instead of crashing."""
+        n = self._consecutive_dispatch_failures + 1
+        self._consecutive_dispatch_failures = n
+        self._had_dispatch_failure = True
+        self._chunks_since_failure = 0
+        self.metrics.record_dispatch_retry()
+        if self.timeline is not None:
+            self.timeline.instant(
+                "dispatch_failure", "serving",
+                args={"error": str(exc)[:200], "consecutive": n},
+            )
+        requeued = self._vacate_active()
+        self.scheduler.requeue_front(requeued)
+        self.cache.release_all_slots()
+        self.cache.recover(cache_in)
+        self._state = self._fresh_slot_state()
+        if n >= self._dispatch_retry.max_attempts:
+            self._halt(
+                f"{n} consecutive dispatch failures (last: "
+                f"{type(exc).__name__}: {exc})"
+            )
+            return
+        self.metrics.record_recovery(len(requeued))
+        if self.timeline is not None:
+            self.timeline.instant(
+                "recovery", "serving", args={"requeued": len(requeued)}
+            )
+        # shared decrementing-jitter wait before the next attempt (attempt
+        # index is 0-based): ride out a transient burst without hammering
+        self._sleep(self._dispatch_retry.wait(n - 1))
+        self._sync_health()
 
-    def _pull_key(self, slot: int) -> np.ndarray:
-        """Per-slot device→host key fetch — used only at PREEMPTION (the
-        one place a key must leave the device outside a chunk readback;
-        finishing requests take theirs from the chunk's own sync via
-        ``_chunk_keys``). The chunked step freezes a finished slot's key at
-        its last sampled token, so both paths yield exactly the
-        single-step value."""
-        return np.array(jax.device_get(self._state["keys"][slot]), np.uint32)
+    def _quarantine_slot(self, slot: int, req: Optional[Request],
+                         reason: str, now: float) -> None:
+        """Pull a poisoned slot out of the rotation before its chunk
+        reaches a stream. The victim request resumes from the last chunk
+        boundary in a DIFFERENT slot (``quarantine_policy="requeue"``,
+        bit-identical — its tokens and key were untouched by the poisoned
+        chunk) or fails for cause (``"fail"``). Neighbors are unaffected;
+        losing the last usable slot halts the engine."""
+        self.metrics.record_quarantine(slot, req.rid if req else None)
+        if self.timeline is not None:
+            self.timeline.instant(
+                f"quarantine slot {slot}", "serving",
+                args={"reason": reason, "rid": req.rid if req else None},
+            )
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._state = self._slot_clear(self._state, np.int32(slot))
+        self.cache.quarantine(slot)
+        self.cache.free(slot)  # clears the row; never rejoins the rotation
+        if req is not None:
+            req.slot = None
+            if self._quarantine_policy == "requeue" and not req.finished:
+                self.scheduler.requeue_front([req])
+            else:
+                req.state = RequestState.FAILED
+                req.error = f"slot {slot} quarantined: {reason}"
+                req.finish_time = now
+                self.metrics.record_failed(req, now, kind="quarantine")
+                self._on_token.pop(req.rid, None)
+        if self.cache.usable_slots == 0:
+            self._halt("all slots quarantined")
+
+    # --- lifecycle helpers --------------------------------------------------
 
     def _emit_token(self, req: Request, tok: int, now: float,
                     first: bool = False) -> None:
@@ -555,11 +993,6 @@ class ServingEngine:
         if hit_eos or len(req.tokens) >= req.config.max_new_tokens:
             req.state = RequestState.DONE
             req.finish_time = now
-            if req.slot is not None and self._chunk_keys is not None:
-                # retiring mid-unpack: the post-chunk key already rode the
-                # chunk's single readback (at prefill-time finishes req.key
-                # is current on the host and needs no update)
-                req.key = np.array(self._chunk_keys[req.slot], np.uint32)
             self.metrics.record_finish(req, now)
             self._release_slot(req)
             if self.timeline is not None:
@@ -583,19 +1016,28 @@ class ServingEngine:
                 req.finish_time = now
                 self._release_slot(req)
 
-    def _preempt_all(self) -> None:
-        """Out of cache columns: push every active request back to the queue
-        (keeping its generated tokens and its device-held key), rewind the
-        cache, and let admission re-prefill their contexts. Token streams
-        are unaffected — resume replays the exact context the request had."""
-        preempted = [r for r in self._slot_req if r is not None]
-        for req in preempted:
-            req.preemptions += 1
-            self.metrics.record_preemption(req)
-            req.key = self._pull_key(req.slot)
+    def _vacate_active(self) -> List[Request]:
+        """Unbind every active request from its slot (host bookkeeping
+        only) and return them in slot order — the shared first half of
+        preemption and dispatch recovery. ``req.key``/``req.tokens`` are
+        already host-current as of the last chunk boundary, so no device
+        state is touched (it may not even exist after a failed donation)."""
+        vacated = [r for r in self._slot_req if r is not None]
+        for req in vacated:
             slot, req.slot = req.slot, None
             self._slot_req[slot] = None
             self._active[slot] = False
+        return vacated
+
+    def _preempt_all(self) -> None:
+        """Out of cache columns: push every active request back to the queue
+        (keeping its generated tokens and its host-mirrored key), rewind the
+        cache, and let admission re-prefill their contexts. Token streams
+        are unaffected — resume replays the exact context the request had."""
+        preempted = self._vacate_active()
+        for req in preempted:
+            req.preemptions += 1
+            self.metrics.record_preemption(req)
         self.scheduler.requeue_front(preempted)
         # ONE device reset invalidates every row — per-slot free() dispatches
         # here would be N redundant full-cache programs; only the host

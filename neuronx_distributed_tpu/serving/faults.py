@@ -1,0 +1,146 @@
+"""Deterministic fault injection for the serving engine.
+
+Chaos testing a donated, device-resident decode path needs failures that are
+*schedulable*, not random: a dispatch that raises at exactly chunk ``k``, a
+readback poisoned for exactly slot ``s``, a prefill that OOMs on exactly the
+``n``-th admission, a clock that jumps mid-run. ``FaultInjector`` is a
+passive schedule the engine consults at its four hook points; with no
+injector (the default) every hook is a no-op and the hot path is untouched.
+
+Injection points (all indices are 0-based and deterministic):
+
+* ``fail_dispatch(at=k, times=t)`` — the k-th..(k+t-1)-th decode *dispatch
+  attempts* raise ``InjectedDispatchError`` before the jitted chunk runs
+  (the donated buffers are NOT consumed, mirroring a host-side enqueue
+  failure). ``times=None`` fails every attempt from ``at`` on — the way to
+  drive the engine into ``HALTED``.
+* ``poison_readback(at=k, slot=s, token=v)`` — mutates the host token block
+  of the k-th *successful* readback: slot ``s``'s first token becomes ``v``
+  (out-of-vocab by default), modeling a corrupted device buffer. Neighbor
+  slots' columns are untouched, so isolation is testable. If slot ``s`` is
+  not active at readback ``k`` the poison DEFERS to the next readback
+  (firing into an empty slot would prove nothing).
+* ``fail_prefill(at=n, times=t)`` — the n-th prefill call raises
+  ``InjectedPrefillError`` (an OOM-like admission failure).
+* ``skew_clock(by=s)`` / ``skew_clock(by=s, after=t)`` — the engine clock
+  reads ``s`` seconds ahead (optionally only once real time passes
+  ``after``), driving deadline/queue-timeout shedding paths without
+  sleeping.
+
+``counters`` records every fault actually fired so chaos tests can assert
+the schedule ran (an injection that never fired proves nothing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """Base class for injected failures (never raised by real code paths)."""
+
+
+class InjectedDispatchError(InjectedFault):
+    """Scheduled decode-dispatch failure."""
+
+
+class InjectedPrefillError(InjectedFault):
+    """Scheduled prefill failure (OOM-like admission fault)."""
+
+
+class FaultInjector:
+    """Schedule-driven fault source consulted by ``ServingEngine`` hooks."""
+
+    def __init__(self):
+        # [at, end) half-open attempt windows; end=None → open-ended
+        self._dispatch_windows: List[Tuple[int, Optional[int]]] = []
+        self._poisons: Dict[int, List[Tuple[int, int]]] = {}  # readback -> [(slot, token)]
+        self._prefill_windows: List[Tuple[int, Optional[int]]] = []
+        self._skew: float = 0.0
+        self._skew_after: Optional[float] = None
+        self.counters: Dict[str, int] = {
+            "dispatch_failures": 0,
+            "poisoned_readbacks": 0,
+            "prefill_failures": 0,
+        }
+
+    # --- schedule construction ----------------------------------------------
+
+    def fail_dispatch(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
+        end = None if times is None else at + times
+        self._dispatch_windows.append((at, end))
+        return self
+
+    def poison_readback(self, at: int, slot: int, token: int = -1) -> "FaultInjector":
+        self._poisons.setdefault(at, []).append((slot, token))
+        return self
+
+    def fail_prefill(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
+        end = None if times is None else at + times
+        self._prefill_windows.append((at, end))
+        return self
+
+    def skew_clock(self, by: float, after: Optional[float] = None) -> "FaultInjector":
+        self._skew = by
+        self._skew_after = after
+        return self
+
+    # --- engine hooks --------------------------------------------------------
+
+    @staticmethod
+    def _hit(windows, index: int) -> bool:
+        return any(
+            index >= at and (end is None or index < end)
+            for at, end in windows
+        )
+
+    def on_dispatch(self, attempt: int) -> None:
+        """Called with the 0-based dispatch ATTEMPT index (failed attempts
+        count, so a retry schedule is deterministic). Raises when the
+        schedule says this attempt fails."""
+        if self._hit(self._dispatch_windows, attempt):
+            self.counters["dispatch_failures"] += 1
+            raise InjectedDispatchError(
+                f"injected dispatch failure at attempt {attempt}"
+            )
+
+    def on_readback(self, readback: int, toks, counts, active=None):
+        """Called with the 0-based successful-readback index, the HOST
+        copies of the chunk's token block ``(chunk, slots)`` and per-slot
+        counts, and the active-slot mask. Returns the (possibly poisoned)
+        pair. A poison whose slot is not active yet DEFERS to the next
+        readback instead of firing into the void — the counter increments
+        only when garbage actually lands where the engine must catch it,
+        so asserting on it really proves the quarantine path ran."""
+        deferred = []
+        for slot, token in self._poisons.pop(readback, ()):
+            if active is not None and not bool(active[slot]):
+                deferred.append((slot, token))
+                continue
+            toks = toks.copy()
+            counts = counts.copy()
+            if counts[slot] <= 0:
+                counts[slot] = 1  # a poisoned slot claims at least one token
+            toks[0, slot] = token
+            self.counters["poisoned_readbacks"] += 1
+        if deferred:
+            self._poisons.setdefault(readback + 1, []).extend(deferred)
+        return toks, counts
+
+    def on_prefill(self, call: int) -> None:
+        """Called with the 0-based prefill call index before the prefill
+        dispatch."""
+        if self._hit(self._prefill_windows, call):
+            self.counters["prefill_failures"] += 1
+            raise InjectedPrefillError(
+                f"injected prefill failure at call {call} "
+                "(RESOURCE_EXHAUSTED: out of memory)"
+            )
+
+    def now(self, real_now: float) -> float:
+        """Clock hook: the engine's view of time, skewed per schedule."""
+        if self._skew and (
+            self._skew_after is None or real_now >= self._skew_after
+        ):
+            return real_now + self._skew
+        return real_now

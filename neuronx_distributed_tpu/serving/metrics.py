@@ -29,6 +29,16 @@ class ServingMetrics:
         self.completed = 0
         self.cancelled = 0
         self.preemptions = 0
+        # fault-tolerance counters (serving robustness layer)
+        self.sheds = 0  # deadline/queue-timeout expiries (queued + in-flight)
+        self.rejects = 0  # backpressure / drain / halt submit refusals
+        self.quarantines = 0  # slots pulled from rotation for bad readbacks
+        self.dispatch_retries = 0  # failed decode dispatches that recovered
+        self.recoveries = 0  # completed requeue-and-resume recoveries
+        self.prefill_failures = 0
+        self.failed = 0  # requests terminated in FAILED (for cause)
+        self.timed_out = 0  # requests terminated in TIMED_OUT
+        self.health = "ok"  # engine-owned mirror of ServingEngine.health()
         self.cursor_high_water = 0
         self.occupied_slot_steps = 0  # Σ active slots over decode steps
         # decode hot-path wall time, split at the host-sync boundary:
@@ -83,6 +93,45 @@ class ServingMetrics:
 
     def record_preemption(self, req) -> None:
         self.preemptions += 1
+
+    # --- fault tolerance ----------------------------------------------------
+
+    def record_shed(self, req, now: float, where: str) -> None:
+        """A request timed out — ``where`` is ``"queue"`` (shed before
+        prefill) or ``"inflight"`` (deadline hit at a chunk boundary)."""
+        r = self._requests.get(req.rid)
+        if r is not None:
+            r["finish_time"] = now
+            r["timed_out"] = True
+            r["shed_where"] = where
+            r["tokens"] = len(req.tokens)
+        self.sheds += 1
+        self.timed_out += 1
+
+    def record_reject(self, queue_depth: int, reason: str) -> None:
+        self.rejects += 1
+
+    def record_quarantine(self, slot: int, rid) -> None:
+        self.quarantines += 1
+
+    def record_dispatch_retry(self) -> None:
+        self.dispatch_retries += 1
+
+    def record_recovery(self, requeued: int) -> None:
+        self.recoveries += 1
+
+    def record_failed(self, req, now: float, kind: str = "engine") -> None:
+        """A request the engine failed for cause (``req.error`` has the
+        reason): ``kind`` is ``"prefill"`` (OOM-like admission fault) or
+        ``"quarantine"`` (poisoned slot under the fail policy)."""
+        r = self._requests.get(req.rid)
+        if r is not None:
+            r["finish_time"] = now
+            r["failed"] = True
+            r["failed_kind"] = kind
+        self.failed += 1
+        if kind == "prefill":
+            self.prefill_failures += 1
 
     # --- engine step --------------------------------------------------------
 
@@ -148,6 +197,15 @@ class ServingMetrics:
             "completed": self.completed,
             "cancelled": self.cancelled,
             "preemptions": self.preemptions,
+            "sheds": self.sheds,
+            "rejects": self.rejects,
+            "quarantines": self.quarantines,
+            "dispatch_retries": self.dispatch_retries,
+            "recoveries": self.recoveries,
+            "prefill_failures": self.prefill_failures,
+            "failed": self.failed,
+            "timed_out": self.timed_out,
+            "health": self.health,
             "cursor_high_water": self.cursor_high_water,
             "mean_occupancy": self.mean_occupancy,
             "mean_ttft": _mean(ttfts),

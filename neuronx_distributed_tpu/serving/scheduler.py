@@ -32,6 +32,12 @@ class RequestState(enum.Enum):
     DECODE = "decode"
     DONE = "done"
     CANCELLED = "cancelled"
+    # terminal fault states (serving robustness layer): TIMED_OUT — shed for
+    # missing its queue timeout or in-flight deadline; FAILED — the engine
+    # gave up on it for cause (``Request.error`` carries the reason, e.g. a
+    # prefill fault or a quarantined slot under the fail policy)
+    TIMED_OUT = "timed_out"
+    FAILED = "failed"
 
 
 @dataclasses.dataclass
@@ -53,6 +59,11 @@ class Request:
     slot: Optional[int] = None
     preemptions: int = 0
     error: Optional[str] = None
+    # absolute engine-clock deadlines (None = unbounded): ``deadline`` ends
+    # the request wherever it is (enforced at chunk boundaries once
+    # decoding); ``queue_deadline`` sheds it if it has not been admitted yet
+    deadline: Optional[float] = None
+    queue_deadline: Optional[float] = None
     # timestamps (engine clock) for metrics
     submit_time: Optional[float] = None
     admit_time: Optional[float] = None
@@ -81,7 +92,12 @@ class Request:
 
     @property
     def finished(self) -> bool:
-        return self.state in (RequestState.DONE, RequestState.CANCELLED)
+        return self.state in (
+            RequestState.DONE,
+            RequestState.CANCELLED,
+            RequestState.TIMED_OUT,
+            RequestState.FAILED,
+        )
 
 
 class Scheduler:
@@ -91,6 +107,10 @@ class Scheduler:
         self.max_tokens_in_flight = max_tokens_in_flight
         self._queue: Deque[Request] = deque()
         self._requests: Dict[int, Request] = {}
+        # monotone flag: set once any deadline-carrying request enters the
+        # queue, so deadline-free engines pay O(1) in expire() per step
+        # instead of an O(queue) scan of a deep backlog
+        self._saw_deadlines = False
 
     # --- intake -------------------------------------------------------------
 
@@ -98,6 +118,8 @@ class Scheduler:
         request.state = RequestState.QUEUED
         self._requests[request.rid] = request
         self._queue.append(request)
+        if request.deadline is not None or request.queue_deadline is not None:
+            self._saw_deadlines = True
 
     def requeue_front(self, requests: List[Request]) -> None:
         """Preempted requests rejoin at the FRONT, original arrival order
@@ -119,6 +141,37 @@ class Scheduler:
             pass  # already admitted; the engine frees its slot
         return True
 
+    def expire(self, now: float) -> List[tuple]:
+        """Pop every still-queued request whose queue timeout or overall
+        deadline has passed (``now >= deadline``) and return ``(request,
+        reason)`` pairs — the engine marks them TIMED_OUT and records the
+        shed with the reason (classified HERE, the one place the overdue
+        predicate lives). The queue timeout governs time-to-FIRST-admission
+        only: a request requeued after preemption or dispatch recovery was
+        already admitted in time (``admit_time`` set), so only its overall
+        deadline can still shed it. Queue order of the survivors is
+        preserved; a queue that never saw a deadline returns in O(1)."""
+        expired: List[tuple] = []
+        if not self._queue or not self._saw_deadlines:
+            return expired
+        keep: Deque[Request] = deque()
+        while self._queue:
+            req = self._queue.popleft()
+            if req.state is not RequestState.QUEUED:
+                keep.append(req)
+            elif (
+                req.queue_deadline is not None
+                and req.admit_time is None
+                and now >= req.queue_deadline
+            ):
+                expired.append((req, "queue timeout before admission"))
+            elif req.deadline is not None and now >= req.deadline:
+                expired.append((req, "deadline exceeded while queued"))
+            else:
+                keep.append(req)
+        self._queue = keep
+        return expired
+
     # --- admission ----------------------------------------------------------
 
     def select(
@@ -136,7 +189,7 @@ class Scheduler:
         budget = in_flight_tokens
         while self._queue and len(selected) < free_slots:
             req = self._queue[0]
-            if req.state is RequestState.CANCELLED:
+            if req.finished:  # cancelled/shed while queued — drop in place
                 self._queue.popleft()
                 continue
             if (
@@ -161,10 +214,13 @@ class Scheduler:
         return self._requests
 
     @property
+    def queued_requests(self) -> List[Request]:
+        """Live (not finished) queue entries, in queue order."""
+        return [r for r in self._queue if not r.finished]
+
+    @property
     def queued(self) -> int:
-        return sum(
-            1 for r in self._queue if r.state is not RequestState.CANCELLED
-        )
+        return len(self.queued_requests)
 
     def get(self, rid: int) -> Optional[Request]:
         return self._requests.get(rid)

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 import jax
 
 from neuronx_distributed_tpu.utils.logger import get_logger
+from neuronx_distributed_tpu.utils.retry import RetryPolicy, with_retries
 
 logger = get_logger(__name__)
 
@@ -160,32 +161,26 @@ _TRANSIENT_ERRORS: Tuple[type, ...] = (OSError, IOError, TimeoutError)
 
 
 def _with_retries(fn, what: str, max_attempts: int = 5,
-                  first_wait: float = 4.0, min_wait: float = 0.5):
+                  first_wait: float = 4.0, min_wait: float = 0.5,
+                  sleep=None, rng=None):
     """Reference ``wait_decrementing_with_jitter`` (checkpoint_storage.py:236):
     retry on transient object-store errors with a DEcreasing jittered wait —
     the first wait is longest (ride out a throttle burst), later waits shrink.
-    """
-    import random
-    import time as _time
 
-    last: Optional[BaseException] = None
-    for attempt in range(max_attempts):
-        try:
-            return fn()
-        except FileNotFoundError:
-            raise  # a missing object is a result, not a transient fault
-        except _TRANSIENT_ERRORS as e:  # noqa: PERF203
-            last = e
-            if attempt == max_attempts - 1:
-                break
-            wait = max(min_wait, first_wait / (attempt + 1))
-            wait *= 0.5 + random.random()  # jitter in [0.5, 1.5)·wait
-            logger.warning(
-                "%s failed (%s: %s) — retry %d/%d in %.1fs",
-                what, type(e).__name__, e, attempt + 1, max_attempts - 1, wait,
-            )
-            _time.sleep(wait)
-    raise last  # type: ignore[misc]
+    The schedule lives in :mod:`neuronx_distributed_tpu.utils.retry`
+    (shared with the serving engine's dispatch-recovery loop); this wrapper
+    keeps the checkpoint call sites' signature and behavior bit-identical
+    to the pre-extraction implementation (seeded-RNG pinned in
+    ``tests/utils/test_retry.py``)."""
+    return with_retries(
+        fn, what,
+        policy=RetryPolicy(
+            max_attempts=max_attempts, first_wait=first_wait,
+            min_wait=min_wait,
+        ),
+        transient=_TRANSIENT_ERRORS,
+        sleep=sleep, rng=rng,
+    )
 
 
 class FsspecCheckpointStorage(BaseCheckpointStorage):

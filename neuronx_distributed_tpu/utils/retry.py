@@ -1,0 +1,84 @@
+"""Shared transient-fault retry policy (reference: tenacity's
+``wait_decrementing_with_jitter`` in NxD's ``checkpoint_storage.py:236``).
+
+One wait schedule serves every consumer that has to ride out a throttle
+burst: checkpoint object-store metadata ops (``trainer/checkpoint.py``) and
+the serving engine's dispatch-recovery loop (``serving/engine.py``). The
+schedule DEcrements — the first wait is longest (outlast the burst), later
+waits shrink toward ``min_wait`` — and every wait is jittered into
+``[0.5, 1.5)·wait`` so a fleet of retriers never thunders in phase.
+
+``rng`` and ``sleep`` are injectable so tests can pin the exact schedule
+with a seeded RNG (the checkpoint behavior must stay bit-identical to the
+pre-extraction ``_with_retries``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _random
+import time as _time
+from typing import Callable, Optional, Tuple
+
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retries with decrementing jittered waits.
+
+    ``max_attempts`` counts TOTAL tries (1 initial + max_attempts-1
+    retries). ``wait(k)`` is the pause after failed attempt ``k``
+    (0-based): ``max(min_wait, first_wait / (k + 1))`` scaled by a jitter
+    factor in ``[0.5, 1.5)``.
+    """
+
+    max_attempts: int = 5
+    first_wait: float = 4.0
+    min_wait: float = 0.5
+
+    def base_wait(self, attempt: int) -> float:
+        """The un-jittered wait after 0-based failed attempt ``attempt``."""
+        return max(self.min_wait, self.first_wait / (attempt + 1))
+
+    def wait(self, attempt: int, rng=None) -> float:
+        """Jittered wait after 0-based failed attempt ``attempt``."""
+        r = (rng if rng is not None else _random).random()
+        return self.base_wait(attempt) * (0.5 + r)
+
+
+def with_retries(
+    fn: Callable,
+    what: str,
+    policy: RetryPolicy = RetryPolicy(),
+    transient: Tuple[type, ...] = (OSError, IOError, TimeoutError),
+    passthrough: Tuple[type, ...] = (FileNotFoundError,),
+    sleep: Optional[Callable[[float], None]] = None,
+    rng=None,
+):
+    """Call ``fn()`` riding out up to ``policy.max_attempts`` transient
+    failures. ``passthrough`` errors raise immediately (a missing object is
+    a RESULT, not a fault — no retry burned); after the final attempt the
+    last transient error raises. ``sleep``/``rng`` default to
+    ``time.sleep`` / the global ``random`` module and exist for
+    deterministic tests."""
+    last: Optional[BaseException] = None
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except passthrough:
+            raise
+        except transient as e:  # noqa: PERF203
+            last = e
+            if attempt == policy.max_attempts - 1:
+                break
+            pause = policy.wait(attempt, rng=rng)
+            logger.warning(
+                "%s failed (%s: %s) — retry %d/%d in %.1fs",
+                what, type(e).__name__, e,
+                attempt + 1, policy.max_attempts - 1, pause,
+            )
+            (sleep if sleep is not None else _time.sleep)(pause)
+    raise last  # type: ignore[misc]

@@ -94,14 +94,20 @@ class Timeline:
                  "args": {name: value}}
             )
 
-    def instant(self, name: str, category: str = "host") -> None:
+    def instant(
+        self, name: str, category: str = "host", args: Optional[dict] = None
+    ) -> None:
+        """Zero-duration marker. ``args`` attaches a payload dict (e.g. the
+        serving engine's shed/quarantine/recovery events carry the request
+        id and reason, so a Perfetto view of a chaos run explains itself)."""
         if not self.enabled:
             return
         with self._lock:
-            self._events.append(
-                {"name": name, "cat": category, "ph": "i", "ts": self._now_us(),
-                 "pid": self.rank, "s": "g"}
-            )
+            ev = {"name": name, "cat": category, "ph": "i",
+                  "ts": self._now_us(), "pid": self.rank, "s": "g"}
+            if args:
+                ev["args"] = dict(args)
+            self._events.append(ev)
 
     def save(self) -> None:
         """Dump accumulated events (reference per-step JSON dump)."""

@@ -1,0 +1,747 @@
+"""Chaos suite for the serving engine's fault-tolerance layer.
+
+Every recovery path is driven DETERMINISTICALLY through ``FaultInjector``
+schedules (no randomness, no sleeping — the engine clock and retry waits
+are injected), and the acceptance bar is the engine's core contract under
+fire: after an injected mid-stream dispatch failure every surviving
+request's token stream is BIT-IDENTICAL to its solo ``generate()`` call
+(zero token loss or duplication), a poisoned slot never alters a
+neighbor's stream, and N consecutive failures halt the engine with the
+work requeued — never crash the host loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig, generate
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.serving import (
+    EngineHealth,
+    FaultInjector,
+    RejectedError,
+    RequestState,
+    ServingEngine,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+def _solo(model, params, prompt, key, gcfg):
+    toks = np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], key, gcfg)
+    )[0].tolist()
+    if gcfg.eos_token_id is not None and gcfg.eos_token_id in toks:
+        toks = toks[: toks.index(gcfg.eos_token_id) + 1]
+    return toks
+
+
+def _workload(cfg, n=4, seed=17):
+    rng = np.random.RandomState(seed)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(3, 12)).astype(np.int32)
+        for _ in range(n)
+    ]
+    gcfgs = [
+        GenerationConfig(max_new_tokens=10, temperature=0.0),
+        GenerationConfig(max_new_tokens=12, temperature=0.8, top_k=17),
+        GenerationConfig(max_new_tokens=8, temperature=1.1, top_p=0.9),
+        GenerationConfig(max_new_tokens=11, temperature=0.6, top_k=30),
+    ][:n]
+    keys = [jax.random.PRNGKey(500 + i) for i in range(n)]
+    return prompts, gcfgs, keys
+
+
+# --- dispatch failure recovery ----------------------------------------------
+
+
+def test_dispatch_failure_recovery_streams_bit_identical(setup):
+    """Acceptance: a dispatch failure injected MID-STREAM (chunk 1, with
+    every slot active and tokens already emitted) recovers through the
+    requeue machinery and every request still matches its solo generate()
+    stream exactly — zero tokens lost, zero duplicated."""
+    cfg, model, params = setup
+    prompts, gcfgs, keys = _workload(cfg)
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    waits = []
+    inj = FaultInjector().fail_dispatch(at=1, times=1)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3,
+        fault_injector=inj, sleep_fn=waits.append,
+    )
+    reqs = [
+        engine.submit(p, c, key=k)
+        for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run()
+    assert inj.counters["dispatch_failures"] == 1  # the schedule fired
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE
+        assert req.tokens == ref, f"request {i} diverged across recovery"
+    snap = engine.metrics.snapshot()
+    assert snap["dispatch_retries"] == 1
+    assert snap["recoveries"] == 1
+    assert snap["completed"] == len(reqs)
+    assert len(waits) == 1 and waits[0] > 0  # the shared jittered wait ran
+    assert engine.decode_compilations == 1  # recovery reuses the program
+
+
+def test_dispatch_failure_marks_degraded_then_cools_down(setup):
+    """Health: one recovered failure reads DEGRADED, then returns to OK
+    after the cooldown's worth of clean chunks."""
+    cfg, model, params = setup
+    inj = FaultInjector().fail_dispatch(at=1, times=1)
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=1,
+        degraded_cooldown_chunks=3, fault_injector=inj,
+        sleep_fn=lambda s: None,
+    )
+    req = engine.submit(
+        np.asarray([3, 5, 7], np.int32),
+        GenerationConfig(max_new_tokens=20, temperature=0.0),
+    )
+    engine.step()  # admit + first chunk
+    engine.step()  # injected failure → recovery
+    assert engine.health() is EngineHealth.DEGRADED
+    assert engine.metrics.snapshot()["health"] == "degraded"
+    engine.run()
+    assert req.state is RequestState.DONE
+    assert engine.health() is EngineHealth.OK  # cooled down
+    assert engine.metrics.snapshot()["health"] == "ok"
+
+
+def test_consecutive_dispatch_failures_halt_with_work_requeued(setup):
+    """Acceptance: N consecutive dispatch failures land the engine in
+    HALTED — in-flight requests are REQUEUED (tokens kept), run() returns
+    instead of spinning or crashing, and submissions are rejected."""
+    cfg, model, params = setup
+    prompts, gcfgs, keys = _workload(cfg, n=2)
+    inj = FaultInjector().fail_dispatch(at=1, times=None)  # fail forever
+    waits = []
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2,
+        fault_injector=inj, sleep_fn=waits.append,
+    )
+    reqs = [
+        engine.submit(p, c, key=k)
+        for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run()  # must RETURN (halt), not raise or livelock
+    assert engine.health() is EngineHealth.HALTED
+    assert "consecutive dispatch failures" in engine.halt_reason
+    assert engine.metrics.dispatch_retries == 3  # default max_attempts
+    for req in reqs:
+        assert req.state is RequestState.QUEUED  # requeued, not lost
+        assert len(req.tokens) >= 1  # progress from before the fault kept
+    assert not engine.has_work  # halted engines make no progress
+    with pytest.raises(RejectedError):
+        engine.submit(prompts[0], gcfgs[0])
+    # only non-final failures wait (the halting failure exits immediately)
+    assert len(waits) == 2
+
+
+def test_recovery_with_consumed_buffers_reallocates(setup):
+    """A dispatch that consumed the donated buffers before failing (the
+    worst case: XLA already invalidated the cache) still recovers — the
+    manager drops to lazy reallocation, and the requeued request's stream
+    stays exact because tokens/keys were host-current at the boundary."""
+    cfg, model, params = setup
+    gcfg = GenerationConfig(max_new_tokens=10, temperature=0.7, top_k=9)
+    prompt = np.asarray([2, 3, 4, 5], np.int32)
+    ref = _solo(model, params, prompt, jax.random.PRNGKey(77), gcfg)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2,
+        sleep_fn=lambda s: None,
+    )
+    req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(77))
+    engine.step()  # admit + one clean chunk
+    real = engine._decode_chunk
+
+    def consume_then_fail(params, cache, state):
+        real(params, cache, state)  # donation consumes cache+state buffers
+        raise RuntimeError("fault after consumption")
+
+    engine._decode_chunk = consume_then_fail
+    engine.step()  # failure → recovery must not touch deleted buffers
+    engine._decode_chunk = real
+    assert engine.cache.cache is None  # storage dropped, not left poisoned
+    assert req.state is RequestState.QUEUED
+    engine.run()
+    assert req.state is RequestState.DONE
+    assert req.tokens == ref
+
+
+# --- output validation & quarantine -----------------------------------------
+
+
+def test_quarantine_isolates_poisoned_slot(setup):
+    """Acceptance: a poisoned readback quarantines exactly its slot — the
+    victim request resumes in another slot with a BIT-IDENTICAL stream
+    (the poisoned chunk is discarded before any token reaches it), and no
+    neighbor's stream changes."""
+    cfg, model, params = setup
+    prompts, gcfgs, keys = _workload(cfg, n=3)
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    inj = FaultInjector().poison_readback(at=1, slot=0, token=-3)
+    engine = ServingEngine(
+        model, params, num_slots=3, decode_chunk_size=2,
+        fault_injector=inj, sleep_fn=lambda s: None,
+    )
+    reqs = [
+        engine.submit(p, c, key=k)
+        for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run()
+    assert inj.counters["poisoned_readbacks"] == 1
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE
+        assert req.tokens == ref, f"request {i} corrupted by the poison"
+    snap = engine.metrics.snapshot()
+    assert snap["quarantines"] == 1
+    assert engine.cache.usable_slots == 2  # slot 0 out of rotation
+    assert engine.cache.quarantined_slots == [0]
+    assert engine.health() is EngineHealth.DEGRADED  # reduced capacity
+    # the quarantined slot never hosts another request
+    assert all(r.slot != 0 for r in reqs)
+
+
+def test_quarantine_fail_policy_fails_the_victim(setup):
+    """``quarantine_policy="fail"`` terminates the victim with a reason
+    instead of requeueing; neighbors still finish exactly."""
+    cfg, model, params = setup
+    prompts, gcfgs, keys = _workload(cfg, n=2)
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    inj = FaultInjector().poison_readback(at=1, slot=0, token=cfg.vocab_size)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2,
+        quarantine_policy="fail", fault_injector=inj,
+    )
+    reqs = [
+        engine.submit(p, c, key=k)
+        for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run()
+    victim = next(r for r in reqs if r.state is RequestState.FAILED)
+    survivor = next(r for r in reqs if r is not victim)
+    assert "quarantined" in victim.error
+    assert survivor.state is RequestState.DONE
+    assert survivor.tokens == refs[reqs.index(survivor)]
+    assert engine.metrics.snapshot()["failed"] == 1
+
+
+def test_all_slots_quarantined_halts(setup):
+    """Graceful degradation bottoms out: losing every slot halts the
+    engine rather than spinning admission against an empty rotation."""
+    cfg, model, params = setup
+    gcfg = GenerationConfig(max_new_tokens=20, temperature=0.0)
+    inj = (
+        FaultInjector()
+        .poison_readback(at=1, slot=0, token=-1)
+        .poison_readback(at=2, slot=0, token=-1)
+    )
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=2,
+        fault_injector=inj, sleep_fn=lambda s: None,
+    )
+    req = engine.submit(np.asarray([3, 5, 7], np.int32), gcfg)
+    engine.run()
+    assert engine.health() is EngineHealth.HALTED
+    assert engine.halt_reason == "all slots quarantined"
+    assert req.state is RequestState.QUEUED  # requeued, inspectable
+
+
+# --- deadlines, shedding, backpressure, drain --------------------------------
+
+
+def test_queue_timeout_sheds_before_prefill(setup):
+    """Deterministic under a fake clock: a request whose queue timeout
+    expires before a slot frees is shed BEFORE prefill (no compute spent),
+    with the TIMED_OUT terminal state and a shed metric."""
+    cfg, model, params = setup
+    clock = {"t": 0.0}
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=2,
+        time_fn=lambda: clock["t"],
+    )
+    blocker = engine.submit(
+        np.asarray([1, 2, 3], np.int32),
+        GenerationConfig(max_new_tokens=30, temperature=0.0),
+    )
+    engine.step()  # blocker takes the only slot
+    victim = engine.submit(
+        np.asarray([4, 5, 6], np.int32),
+        GenerationConfig(max_new_tokens=5, temperature=0.0),
+        queue_timeout_s=2.0,
+    )
+    prefills_before = engine.metrics.prefills
+    clock["t"] = 3.0  # past the queue timeout
+    engine.step()
+    assert victim.state is RequestState.TIMED_OUT
+    assert victim.error == "queue timeout before admission"
+    assert victim.tokens == []  # shed before any compute
+    assert engine.metrics.prefills == prefills_before  # no prefill burned
+    engine.run()
+    assert blocker.state is RequestState.DONE
+    snap = engine.metrics.snapshot()
+    assert snap["sheds"] == 1 and snap["timed_out"] == 1
+    assert engine.metrics.request_snapshot(victim.rid)["shed_where"] == "queue"
+
+
+def test_inflight_deadline_enforced_at_chunk_boundary(setup):
+    """An in-flight deadline sheds at the NEXT chunk boundary: the request
+    keeps every token already streamed, the slot frees, neighbors run on."""
+    cfg, model, params = setup
+    clock = {"t": 0.0}
+    gcfg_free = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    other_prompt = np.asarray([11, 13, 17], np.int32)
+    ref_other = _solo(
+        model, params, other_prompt, jax.random.PRNGKey(9), gcfg_free
+    )
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2,
+        time_fn=lambda: clock["t"],
+    )
+    doomed = engine.submit(
+        np.asarray([2, 4, 6], np.int32),
+        GenerationConfig(max_new_tokens=40, temperature=0.0),
+        deadline_s=5.0,
+    )
+    other = engine.submit(other_prompt, gcfg_free, key=jax.random.PRNGKey(9))
+    engine.step()
+    engine.step()
+    tokens_at_boundary = len(doomed.tokens)
+    assert tokens_at_boundary > 0
+    clock["t"] = 6.0  # past the deadline, mid-generation
+    engine.run()
+    assert doomed.state is RequestState.TIMED_OUT
+    assert doomed.error == "deadline exceeded mid-generation"
+    assert len(doomed.tokens) == tokens_at_boundary  # partial stream kept
+    assert other.state is RequestState.DONE
+    assert other.tokens == ref_other  # neighbor untouched by the shed
+    assert (
+        engine.metrics.request_snapshot(doomed.rid)["shed_where"] == "inflight"
+    )
+
+
+def test_clock_skew_injection_drives_shedding(setup):
+    """The injector's clock-skew hook triggers deadline paths without a
+    fake clock wiring — the engine's scheduling clock jumps, real wall
+    time does not."""
+    cfg, model, params = setup
+    inj = FaultInjector()
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=2, fault_injector=inj
+    )
+    req = engine.submit(
+        np.asarray([1, 2], np.int32),
+        GenerationConfig(max_new_tokens=30, temperature=0.0),
+        deadline_s=50.0,  # generous — but the skew jumps right past it
+    )
+    inj.skew_clock(by=100.0)  # armed AFTER submit: the deadline is unskewed
+    engine.run()
+    assert req.state is RequestState.TIMED_OUT
+
+
+def test_bounded_queue_rejects_with_depth(setup):
+    """Backpressure: the bounded queue rejects loudly (RejectedError with
+    the observed depth) instead of absorbing an unserviceable backlog."""
+    cfg, model, params = setup
+    gcfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    engine = ServingEngine(model, params, num_slots=1, max_queue=2)
+    engine.submit(np.asarray([1, 2], np.int32), gcfg)
+    engine.step()  # slot taken
+    engine.submit(np.asarray([3, 4], np.int32), gcfg)
+    engine.submit(np.asarray([5, 6], np.int32), gcfg)
+    with pytest.raises(RejectedError) as exc:
+        engine.submit(np.asarray([7, 8], np.int32), gcfg)
+    assert exc.value.queue_depth == 2
+    assert engine.metrics.snapshot()["rejects"] == 1
+    engine.run()  # everything admitted finishes normally
+    assert engine.metrics.completed == 3
+
+
+def test_drain_finishes_in_flight_and_admits_nothing_new(setup):
+    """Acceptance: drain() keeps serving admitted work to completion,
+    leaves never-admitted queued requests untouched, rejects submissions,
+    and run() terminates once in-flight work is done."""
+    cfg, model, params = setup
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    engine = ServingEngine(model, params, num_slots=1)
+    ref = _solo(
+        model, params, np.asarray([1, 2, 3], np.int32),
+        jax.random.PRNGKey(4), gcfg,
+    )
+    active = engine.submit(
+        np.asarray([1, 2, 3], np.int32), gcfg, key=jax.random.PRNGKey(4)
+    )
+    engine.step()  # active in the slot
+    queued = engine.submit(np.asarray([4, 5], np.int32), gcfg)
+    engine.drain()
+    assert engine.health() is EngineHealth.DRAINING
+    with pytest.raises(RejectedError):
+        engine.submit(np.asarray([6, 7], np.int32), gcfg)
+    engine.run()  # terminates: queued never-admitted work is not "work"
+    assert active.state is RequestState.DONE
+    assert active.tokens == ref
+    assert queued.state is RequestState.QUEUED  # held, not shed
+    assert engine.metrics.snapshot()["health"] == "draining"
+    engine.resume()
+    engine.run()
+    assert queued.state is RequestState.DONE  # resumes after undrain
+
+
+def test_drain_still_finishes_preempted_work(setup):
+    """Preempted requests are in-flight work: drain must let them resume
+    (they rejoin at the queue FRONT) and finish exactly."""
+    cfg0, model0, params = setup
+    cfg = tiny_llama(max_seq_len=48)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    gcs = [
+        GenerationConfig(max_new_tokens=30, temperature=0.0),
+        GenerationConfig(max_new_tokens=20, temperature=0.0),
+        GenerationConfig(max_new_tokens=25, temperature=0.0),
+    ]
+    prompts = [
+        np.asarray([3, 5, 7, 11], np.int32),
+        np.asarray([13, 17, 19, 23], np.int32),
+        np.asarray([29, 31, 37, 41], np.int32),
+    ]
+    refs = [
+        _solo(model, params, p, jax.random.PRNGKey(60 + i), gc)
+        for i, (p, gc) in enumerate(zip(prompts, gcs))
+    ]
+    engine = ServingEngine(model, params, num_slots=2, admission="eager")
+    reqs = [
+        engine.submit(p, gc, key=jax.random.PRNGKey(60 + i))
+        for i, (p, gc) in enumerate(zip(prompts, gcs))
+    ]
+    # step until the cursor wall forces a preemption, then drain mid-flight
+    while engine.metrics.preemptions == 0 and engine.has_work:
+        engine.step()
+    assert engine.metrics.preemptions > 0
+    engine.drain()
+    engine.run()
+    for req, ref in zip(reqs, refs):
+        assert req.state is RequestState.DONE
+        assert req.tokens == ref
+
+
+# --- prefill faults ----------------------------------------------------------
+
+
+def test_prefill_fault_fails_one_request_not_the_loop(setup):
+    """An OOM-like prefill fault fails exactly the victim request (FAILED,
+    reason recorded), returns its slot, and every other stream is exact."""
+    cfg, model, params = setup
+    prompts, gcfgs, keys = _workload(cfg, n=3)
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    inj = FaultInjector().fail_prefill(at=1, times=1)
+    engine = ServingEngine(
+        model, params, num_slots=2, fault_injector=inj
+    )
+    reqs = [
+        engine.submit(p, c, key=k)
+        for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run()
+    assert inj.counters["prefill_failures"] == 1
+    failed = [r for r in reqs if r.state is RequestState.FAILED]
+    assert len(failed) == 1
+    assert "prefill failed" in failed[0].error
+    for req, ref in zip(reqs, refs):
+        if req.state is RequestState.DONE:
+            assert req.tokens == ref
+    assert engine.metrics.snapshot()["prefill_failures"] == 1
+    assert engine.cache.free_slots == engine.num_slots  # slot returned
+
+
+def test_queue_timeout_spares_requeued_inflight_work(setup):
+    """Regression (review): the queue timeout governs FIRST admission only.
+    A request admitted in time and then requeued by dispatch recovery (or
+    preemption) must NOT be shed as 'queue timeout' while it waits to
+    resume — only its overall deadline can still end it. Stream stays
+    bit-identical to solo generate()."""
+    cfg, model, params = setup
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.7, top_k=11)
+    prompt = np.asarray([3, 5, 7, 9], np.int32)
+    ref = _solo(model, params, prompt, jax.random.PRNGKey(31), gcfg)
+    clock = {"t": 0.0}
+    inj = FaultInjector().fail_dispatch(at=1, times=1)
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=2,
+        fault_injector=inj, sleep_fn=lambda s: None,
+        time_fn=lambda: clock["t"],
+    )
+    req = engine.submit(
+        prompt, gcfg, key=jax.random.PRNGKey(31), queue_timeout_s=1.0
+    )
+    engine.step()  # admitted at t=0, well inside the window
+    engine.step()  # injected dispatch failure → requeued mid-flight
+    assert req.state is RequestState.QUEUED and req.admit_time is not None
+    clock["t"] = 5.0  # far past submit_time + queue_timeout_s
+    engine.run()
+    assert req.state is RequestState.DONE  # resumed, not shed
+    assert req.tokens == ref
+    assert engine.metrics.sheds == 0
+
+
+def test_persistent_prefill_failures_halt_not_silent(setup):
+    """Regression (review): a prefill that fails EVERY admission must not
+    silently fail 100% of traffic while health() reads OK — consecutive
+    prefill failures are bounded like dispatch failures and halt the
+    engine, with the unprocessed queue left intact for handoff."""
+    cfg, model, params = setup
+    gcfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    inj = FaultInjector().fail_prefill(at=0, times=None)  # never recovers
+    engine = ServingEngine(model, params, num_slots=2, fault_injector=inj)
+    reqs = [
+        engine.submit(np.asarray([i + 1, i + 2], np.int32), gcfg)
+        for i in range(6)
+    ]
+    engine.run()  # returns (halt), does not fail the whole backlog
+    assert engine.health() is EngineHealth.HALTED
+    assert "consecutive prefill failures" in engine.halt_reason
+    failed = [r for r in reqs if r.state is RequestState.FAILED]
+    queued = [r for r in reqs if r.state is RequestState.QUEUED]
+    assert len(failed) == 3  # the bounded consecutive budget, not all 6
+    assert len(queued) == 3  # the rest requeued intact
+    assert engine.metrics.prefill_failures == 3
+    assert engine.cache.free_slots == engine.num_slots  # slots all returned
+
+
+def test_prefill_halt_requeues_actively_decoding_requests(setup):
+    """Regression (review): a prefill-failure halt must honor the HALTED
+    contract for requests that were actively DECODING when the admission
+    path died — they are requeued with their partial streams, not stranded
+    in DECODE with a bound slot, and no further chunk is dispatched."""
+    cfg, model, params = setup
+    long_gcfg = GenerationConfig(max_new_tokens=40, temperature=0.0)
+    inj = FaultInjector().fail_prefill(at=2, times=None)  # after 2 good ones
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2, fault_injector=inj
+    )
+    active = [
+        engine.submit(np.asarray([i + 2, i + 3, i + 4], np.int32), long_gcfg)
+        for i in range(2)
+    ]
+    engine.step()  # both admitted (prefills 0 and 1), decoding
+    assert all(r.state is RequestState.DECODE for r in active)
+    laters = [
+        engine.submit(np.asarray([i + 9, i + 10], np.int32), long_gcfg)
+        for i in range(4)
+    ]
+    # finish the actives' slots? no — keep them mid-decode; the queued
+    # requests can only admit once a slot frees, so force churn by
+    # cancelling one active to open a slot for the failing prefills
+    engine.cancel(active[1].rid)
+    engine.run()
+    assert engine.health() is EngineHealth.HALTED
+    assert "consecutive prefill failures" in engine.halt_reason
+    # the still-decoding request was REQUEUED with its progress, not
+    # stranded in DECODE with a bound slot
+    assert active[0].state is RequestState.QUEUED
+    assert active[0].slot is None
+    assert len(active[0].tokens) > 0
+    assert not any(engine._active)
+    failed = [r for r in laters if r.state is RequestState.FAILED]
+    assert len(failed) == 3  # the bounded consecutive budget
+
+
+def test_poison_defers_until_slot_active(setup):
+    """Regression (review): a poison scheduled for a readback where its
+    slot is INACTIVE defers to a later readback instead of firing into the
+    void — the counter increments only when garbage actually lands, so
+    asserting on it really proves the quarantine path ran."""
+    inj = FaultInjector().poison_readback(at=0, slot=1, token=-1)
+    toks = np.zeros((2, 2), np.int32)
+    counts = np.ones((2,), np.int32)
+    # slot 1 empty at readback 0: no fire, schedule carried forward
+    t, c = inj.on_readback(0, toks, counts, np.array([True, False]))
+    assert inj.counters["poisoned_readbacks"] == 0
+    assert (t == 0).all() and (c == 1).all()
+    # slot 1 active at readback 1: the deferred poison lands
+    t, c = inj.on_readback(1, toks, counts, np.array([True, True]))
+    assert inj.counters["poisoned_readbacks"] == 1
+    assert t[0, 1] == -1
+    # end-to-end: a poison aimed at an always-empty slot never fires and
+    # never perturbs the engine
+    cfg, model, params = setup
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    prompt = np.asarray([2, 4, 6], np.int32)
+    ref = _solo(model, params, prompt, jax.random.PRNGKey(3), gcfg)
+    inj2 = FaultInjector().poison_readback(at=0, slot=1, token=-1)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2, fault_injector=inj2
+    )
+    req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(3))
+    engine.run()
+    assert req.tokens == ref
+    assert inj2.counters["poisoned_readbacks"] == 0
+    assert engine.metrics.quarantines == 0
+
+
+# --- infeasible submissions (bugfix satellite) -------------------------------
+
+
+def test_unplaceable_submit_rejected_up_front(setup):
+    """Regression: a permanently-unplaceable request must fail at submit()
+    — queueing it would livelock run() behind a FIFO head that no
+    admission round can ever select. Nothing may be left in the scheduler
+    after the raise."""
+    cfg, model, params = setup
+    engine = ServingEngine(model, params, num_slots=2, max_tokens_in_flight=20)
+    # footprint over the whole token budget
+    with pytest.raises(ValueError, match="max_tokens_in_flight"):
+        engine.submit(
+            np.arange(1, 16, dtype=np.int32),
+            GenerationConfig(max_new_tokens=10),
+        )
+    # prompt + generation over max_seq_len (the shared generate() contract)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        engine.submit(
+            np.arange(1, cfg.max_seq_len, dtype=np.int32),
+            GenerationConfig(max_new_tokens=8),
+        )
+    assert engine.scheduler.queued == 0
+    assert not engine.scheduler.requests  # nothing half-registered
+    assert not engine.has_work  # run() returns immediately
+    engine.run()
+
+
+def test_deadline_validation(setup):
+    cfg, model, params = setup
+    engine = ServingEngine(model, params, num_slots=1)
+    with pytest.raises(ValueError, match="deadline_s"):
+        engine.submit(
+            np.asarray([1, 2], np.int32), GenerationConfig(), deadline_s=0.0
+        )
+    with pytest.raises(ValueError, match="queue_timeout_s"):
+        engine.submit(
+            np.asarray([1, 2], np.int32), GenerationConfig(),
+            queue_timeout_s=-1.0,
+        )
+
+
+# --- timeline ----------------------------------------------------------------
+
+
+def test_fault_events_land_on_the_timeline(setup, tmp_path):
+    """Chaos runs must explain themselves in the trace: dispatch_failure /
+    recovery / shed / quarantine instants carry their reason payloads."""
+    import json
+
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    cfg, model, params = setup
+    clock = {"t": 0.0}
+    trace = tmp_path / "chaos_trace.json"
+    tl = Timeline(str(trace))
+    inj = (
+        FaultInjector()
+        .fail_dispatch(at=1, times=1)
+        .poison_readback(at=3, slot=0, token=-1)
+    )
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2,
+        fault_injector=inj, timeline=tl, sleep_fn=lambda s: None,
+        time_fn=lambda: clock["t"],
+    )
+    engine.submit(
+        np.asarray([1, 2, 3], np.int32),
+        GenerationConfig(max_new_tokens=20, temperature=0.0),
+    )
+    victim = engine.submit(
+        np.asarray([4, 5], np.int32),
+        GenerationConfig(max_new_tokens=20, temperature=0.0),
+        deadline_s=5.0,
+    )
+    for _ in range(3):
+        engine.step()
+    clock["t"] = 6.0  # shed the deadline-bound request mid-flight
+    engine.run()
+    tl.save()
+    events = json.loads(trace.read_text())["traceEvents"]
+    names = [e["name"] for e in events]
+    assert "dispatch_failure" in names
+    assert "recovery" in names
+    assert any(n.startswith("quarantine") for n in names)
+    assert any(n.startswith("shed") for n in names)
+    shed = next(e for e in events if e["name"].startswith("shed"))
+    assert "args" in shed  # instant events carry their payload
+    assert victim.state is RequestState.TIMED_OUT
+
+
+# --- soak (excluded from tier-1) --------------------------------------------
+
+
+@pytest.mark.slow
+def test_soak_mixed_faults_under_load(setup):
+    """Long chaos soak: repeated dispatch faults + a poisoned slot + tight
+    deadlines over a large staggered workload — the engine must end the
+    run un-crashed with every non-shed stream exact."""
+    cfg, model, params = setup
+    rng = np.random.RandomState(0)
+    n = 16
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(3, 12)).astype(np.int32)
+        for _ in range(n)
+    ]
+    gcfgs = [
+        GenerationConfig(
+            max_new_tokens=int(rng.randint(4, 14)),
+            temperature=float(rng.choice([0.0, 0.8])),
+        )
+        for _ in range(n)
+    ]
+    keys = [jax.random.PRNGKey(900 + i) for i in range(n)]
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    inj = (
+        FaultInjector()
+        .fail_dispatch(at=2, times=1)
+        .fail_dispatch(at=9, times=1)
+        .poison_readback(at=5, slot=1, token=-1)
+    )
+    engine = ServingEngine(
+        model, params, num_slots=4, decode_chunk_size=2,
+        fault_injector=inj, sleep_fn=lambda s: None,
+    )
+    reqs = [
+        engine.submit(p, c, key=k)
+        for p, c, k in zip(prompts[:4], gcfgs[:4], keys[:4])
+    ]
+    i = 4
+    while engine.has_work or i < n:
+        engine.step()
+        if i < n:
+            reqs.append(engine.submit(prompts[i], gcfgs[i], key=keys[i]))
+            i += 1
+    engine.run()
+    assert engine.metrics.dispatch_retries == 2
+    assert engine.metrics.quarantines == 1
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE
+        assert req.tokens == ref, f"request {i} diverged in the soak"

@@ -105,3 +105,51 @@ def test_cancel_counts():
     m.record_cancel(r, 1.0)
     assert m.cancelled == 1
     assert m.request_snapshot(3)["cancelled"] is True
+
+
+def test_fault_tolerance_counters_in_snapshot():
+    """Satellite: the snapshot carries the robustness counters — sheds,
+    rejects, quarantines, dispatch_retries, health — plus the recovery/
+    failure breakdown, and the per-request dicts record why a request
+    ended (shed_where / failed_kind)."""
+    m = ServingMetrics(num_slots=4)
+    snap = m.snapshot()
+    for key in (
+        "sheds", "rejects", "quarantines", "dispatch_retries",
+        "recoveries", "prefill_failures", "failed", "timed_out",
+    ):
+        assert snap[key] == 0, key
+    assert snap["health"] == "ok"
+
+    shed_q, shed_f = _req(0), _req(1)
+    m.record_submit(shed_q, 0.0)
+    m.record_submit(shed_f, 0.0)
+    shed_f.tokens.extend([5, 6])
+    m.record_shed(shed_q, 2.0, where="queue")
+    m.record_shed(shed_f, 3.0, where="inflight")
+    m.record_reject(7, "queue full")
+    m.record_quarantine(2, rid=9)
+    m.record_dispatch_retry()
+    m.record_dispatch_retry()
+    m.record_recovery(requeued=3)
+    failed = _req(2)
+    m.record_submit(failed, 0.0)
+    m.record_failed(failed, 4.0, kind="prefill")
+    m.health = "degraded"
+
+    snap = m.snapshot()
+    assert snap["sheds"] == 2 and snap["timed_out"] == 2
+    assert snap["rejects"] == 1
+    assert snap["quarantines"] == 1
+    assert snap["dispatch_retries"] == 2
+    assert snap["recoveries"] == 1
+    assert snap["prefill_failures"] == 1 and snap["failed"] == 1
+    assert snap["health"] == "degraded"
+    assert m.request_snapshot(0)["shed_where"] == "queue"
+    r1 = m.request_snapshot(1)
+    assert r1["shed_where"] == "inflight"
+    assert r1["timed_out"] is True
+    assert r1["tokens"] == 2  # partial stream length recorded at the shed
+    assert m.request_snapshot(2)["failed_kind"] == "prefill"
+    # shed/failed requests never count as completed
+    assert snap["completed"] == 0

@@ -1,0 +1,151 @@
+"""Shared retry policy (utils/retry.py): the decrementing-jitter schedule
+extracted from trainer/checkpoint.py must be pinned — a seeded RNG
+reproduces the exact waits, and the checkpoint-side ``_with_retries``
+wrapper produces the IDENTICAL schedule (the extraction changed zero
+behavior)."""
+
+import random
+
+import pytest
+
+from neuronx_distributed_tpu.trainer.checkpoint import _with_retries
+from neuronx_distributed_tpu.utils.retry import RetryPolicy, with_retries
+
+
+def _expected_waits(policy: RetryPolicy, failures: int, seed: int):
+    """The schedule the implementation must reproduce, computed from the
+    published formula: max(min_wait, first_wait/(k+1)) · (0.5 + U[0,1))."""
+    rng = random.Random(seed)
+    return [
+        max(policy.min_wait, policy.first_wait / (k + 1)) * (0.5 + rng.random())
+        for k in range(failures)
+    ]
+
+
+def test_seeded_rng_pins_the_wait_schedule():
+    """Same seed → exactly the same jittered waits, decrementing toward
+    min_wait (the first wait is the longest — ride out the burst)."""
+    policy = RetryPolicy(max_attempts=5, first_wait=4.0, min_wait=0.5)
+    calls = {"n": 0}
+    waits = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 5:
+            raise OSError("503 slow down")
+        return "ok"
+
+    assert (
+        with_retries(
+            flaky, "op", policy, sleep=waits.append, rng=random.Random(42)
+        )
+        == "ok"
+    )
+    assert waits == pytest.approx(_expected_waits(policy, 4, seed=42))
+    # decrementing: un-jittered base halves then floors at min_wait
+    assert [policy.base_wait(k) for k in range(4)] == [4.0, 2.0, 4.0 / 3, 1.0]
+    assert policy.base_wait(100) == policy.min_wait
+
+
+def test_checkpoint_wrapper_schedule_is_identical():
+    """Satellite acceptance: ``trainer.checkpoint._with_retries`` rides the
+    shared implementation with a BIT-IDENTICAL wait schedule — same seed,
+    same waits as calling utils.retry directly."""
+    seen_ckpt, seen_shared = [], []
+
+    def make_flaky():
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 4:
+                raise OSError("transient")
+            return calls["n"]
+
+        return flaky
+
+    assert (
+        _with_retries(
+            make_flaky(), "ckpt-op", max_attempts=5, first_wait=4.0,
+            min_wait=0.5, sleep=seen_ckpt.append, rng=random.Random(7),
+        )
+        == 4
+    )
+    assert (
+        with_retries(
+            make_flaky(), "shared-op",
+            RetryPolicy(max_attempts=5, first_wait=4.0, min_wait=0.5),
+            sleep=seen_shared.append, rng=random.Random(7),
+        )
+        == 4
+    )
+    assert seen_ckpt == seen_shared
+    assert seen_ckpt == pytest.approx(
+        _expected_waits(RetryPolicy(5, 4.0, 0.5), 3, seed=7)
+    )
+
+
+def test_exhaustion_raises_last_error():
+    waits = []
+
+    def dead():
+        raise TimeoutError("gone")
+
+    with pytest.raises(TimeoutError, match="gone"):
+        with_retries(
+            dead, "dead", RetryPolicy(max_attempts=3), sleep=waits.append,
+            rng=random.Random(0),
+        )
+    assert len(waits) == 2  # no wait after the final attempt
+
+
+def test_passthrough_errors_skip_retries():
+    """FileNotFoundError is a RESULT (missing object), not a fault — it
+    must raise on the first attempt with zero retries burned, even though
+    it subclasses the transient OSError."""
+    calls = {"n": 0}
+
+    def missing():
+        calls["n"] += 1
+        raise FileNotFoundError("no such object")
+
+    with pytest.raises(FileNotFoundError):
+        with_retries(missing, "missing", sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_non_transient_errors_propagate_immediately():
+    calls = {"n": 0}
+
+    def boom():
+        calls["n"] += 1
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        with_retries(boom, "boom", sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_custom_transient_classes():
+    """Consumers pick their own transient set (the serving engine retries
+    on anything Exception-shaped; checkpoints on OS-level faults only)."""
+
+    class Flaky(RuntimeError):
+        pass
+
+    calls = {"n": 0}
+
+    def op():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise Flaky("once")
+        return "ok"
+
+    assert (
+        with_retries(
+            op, "custom", transient=(Flaky,), sleep=lambda s: None,
+            rng=random.Random(1),
+        )
+        == "ok"
+    )
+    assert calls["n"] == 2
