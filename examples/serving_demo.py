@@ -20,6 +20,14 @@ request arriving mid-chunk grows by up to a chunk of decode steps.
 ``--decode-chunk 1`` is the per-token loop. Streams are bit-identical
 either way.
 
+``--shared-prefix N`` prepends the same N-token "system prompt" to every
+request — the workload shape the engine's prefix cache is built for. The
+first admission prefills (and stores) the shared prefix; every later one
+reuses it and prefills only its unique tail, visible in the summary's
+``prefix_hits`` / ``prefix_tokens_reused`` counters and the per-request
+TTFTs. ``--no-prefix-cache`` disables the store (today's full-prefill
+path); streams are bit-identical either way.
+
 ``--inject-fault`` drives the fault-tolerance layer end to end through the
 deterministic ``FaultInjector`` harness: ``dispatch`` injects one decode
 dispatch failure mid-run (the engine requeues in-flight requests and
@@ -36,6 +44,8 @@ CPU-runnable out of the box:
   python examples/serving_demo.py
   python examples/serving_demo.py --requests 12 --slots 2 --admission eager
   python examples/serving_demo.py --decode-chunk 1   # per-token stepping
+  python examples/serving_demo.py --shared-prefix 24 # system-prompt reuse
+  python examples/serving_demo.py --shared-prefix 24 --no-prefix-cache
   python examples/serving_demo.py --inject-fault dispatch
   python examples/serving_demo.py --inject-fault poison --slots 4
   python examples/serving_demo.py --deadline 0.5 --inject-fault skew
@@ -65,6 +75,14 @@ def parse_args(argv=None):
                    help="fused decode steps per host sync (1 = per-token "
                         "loop; higher = more decode throughput, coarser "
                         "TTFT/cancel granularity at chunk boundaries)")
+    p.add_argument("--shared-prefix", type=int, default=0,
+                   help="prepend the same N-token system prompt to every "
+                        "request (N=0 disables) — the prefix cache serves "
+                        "every request after the first from its stored KV")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the prefix cache (full prefill for every "
+                        "admission — today's legacy path; streams are "
+                        "bit-identical either way)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--inject-fault", default="none",
                    choices=["none", "dispatch", "halt", "poison", "prefill",
@@ -130,6 +148,10 @@ def main(argv=None):
 
             injector.skew_clock(by=3600.0, after=_time.monotonic() + 0.3)
 
+    shared = (
+        rng.randint(1, cfg.vocab_size, size=args.shared_prefix).astype(np.int32)
+        if args.shared_prefix > 0 else None
+    )
     timeline = Timeline(args.timeline) if args.timeline else None
     engine = ServingEngine(
         model, params,
@@ -137,6 +159,7 @@ def main(argv=None):
         max_tokens_in_flight=args.max_tokens_in_flight,
         admission=args.admission,
         decode_chunk_size=args.decode_chunk,
+        prefix_cache=None if args.no_prefix_cache else "auto",
         fault_injector=injector,
         timeline=timeline,
     )
@@ -151,6 +174,8 @@ def main(argv=None):
         nonlocal rejected
         plen = int(rng.randint(3, 17))
         prompt = rng.randint(1, cfg.vocab_size, size=plen).astype(np.int32)
+        if shared is not None:
+            prompt = np.concatenate([shared, prompt])
         gcfg = GenerationConfig(
             max_new_tokens=int(rng.randint(4, args.max_new_tokens + 1)),
             temperature=float(rng.choice([0.0, 0.7, 1.0])),
@@ -183,9 +208,15 @@ def main(argv=None):
             break
     engine.run()
 
+    prefix_desc = (
+        "off" if args.no_prefix_cache
+        else f"on (shared {args.shared_prefix} tokens)" if shared is not None
+        else "on"
+    )
     print(f"\n=== {len(reqs)} requests through {args.slots} slots "
           f"({args.admission} admission, decode chunk "
-          f"{args.decode_chunk}, fault={args.inject_fault}) ===")
+          f"{args.decode_chunk}, prefix cache {prefix_desc}, "
+          f"fault={args.inject_fault}) ===")
     for req in reqs:
         r = engine.metrics.request_snapshot(req.rid)
         ttft = r.get("ttft")

@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from neuronx_distributed_tpu.utils.sampling import sample
 
@@ -36,6 +37,33 @@ class GenerationConfig:
     top_k: Optional[int] = None
     top_p: Optional[float] = None
     eos_token_id: Optional[int] = None
+
+
+def pack_padded_prompt(tokens, padded_len: int, pad_side: str = "left"):
+    """Pack a token sequence into a ``(1, padded_len)`` ids/mask pair — the
+    ONE place the serving stack builds padded prompt buffers.
+
+    ``pad_side="left"`` is the generate()/engine prefill contract: content
+    right-aligned (the last real token at index -1, where the next-token
+    logits are read), padding in front. ``pad_side="right"`` is the
+    suffix-prefill chunk layout: content at index 0 so the decode-path RoPE
+    positions (``prefix_valid_count + arange``) line up with the real
+    tokens, padding behind (its K/V writes are mask-invalidated).
+    Returns host ``np`` arrays (ids int32, mask bool)."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    p = tokens.size
+    if p > padded_len:
+        raise ValueError(
+            f"{p} tokens do not fit a padded length of {padded_len}"
+        )
+    if pad_side not in ("left", "right"):
+        raise ValueError(f"unknown pad_side {pad_side!r}")
+    ids = np.zeros((1, padded_len), np.int32)
+    mask = np.zeros((1, padded_len), bool)
+    sl = slice(padded_len - p, None) if pad_side == "left" else slice(0, p)
+    ids[0, sl] = tokens
+    mask[0, sl] = True
+    return ids, mask
 
 
 def serving_clones(model):
@@ -154,6 +182,52 @@ def chunked_decode_step(decode_model, chunk_size: int, max_seq_len: int):
     return chunk_fn
 
 
+def suffix_prefill_step(decode_model):
+    """Build the SUFFIX-prefill program for the serving engine's prefix
+    cache: given a batch-1 cache row already seeded with a reused prefix
+    (``modules/attention.seed_cache_prefix`` — prefix K/V in place, write
+    cursor at the prefix end), run ONLY the uncached tail through the
+    decode-mode model in one multi-token step and hand back the row ready
+    for slot admission.
+
+    This IS the cache-write path with an explicit start cursor: the decode
+    mode's ``KVCache.decode_write`` appends the chunk's K/V at the row's
+    cursor, ``decode_positions`` continues RoPE at the prefix's valid count,
+    and ``decode_attention`` lets each suffix token attend the prefix plus
+    the suffix up to itself (causal by column position) — so a hit computes
+    QKV/MLP for ``s`` suffix tokens instead of the whole prompt.
+
+    Returned callable::
+
+        fn(params, row_cache, ids, valid_len) -> (last_logits, row_cache)
+
+    ``ids`` is a ``(1, chunk)`` RIGHT-padded suffix
+    (:func:`pack_padded_prompt` ``pad_side="right"``: real tokens first so
+    their RoPE positions are exact; the pad tail's K/V is written
+    mask-invalid and overwritten by later decode steps). ``valid_len`` is
+    the traced real-suffix length — ``last_logits`` reads index
+    ``valid_len - 1``, the same next-token logits a full prefill reads at
+    index -1. One jitted program per chunk bucket (``ids.shape[1]``);
+    nothing is donated — the seeded row is consumed forward, the stored
+    prefix entry the row was built from is never aliased."""
+    from neuronx_distributed_tpu.inference.utils import unwrap_logits
+
+    def fn(params, row_cache, ids, valid_len):
+        chunk = ids.shape[1]
+        mask = jnp.arange(chunk, dtype=jnp.int32)[None] < valid_len
+        out, variables = decode_model.apply(
+            {**params, "cache": row_cache}, ids,
+            padding_mask=mask, mutable=["cache"],
+        )
+        logits = unwrap_logits(out)[0]  # (chunk, vocab)
+        last = jax.lax.dynamic_index_in_dim(
+            logits, valid_len - 1, axis=0, keepdims=False
+        )
+        return last, variables["cache"]
+
+    return fn
+
+
 def validate_generate_args(model, prompt_ids, max_new_tokens, attention_mask):
     """Host-side checks shared by `generate` and the serving engine's
     admission path: capacity (prompt + new tokens within the cache) and the
@@ -178,8 +252,6 @@ def validate_generate_args(model, prompt_ids, max_new_tokens, attention_mask):
             )
         if isinstance(attention_mask, jax.core.Tracer):
             return
-        import numpy as np
-
         if not bool(np.asarray(attention_mask)[:, -1].all()):
             # right padding would make _logits[:, -1] a pad-slot query and
             # silently corrupt the whole continuation

@@ -335,6 +335,104 @@ def reset_cache(cache):
     return jax.tree_util.tree_map_with_path(fn, cache)
 
 
+def _col_window(leaf_ndim: int, axis: int, length: int, lo, hi):
+    """Boolean mask over a cache leaf's column axis: True on ``[lo, hi)``.
+    ``lo``/``hi`` may be traced scalars; the mask broadcasts against the
+    leaf (singleton every other axis)."""
+    shape = [1] * leaf_ndim
+    shape[axis] = length
+    cols = jnp.arange(length, dtype=jnp.int32).reshape(shape)
+    return (cols >= lo) & (cols < hi)
+
+
+def extract_cache_prefix(cache, start, m, bucket: int):
+    """Copy the ``m`` cache columns starting at ``start`` out of a (batch-1)
+    cache collection into a COMPACT prefix block of ``bucket`` columns
+    (token 0 of the prefix at column 0), zero beyond ``m``.
+
+    This is the prefix-cache STORE side: the block is a fresh copy (never a
+    view of the source row, which the serving engine's donating programs may
+    consume later), canonically zero-padded so identical prefixes produce
+    identical blocks whatever padded bucket their donor prefill used. The
+    roll-then-slice formulation keeps a window that touches the end of the
+    row exact (a clamped ``dynamic_slice`` would silently shift it).
+    ``start``/``m`` are traced scalars; ``bucket`` (>= m) is static — one
+    compiled program per storage bucket. The ``index`` leaves carry ``m``
+    (the block's token count rides the tree for fingerprinting)."""
+
+    def fn(path, leaf):
+        name = cache_leaf_name(path)
+        ax = cache_batch_axis(name, leaf.ndim)
+        if ax is None:  # index cursor → the prefix token count
+            return jnp.full_like(leaf, m)
+        col = ax + 1  # k/v AND kv_valid: column axis right after batch
+        rolled = jnp.roll(leaf, -start, axis=col)
+        sliced = jax.lax.slice_in_dim(rolled, 0, bucket, axis=col)
+        window = _col_window(sliced.ndim, col, bucket, 0, m)
+        if name == "kv_valid":
+            return sliced & window
+        return jnp.where(window, sliced, jnp.zeros_like(sliced))
+
+    return jax.tree_util.tree_map_with_path(fn, cache)
+
+
+def seed_cache_prefix(prefix, m, start, length: int):
+    """Build a fresh batch-1 cache row of ``length`` columns whose columns
+    ``[start, start + m)`` hold the stored prefix block's first ``m``
+    tokens, with the write cursor at ``start + m`` — the explicit start
+    cursor a suffix prefill continues from (its decode-path writes land at
+    ``start + m``, its RoPE positions continue at the prefix's valid count
+    ``m``). Everything outside the window is zero/invalid, so the row is
+    indistinguishable from a full left-padded prefill of the same tokens as
+    far as the attention math can see. ``m``/``start`` are traced (one
+    compiled program per stored bucket); the prefix block is read, never
+    aliased — the stored entry survives the call untouched."""
+
+    def fn(path, leaf):
+        name = cache_leaf_name(path)
+        ax = cache_batch_axis(name, leaf.ndim)
+        if ax is None:
+            return jnp.full_like(leaf, start + m)
+        col = ax + 1
+        bucket = leaf.shape[col]
+        pad = [(0, 0)] * leaf.ndim
+        pad[col] = (0, length - bucket)
+        full = jnp.pad(leaf, pad)
+        rolled = jnp.roll(full, start, axis=col)
+        window = _col_window(full.ndim, col, length, start, start + m)
+        if name == "kv_valid":
+            return rolled & window
+        return jnp.where(window, rolled, jnp.zeros_like(rolled))
+
+    return jax.tree_util.tree_map_with_path(fn, prefix)
+
+
+def cache_fingerprint(cache):
+    """Cheap integrity fingerprint of a cache(-prefix) tree: a float32
+    reduction over every leaf, position-weighted along the column axis so a
+    corrupted element OR a shifted block changes the value. Recomputed on
+    the same data by the same program it is bit-deterministic, so the
+    serving engine's prefix-reuse validation compares it with exact float
+    equality — this is corruption detection (bit flips, injected poison),
+    not cryptographic integrity."""
+    total = jnp.zeros((), jnp.float32)
+    flat, _ = jax.tree_util.tree_flatten_with_path(cache)
+    for path, leaf in flat:
+        name = cache_leaf_name(path)
+        ax = cache_batch_axis(name, leaf.ndim)
+        x = jnp.abs(leaf.astype(jnp.float32)) if jnp.issubdtype(
+            leaf.dtype, jnp.floating
+        ) else leaf.astype(jnp.float32)
+        if ax is not None:
+            col = ax + 1
+            shape = [1] * leaf.ndim
+            shape[col] = leaf.shape[col]
+            w = (1.0 + jnp.arange(leaf.shape[col], dtype=jnp.float32)).reshape(shape)
+            x = x * w
+        total = total + jnp.sum(x)
+    return total
+
+
 # cache length at which decode switches from the fused einsum to the Pallas
 # flash-decode kernel on TPU: below this the (s, L) score tensor is small and
 # the einsum path's simplicity wins; above it the kernel's single streaming

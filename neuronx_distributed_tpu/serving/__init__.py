@@ -15,7 +15,11 @@ Layers:
   (QUEUED→PREFILL→DECODE→DONE/CANCELLED).
 * :mod:`cache_manager` — slot allocation/roll-in/reset on top of the
   ``modules/attention.KVCache`` collection layout (no reallocation between
-  requests).
+  requests), plus :class:`PrefixCache` — the host-managed, ref-counted,
+  LRU-evicted store of prompt-prefix KV blocks behind the engine's
+  shared-prompt admission path (longest-match lookup → suffix prefill of
+  the uncached tail → insert-on-miss; streams bit-identical to cache-off,
+  disable with ``ServingEngine(prefix_cache=None)``).
 * :mod:`metrics` — TTFT / decode throughput / queue wait / occupancy /
   preemption counters plus the fault-tolerance counters (sheds, rejects,
   quarantines, dispatch retries, health), exported as a plain dict snapshot
@@ -34,7 +38,11 @@ RejectedError`; ``drain()`` finishes in-flight work while admitting nothing
 new; ``health()`` reports ``OK/DEGRADED/DRAINING/HALTED``.
 """
 
-from neuronx_distributed_tpu.serving.cache_manager import SlotCacheManager
+from neuronx_distributed_tpu.serving.cache_manager import (
+    PrefixCache,
+    PrefixEntry,
+    SlotCacheManager,
+)
 from neuronx_distributed_tpu.serving.engine import (
     EngineHealth,
     RejectedError,
@@ -59,6 +67,8 @@ __all__ = [
     "InjectedDispatchError",
     "InjectedFault",
     "InjectedPrefillError",
+    "PrefixCache",
+    "PrefixEntry",
     "RejectedError",
     "Request",
     "RequestState",

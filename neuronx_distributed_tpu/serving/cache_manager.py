@@ -24,11 +24,22 @@ repo's KV cache already speaks for left-padded batches:
 The cursor therefore advances monotonically while any slot is active; the
 engine preempts-and-rewinds when it would run off ``max_seq_len`` (see
 ``ServingEngine``).
+
+This module also owns :class:`PrefixCache` — the host-managed, ref-counted,
+LRU-evicted store of prompt-prefix KV blocks behind the engine's
+prefix-reuse admission path (lookup → longest-match reuse → suffix prefill
+→ insert-on-miss). Entries are compact COPIES extracted from prefill rows
+(``modules/attention.extract_cache_prefix``), so they are immune to the
+donation regime above: a donated decode consuming the big cache, a
+quarantined slot, or a dropped-for-reallocation recovery never touches a
+stored prefix.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -230,3 +241,236 @@ class SlotCacheManager:
         self.cursor = 0
         if self.cache is not None:
             self.cache = self._reset_fn(self.cache)
+
+
+# --- host-managed prefix KV cache ---------------------------------------------
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    """One stored prompt prefix: ``tokens`` (the full token path, length
+    ``m``), its compact device KV block ``tree`` (``bucket`` columns, token
+    0 at column 0 — a COPY made at insert time, never a view of any
+    engine-owned or donated buffer), the integrity ``fingerprint`` +
+    ``shapes`` recorded at insert (validated on every reuse), and ``refs``
+    — the pin count that protects an entry backing an in-flight suffix
+    prefill from eviction."""
+
+    tokens: Tuple[int, ...]
+    tree: Any
+    bucket: int
+    # device scalar (or host float in tests): kept un-synced at insert so
+    # a miss admission never blocks on it; reuse-time validation floats it
+    fingerprint: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    refs: int = 0
+
+    @property
+    def m(self) -> int:
+        return len(self.tokens)
+
+
+class _TrieNode:
+    __slots__ = ("children", "entry")
+
+    def __init__(self):
+        self.children: Dict[int, "_TrieNode"] = {}
+        self.entry: Optional[PrefixEntry] = None
+
+
+class PrefixCache:
+    """Host-managed, ref-counted, LRU-evicted store of prompt-prefix KV
+    blocks, keyed by a token trie.
+
+    The serving engine consults it at admission: the longest stored prefix
+    of the incoming context (capped at ``context - 1`` — at least one token
+    must run so the admission has next-token logits to sample from) is
+    copied into the slot's row and only the uncached tail is prefilled.
+    A stored entry's first ``d`` columns serve ANY context sharing its
+    first ``d`` tokens, so a single long entry covers every shorter shared
+    prefix — lookup walks the trie to the deepest reachable node and uses
+    any entry beneath it.
+
+    Matches shorter than ``min_match`` tokens are misses (a tiny reuse
+    does not pay for the extra programs), and contexts shorter than
+    ``min_match`` are never stored. ``max_entries=0`` disables the cache
+    entirely — the engine then runs today's exact full-prefill path.
+
+    Eviction is LRU over entries (hits, covers and inserts refresh
+    recency) and NEVER frees a pinned entry (``refs > 0`` — an in-flight
+    suffix prefill holds one); if every entry is pinned the store
+    temporarily overflows rather than corrupt an in-flight admission.
+    The engine owns the counters (metrics) — this class just reports what
+    each call did."""
+
+    def __init__(self, max_entries: int = 32, min_match: int = 8):
+        if min_match < 1:
+            raise ValueError(f"min_match must be >= 1, got {min_match}")
+        self.max_entries = max_entries
+        self.min_match = min_match
+        self._root = _TrieNode()
+        self._lru: "OrderedDict[Tuple[int, ...], PrefixEntry]" = OrderedDict()
+
+    # --- introspection ------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_entries > 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    @property
+    def entries(self):
+        """Stored entries, least-recently-used first."""
+        return list(self._lru.values())
+
+    @property
+    def tokens_stored(self) -> int:
+        return sum(e.m for e in self._lru.values())
+
+    def _walk(self, tokens) -> Tuple[_TrieNode, int]:
+        """Deepest trie node reachable along ``tokens`` and its depth.
+        Every live node has at least one entry in its subtree (eviction
+        prunes entry-less childless chains), so reaching depth ``d`` means
+        some stored entry shares the first ``d`` tokens."""
+        node, depth = self._root, 0
+        for t in tokens:
+            nxt = node.children.get(int(t))
+            if nxt is None:
+                break
+            node, depth = nxt, depth + 1
+        return node, depth
+
+    @staticmethod
+    def _subtree_entry(node: _TrieNode) -> Optional[PrefixEntry]:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if n.entry is not None:
+                return n.entry
+            stack.extend(n.children.values())
+        return None
+
+    def match_len(self, tokens) -> int:
+        """Read-only longest USABLE match length for ``tokens`` (0 when
+        below ``min_match``) — the scheduler's effective-prefill-cost peek;
+        no LRU state moves."""
+        if not self.enabled:
+            return 0
+        _, depth = self._walk(tokens)
+        use = min(depth, len(tokens) - 1)
+        return use if use >= self.min_match else 0
+
+    # --- lookup / insert ----------------------------------------------------
+
+    def lookup(self, tokens) -> Optional[Tuple[PrefixEntry, int]]:
+        """Longest-match lookup: ``(entry, m_use)`` where the entry's first
+        ``m_use`` columns are the reusable prefix KV, or ``None`` on a miss
+        (no match, or a match shorter than ``min_match``). Refreshes the
+        matched entry's recency; the caller pins it (:meth:`pin`) for the
+        duration of the suffix prefill."""
+        if not self.enabled:
+            return None
+        node, depth = self._walk(tokens)
+        m_use = min(depth, len(tokens) - 1)
+        if m_use < self.min_match:
+            return None
+        entry = self._subtree_entry(node)
+        if entry is None:  # unreachable for a live trie; be safe
+            return None
+        self._lru.move_to_end(entry.tokens)
+        return entry, m_use
+
+    def covers(self, tokens) -> bool:
+        """Whether some stored entry already extends (or equals) ``tokens``
+        — inserting them again would add nothing. Refreshes the covering
+        entry so the hot prefix stays resident."""
+        if not self.enabled:
+            return False
+        node, depth = self._walk(tokens)
+        if depth < len(tokens):
+            return False
+        entry = self._subtree_entry(node)
+        if entry is not None:
+            self._lru.move_to_end(entry.tokens)
+        return entry is not None
+
+    def insert(self, tokens, tree, fingerprint,
+               bucket: int) -> Tuple[Optional[PrefixEntry], int]:
+        """Store a prefix block. Returns ``(entry, n_evicted)`` — entry is
+        ``None`` when the insert was skipped (disabled, too short, or
+        already covered). Evicts least-recently-used UNPINNED entries until
+        the store fits ``max_entries``."""
+        key = tuple(int(t) for t in tokens)
+        if not self.enabled or len(key) < self.min_match:
+            return None, 0
+        if self.covers(key):
+            return None, 0
+        entry = PrefixEntry(
+            tokens=key, tree=tree, bucket=bucket,
+            fingerprint=fingerprint,
+            shapes=tuple(
+                tuple(leaf.shape) for leaf in jax.tree_util.tree_leaves(tree)
+            ),
+        )
+        node = self._root
+        for t in key:
+            node = node.children.setdefault(t, _TrieNode())
+        node.entry = entry
+        self._lru[key] = entry
+        evicted = 0
+        while len(self._lru) > self.max_entries:
+            victim = next(
+                (e for e in self._lru.values() if e.refs == 0 and e is not entry),
+                None,
+            )
+            if victim is None:  # everything pinned: overflow, never corrupt
+                break
+            self.evict_entry(victim)
+            evicted += 1
+        return entry, evicted
+
+    # --- pins / eviction ----------------------------------------------------
+
+    def pin(self, entry: PrefixEntry) -> None:
+        entry.refs += 1
+
+    def release(self, entry: PrefixEntry) -> None:
+        entry.refs = max(0, entry.refs - 1)
+
+    def release_all(self) -> None:
+        """Drop every pin — the engine's recovery/halt paths call this so a
+        failed in-flight suffix prefill can never leave a stale ref that
+        blocks eviction forever (PR 3's recovery contract)."""
+        for e in self._lru.values():
+            e.refs = 0
+
+    def evict_entry(self, entry: PrefixEntry) -> bool:
+        """Remove one entry (LRU eviction, or forced — validation failure /
+        poison), pruning the trie chain it leaves behind."""
+        if self._lru.pop(entry.tokens, None) is None:
+            return False
+        path = [self._root]
+        for t in entry.tokens:
+            nxt = path[-1].children.get(t)
+            if nxt is None:
+                return True  # trie already pruned past here
+            path.append(nxt)
+        path[-1].entry = None
+        for depth in range(len(path) - 1, 0, -1):
+            node = path[depth]
+            if node.entry is None and not node.children:
+                del path[depth - 1].children[entry.tokens[depth - 1]]
+            else:
+                break
+        return True
+
+    def clear(self) -> int:
+        """Drop everything (the engine calls this on a weight swap — prefix
+        KV computed under old params must never serve new-params traffic).
+        Returns how many entries were dropped."""
+        n = len(self._lru)
+        self._root = _TrieNode()
+        self._lru = OrderedDict()
+        return n

@@ -36,6 +36,36 @@ Decode hot path — device-resident between admission events:
   so larger chunks trade a little TTFT/cancel latency for per-token host
   overhead amortized ``chunk``-fold.
 
+Prefix-cache KV reuse — the admission-path optimization for shared-prompt
+traffic (system prompts, few-shot templates, multi-turn histories):
+
+* A host-managed :class:`~neuronx_distributed_tpu.serving.cache_manager.
+  PrefixCache` (token trie, ref-counted entries, LRU eviction) stores
+  compact COPIES of previously-prefilled context KV. On admission the
+  engine looks up the longest stored prefix of the incoming context
+  (capped at ``context - 1``), validates the entry (shape + fingerprint —
+  a corrupted entry is evicted and the admission falls back to the full
+  prefill), seeds a fresh cache row from it, and prefills ONLY the
+  uncached tail through the decode-mode cache-write path at an explicit
+  start cursor (``suffix_prefill_step``): QKV/MLP compute for ``s``
+  suffix tokens instead of the whole prompt. Misses insert the admitted
+  context so the next shared-prefix request hits.
+* Alignment is the load-bearing invariant: the seeded row reproduces the
+  exact left-padded layout a full prefill of the same context would build
+  (prefix at ``[padded - p, padded - s)``, suffix written at
+  ``padded - s``, RoPE continuing at the prefix's valid count), so slot
+  roll-in, cursor arithmetic, and every later decode step are unchanged —
+  token streams stay bit-identical to the cache-off path across hit /
+  miss / partial-match / eviction / preemption-resume patterns.
+* Donation safety: entries are extracted as fresh copies BEFORE the row
+  enters the donating admit program and are pinned (ref-counted) while a
+  suffix prefill is in flight — eviction never frees an entry backing an
+  admission, and no stored buffer is ever aliased into a donated pytree.
+  A weight swap (``engine.params = ...``) clears the store (old-weight KV
+  must not serve new-weight traffic); recovery/halt drop any in-flight
+  pins. ``prefix_cache=None`` (or size 0) disables everything and
+  restores the exact legacy admission path.
+
 Token-stream fidelity: a request served through the engine produces EXACTLY
 the tokens of a solo ``generate(prompt, key)`` call — same prefill math
 (left-padded prompts are already proven token-identical to unpadded ones),
@@ -108,11 +138,21 @@ import numpy as np
 from neuronx_distributed_tpu.inference.generate import (
     GenerationConfig,
     chunked_decode_step,
+    pack_padded_prompt,
     serving_clones,
+    suffix_prefill_step,
     validate_generate_args,
 )
 from neuronx_distributed_tpu.inference.utils import unwrap_logits
-from neuronx_distributed_tpu.serving.cache_manager import SlotCacheManager
+from neuronx_distributed_tpu.modules.attention import (
+    cache_fingerprint,
+    extract_cache_prefix,
+    seed_cache_prefix,
+)
+from neuronx_distributed_tpu.serving.cache_manager import (
+    PrefixCache,
+    SlotCacheManager,
+)
 from neuronx_distributed_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_tpu.serving.scheduler import (
     Request,
@@ -179,6 +219,27 @@ def _bucket(p: int, max_seq_len: int, remaining: int, floor: int = 8) -> int:
     return b
 
 
+def _suffix_bucket(s: int, padded: int, max_seq_len: int) -> int:
+    """Padded chunk length for an s-token suffix prefill: next power of two
+    (one compiled suffix program per chunk bucket), falling back to the
+    exact length whenever the padded chunk's writes — which start at
+    ``padded - s``, the reused prefix's end — would run past the cache row
+    (a clamped ``dynamic_update_slice`` would silently shift them onto the
+    prefix). This is the reused-token side of the admission fits
+    arithmetic: the prefix occupies its columns for free, so only the
+    suffix chunk needs write room."""
+    b = max(1, 1 << max(s - 1, 0).bit_length())
+    if padded - s + b > max_seq_len:
+        b = s
+    return b
+
+
+def _prefix_bucket(p: int, max_seq_len: int) -> int:
+    """Storage bucket for a p-token prefix entry: next power of two clamped
+    to the cache length (one compiled extract program per bucket)."""
+    return min(max(1, 1 << max(p - 1, 0).bit_length()), max_seq_len)
+
+
 def _validate_readback(toks, counts, chunk_size: int, vocab: Optional[int],
                        slots) -> Dict[int, str]:
     """Host-side sanity check of a chunk readback — the one-per-chunk sync
@@ -240,6 +301,7 @@ class ServingEngine:
         admission: str = "conservative",
         decode_chunk_size: int = 8,
         max_queue: Optional[int] = None,
+        prefix_cache="auto",
         dispatch_retry: Optional[RetryPolicy] = None,
         degraded_cooldown_chunks: int = 8,
         quarantine_policy: str = "requeue",
@@ -284,6 +346,20 @@ class ServingEngine:
         self._degraded_cooldown = degraded_cooldown_chunks
         self._quarantine_policy = quarantine_policy
         self._faults = fault_injector
+        # prefix cache: "auto" (default) builds the standard store; an int
+        # sizes it (0 disables); None or a disabled instance restores the
+        # full-prefill-only admission path exactly
+        if prefix_cache == "auto":
+            prefix_cache = PrefixCache()
+        elif isinstance(prefix_cache, int):
+            prefix_cache = (
+                PrefixCache(max_entries=prefix_cache) if prefix_cache > 0
+                else None
+            )
+        if prefix_cache is not None and not prefix_cache.enabled:
+            prefix_cache = None
+        self.prefix = prefix_cache
+        self._prefix_reuses = 0  # reuse-attempt index (poison-hook schedule)
         self._prefill_model, self._decode_model = serving_clones(model)
         self.scheduler = Scheduler(max_tokens_in_flight)
         self.cache = SlotCacheManager(num_slots)
@@ -319,6 +395,30 @@ class ServingEngine:
         self._slot_write = jax.jit(_slot_write, donate_argnums=(0,))
         self._slot_clear = jax.jit(_slot_clear, donate_argnums=(0,))
         self._first_token = jax.jit(sample_row)
+        # prefix-reuse programs (compiled lazily, only when the cache hits):
+        # suffix prefill keys on the chunk bucket, extract/seed on the
+        # storage bucket, the fingerprint on the entry shapes. NOTHING here
+        # donates — a stored entry must stay a live COPY (the decode chunk's
+        # donation regime must never be able to consume prefix storage)
+        self._suffix_fn = jax.jit(suffix_prefill_step(self._decode_model))
+        # per-engine lambda wrappers: in this jax (0.4.37), _cache_size()
+        # is SHARED between jax.jit wrappers of the same function object
+        # (two jax.jit(f) both read 1 after either is called — verified),
+        # so jitting the module-level helpers directly would cross-pollute
+        # the compile counts across engines
+        self._extract_fn = jax.jit(
+            lambda cache, start, m, bucket: extract_cache_prefix(
+                cache, start, m, bucket
+            ),
+            static_argnums=(3,),
+        )
+        self._seed_fn = jax.jit(
+            lambda prefix, m, start, length: seed_cache_prefix(
+                prefix, m, start, length
+            ),
+            static_argnums=(3,),
+        )
+        self._fingerprint_fn = jax.jit(lambda tree: cache_fingerprint(tree))
 
     def _fresh_slot_state(self):
         b = self.num_slots
@@ -347,6 +447,15 @@ class ServingEngine:
     def params(self, value):
         self._params_src = value
         self._params = dict(value)
+        # a weight swap invalidates every stored prefix: its KV was computed
+        # under the OLD weights, and the cache-off path would recompute it —
+        # serving it would silently break bit-identity (and correctness)
+        prefix = getattr(self, "prefix", None)  # None during __init__
+        if prefix is not None:
+            dropped = prefix.clear()
+            metrics = getattr(self, "metrics", None)
+            if dropped and metrics is not None:
+                metrics.record_prefix_eviction(dropped)
 
     def _now(self) -> float:
         """The engine's scheduling clock — the injected ``time_fn``,
@@ -514,6 +623,12 @@ class ServingEngine:
             self.cache.release_all_slots()
             self.cache.reset()
             self._state = self._fresh_slot_state()
+        if self.prefix is not None:
+            # PR 3 recovery contract, prefix edition: no in-flight suffix
+            # prefill survives a halt, so no pin may either — a leaked ref
+            # would block eviction forever. Entries themselves stay valid
+            # (independent copies, untouched by cache loss)
+            self.prefix.release_all()
         self._halted = True
         self._halt_reason = reason
         if self.timeline is not None:
@@ -550,11 +665,25 @@ class ServingEngine:
 
     @property
     def prefill_compilations(self) -> int:
-        """How many distinct prefill programs XLA compiled — one per padded
-        bucket length actually used, so growth is bounded by the number of
-        distinct ``_bucket`` outputs (a handful of powers of two plus exact
-        fallbacks)."""
-        return sum(int(fn._cache_size()) for fn in self._prefill_fns.values())
+        """How many distinct prefill programs XLA compiled — full prefills
+        (one per padded ``_bucket`` length actually used) plus suffix
+        prefills (one per ``_suffix_bucket`` chunk length), so growth is
+        bounded by the two bucket counts (powers of two plus exact
+        fallbacks), never by request count or prefix-cache churn."""
+        return (
+            sum(int(fn._cache_size()) for fn in self._prefill_fns.values())
+            + int(self._suffix_fn._cache_size())
+        )
+
+    @property
+    def prefix_compilations(self) -> int:
+        """Prefix-cache maintenance programs XLA compiled (extract + seed,
+        one per storage bucket; fingerprint, one per entry shape) — bounded
+        by the ``_prefix_bucket`` count."""
+        return sum(
+            int(fn._cache_size())
+            for fn in (self._extract_fn, self._seed_fn, self._fingerprint_fn)
+        )
 
     def step(self) -> bool:
         """One engine iteration: reap cancellations → shed expired deadlines
@@ -667,8 +796,20 @@ class ServingEngine:
             maxrem = max(maxrem, req.remaining_new_tokens)
             return True
 
+        cost = None
+        if self.prefix is not None:
+            # effective prefill work: context minus the reusable prefix (a
+            # read-only peek — no LRU state moves until the real lookup).
+            # Longest-EFFECTIVE-prefill-first keeps the overlap rationale
+            # when a long shared context is actually a cheap suffix
+            def cost(req: Request) -> int:
+                return len(req.context_ids) - self.prefix.match_len(
+                    req.context_ids
+                )
+
         selected = self.scheduler.select(
-            self.cache.free_slots, self._in_flight_tokens(), fits
+            self.cache.free_slots, self._in_flight_tokens(), fits,
+            prefill_cost=cost,
         )
         for idx, req in enumerate(selected):  # longest-prefill-first
             self._prefill_into_slot(req, self.cache.acquire(), now)
@@ -699,20 +840,44 @@ class ServingEngine:
         ctx = req.context_ids
         p = len(ctx)
         padded = _bucket(p, self.max_seq_len, req.remaining_new_tokens)
-        ids = np.zeros((1, padded), np.int32)
-        mask = np.zeros((1, padded), bool)
-        ids[0, padded - p:] = ctx  # LEFT padding: last token at index -1
-        mask[0, padded - p:] = True
+        plan = self._plan_prefix_reuse(ctx, p, padded)
         if self.timeline is not None:
             self.timeline.mark_event_start("prefill", "serving")
         call = self._prefill_calls
         self._prefill_calls += 1
+        t0 = self._clock()
         try:
-            if self._faults is not None:
-                self._faults.on_prefill(call)
-            logits, row_cache = self._prefill_fn(padded)(
-                self._params, jnp.asarray(ids), jnp.asarray(mask)
-            )
+            try:
+                if self._faults is not None:
+                    self._faults.on_prefill(call)
+                if plan is not None:
+                    entry, m_use, chunk = plan
+                    s = p - m_use
+                    # seed a fresh row from the stored prefix COPY (the
+                    # entry is pinned, read, never aliased or donated),
+                    # then prefill only the uncached tail through the
+                    # decode-mode cache-write path at the prefix's cursor
+                    row = self._seed_fn(
+                        entry.tree,
+                        jnp.asarray(m_use, jnp.int32),
+                        jnp.asarray(padded - p, jnp.int32),
+                        self.max_seq_len,
+                    )
+                    sfx_ids, _ = pack_padded_prompt(
+                        ctx[m_use:], chunk, pad_side="right"
+                    )
+                    logits, row_cache = self._suffix_fn(
+                        self._params, row, jnp.asarray(sfx_ids),
+                        jnp.asarray(s, jnp.int32),
+                    )
+                else:
+                    ids, mask = pack_padded_prompt(ctx, padded)
+                    logits, row_cache = self._prefill_fn(padded)(
+                        self._params, jnp.asarray(ids), jnp.asarray(mask)
+                    )
+            finally:
+                if plan is not None:
+                    self.prefix.release(plan[0])
         except Exception as e:
             # an OOM-like prefill fault fails ONE request for cause instead
             # of crashing the loop; the slot returns to the rotation.
@@ -745,10 +910,21 @@ class ServingEngine:
                 )
             return
         self._consecutive_prefill_failures = 0
+        self.metrics.record_prefill_wall(
+            self._clock() - t0, kind="suffix" if plan is not None else "full"
+        )
         if self.timeline is not None:
             self.timeline.mark_event_end(
-                "prefill", "serving", args={"rid": req.rid, "padded": padded}
+                "prefill", "serving",
+                args={
+                    "rid": req.rid, "padded": padded,
+                    "reused": plan[1] if plan is not None else 0,
+                },
             )
+        self._remember_prefix(
+            ctx, p, padded, row_cache,
+            matched=plan[1] if plan is not None else 0,
+        )
         self.cache.admit(row_cache, slot, padded)
         self.metrics.record_admit(req, now)
         if req.admit_time is None:
@@ -791,6 +967,113 @@ class ServingEngine:
         # a request can be born finished (max_new_tokens == 1, or EOS as
         # its very first token) — retire before it ever decodes
         self._maybe_finish(req, now)
+
+    # --- prefix reuse -------------------------------------------------------
+
+    def _plan_prefix_reuse(self, ctx, p: int, padded: int):
+        """Admission-time prefix lookup. Returns ``(entry, m_use, chunk)``
+        for a validated hit — ``entry`` PINNED (the caller releases it when
+        the suffix prefill settles, success or failure) — or ``None`` for
+        a miss, a match below ``min_match``, or an entry that failed its
+        reuse-time checksum/shape validation (evicted on the spot and the
+        admission falls back to the full prefill: poisoned KV never
+        reaches a slot)."""
+        if self.prefix is None:
+            return None
+        hit = self.prefix.lookup(ctx)
+        if hit is None:
+            self.metrics.record_prefix_miss()
+            if self.timeline is not None:
+                self.timeline.instant(
+                    "prefix_miss", "serving", args={"prompt": p}
+                )
+            return None
+        entry, m_use = hit
+        reuse = self._prefix_reuses
+        self._prefix_reuses += 1
+        if self._faults is not None:
+            self._faults.on_prefix_reuse(reuse, entry)
+        if not self._validate_prefix(entry):
+            self.prefix.evict_entry(entry)
+            self.metrics.record_prefix_validation_failure()
+            self.metrics.record_prefix_eviction()
+            self.metrics.record_prefix_miss()
+            if self.timeline is not None:
+                self.timeline.instant(
+                    "prefix_poisoned", "serving",
+                    args={"matched": m_use, "prompt": p},
+                )
+            return None
+        self.prefix.pin(entry)
+        chunk = _suffix_bucket(p - m_use, padded, self.max_seq_len)
+        self.metrics.record_prefix_hit(m_use, p)
+        if self.timeline is not None:
+            self.timeline.instant(
+                "prefix_hit", "serving", args={"matched": m_use, "prompt": p}
+            )
+        return entry, m_use, chunk
+
+    def _validate_prefix(self, entry) -> bool:
+        """Reuse-time integrity check of a stored entry: leaf shapes against
+        the insert-time record, then the position-weighted fingerprint
+        recomputed on device and compared with exact float equality (same
+        program + same data is bit-deterministic). Cost is one scalar
+        readback — the admission path syncs for the first token anyway."""
+        try:
+            shapes = tuple(
+                tuple(leaf.shape)
+                for leaf in jax.tree_util.tree_leaves(entry.tree)
+            )
+            if shapes != entry.shapes:
+                return False
+            # entry.fingerprint is a device scalar computed asynchronously
+            # at insert time — long settled by now, so its float() is a
+            # plain copy; the recomputation's readback is the validation
+            # sync (the admission path syncs for the first token anyway)
+            return float(self._fingerprint_fn(entry.tree)) == float(
+                entry.fingerprint
+            )
+        except Exception:
+            return False
+
+    def _remember_prefix(self, ctx, p: int, padded: int, row_cache,
+                         matched: int = 0) -> None:
+        """Insert-on-miss (and trie extension on long partial hits):
+        extract the admitted context's KV columns from the freshly built
+        row into a compact COPY and store it keyed by the token path. Runs
+        BEFORE ``cache.admit`` so the entry can never alias storage the
+        donating slot programs will consume. Skipped for contexts too
+        short to ever be reused, contexts an existing entry already
+        covers, and hits whose tail extends the match by less than
+        ``min_match`` (the new entry could never deliver a usefully longer
+        reuse than the one that just served — and skipping keeps the hot
+        hit path at three small dispatches instead of five)."""
+        if self.prefix is None or p < self.prefix.min_match:
+            return
+        if matched and p - matched < self.prefix.min_match:
+            return
+        key = tuple(int(t) for t in ctx)
+        if self.prefix.covers(key):
+            return
+        bucket = _prefix_bucket(p, self.max_seq_len)
+        tree = self._extract_fn(
+            row_cache,
+            jnp.asarray(padded - p, jnp.int32),
+            jnp.asarray(p, jnp.int32),
+            bucket,
+        )
+        # the fingerprint stays a DEVICE scalar: forcing it to host here
+        # would serialize every miss admission against the device before
+        # admit/first-token even dispatch — validation (which already
+        # syncs) floats it on first reuse instead
+        fp = self._fingerprint_fn(tree)
+        _, evicted = self.prefix.insert(key, tree, fp, bucket)
+        if evicted:
+            self.metrics.record_prefix_eviction(evicted)
+            if self.timeline is not None:
+                self.timeline.instant(
+                    "prefix_evict", "serving", args={"evicted": evicted}
+                )
 
     # --- decode -------------------------------------------------------------
 
@@ -923,6 +1206,12 @@ class ServingEngine:
         self.cache.release_all_slots()
         self.cache.recover(cache_in)
         self._state = self._fresh_slot_state()
+        if self.prefix is not None:
+            # recovery never resurrects stale KV THROUGH the prefix store:
+            # entries are independent copies (still valid under the same
+            # weights), but any pin a failed admission might have left is
+            # dropped so eviction can proceed
+            self.prefix.release_all()
         if n >= self._dispatch_retry.max_attempts:
             self._halt(
                 f"{n} consecutive dispatch failures (last: "

@@ -22,6 +22,12 @@ Injection points (all indices are 0-based and deterministic):
   (firing into an empty slot would prove nothing).
 * ``fail_prefill(at=n, times=t)`` — the n-th prefill call raises
   ``InjectedPrefillError`` (an OOM-like admission failure).
+* ``poison_prefix(at=k, times=t)`` — corrupts the STORED prefix-cache entry
+  the k-th prefix *reuse attempt* is about to copy from (every float leaf of
+  its KV block is perturbed), modeling silent corruption of host-managed
+  prefix storage. The engine's reuse-time checksum/shape validation must
+  evict the entry and fall back to a full prefill — poisoned KV must never
+  reach a slot.
 * ``skew_clock(by=s)`` / ``skew_clock(by=s, after=t)`` — the engine clock
   reads ``s`` seconds ahead (optionally only once real time passes
   ``after``), driving deadline/queue-timeout shedding paths without
@@ -56,12 +62,14 @@ class FaultInjector:
         self._dispatch_windows: List[Tuple[int, Optional[int]]] = []
         self._poisons: Dict[int, List[Tuple[int, int]]] = {}  # readback -> [(slot, token)]
         self._prefill_windows: List[Tuple[int, Optional[int]]] = []
+        self._prefix_windows: List[Tuple[int, Optional[int]]] = []
         self._skew: float = 0.0
         self._skew_after: Optional[float] = None
         self.counters: Dict[str, int] = {
             "dispatch_failures": 0,
             "poisoned_readbacks": 0,
             "prefill_failures": 0,
+            "poisoned_prefixes": 0,
         }
 
     # --- schedule construction ----------------------------------------------
@@ -78,6 +86,11 @@ class FaultInjector:
     def fail_prefill(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
         end = None if times is None else at + times
         self._prefill_windows.append((at, end))
+        return self
+
+    def poison_prefix(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
+        end = None if times is None else at + times
+        self._prefix_windows.append((at, end))
         return self
 
     def skew_clock(self, by: float, after: Optional[float] = None) -> "FaultInjector":
@@ -136,6 +149,27 @@ class FaultInjector:
                 f"injected prefill failure at call {call} "
                 "(RESOURCE_EXHAUSTED: out of memory)"
             )
+
+    def on_prefix_reuse(self, reuse: int, entry) -> None:
+        """Called with the 0-based prefix REUSE-attempt index and the
+        matched ``PrefixEntry`` the engine is about to copy from, BEFORE
+        validation. When the schedule says this reuse is poisoned, the
+        entry's stored KV block is corrupted IN PLACE (every float leaf
+        perturbed, shapes untouched) — so the test proves the engine's
+        checksum validation catches silent data corruption, not a shape
+        mismatch."""
+        if not self._hit(self._prefix_windows, reuse):
+            return
+        import jax
+        import jax.numpy as jnp
+
+        def corrupt(leaf):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                return leaf + jnp.asarray(1024.0, leaf.dtype)
+            return leaf
+
+        entry.tree = jax.tree_util.tree_map(corrupt, entry.tree)
+        self.counters["poisoned_prefixes"] += 1
 
     def now(self, real_now: float) -> float:
         """Clock hook: the engine's view of time, skewed per schedule."""

@@ -179,12 +179,21 @@ class Scheduler:
         free_slots: int,
         in_flight_tokens: int,
         fits: Optional[Callable[[Request], bool]] = None,
+        prefill_cost: Optional[Callable[[Request], int]] = None,
     ) -> List[Request]:
         """Pick the FIFO prefix that fits ``free_slots``, the token budget,
         and the engine's capacity predicate ``fits`` (checked in queue
         order, so ``fits`` may accumulate a projected cursor). Selected
         requests leave the queue in state PREFILL, returned
-        longest-prefill-first."""
+        longest-prefill-first.
+
+        ``prefill_cost`` replaces the ordering key with the EFFECTIVE
+        prefill work (the prefix-cache-aware engine passes context length
+        minus reusable tokens): a long context whose prefix is cached is a
+        cheap suffix prefill, so the truly-expensive prefill still goes
+        first and overlaps the least work. Ordering only — selection,
+        capacity projection, and the cursor targets ``fits`` accumulates
+        stay in queue order, so token streams are unaffected."""
         selected: List[Request] = []
         budget = in_flight_tokens
         while self._queue and len(selected) < free_slots:
@@ -203,7 +212,8 @@ class Scheduler:
             req.state = RequestState.PREFILL
             budget += req.token_footprint
             selected.append(req)
-        selected.sort(key=lambda r: len(r.context_ids), reverse=True)
+        key = prefill_cost or (lambda r: len(r.context_ids))
+        selected.sort(key=key, reverse=True)
         return selected
 
     # --- introspection ------------------------------------------------------

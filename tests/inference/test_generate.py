@@ -170,6 +170,53 @@ def test_generate_survives_jit_wrapping_with_mask():
     )
 
 
+def test_pack_padded_prompt_is_the_single_packing_source_of_truth():
+    """Satellite: the shared left-pad packing helper — LEFT padding puts
+    the last real token at index -1 (the prefill/logits contract), RIGHT
+    padding puts token 0 at index 0 (the suffix-prefill chunk layout), the
+    mask marks exactly the real tokens, and an oversized prompt raises."""
+    import pytest
+
+    from neuronx_distributed_tpu.inference.generate import pack_padded_prompt
+
+    toks = np.asarray([5, 7, 11], np.int32)
+    ids, mask = pack_padded_prompt(toks, 8)
+    assert ids.shape == mask.shape == (1, 8)
+    assert ids.dtype == np.int32 and mask.dtype == bool
+    np.testing.assert_array_equal(ids[0], [0, 0, 0, 0, 0, 5, 7, 11])
+    np.testing.assert_array_equal(mask[0, 5:], True)
+    assert not mask[0, :5].any()
+
+    ids, mask = pack_padded_prompt(toks, 8, pad_side="right")
+    np.testing.assert_array_equal(ids[0], [5, 7, 11, 0, 0, 0, 0, 0])
+    assert mask[0, :3].all() and not mask[0, 3:].any()
+
+    # exact fit, both sides
+    ids, mask = pack_padded_prompt(toks, 3)
+    np.testing.assert_array_equal(ids[0], toks)
+    assert mask.all()
+
+    with pytest.raises(ValueError, match="do not fit"):
+        pack_padded_prompt(toks, 2)
+    with pytest.raises(ValueError, match="pad_side"):
+        pack_padded_prompt(toks, 8, pad_side="middle")
+
+    # the packed pair satisfies generate()'s own left-padding contract
+    cfg, model, ids_setup, params = _setup()
+    prompt = np.asarray([3, 5, 7, 11, 13], np.int32)
+    ids, mask = pack_padded_prompt(prompt, S)
+    ref = generate(
+        model, params, jnp.asarray(prompt)[None], jax.random.PRNGKey(2),
+        GenerationConfig(max_new_tokens=NEW, temperature=0.0),
+    )
+    toks = generate(
+        model, params, jnp.asarray(ids), jax.random.PRNGKey(2),
+        GenerationConfig(max_new_tokens=NEW, temperature=0.0),
+        attention_mask=jnp.asarray(mask),
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
 def test_right_padding_still_rejected_on_host_path():
     """The host-side left-padding contract keeps raising for concrete
     masks (the tracer skip must not drop validation where it CAN run)."""

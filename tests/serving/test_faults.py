@@ -476,6 +476,69 @@ def test_prefill_fault_fails_one_request_not_the_loop(setup):
     assert engine.cache.free_slots == engine.num_slots  # slot returned
 
 
+def test_poisoned_prefix_entry_evicted_and_stream_bit_identical(setup):
+    """Satellite: ``poison_prefix`` corrupts the STORED prefix entry the
+    next reuse would copy from. The engine's reuse-time checksum validation
+    must catch it, evict the entry, and fall back to a full prefill — the
+    victim's stream stays bit-identical to solo generate() and poisoned KV
+    never reaches a slot."""
+    from neuronx_distributed_tpu.serving import PrefixCache
+
+    cfg, model, params = setup
+    prompt = np.arange(2, 18, dtype=np.int32)  # 16 tokens
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.8, top_k=13)
+    ref = _solo(model, params, prompt, jax.random.PRNGKey(61), gcfg)
+    inj = FaultInjector().poison_prefix(at=0, times=1)
+    engine = ServingEngine(
+        model, params, num_slots=1, fault_injector=inj,
+        prefix_cache=PrefixCache(max_entries=4, min_match=4),
+    )
+    r1 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(61))
+    engine.run()  # seeds the entry (miss)
+    r2 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(61))
+    engine.run()  # reuse attempt 0: poisoned → evict → full prefill
+    assert inj.counters["poisoned_prefixes"] == 1  # the schedule fired
+    snap = engine.metrics.snapshot()
+    assert snap["prefix_validation_failures"] == 1
+    assert snap["prefix_evictions"] >= 1
+    assert snap["prefix_hits"] == 0  # the poisoned reuse never counted
+    assert r1.tokens == ref
+    assert r2.tokens == ref  # bit-identical through the fallback
+    # the fallback re-inserted a CLEAN entry: the next reuse hits and
+    # still matches (the store recovered, not just survived)
+    r3 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(61))
+    engine.run()
+    assert r3.tokens == ref
+    assert engine.metrics.snapshot()["prefix_hits"] == 1
+
+
+def test_prefill_fault_on_suffix_path_releases_pin(setup):
+    """A prefill fault injected while the admission is riding a PREFIX HIT
+    must fail that one request, release the entry's pin (no leaked ref
+    blocking eviction), and leave the store serving later requests."""
+    from neuronx_distributed_tpu.serving import PrefixCache
+
+    cfg, model, params = setup
+    prompt = np.arange(3, 17, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    ref = _solo(model, params, prompt, jax.random.PRNGKey(62), gcfg)
+    inj = FaultInjector().fail_prefill(at=1, times=1)  # the 2nd admission
+    engine = ServingEngine(
+        model, params, num_slots=1, fault_injector=inj,
+        prefix_cache=PrefixCache(max_entries=4, min_match=4),
+    )
+    r1 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(62))
+    engine.run()
+    r2 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(62))
+    engine.run()  # hit planned, then the injected fault fails the prefill
+    assert r2.state is RequestState.FAILED
+    assert all(e.refs == 0 for e in engine.prefix.entries)  # pin released
+    r3 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(62))
+    engine.run()
+    assert r1.tokens == ref and r3.tokens == ref
+    assert engine.metrics.snapshot()["prefix_hits"] == 2  # r2's and r3's
+
+
 def test_queue_timeout_spares_requeued_inflight_work(setup):
     """Regression (review): the queue timeout governs FIRST admission only.
     A request admitted in time and then requeued by dispatch recovery (or

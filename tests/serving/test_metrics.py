@@ -107,6 +107,47 @@ def test_cancel_counts():
     assert m.request_snapshot(3)["cancelled"] is True
 
 
+def test_prefix_cache_counters_and_prefill_stats_in_snapshot():
+    """Satellite: prefix_hits / prefix_misses / prefix_tokens_reused /
+    prefix_evictions (+ validation failures and the derived hit rate) and
+    the prefill latency stats (count/mean/p95, full-vs-suffix wall split)
+    ride the snapshot."""
+    m = ServingMetrics(num_slots=2)
+    snap = m.snapshot()
+    for key in (
+        "prefix_hits", "prefix_misses", "prefix_tokens_reused",
+        "prefix_evictions", "prefix_validation_failures", "prefill_count",
+    ):
+        assert snap[key] == 0, key
+    assert snap["prefix_hit_rate"] == 0.0
+
+    m.record_prefix_miss()
+    m.record_prefix_hit(matched=12, prompt_len=16)
+    m.record_prefix_hit(matched=9, prompt_len=10)
+    m.record_prefix_miss()
+    m.record_prefix_hit(matched=31, prompt_len=32)
+    m.record_prefix_eviction()
+    m.record_prefix_eviction(2)
+    m.record_prefix_validation_failure()
+    for w in (0.5, 0.1, 0.2, 0.3):
+        m.record_prefill_wall(w, kind="full")
+    m.record_prefill_wall(0.05, kind="suffix")
+
+    snap = m.snapshot()
+    assert snap["prefix_hits"] == 3
+    assert snap["prefix_misses"] == 2
+    assert abs(snap["prefix_hit_rate"] - 3 / 5) < 1e-9
+    assert snap["prefix_tokens_reused"] == 12 + 9 + 31
+    assert snap["prefix_evictions"] == 3
+    assert snap["prefix_validation_failures"] == 1
+    assert snap["prefill_count"] == 5
+    assert abs(snap["prefill_wall_s"] - 1.15) < 1e-9
+    assert abs(snap["prefill_mean_s"] - 1.15 / 5) < 1e-9
+    assert snap["prefill_p95_s"] == 0.5  # p95 of 5 samples = the max
+    assert abs(snap["prefill_full_wall_s"] - 1.1) < 1e-9
+    assert abs(snap["prefill_suffix_wall_s"] - 0.05) < 1e-9
+
+
 def test_fault_tolerance_counters_in_snapshot():
     """Satellite: the snapshot carries the robustness counters — sheds,
     rejects, quarantines, dispatch_retries, health — plus the recovery/

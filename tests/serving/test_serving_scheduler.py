@@ -45,6 +45,26 @@ def test_longest_prefill_first_ordering():
     assert [r.rid for r in picked] == [1, 2, 0]  # longest context first
 
 
+def test_prefill_cost_orders_by_effective_work():
+    """Satellite (prefix-cache admission): with a ``prefill_cost`` key the
+    round is ordered by EFFECTIVE prefill work — a long context whose
+    prefix is cached (cheap suffix) yields the lead to the truly-expensive
+    prefill. Selection itself stays FIFO (same requests picked either
+    way)."""
+    sched = Scheduler()
+    rs = [_req(0, 24), _req(1, 12), _req(2, 7)]
+    for r in rs:
+        sched.submit(r)
+    # rid 0's 24-token context has 20 tokens cached → effective cost 4
+    cached = {0: 20, 1: 0, 2: 0}
+    picked = sched.select(
+        free_slots=3, in_flight_tokens=0,
+        prefill_cost=lambda r: len(r.context_ids) - cached[r.rid],
+    )
+    assert [r.rid for r in picked] == [1, 2, 0]
+    assert all(r.state is RequestState.PREFILL for r in picked)
+
+
 def test_free_slot_limit():
     sched = Scheduler()
     for i in range(5):
