@@ -55,6 +55,7 @@ PROXY_TIMEOUT_S = 420
 SERVING_TIMEOUT_S = 420
 FAULTS_TIMEOUT_S = 300
 PREFIX_TIMEOUT_S = 420
+TRAIN_FAULTS_TIMEOUT_S = 420
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -523,6 +524,17 @@ def _measure_serving_chunk(devs):
     return out
 
 
+def _divergence_lost(clean, other):
+    """Clean-run entries NOT reproduced by ``other``: everything past the
+    first divergence point (every recovery contract here requires 0)."""
+    agree = 0
+    for a, b in zip(clean, other):
+        if a != b:
+            break
+        agree += 1
+    return len(clean) - agree
+
+
 def _measure_serving_faults(devs):
     """Fault-tolerance recovery overhead (``--child-faults``): the SAME
     request workload through the continuous-batching engine clean vs with
@@ -586,18 +598,8 @@ def _measure_serving_faults(devs):
     clean_streams = [r.tokens for r in clean_reqs]
     fault_streams = [r.tokens for r in fault_reqs]
 
-    def _lost(clean, faulted):
-        # clean-run tokens NOT reproduced by the faulted run: everything
-        # past the first divergence point (the recovery contract is 0)
-        agree = 0
-        for a, b in zip(clean, faulted):
-            if a != b:
-                break
-            agree += 1
-        return len(clean) - agree
-
     tokens_lost = sum(
-        _lost(c, f) for c, f in zip(clean_streams, fault_streams)
+        _divergence_lost(c, f) for c, f in zip(clean_streams, fault_streams)
     )
     return {
         "injected_dispatch_failures": inj.counters["dispatch_failures"],
@@ -612,6 +614,93 @@ def _measure_serving_faults(devs):
         "recovery_overhead_pct": round(
             100.0 * (fault_wall - clean_wall) / clean_wall, 2
         ) if clean_wall > 0 else 0.0,
+    }
+
+
+def _measure_train_faults(devs):
+    """Training fault-tolerance (``--child-train-faults``): the SAME short
+    training run on the CPU backend clean vs fault-injected (one NaN loss
+    skipped on device + one recovered dispatch failure), recording the
+    recovery's wall overhead and the anomaly-skip count — then a
+    kill-and-resume split of the same run proving the resumed loss stream
+    is bit-identical to the uninterrupted one (tokens_lost must be 0: the
+    exact-resume contract, not an approximation)."""
+    import tempfile
+    import time as _t
+
+    import jax
+
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+    from neuronx_distributed_tpu.trainer import OptimizerConfig
+    from neuronx_distributed_tpu.trainer.data import SyntheticTokens
+    from neuronx_distributed_tpu.trainer.faults import FaultInjector
+    from neuronx_distributed_tpu.trainer.loop import CheckpointCallback, Trainer
+    from neuronx_distributed_tpu.utils.retry import RetryPolicy
+
+    if not mesh_lib.model_parallel_is_initialized():
+        mesh_lib.initialize_model_parallel()
+    cfg = tiny_llama(num_layers=2, max_seq_len=32)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    STEPS, BS, SEQ = 8, 4, 16
+
+    class Rec:
+        def __init__(self):
+            self.losses = []
+
+        def on_train_start(self, t):
+            pass
+
+        def on_step_end(self, t, m):
+            self.losses.append(float(m["loss"]))
+
+        def on_train_end(self, t):
+            pass
+
+    def run(injector=None, steps=STEPS, resume_from=None, callbacks=()):
+        rec = Rec()
+        tr = Trainer(
+            model=model, optimizer_config=OptimizerConfig(zero1=False),
+            callbacks=[rec, *callbacks], fault_injector=injector,
+            dispatch_retry=RetryPolicy(max_attempts=3, first_wait=0.01,
+                                       min_wait=0.0),
+        )
+        t0 = _t.perf_counter()
+        tr.fit(
+            SyntheticTokens(cfg.vocab_size, BS, SEQ, seed=11),
+            jax.random.PRNGKey(0), max_steps=steps, resume_from=resume_from,
+        )
+        return tr, rec.losses, _t.perf_counter() - t0
+
+    run(steps=2)  # compile outside the timed windows
+    _, clean_losses, clean_wall = run()
+
+    # dispatch attempts are counted per fit(): 8 steps = attempts 0..7, so
+    # attempt 5 is a mid-run failure (its retry lands the same run)
+    inj = FaultInjector().nan_loss(at=3).fail_dispatch(at=5, times=1)
+    tr_f, fault_losses, fault_wall = run(injector=inj)
+
+    # kill-and-resume split: 4 steps + checkpoint, fresh trainer to 8
+    with tempfile.TemporaryDirectory() as d:
+        _, head, _ = run(steps=4, callbacks=[CheckpointCallback(d, every=4, async_save=False)])
+        tr_r, tail, _ = run(steps=STEPS, resume_from=d)
+    resumed = head + tail
+
+    return {
+        "steps": STEPS,
+        "injected": dict(inj.counters),
+        "anomaly_skips": int(tr_f.anomaly_skips),
+        "dispatch_retries": int(tr_f.dispatch_retries),
+        "health_after_faults": tr_f.health().value,
+        "clean_wall_s": round(clean_wall, 4),
+        "fault_wall_s": round(fault_wall, 4),
+        "recovery_overhead_s": round(fault_wall - clean_wall, 4),
+        "recovery_overhead_pct": round(
+            100.0 * (fault_wall - clean_wall) / clean_wall, 2
+        ) if clean_wall > 0 else 0.0,
+        "resume_bit_identical": resumed == clean_losses,
+        "resumed_tokens_lost": int(_divergence_lost(clean_losses, resumed)),
+        "resumed_steps_run": int(tr_r.steps_run),
     }
 
 
@@ -739,16 +828,8 @@ def _measure_serving_prefix(devs):
     clean_streams = [r.tokens for r in clean_reqs]
     cache_streams = [r.tokens for r in cache_reqs]
 
-    def _lost(clean, cached):
-        agree = 0
-        for a, b in zip(clean, cached):
-            if a != b:
-                break
-            agree += 1
-        return len(clean) - agree
-
     tokens_lost = sum(
-        _lost(c, f) for c, f in zip(clean_streams, cache_streams)
+        _divergence_lost(c, f) for c, f in zip(clean_streams, cache_streams)
     )
     hits = cache_d["prefix_hits"]
     total = hits + cache_d["prefix_misses"]
@@ -1035,6 +1116,33 @@ def child_prefix() -> None:
         _emit(
             {
                 "metric": "serving_prefix",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
+def child_train_faults() -> None:
+    """Training fault-tolerance child (``--child-train-faults``): clean vs
+    fault-injected short training run on the CPU backend (anomaly-skip
+    count, recovery overhead) + kill-and-resume bit-identity proof. Prints
+    one JSON line; merged into the BENCH artifact as
+    ``extras.train_faults``."""
+    os.environ.setdefault("BENCH_FORCE_PLATFORM", "cpu")
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "train_faults",
+                "unit": "recovery overhead + exact resume",
+                "platform": devs[0].platform,
+                **_measure_train_faults(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "train_faults",
                 "error": f"{type(e).__name__}: {str(e)[:400]}",
             }
         )
@@ -1350,6 +1458,7 @@ def main() -> None:
     serving_result = None
     faults_result = None
     prefix_result = None
+    train_faults_result = None
 
     import signal
 
@@ -1379,6 +1488,11 @@ def main() -> None:
             prefix_result
             if prefix_result is not None
             else {"error": "prefix child did not finish"}
+        )
+        extras["train_faults"] = (
+            train_faults_result
+            if train_faults_result is not None
+            else {"error": "train-faults child did not finish"}
         )
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
         builder = _load_builder_artifact()
@@ -1505,6 +1619,16 @@ def main() -> None:
     else:
         prefix_result = {"error": f"prefix child: {err}"}
 
+    # 8. Training fault-tolerance child: clean-vs-chaos training wall +
+    #    exact-resume bit-identity on the CPU backend (serialized after the
+    #    other wall-clock children for the same core-contention reason).
+    tfaults, err = _run_child("--child-train-faults", TRAIN_FAULTS_TIMEOUT_S)
+    if tfaults is not None:
+        tfaults.pop("metric", None)
+        train_faults_result = tfaults
+    else:
+        train_faults_result = {"error": f"train-faults child: {err}"}
+
     _finalize()
 
 
@@ -1517,6 +1641,8 @@ if __name__ == "__main__":
         child_sweep()
     elif "--child-serving" in sys.argv:
         child_serving()
+    elif "--child-train-faults" in sys.argv:
+        child_train_faults()
     elif "--child-faults" in sys.argv:
         child_faults()
     elif "--child-prefix" in sys.argv:

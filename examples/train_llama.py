@@ -99,6 +99,22 @@ def parse_args(argv=None):
     io.add_argument("--timeline", default=None,
                     help="write a chrome-trace timeline JSON here")
 
+    f = p.add_argument_group("fault injection (chaos demo)")
+    f.add_argument("--inject-fault", default=None,
+                   choices=["nan", "spike", "dispatch", "ckpt", "sigterm"],
+                   help="drive one deterministic fault through the trainer's "
+                        "recovery machinery: 'nan' (NaN loss skipped on "
+                        "device), 'spike' (grad-norm spike skipped), "
+                        "'dispatch' (train-step dispatch failure, retried), "
+                        "'ckpt' (checkpoint corrupted after save — resume "
+                        "falls back), 'sigterm' (real SIGTERM: finish step, "
+                        "checkpoint, exit cleanly)")
+    f.add_argument("--fault-at", type=int, default=2,
+                   help="0-based step (or dispatch attempt) the fault fires at")
+    f.add_argument("--anomaly-budget", type=int, default=25,
+                   help="max anomalous (skipped) steps before the run halts "
+                        "with an emergency checkpoint")
+
     e = p.add_argument_group("environment")
     e.add_argument("--force-cpu-devices", type=int, default=None,
                    help="run on N virtual CPU devices (development mode)")
@@ -139,17 +155,22 @@ def build_config(args):
 
 
 def make_data_iter(args, cfg, batch_size: int, seq_len: int):
-    """Yield host batches {input_ids, labels} forever (reference: the HF
-    dataloader in run_llama_nxd.py; synthetic keeps the harness hermetic)."""
+    """Host batches {input_ids, labels} forever (reference: the HF
+    dataloader in run_llama_nxd.py; synthetic keeps the harness hermetic).
+    Returns the SOURCE iterable — synthetic and packed sources carry the
+    ``state()/restore()`` cursor, so ``--resume`` reproduces an interrupted
+    run bit-identically (Trainer checkpoints the cursor)."""
     import numpy as np
 
     if args.data == "synthetic":
-        rng = np.random.default_rng(args.seed)
-        while True:
-            ids = rng.integers(0, cfg.vocab_size, (batch_size, seq_len + 1),
-                               dtype=np.int32)
-            yield {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
-    elif args.data.startswith("packed:"):
+        from neuronx_distributed_tpu.trainer.data import SyntheticTokens
+
+        # the always-present loss_mask also lets --inject-fault corrupt
+        # batches without a retrace
+        return SyntheticTokens(
+            cfg.vocab_size, batch_size, seq_len, seed=args.seed
+        )
+    if args.data.startswith("packed:"):
         from neuronx_distributed_tpu.trainer.data import PackedCorpus
 
         corpus = PackedCorpus(
@@ -159,8 +180,8 @@ def make_data_iter(args, cfg, batch_size: int, seq_len: int):
         )
         print(f"packed corpus: {len(corpus.windows)} windows, "
               f"{corpus.num_batches_per_epoch} batches/epoch")
-        yield from corpus
-    elif args.data.startswith("npy:"):
+        return corpus
+    if args.data.startswith("npy:"):
         path = args.data[4:]
         tokens = np.load(path, mmap_mode="r")
         if hasattr(tokens, "files"):  # .npz archive: use its first array
@@ -170,17 +191,21 @@ def make_data_iter(args, cfg, batch_size: int, seq_len: int):
         n = (len(tokens) - 1) // (batch_size * seq_len)
         if n == 0:
             raise ValueError(f"{path}: too few tokens for one batch")
-        while True:
-            for i in range(n):
-                lo = i * batch_size * seq_len
-                chunk = np.asarray(
-                    tokens[lo : lo + batch_size * seq_len + 1], dtype=np.int32
-                )
-                ids = chunk[:-1].reshape(batch_size, seq_len)
-                lbl = chunk[1:].reshape(batch_size, seq_len)
-                yield {"input_ids": ids, "labels": lbl}
-    else:
-        raise ValueError(f"unknown --data {args.data!r}")
+
+        def stream():
+            while True:
+                for i in range(n):
+                    lo = i * batch_size * seq_len
+                    chunk = np.asarray(
+                        tokens[lo : lo + batch_size * seq_len + 1],
+                        dtype=np.int32,
+                    )
+                    ids = chunk[:-1].reshape(batch_size, seq_len)
+                    lbl = chunk[1:].reshape(batch_size, seq_len)
+                    yield {"input_ids": ids, "labels": lbl}
+
+        return stream()
+    raise ValueError(f"unknown --data {args.data!r}")
 
 
 def main(argv=None):
@@ -257,8 +282,42 @@ def main(argv=None):
     if args.ckpt_dir:
         callbacks.append(
             CheckpointCallback(args.ckpt_dir, every=args.ckpt_every,
-                               num_kept=args.ckpt_keep)
+                               num_kept=args.ckpt_keep,
+                               # a ckpt-corruption demo must leave the
+                               # corrupt tag in place — save_on_end would
+                               # notice the missing done marker and heal it
+                               save_on_end=args.inject_fault != "ckpt")
         )
+
+    injector = None
+    if args.inject_fault:
+        from neuronx_distributed_tpu.trainer.faults import FaultInjector
+
+        injector = FaultInjector()
+        at = args.fault_at
+        if args.inject_fault == "nan":
+            injector.nan_loss(at=at)
+        elif args.inject_fault == "spike":
+            injector.spike_grads(at=at)
+        elif args.inject_fault == "dispatch":
+            injector.fail_dispatch(at=at, times=1)
+        elif args.inject_fault == "ckpt":
+            if not args.ckpt_dir:
+                raise SystemExit("--inject-fault ckpt requires --ckpt-dir")
+            # corrupt the LAST periodic save — the tag `newest` will point
+            # at — so the following --resume exercises the fallback to the
+            # newest COMPLETED tag (a mid-run tag would just be skipped)
+            last_tag = (args.steps // args.ckpt_every) * args.ckpt_every
+            if last_tag <= 0:
+                raise SystemExit(
+                    "--inject-fault ckpt needs at least one periodic save "
+                    "(--steps >= --ckpt-every)"
+                )
+            injector.corrupt_checkpoint(f"step_{last_tag}")
+        elif args.inject_fault == "sigterm":
+            injector.deliver_sigterm(at=at)
+
+    from neuronx_distributed_tpu.trainer import AnomalyGuardConfig
 
     trainer = Trainer(
         model=model,
@@ -266,6 +325,20 @@ def main(argv=None):
         callbacks=callbacks,
         pipeline=pipeline,
         timeline=Timeline(args.timeline) if args.timeline else None,
+        fault_injector=injector,
+        # chaos-demo warmup: under --inject-fault the spike detector arms
+        # after 2 good steps so a spike at the default --fault-at 2 is
+        # actually caught in a short run; clean runs keep the production
+        # warmup (a 2-step EMA is hair-trigger on real early-training
+        # grad-norm volatility and would silently skip legitimate steps)
+        anomaly_guard=AnomalyGuardConfig(
+            budget=args.anomaly_budget,
+            warmup_steps=(
+                2 if args.inject_fault
+                else AnomalyGuardConfig.warmup_steps
+            ),
+        ),
+        emergency_dir=args.ckpt_dir,
     )
     data = make_data_iter(args, cfg, batch_size, seq_len)
 
@@ -276,13 +349,36 @@ def main(argv=None):
         not args.no_zero1, batch_size, seq_len, args.steps,
     )
     t0 = time.perf_counter()
-    metrics = trainer.fit(
-        data,
-        jax.random.PRNGKey(args.seed),
-        args.steps,
-        resume_from=args.ckpt_dir if args.resume else None,
-    )
+    from neuronx_distributed_tpu.trainer.loop import TrainerHalted
+
+    try:
+        metrics = trainer.fit(
+            data,
+            jax.random.PRNGKey(args.seed),
+            args.steps,
+            resume_from=args.ckpt_dir if args.resume else None,
+        )
+    except TrainerHalted as e:
+        print(
+            f"HALTED at step {trainer.step}: {e.reason} "
+            f"(emergency checkpoint: {e.emergency_tag or 'none'})"
+        )
+        return None
     wall = time.perf_counter() - t0
+    if injector is not None or trainer.preempted:
+        print(
+            f"fault summary: health={trainer.health().value} "
+            f"anomaly_skips={trainer.anomaly_skips} "
+            f"dispatch_retries={trainer.dispatch_retries} "
+            f"preempted={trainer.preempted} "
+            f"injected={getattr(injector, 'counters', {})}"
+        )
+    if trainer.preempted:
+        print(
+            f"preempted cleanly at step {trainer.step} — resume with "
+            f"--resume --ckpt-dir {args.ckpt_dir or '<dir>'}"
+        )
+        return metrics
     if "loss" not in metrics:
         # resumed at/after --steps: nothing left to train
         print(f"nothing to do: resumed at step {trainer.step} >= --steps {args.steps}")

@@ -86,7 +86,7 @@ def _sharded_blockwise_mlp(mesh, ep_ax, tp_ax, E_l: int, ep: int, glu: bool,
     def sharded_mlp(x, token_idx, ws, sizes, gate_, up_, down_):
         T = x.shape[0]
         N = token_idx.shape[0]
-        ep_rank = jax.lax.axis_index(ep_ax) if ep > 1 else 0
+        ep_rank = mesh_lib.compat_axis_index(ep_ax) if ep > 1 else 0
         local_sizes = jax.lax.dynamic_slice_in_dim(sizes, ep_rank * E_l, E_l)
         offsets = jnp.concatenate(
             [jnp.zeros((1,), sizes.dtype), jnp.cumsum(sizes)]
@@ -109,7 +109,7 @@ def _sharded_blockwise_mlp(mesh, ep_ax, tp_ax, E_l: int, ep: int, glu: bool,
         return contrib[None, None]
 
     return jax.jit(
-        jax.shard_map(
+        mesh_lib.compat_shard_map(
             sharded_mlp,
             mesh=mesh,
             in_specs=(P(), P(), P(), P(), wspec_col, wspec_col, wspec_row),
@@ -146,7 +146,7 @@ def _sharded_blockwise_mlp_manual(mesh, edp_ax, ep_ax, tp_ax, E: int,
         sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
         ws = top_w.reshape(-1)[order].astype(x.dtype)
         N = token_idx.shape[0]
-        ep_rank = jax.lax.axis_index(ep_ax) if ep > 1 else 0
+        ep_rank = mesh_lib.compat_axis_index(ep_ax) if ep > 1 else 0
         local_sizes = jax.lax.dynamic_slice_in_dim(sizes, ep_rank * E_l, E_l)
         offsets = jnp.concatenate(
             [jnp.zeros((1,), sizes.dtype), jnp.cumsum(sizes)]
@@ -167,7 +167,7 @@ def _sharded_blockwise_mlp_manual(mesh, edp_ax, ep_ax, tp_ax, E: int,
         return contrib
 
     return jax.jit(
-        jax.shard_map(
+        mesh_lib.compat_shard_map(
             sharded_mlp,
             mesh=mesh,
             in_specs=(tok_spec, tok_spec, tok_spec, wspec_col, wspec_col,
@@ -192,7 +192,7 @@ def _sharded_blockwise_mlp_rolled(mesh, ep_ax, tp_ax, E_l: int, ep: int,
 
     def sharded_mlp(xs_, sizes, gate_, up_, down_):
         N = xs_.shape[0]
-        ep_rank = jax.lax.axis_index(ep_ax) if ep > 1 else 0
+        ep_rank = mesh_lib.compat_axis_index(ep_ax) if ep > 1 else 0
         local_sizes = jax.lax.dynamic_slice_in_dim(sizes, ep_rank * E_l, E_l)
         offsets = jnp.concatenate(
             [jnp.zeros((1,), sizes.dtype), jnp.cumsum(sizes)]
@@ -207,7 +207,7 @@ def _sharded_blockwise_mlp_rolled(mesh, ep_ax, tp_ax, E_l: int, ep: int,
         return y[None, None]
 
     return jax.jit(
-        jax.shard_map(
+        mesh_lib.compat_shard_map(
             sharded_mlp,
             mesh=mesh,
             in_specs=(P(), P(), wspec_col, wspec_col, wspec_row),
@@ -420,7 +420,7 @@ class ExpertMLPs(nn.Module):
             # fully-manual in-region-psum path: needs the token dim cleanly
             # divisible over edp and no cp sequence sharding folded into it
             if cp == 1 and T % edp == 0:
-                ctx_mesh = jax.sharding.get_abstract_mesh()
+                ctx_mesh = mesh_lib.ctx_abstract_mesh()
                 smapped = _sharded_blockwise_mlp_manual(
                     mesh if ctx_mesh.empty else ctx_mesh,
                     mesh_lib.EDP_AXIS if edp > 1 else None,
@@ -461,7 +461,7 @@ class ExpertMLPs(nn.Module):
             if E % max(ep, 1) != 0:
                 raise ValueError(f"num_experts {E} not divisible by ep {ep}")
             mesh = mesh_lib.get_mesh()
-            ctx_mesh = jax.sharding.get_abstract_mesh()
+            ctx_mesh = mesh_lib.ctx_abstract_mesh()
             # only claim axes of size > 1: a claimed-but-unreduced axis breaks
             # the psum transpose rule in the backward
             smapped = _sharded_blockwise_mlp(

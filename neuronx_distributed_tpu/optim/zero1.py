@@ -240,8 +240,8 @@ def build_explicit_zero1_update(optimizer, params_shardings, opt_state_shardings
         # this traces once and inlines (same rule as mesh.manual_shard_map).
         # Rank indices enter as sharded iotas — NOT lax.axis_index, whose
         # in-region lowering re-binds already-manual axes (see rank_arrays).
-        ctx_mesh = jax.sharding.get_abstract_mesh()
-        fn = jax.jit(jax.shard_map(
+        ctx_mesh = mesh_lib.ctx_abstract_mesh()
+        fn = jax.jit(mesh_lib.compat_shard_map(
             inner,
             mesh=mesh if ctx_mesh.empty else ctx_mesh,
             in_specs=(rank_specs, param_specs, opt_specs, param_specs),

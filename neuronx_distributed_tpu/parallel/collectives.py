@@ -91,7 +91,7 @@ def shift_right(x, axis_name: AxisName):
 def broadcast(x, axis_name: AxisName, root: int = 0):
     """Replicate ``root``'s value across the axis (reference loads use
     all-reduce-as-broadcast, trainer/checkpoint.py:346)."""
-    idx = lax.axis_index(axis_name)
+    idx = axis_index(axis_name)
     import jax.numpy as jnp
 
     masked = jax.tree.map(lambda t: jnp.where(idx == root, t, jnp.zeros_like(t)), x)
@@ -99,7 +99,9 @@ def broadcast(x, axis_name: AxisName, root: int = 0):
 
 
 def axis_index(axis_name: AxisName):
-    return lax.axis_index(axis_name)
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    return mesh_lib.compat_axis_index(axis_name)
 
 
 def axis_size(axis_name: AxisName) -> int:

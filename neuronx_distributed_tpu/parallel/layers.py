@@ -402,7 +402,7 @@ def _vocab_parallel_lookup(mesh, axis: str):
 
     def local_lookup(table_l, ids_):
         per = table_l.shape[0]
-        lo = jax.lax.axis_index(axis) * per
+        lo = mesh_lib.compat_axis_index(axis) * per
         local_ids = ids_ - lo
         ok = (local_ids >= 0) & (local_ids < per)
         rows = jnp.take(table_l, jnp.clip(local_ids, 0, per - 1), axis=0)
@@ -410,7 +410,7 @@ def _vocab_parallel_lookup(mesh, axis: str):
         return psum_cpu_safe(rows, axis)
 
     return jax.jit(
-        jax.shard_map(
+        mesh_lib.compat_shard_map(
             local_lookup,
             mesh=mesh,
             in_specs=(P(axis, None), P()),
@@ -469,7 +469,7 @@ class ParallelEmbedding(nn.Module):
         if self.shard_dim != 0 or tp <= 1 or self.num_embeddings % tp != 0:
             return jnp.take(table, ids, axis=0)
         mesh = mesh_lib.get_mesh()
-        ctx_mesh = jax.sharding.get_abstract_mesh()
+        ctx_mesh = mesh_lib.ctx_abstract_mesh()
         # gather the feature dim BEFORE entering the partial-manual region:
         # under ZeRO-1 the table arrives with H sharded over (edp, ep, cp),
         # and inside the region that sharding collides with the (B, S)-

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import jax
 
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
 # Parity constant with the reference's model-parallel seed offset (random.py:64).
 TENSOR_PARALLEL_SEED_OFFSET = 2718
 
@@ -30,5 +32,5 @@ def model_parallel_base_key(key: jax.Array) -> jax.Array:
 def fold_in_axes(key: jax.Array, *axis_names: str) -> jax.Array:
     """Per-rank key inside ``shard_map``: folds each mesh axis index in turn."""
     for name in axis_names:
-        key = jax.random.fold_in(key, jax.lax.axis_index(name))
+        key = jax.random.fold_in(key, mesh_lib.compat_axis_index(name))
     return key

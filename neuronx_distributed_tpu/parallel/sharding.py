@@ -33,7 +33,7 @@ def constrain(x, spec: P):
     (edp, ep) DATA_AXES pair — never pp, the pipeline's manual axis)."""
     if not mesh_lib.model_parallel_is_initialized():
         return x
-    ctx_mesh = jax.sharding.get_abstract_mesh()
+    ctx_mesh = mesh_lib.ctx_abstract_mesh()
     if not ctx_mesh.empty and not ctx_mesh.are_all_axes_auto:
         return jax.lax.with_sharding_constraint(x, spec)
     return jax.lax.with_sharding_constraint(

@@ -234,8 +234,10 @@ class GenericPipelineAdapter:
             max_grad_norm=max_grad_norm,
             **step_kw,
         )
+        from neuronx_distributed_tpu.trainer.trainer import committed_step0
+
         state = TrainState(
-            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+            step=committed_step0(), params=params, opt_state=opt_state
         )
         return state, step, engine
 

@@ -146,7 +146,7 @@ class PipelineEngine:
         stage_fn = self._make_stage_fn(layer_apply)
 
         def pipelined(layers_local, embed_params, batch):
-            rank = lax.axis_index(mesh_lib.PP_AXIS)
+            rank = mesh_lib.compat_axis_index(mesh_lib.PP_AXIS)
             layers_local = jax.tree.map(lambda a: a[0], layers_local)  # drop stage dim
             # Embed all M microbatches once, OUTSIDE the tick loop: the loop
             # otherwise pays M+S-1 embedding fwd (and bwd) passes per stage for
@@ -179,7 +179,7 @@ class PipelineEngine:
             # this rank's stage outputs per tick + its layers' aux total
             return ys, aux_acc[None]
 
-        fn = jax.shard_map(
+        fn = mesh_lib.compat_shard_map(
             pipelined,
             mesh=mesh,
             in_specs=(P(mesh_lib.PP_AXIS), P(), P()),
@@ -398,7 +398,7 @@ class OneFOneBEngine(PipelineEngine):
         )
 
         def pipelined(layers_local, head_params, embedded, batch):
-            rank = lax.axis_index(mesh_lib.PP_AXIS)
+            rank = mesh_lib.compat_axis_index(mesh_lib.PP_AXIS)
             layers_local = jax.tree.map(lambda a: a[:, 0], layers_local)  # (C, Lc, ...)
             is_last = rank == S - 1
             is_first = rank == 0
@@ -562,7 +562,7 @@ class OneFOneBEngine(PipelineEngine):
             loss_sum = lax.psum(loss_sum, mesh_lib.PP_AXIS)
             return g_layers, g_head, d_emb, loss_sum
 
-        fn = jax.shard_map(
+        fn = mesh_lib.compat_shard_map(
             pipelined,
             mesh=mesh,
             in_specs=(P(None, mesh_lib.PP_AXIS), P(), P(), P()),
@@ -612,7 +612,7 @@ class OneFOneBEngine(PipelineEngine):
         stage_fn = self._make_stage_fn(self.layer_apply)
 
         def pipelined(layers_local, embedded):
-            rank = lax.axis_index(mesh_lib.PP_AXIS)
+            rank = mesh_lib.compat_axis_index(mesh_lib.PP_AXIS)
             layers_local = jax.tree.map(lambda a: a[:, 0], layers_local)
             is_last = rank == S - 1
             is_first = rank == 0
@@ -657,7 +657,7 @@ class OneFOneBEngine(PipelineEngine):
             (_, out_buf, aux_acc), _ = lax.scan(cycle, init, jnp.arange(cycles))
             return out_buf[None], aux_acc[None]
 
-        fn = jax.shard_map(
+        fn = mesh_lib.compat_shard_map(
             pipelined,
             mesh=mesh,
             in_specs=(P(None, mesh_lib.PP_AXIS), P()),

@@ -1,9 +1,11 @@
 from neuronx_distributed_tpu.trainer.trainer import (
+    AnomalyGuardConfig,
     OptimizerConfig,
     TrainingConfig,
     TrainState,
     build_train_step,
     create_train_state,
+    init_anomaly_guard_state,
     initialize_parallel_model,
     initialize_parallel_optimizer,
     make_optimizer,
@@ -12,11 +14,13 @@ from neuronx_distributed_tpu.trainer.trainer import (
 )
 
 __all__ = [
+    "AnomalyGuardConfig",
     "OptimizerConfig",
     "TrainingConfig",
     "TrainState",
     "build_train_step",
     "create_train_state",
+    "init_anomaly_guard_state",
     "initialize_parallel_model",
     "initialize_parallel_optimizer",
     "make_optimizer",

@@ -512,20 +512,51 @@ def save_checkpoint(
 
 def latest_checkpoint_tag(checkpoint_dir: str) -> Optional[str]:
     """Resolve the newest completed tag: the ``newest`` pointer when valid,
-    else the highest-step tag carrying a ``done`` marker."""
+    else the newest tag carrying a ``done`` marker.
+
+    A ``newest`` pointer whose tag is MISSING its done marker means the
+    run was killed between the tensor flush and the marker write — the
+    pointed-at bytes are untrustworthy. The resolution falls back to the
+    newest *done* tag and cleans up the corrupt leftover (the reference's
+    ``_determine_remove_tags`` behavior), repointing ``newest`` at the
+    fallback so later loads don't re-walk the corruption. Tags whose save
+    is still in flight (async save registered, marker legitimately not yet
+    written) are never touched.
+
+    The in-flight guard is PROCESS-local (like the async-save machinery
+    itself): a checkpoint directory has exactly one writer process.
+    Calling this from a *different* process while that writer is
+    mid-async-save can misread its not-yet-marked tag as a killed-mid-save
+    leftover and delete it — inspect such directories read-only
+    (``list_checkpoint_tags`` + done-marker checks) instead."""
     storage = create_checkpoint_storage(checkpoint_dir)
+    corrupt_newest = None
     if storage.file_exists(NEWEST_FILE):
         tag = storage.load_text(NEWEST_FILE).strip()
         if storage.file_exists(os.path.join(tag, DONE_MARKER)):
             return tag
+        if tag not in _IO_STATE.in_flight_tags():
+            corrupt_newest = tag
     done = [
         t
         for t in storage.list_checkpoint_tags()
         if storage.file_exists(os.path.join(t, DONE_MARKER))
     ]
-    if not done:
-        return None
-    return max(done, key=lambda t: _tag_order_key(storage, t))
+    newest_done = (
+        max(done, key=lambda t: _tag_order_key(storage, t)) if done else None
+    )
+    if corrupt_newest is not None:
+        logger.warning(
+            "newest pointer targets '%s' which has no done marker (killed "
+            "mid-save?) — removing it and falling back to the newest "
+            "completed tag", corrupt_newest,
+        )
+        storage.remove_checkpoint(corrupt_newest)
+        if newest_done is not None:
+            storage.save_text(newest_done, NEWEST_FILE)
+        else:
+            storage.remove_file(NEWEST_FILE)
+    return newest_done
 
 
 def load_checkpoint(

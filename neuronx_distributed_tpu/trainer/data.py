@@ -39,6 +39,16 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+# --- exact-resume protocol ----------------------------------------------------
+# A checkpointable data source exposes ``state() -> dict`` (a small
+# JSON-able cursor) and ``restore(state)``. ``Trainer.fit`` persists the
+# cursor into every checkpoint's user_content and restores it on
+# ``resume_from=...`` — the load-bearing half of bit-identical resume
+# (params/optimizer come back exactly via orbax; the batch STREAM must too).
+# Both iterators here hold ONE cursor on the source object (the
+# single-controller loop has one consumer); ``iter()`` continues from the
+# cursor rather than restarting.
+
 
 def pack_documents(
     docs, seq_len: int, eos_token_id: Optional[int] = None,
@@ -149,6 +159,22 @@ class PackedCorpus:
                 f"{self.batch_size}"
             )
         self.num_batches_per_epoch = len(self.windows) // self.batch_size
+        # exact-resume cursor (see the protocol note above): epoch + index
+        # of the NEXT batch within it; the permutation is re-derivable from
+        # (seed, epoch), so this tiny pair IS the full stream position
+        self._epoch = 0
+        self._cursor = 0
+        self._order_cache: Optional[tuple] = None
+
+    def state(self) -> dict:
+        """JSON-able stream cursor (position of the NEXT batch)."""
+        return {"epoch": int(self._epoch), "batch": int(self._cursor)}
+
+    def restore(self, state: dict) -> None:
+        """Reposition the stream; takes effect on the next ``next()`` even
+        for iterators created before the restore."""
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state["batch"])
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         if not self.shuffle:
@@ -157,26 +183,78 @@ class PackedCorpus:
             np.random.SeedSequence([self.seed, epoch])
         ).permutation(len(self.windows))
 
+    def _order_for(self, epoch: int) -> np.ndarray:
+        if self._order_cache is None or self._order_cache[0] != epoch:
+            self._order_cache = (epoch, self._epoch_order(epoch))
+        return self._order_cache[1]
+
     def __iter__(self) -> Iterator[dict]:
-        epoch = 0
         while True:
-            order = self._epoch_order(epoch)
-            for b in range(self.num_batches_per_epoch):
-                idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-                # fancy-index materializes just this batch from the memmap;
-                # sorted first (memmap reads in file order), then restored
-                sort = np.argsort(idx)
-                rows = np.asarray(self.windows[idx[sort]], np.int32)
-                rows = rows[np.argsort(sort)]
-                batch = {"input_ids": rows[:, :-1], "labels": rows[:, 1:]}
-                if self.segments is not None:
-                    seg = np.asarray(self.segments[idx[sort]], np.int32)
-                    seg = seg[np.argsort(sort)]
-                    batch["segment_ids"] = seg[:, :-1]
-                    # a label drawn from the NEXT document (the token after a
-                    # boundary) is noise — mask it from the loss
-                    batch["loss_mask"] = (
-                        seg[:, :-1] == seg[:, 1:]
-                    ).astype(np.float32)
-                yield batch
-            epoch += 1
+            if self._cursor >= self.num_batches_per_epoch:
+                self._epoch += 1
+                self._cursor = 0
+            order = self._order_for(self._epoch)
+            b = self._cursor
+            self._cursor += 1
+            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            # fancy-index materializes just this batch from the memmap;
+            # sorted first (memmap reads in file order), then restored
+            sort = np.argsort(idx)
+            rows = np.asarray(self.windows[idx[sort]], np.int32)
+            rows = rows[np.argsort(sort)]
+            batch = {"input_ids": rows[:, :-1], "labels": rows[:, 1:]}
+            if self.segments is not None:
+                seg = np.asarray(self.segments[idx[sort]], np.int32)
+                seg = seg[np.argsort(sort)]
+                batch["segment_ids"] = seg[:, :-1]
+                # a label drawn from the NEXT document (the token after a
+                # boundary) is noise — mask it from the loss
+                batch["loss_mask"] = (
+                    seg[:, :-1] == seg[:, 1:]
+                ).astype(np.float32)
+            yield batch
+
+
+class SyntheticTokens:
+    """Seeded infinite random-token batches with the ``state()/restore()``
+    exact-resume protocol (O(1) restore: batch ``i`` is drawn from
+    ``SeedSequence([seed, i])``, so the cursor is just ``i``). The hermetic
+    stand-in for a tokenized corpus in examples, bench children, and chaos
+    tests.
+
+    ``emit_mask`` attaches an all-ones ``loss_mask`` — numerically the
+    plain mean loss, but its presence lets the chaos
+    :class:`~neuronx_distributed_tpu.trainer.faults.FaultInjector` corrupt
+    it without changing the batch pytree (no retrace on injection)."""
+
+    def __init__(self, vocab_size: int, batch_size: int, seq_len: int,
+                 seed: int = 0, emit_mask: bool = True):
+        self.vocab_size = int(vocab_size)
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        self.seed = int(seed)
+        self.emit_mask = emit_mask
+        self._i = 0
+
+    def state(self) -> dict:
+        return {"batch": int(self._i)}
+
+    def restore(self, state: dict) -> None:
+        self._i = int(state["batch"])
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, self._i])
+            )
+            ids = rng.integers(
+                0, self.vocab_size,
+                (self.batch_size, self.seq_len + 1), dtype=np.int32,
+            )
+            self._i += 1
+            batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+            if self.emit_mask:
+                batch["loss_mask"] = np.ones(
+                    (self.batch_size, self.seq_len), np.float32
+                )
+            yield batch

@@ -1,0 +1,191 @@
+"""Deterministic fault injection for the training loop.
+
+The serving engine's chaos harness (``serving/faults.py``) proved the
+pattern: failures must be *schedulable*, not random, so a test can assert
+the exact recovery path ran. This is the training-side twin, consulted by
+``Trainer.fit`` through hooks that are no-ops when no injector is attached
+— the hot path is untouched by default.
+
+Injection points (step/attempt indices are 0-based and deterministic):
+
+* ``nan_loss(at=k, times=t)`` — the k-th..(k+t-1)-th steps' batches are
+  corrupted so the REAL loss math produces NaN: every ``loss_mask`` entry
+  becomes NaN (``default_loss_fn``'s masked mean propagates it). The
+  on-device anomaly guard must catch it — params/opt-state unchanged,
+  training continues. Batches without a ``loss_mask`` get one injected
+  (all-NaN); note that changes the batch pytree and costs one retrace, so
+  chaos workloads that also assert compile counts should carry a mask
+  throughout (``PackedCorpus`` with segments does).
+* ``spike_grads(at=k, times=t, factor=1e6)`` — scales the k-th step's
+  ``loss_mask`` by ``-factor``: a positive scale would cancel in the
+  masked mean's denominator, but a negative sum drives
+  ``max(mask.sum(), 1)`` to its clamp branch, so the loss (and every
+  gradient) scales by the full factor — finite, huge, exactly what the
+  grad-norm spike detector must catch.
+* ``fail_dispatch(at=j, times=t)`` — the j-th..(j+t-1)-th train-step
+  *dispatch attempts* raise ``InjectedDispatchError`` before the jitted
+  step runs (donated buffers NOT consumed — a host-side enqueue failure,
+  the recoverable case). ``times=None`` fails every attempt from ``j`` on:
+  the way to drive the trainer into HALTED.
+* ``corrupt_checkpoint(tag)`` — deletes ``tag``'s ``done`` marker right
+  after its save commits, simulating a run killed mid-save; drives the
+  ``load_checkpoint`` newest-pointer fallback.
+* ``deliver_sigterm(at=k)`` — delivers a REAL ``SIGTERM`` to this process
+  at the start of step k (``os.kill``), so the graceful-preemption path is
+  tested through the actual signal handler, not a simulation.
+
+``counters`` records every fault actually fired so chaos tests can assert
+the schedule ran (an injection that never fired proves nothing).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """Base class for injected training failures (never raised by real
+    code paths)."""
+
+
+class InjectedDispatchError(InjectedFault):
+    """Scheduled train-step dispatch failure."""
+
+
+class FaultInjector:
+    """Schedule-driven fault source consulted by ``Trainer.fit`` hooks."""
+
+    def __init__(self):
+        # [at, end) half-open step/attempt windows; end=None → open-ended
+        self._nan_windows: List[Tuple[int, Optional[int]]] = []
+        self._spike_windows: List[Tuple[int, Optional[int], float]] = []
+        self._dispatch_windows: List[Tuple[int, Optional[int]]] = []
+        self._corrupt_tags: Set[str] = set()
+        self._sigterm_steps: Set[int] = set()
+        self.counters: Dict[str, int] = {
+            "nan_losses": 0,
+            "spiked_grads": 0,
+            "dispatch_failures": 0,
+            "corrupted_checkpoints": 0,
+            "sigterms": 0,
+        }
+
+    # --- schedule construction ----------------------------------------------
+
+    def nan_loss(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
+        end = None if times is None else at + times
+        self._nan_windows.append((at, end))
+        return self
+
+    def spike_grads(self, at: int = 0, times: Optional[int] = 1,
+                    factor: float = 1e6) -> "FaultInjector":
+        end = None if times is None else at + times
+        self._spike_windows.append((at, end, factor))
+        return self
+
+    def fail_dispatch(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
+        end = None if times is None else at + times
+        self._dispatch_windows.append((at, end))
+        return self
+
+    def corrupt_checkpoint(self, tag: str) -> "FaultInjector":
+        self._corrupt_tags.add(tag)
+        return self
+
+    def deliver_sigterm(self, at: int) -> "FaultInjector":
+        self._sigterm_steps.add(at)
+        return self
+
+    def pending_corruption(self, tag: str) -> bool:
+        """True when a ``corrupt_checkpoint`` is scheduled for ``tag`` —
+        the save path drains async commits first so the corruption hits a
+        checkpoint that actually exists (see ``on_checkpoint_saved``)."""
+        return tag in self._corrupt_tags
+
+    # --- trainer hooks -------------------------------------------------------
+
+    @staticmethod
+    def _hit(windows, index: int) -> bool:
+        return any(
+            index >= at and (end is None or index < end)
+            for at, end in windows
+        )
+
+    def on_step_start(self, step: int) -> None:
+        """Called with the 0-based global step index before the batch is
+        prepared. Delivers a scheduled REAL SIGTERM (the graceful-preemption
+        handler is under test, not a stand-in)."""
+        if step in self._sigterm_steps:
+            self._sigterm_steps.discard(step)
+            self.counters["sigterms"] += 1
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def corrupt_batch(self, step: int, batch: dict) -> dict:
+        """Called with the host batch for ``step``; returns the (possibly
+        corrupted) batch. NaN injection poisons ``loss_mask`` so the real
+        masked-mean loss math produces NaN; spikes scale it so gradients
+        blow up finitely."""
+        import numpy as np
+
+        nan = self._hit(self._nan_windows, step)
+        spike = next(
+            (f for at, end, f in self._spike_windows
+             if step >= at and (end is None or step < end)),
+            None,
+        )
+        if not nan and spike is None:
+            return batch
+        batch = dict(batch)
+        mask = batch.get("loss_mask")
+        if mask is None:
+            mask = np.ones_like(
+                np.asarray(batch["input_ids"]), dtype=np.float32
+            )
+        else:
+            mask = np.array(mask, dtype=np.float32, copy=True)
+        if nan:
+            mask[...] = np.nan
+            self.counters["nan_losses"] += 1
+        elif spike is not None:
+            # numerator-only scaling (see module docstring): the negative
+            # mask sum lands in the masked mean's max(sum, 1) clamp, so
+            # gradients scale by the full |factor| while staying finite
+            mask *= -abs(spike)
+            self.counters["spiked_grads"] += 1
+        batch["loss_mask"] = mask
+        return batch
+
+    def on_dispatch(self, attempt: int) -> None:
+        """Called with the 0-based dispatch ATTEMPT index (failed attempts
+        count, so a retry schedule is deterministic). Raises when the
+        schedule says this attempt fails — BEFORE the jitted step runs, so
+        donated buffers survive (the recoverable host-side case)."""
+        if self._hit(self._dispatch_windows, attempt):
+            self.counters["dispatch_failures"] += 1
+            raise InjectedDispatchError(
+                f"injected train-step dispatch failure at attempt {attempt}"
+            )
+
+    def on_checkpoint_saved(self, checkpoint_dir: str, tag: str) -> None:
+        """Called after a checkpoint for ``tag`` commits. A scheduled
+        corruption deletes its ``done`` marker — the on-disk state of a run
+        killed between the tensor flush and the marker write."""
+        if tag not in self._corrupt_tags:
+            return
+        from neuronx_distributed_tpu.trainer.checkpoint import (
+            DONE_MARKER,
+            create_checkpoint_storage,
+        )
+
+        storage = create_checkpoint_storage(checkpoint_dir)
+        marker = os.path.join(tag, DONE_MARKER)
+        if not storage.file_exists(marker):
+            # the save has not committed yet (async in flight) — leave the
+            # schedule armed rather than "corrupting" nothing and letting
+            # the background commit write a pristine marker afterwards
+            return
+        self._corrupt_tags.discard(tag)
+        storage.remove_file(marker)
+        self.counters["corrupted_checkpoints"] += 1

@@ -7,29 +7,105 @@
 Lightning's role in the reference — wiring parallel init, precision, the
 train loop, logging, and checkpoint IO — collapses here into one plain
 ``Trainer`` class over the jitted train step. Callbacks get the same hook
-points the reference's Lightning plugins use."""
+points the reference's Lightning plugins use.
+
+Fault tolerance — the unattended-safety contract the serving engine carries
+(``serving/engine.py``), training-side (chaos-tested in
+``tests/trainer/test_faults.py``):
+
+* **Anomaly guards** — a ``good_step`` flag computed INSIDE the jitted step
+  (``build_train_step(anomaly_guard=...)``): non-finite loss/grad-norm or a
+  grad-norm spike vs the device-carried EMA skips the update on device with
+  params/opt-state bit-identical, no host round-trip, no recompile. The
+  host budgets cumulative skips against ``AnomalyGuardConfig.budget`` by
+  reading the previous step's tiny flag pair AFTER the next step has been
+  dispatched (the readback overlaps device compute — the clean path never
+  stalls on the guard); exceeding the budget HALTS with an emergency
+  checkpoint instead of silently training on garbage.
+* **Dispatch recovery** — a failed train-step dispatch retries against the
+  last known-good ``(state, step)`` snapshot (the pre-dispatch state:
+  host-side failures leave donated buffers unconsumed) with the shared
+  decrementing-jitter :class:`~neuronx_distributed_tpu.utils.retry.
+  RetryPolicy`; ``max_attempts`` CONSECUTIVE failures — or a failure that
+  consumed the donated buffers — land in HALTED with an emergency
+  checkpoint of the surviving state (mirroring serving's
+  ``dispatch_retry`` semantics).
+* **Exact resume** — checkpoints carry the base RNG key, the data
+  iterator's cursor (``state()/restore()`` protocol, trainer/data.py), and
+  the throughput/step bookkeeping, so ``fit(resume_from=...)`` after a
+  mid-run kill reproduces the uninterrupted run's loss curve
+  bit-identically.
+* **Graceful preemption** — SIGTERM/SIGINT finish the in-flight step,
+  write a final ``step_N`` checkpoint through the done-marker protocol,
+  and return cleanly (``trainer.preempted``); a second signal falls
+  through to the original handler.
+* **Callback isolation** — one callback raising in a hook is logged with
+  its class name and counted (``callback_errors``), never fatal;
+  ``on_train_end`` still runs for every callback.
+* **Health** — ``trainer.health()`` reports ``OK/DEGRADED/HALTED``
+  (DEGRADED = anomaly skip or dispatch retry within
+  ``degraded_cooldown_steps``); counters mirror into the per-step metrics
+  dict and Timeline instants.
+
+Every fault path is drivable deterministically through
+:class:`~neuronx_distributed_tpu.trainer.faults.FaultInjector`; with no
+injector the hooks are no-ops."""
 
 from __future__ import annotations
 
 import dataclasses
+import enum
+import os
+import signal as _signal
+import threading
 import time
 from collections import deque
 from typing import Any, Callable, Iterable, List, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from neuronx_distributed_tpu.trainer.checkpoint import save_checkpoint
 from neuronx_distributed_tpu.trainer.trainer import (
+    AnomalyGuardConfig,
     OptimizerConfig,
     build_train_step,
     create_train_state,
+    init_anomaly_guard_state,
     make_optimizer,
     shard_batch,
 )
 from neuronx_distributed_tpu.utils.logger import get_logger
+from neuronx_distributed_tpu.utils.retry import RetryPolicy
 from neuronx_distributed_tpu.utils.timeline import Timeline
 
 logger = get_logger(__name__)
+
+
+class TrainerHealth(enum.Enum):
+    """Trainer health snapshot (``Trainer.health()``) — the serving
+    engine's surface, training-side. ``OK`` — training normally.
+    ``DEGRADED`` — progressing, but an anomaly skip or dispatch retry
+    happened within the last ``degraded_cooldown_steps`` steps. ``HALTED``
+    — the anomaly budget or dispatch retry budget is exhausted; the run
+    stopped with an emergency checkpoint and ``halt_reason`` says why."""
+
+    OK = "ok"
+    DEGRADED = "degraded"
+    HALTED = "halted"
+
+
+class TrainerHalted(RuntimeError):
+    """Raised by ``fit`` when the run halts (anomaly budget exceeded or
+    dispatch retries exhausted). ``emergency_tag`` names the emergency
+    checkpoint written before halting (``None`` if no checkpoint directory
+    was known or the state was unusable)."""
+
+    def __init__(self, reason: str, emergency_tag: Optional[str] = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.emergency_tag = emergency_tag
 
 
 class Callback:
@@ -63,7 +139,10 @@ class ThroughputMeter:
 
 class MetricsLogger(Callback):
     """Rank-0 step-gated metric logging, optionally into TensorBoard
-    (reference lightning/logger.py:24 NeuronTensorBoardLogger)."""
+    (reference lightning/logger.py:24 NeuronTensorBoardLogger). Robustness
+    counters (``anomaly_skips``/``dispatch_retries``/
+    ``emergency_checkpoints``/``callback_errors``) ride the same metrics
+    dict, so a fault-injected run's log explains itself."""
 
     def __init__(self, log_every: int = 10, tensorboard_dir: Optional[str] = None):
         self.log_every = log_every
@@ -152,37 +231,64 @@ class HooksCallback(Callback):
 
 class CheckpointCallback(Callback):
     """Periodic async checkpoint with retention (reference
-    lightning/checkpoint_io.py + trainer/checkpoint.py save path)."""
+    lightning/checkpoint_io.py + trainer/checkpoint.py save path).
+
+    ``save_on_end`` writes a final ``step_N`` checkpoint from
+    ``on_train_end`` when the last step did not land on the ``every``
+    boundary (skipped when one for that step already committed — e.g. the
+    graceful-preemption save — and when the trainer halted, which wrote an
+    emergency checkpoint instead). The saved ``user_content`` is the
+    trainer's full exact-resume payload (step, RNG, data cursor,
+    bookkeeping)."""
 
     def __init__(self, checkpoint_dir: str, every: int = 100,
-                 num_kept: Optional[int] = 3, async_save: bool = True):
+                 num_kept: Optional[int] = 3, async_save: bool = True,
+                 save_on_end: bool = True):
         self.checkpoint_dir = checkpoint_dir
         self.every = every
         self.num_kept = num_kept
         self.async_save = async_save
+        self.save_on_end = save_on_end
+
+    def _save(self, trainer, async_save: bool) -> None:
+        trainer.save_tagged_checkpoint(
+            self.checkpoint_dir, f"step_{trainer.step}",
+            num_kept=self.num_kept, async_save=async_save,
+        )
 
     def on_step_end(self, trainer, metrics):
         if trainer.step % self.every != 0:
             return
-        save_checkpoint(
-            self.checkpoint_dir,
-            tag=f"step_{trainer.step}",
-            items={"model": trainer.state.params, "optimizer": trainer.state.opt_state},
-            user_content={"step": trainer.step},
-            num_kept_ckpts=self.num_kept,
-            async_save=self.async_save,
-        )
+        self._save(trainer, self.async_save)
 
     def on_train_end(self, trainer):
-        from neuronx_distributed_tpu.trainer.checkpoint import finalize_checkpoints
+        from neuronx_distributed_tpu.trainer.checkpoint import (
+            DONE_MARKER,
+            create_checkpoint_storage,
+            finalize_checkpoints,
+        )
 
+        # drain in-flight async saves FIRST: a pending step_N commit must
+        # win over (not race) the save_on_end rewrite of the same tag
+        finalize_checkpoints()
+        if (
+            not self.save_on_end
+            or trainer.step == 0
+            or getattr(trainer, "halt_reason", None) is not None
+        ):
+            return
+        storage = create_checkpoint_storage(self.checkpoint_dir)
+        if storage.file_exists(os.path.join(f"step_{trainer.step}", DONE_MARKER)):
+            return  # periodic/preemption save already covered this step
+        self._save(trainer, async_save=False)
         finalize_checkpoints()
 
 
 @dataclasses.dataclass
 class Trainer:
     """Plain training loop over the jitted SPMD step (the reference's
-    Lightning strategy+module+launcher collapse into this)."""
+    Lightning strategy+module+launcher collapse into this), carrying the
+    fault-tolerance layer described in the module docstring."""
 
     model: Any
     optimizer_config: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
@@ -200,9 +306,406 @@ class Trainer:
     # schedule-derived pipeline timeline). Profiles steps [2, 5) of fit().
     profile_dir: Optional[str] = None
 
+    # --- fault tolerance ----------------------------------------------------
+    # On-device anomaly guard config (None disables; pipeline adapters build
+    # their own step, so the guard covers the monolithic path only).
+    anomaly_guard: Optional[AnomalyGuardConfig] = dataclasses.field(
+        default_factory=AnomalyGuardConfig
+    )
+    # Bounded retries for a failed train-step dispatch (serving's defaults).
+    dispatch_retry: Optional[RetryPolicy] = None
+    # Deterministic chaos source (trainer/faults.py); None = all hooks no-op.
+    fault_injector: Optional[Any] = None
+    # Where emergency/preemption checkpoints go; falls back to the first
+    # CheckpointCallback's directory.
+    emergency_dir: Optional[str] = None
+    # DEGRADED window after an anomaly skip / dispatch retry.
+    degraded_cooldown_steps: int = 20
+    # Install SIGTERM/SIGINT graceful-preemption handlers during fit()
+    # (main thread only; a second signal falls through to the original
+    # handler).
+    handle_signals: bool = True
+
     step: int = 0
     state: Any = None
     steps_run: int = 0  # steps executed by the last fit() (excludes resumed ones)
+    # Host-mirrored robustness counters. dispatch_retries /
+    # emergency_checkpoints / callback_errors accumulate across the
+    # Trainer's life; anomaly_skips MIRRORS the checkpoint-carried device
+    # counter the budget reads — it continues across resume_from but
+    # restarts with the fresh guard state of a new (non-resumed) fit.
+    anomaly_skips: int = 0
+    dispatch_retries: int = 0
+    emergency_checkpoints: int = 0
+    callback_errors: int = 0
+    tokens_seen: int = 0
+    train_seconds: float = 0.0
+    halt_reason: Optional[str] = None
+    preempted: bool = False
+
+    # --- health -------------------------------------------------------------
+
+    def health(self) -> TrainerHealth:
+        """Current health (``OK/DEGRADED/HALTED``)."""
+        if self.halt_reason is not None:
+            return TrainerHealth.HALTED
+        last = getattr(self, "_last_fault_step", None)
+        if last is not None and self.step - last < self.degraded_cooldown_steps:
+            return TrainerHealth.DEGRADED
+        return TrainerHealth.OK
+
+    # --- exact-resume payload -----------------------------------------------
+
+    def step_rng(self) -> jax.Array:
+        """Deterministic per-step key — ``fold_in(base, step)``. The base
+        key is checkpointed, so a resumed run's step keys are identical to
+        the uninterrupted run's."""
+        return jax.random.fold_in(self._rng_base, self.step)
+
+    def checkpoint_user_content(self, extra: Optional[dict] = None) -> dict:
+        """The exact-resume payload every checkpoint carries: step, base
+        RNG key, data-iterator cursor, and throughput/step bookkeeping."""
+        uc = {
+            "step": int(self.step),
+            "tokens_seen": int(self.tokens_seen),
+            "train_seconds": float(
+                self.train_seconds
+                + (time.perf_counter() - getattr(self, "_fit_t0", time.perf_counter()))
+            ),
+            "anomaly_skips": int(self.anomaly_skips),
+            "dispatch_retries": int(self.dispatch_retries),
+        }
+        base = getattr(self, "_rng_base", None)
+        if base is not None:
+            raw = base
+            if jnp.issubdtype(raw.dtype, jax.dtypes.prng_key):
+                raw = jax.random.key_data(raw)
+            uc["rng_key"] = np.asarray(raw).astype(np.uint32).tolist()
+        src = getattr(self, "_data_source", None)
+        if src is not None:
+            # a checkpoint written MID-step (emergency halt from a failed
+            # dispatch) or while a pulled batch is still PENDING (preemption
+            # before the first dispatch — the shape probe was drawn but
+            # never trained) must point at the batch the next step was
+            # GOING to train on: the live cursor is one ahead of the truth
+            if getattr(self, "_mid_step", False) or getattr(
+                self, "_pending_untrained", False
+            ):
+                uc["data_state"] = self._data_state_prepull
+            else:
+                uc["data_state"] = src.state()
+        guard = getattr(self.state, "guard", None) if self.state is not None else None
+        if guard is not None:
+            # the anomaly-guard carry rides the checkpoint: without it a
+            # resumed run re-warms the spike EMA from zero and diverges
+            # from the uninterrupted run at the next spike (and the device
+            # skips counter the budget reads would restart at 0)
+            uc["guard"] = {
+                "gnorm_ema": float(np.asarray(guard["gnorm_ema"])),
+                "good_steps": int(np.asarray(guard["good_steps"])),
+                "skips": int(np.asarray(guard["skips"])),
+            }
+        if extra:
+            uc.update(extra)
+        return uc
+
+    def save_tagged_checkpoint(
+        self,
+        checkpoint_dir: str,
+        tag: str,
+        *,
+        extra: Optional[dict] = None,
+        num_kept: Optional[int] = None,
+        async_save: bool = False,
+    ) -> None:
+        """The one save path every tagged checkpoint goes through —
+        periodic (:class:`CheckpointCallback`), graceful preemption, and
+        emergency halt all write the same item set and the full
+        exact-resume ``user_content``, then run the post-save hooks."""
+        save_checkpoint(
+            checkpoint_dir, tag=tag,
+            items={"model": self.state.params, "optimizer": self.state.opt_state},
+            user_content=self.checkpoint_user_content(extra),
+            num_kept_ckpts=num_kept,
+            async_save=async_save,
+        )
+        if (
+            async_save
+            and self.fault_injector is not None
+            and getattr(self.fault_injector, "pending_corruption", lambda _: False)(tag)
+        ):
+            # a scheduled corrupt_checkpoint must hit a COMMITTED save —
+            # drain the async commit first (chaos-only; clean saves keep
+            # the non-blocking path)
+            from neuronx_distributed_tpu.trainer.checkpoint import (
+                finalize_checkpoints,
+            )
+
+            finalize_checkpoints()
+        self.notify_checkpoint_saved(checkpoint_dir, tag)
+
+    def notify_checkpoint_saved(self, checkpoint_dir: str, tag: str) -> None:
+        """Post-save hook: timeline instant + fault-injector consultation
+        (``corrupt_checkpoint`` fires here)."""
+        tl = getattr(self, "_tl", None)
+        if tl is not None:
+            tl.instant("checkpoint", "trainer", args={"tag": tag})
+        if self.fault_injector is not None:
+            self.fault_injector.on_checkpoint_saved(checkpoint_dir, tag)
+
+    def _checkpoint_dir(self) -> Optional[str]:
+        if self.emergency_dir is not None:
+            return self.emergency_dir
+        for cb in self.callbacks:
+            d = getattr(cb, "checkpoint_dir", None)
+            if d is not None:
+                return d
+        return None
+
+    # --- fault machinery ----------------------------------------------------
+
+    def _save_emergency_checkpoint(self, reason: str) -> Optional[str]:
+        d = self._checkpoint_dir()
+        if d is None:
+            logger.warning(
+                "halting without an emergency checkpoint — no checkpoint "
+                "directory known (set Trainer.emergency_dir)"
+            )
+            return None
+        tag = f"emergency_step_{self.step}"
+        self.save_tagged_checkpoint(d, tag, extra={"emergency": reason})
+        self.emergency_checkpoints += 1
+        self._tl.instant("emergency_checkpoint", "trainer", args={"tag": tag})
+        logger.warning("emergency checkpoint '%s' written to %s", tag, d)
+        return tag
+
+    def _halt(self, reason: str, save: bool = True) -> None:
+        self.halt_reason = reason
+        tag = self._save_emergency_checkpoint(reason) if save else None
+        self._tl.instant("halted", "trainer", args={"reason": reason})
+        logger.error("training HALTED: %s", reason)
+        raise TrainerHalted(reason, emergency_tag=tag)
+
+    @staticmethod
+    def _state_consumed(state) -> bool:
+        return any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree.leaves(state)
+        )
+
+    def _dispatch(self, train_step, prepared):
+        """Run one train-step dispatch with bounded recovery: a host-side
+        failure leaves the donated buffers unconsumed, so the retry runs
+        against the last known-good ``(state, step)`` snapshot —
+        ``self.state``, unchanged since the last successful step. Bounded
+        CONSECUTIVE failures (or consumed buffers, which make retry
+        impossible) halt with an emergency checkpoint."""
+        policy = self._dispatch_policy
+        while True:
+            attempt = self._dispatch_attempts
+            self._dispatch_attempts += 1
+            try:
+                if self.fault_injector is not None:
+                    self.fault_injector.on_dispatch(attempt)
+                out = train_step(self.state, prepared)
+                if self._consecutive_dispatch_failures:
+                    self._tl.instant(
+                        "recovery", "trainer",
+                        args={"after_failures": self._consecutive_dispatch_failures,
+                              "step": self.step},
+                    )
+                    self._consecutive_dispatch_failures = 0
+                return out
+            except KeyboardInterrupt:
+                raise
+            except (TypeError, ValueError, NotImplementedError):
+                raise  # deterministic programming errors — retrying is noise
+            except Exception as e:
+                n = self._consecutive_dispatch_failures = (
+                    self._consecutive_dispatch_failures + 1
+                )
+                self.dispatch_retries += 1
+                self._last_fault_step = self.step
+                self._tl.instant(
+                    "dispatch_failure", "trainer",
+                    args={"error": str(e)[:200], "consecutive": n,
+                          "step": self.step},
+                )
+                logger.warning(
+                    "train-step dispatch failed at step %d (%s: %s) — "
+                    "consecutive failure %d/%d",
+                    self.step, type(e).__name__, e, n, policy.max_attempts,
+                )
+                if self._state_consumed(self.state):
+                    # the donated buffers are gone: nothing to retry with
+                    # and nothing to checkpoint — resume from the last
+                    # on-disk checkpoint instead
+                    self._halt(
+                        f"dispatch failed with consumed donated buffers "
+                        f"({type(e).__name__}: {e}) — resume from the last "
+                        "checkpoint",
+                        save=False,
+                    )
+                if n >= policy.max_attempts:
+                    self._halt(
+                        f"{n} consecutive dispatch failures "
+                        f"(last: {type(e).__name__}: {e})"
+                    )
+                # shared decrementing-jitter wait (0-based attempt index)
+                time.sleep(policy.wait(n - 1))
+
+    def _account_guard(self) -> None:
+        """Budget accounting for the DEFERRED guard flags: reads the
+        previous step's ``(good_step, anomaly_skips)`` scalars AFTER the
+        next step has been dispatched, so the tiny readback overlaps device
+        compute — the clean path adds no stall, and the jitted step itself
+        never syncs. Detection therefore lags one step; an anomalous step
+        is already harmless (its update was skipped on device)."""
+        pending = self._pending_guard
+        if pending is None:
+            return
+        self._pending_guard = None
+        at_step, good_dev, skips_dev = pending
+        try:
+            good, skips = jax.device_get((good_dev, skips_dev))
+        except (KeyboardInterrupt, TrainerHalted):
+            raise
+        except Exception as e:
+            # async dispatch means a DEVICE-side execution failure surfaces
+            # here, not at the dispatch call — the step's outputs (now
+            # self.state) are poisoned and the donated inputs are gone, so
+            # there is nothing to retry or checkpoint: halt for cause
+            # instead of leaking a raw backend error past the halt/
+            # on_train_end machinery
+            self._halt(
+                f"train-step execution failed (surfaced at the deferred "
+                f"guard readback): {type(e).__name__}: {e} — resume from "
+                "the last checkpoint",
+                save=False,
+            )
+        skips = int(skips)
+        if not bool(good):
+            self._last_fault_step = self.step
+            self._tl.instant(
+                "anomaly_skip", "trainer",
+                args={"step": at_step, "skips": skips},
+            )
+            logger.warning(
+                "anomalous step %d skipped on device (%d skips total)",
+                at_step, skips,
+            )
+        self.anomaly_skips = skips
+        budget = self.anomaly_guard.budget if self.anomaly_guard else None
+        if budget is not None and skips > budget:
+            self._halt(
+                f"anomaly budget exceeded: {skips} skipped steps > "
+                f"budget {budget}"
+            )
+
+    # --- signals ------------------------------------------------------------
+
+    def _install_signal_handlers(self) -> dict:
+        self._preempt_signum = None
+        if not self.handle_signals:
+            return {}
+        if threading.current_thread() is not threading.main_thread():
+            return {}  # signal.signal is main-thread-only
+        orig = {}
+
+        def handler(signum, frame):
+            if self._preempt_signum is None:
+                self._preempt_signum = signum
+                logger.warning(
+                    "signal %d received — finishing the in-flight step, "
+                    "checkpointing, then exiting cleanly (send again to "
+                    "force)", signum,
+                )
+            else:  # second signal: fall through to the original behavior
+                prev = orig.get(signum)
+                if prev is None:  # None = handler installed by non-Python
+                    prev = _signal.SIG_DFL  # code — closest we can restore
+                _signal.signal(signum, prev)
+                os.kill(os.getpid(), signum)
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                orig[sig] = _signal.signal(sig, handler)
+            except (ValueError, OSError):  # non-main thread or exotic host
+                pass
+        return orig
+
+    def _restore_signal_handlers(self, orig: dict) -> None:
+        for sig, h in orig.items():
+            if h is None:
+                # signal.signal returned None for a handler installed by
+                # non-Python code (embedded interpreter) — there is nothing
+                # Python can restore it to; leave ours in place rather
+                # than crash the fit epilogue with a TypeError
+                continue
+            try:
+                _signal.signal(sig, h)
+            except (ValueError, OSError, TypeError):
+                pass
+
+    def _graceful_preempt(self) -> None:
+        """The in-flight step finished; write a final tagged checkpoint
+        through the done-marker protocol and leave the loop cleanly."""
+        self.preempted = True
+        d = self._checkpoint_dir()
+        tag = f"step_{self.step}"
+        if d is not None:
+            from neuronx_distributed_tpu.trainer.checkpoint import (
+                DONE_MARKER,
+                create_checkpoint_storage,
+                finalize_checkpoints,
+            )
+
+            finalize_checkpoints()  # a pending async save of this tag wins
+            storage = create_checkpoint_storage(d)
+            if not storage.file_exists(os.path.join(tag, DONE_MARKER)):
+                self.save_tagged_checkpoint(
+                    d, tag,
+                    extra={"preempted": int(self._preempt_signum or 0)},
+                )
+        self._tl.instant(
+            "preempted", "trainer",
+            args={"signal": int(self._preempt_signum or 0), "step": self.step},
+        )
+        logger.warning(
+            "preempted by signal %s at step %d — checkpoint %s; exiting "
+            "cleanly", self._preempt_signum, self.step,
+            tag if d is not None else "SKIPPED (no checkpoint dir)",
+        )
+
+    # --- callbacks ------------------------------------------------------------
+
+    def _safe_callback(self, cb, method: str, *args) -> None:
+        """One misbehaving callback must not kill the run: exceptions are
+        logged with the callback's class name, counted in
+        ``callback_errors``, and swallowed — and because each callback is
+        isolated individually, ``on_train_end`` still reaches every one."""
+        try:
+            getattr(cb, method)(*args)
+        except (KeyboardInterrupt, TrainerHalted):
+            raise
+        except Exception as e:
+            self.callback_errors += 1
+            # a failing callback counts as a fault for health(): a broken
+            # CheckpointCallback save means the run is progressing WITHOUT
+            # durable checkpoints — unattended monitoring must see
+            # DEGRADED, not OK, for as long as the errors keep coming
+            self._last_fault_step = self.step
+            self._tl.instant(
+                "callback_error", "trainer",
+                args={"callback": type(cb).__name__, "hook": method,
+                      "error": str(e)[:200]},
+            )
+            logger.exception(
+                "callback %s.%s raised (%s: %s) — training continues",
+                type(cb).__name__, method, type(e).__name__, e,
+            )
+
+    # --- the loop -------------------------------------------------------------
 
     def fit(
         self,
@@ -213,17 +716,43 @@ class Trainer:
         resume_from: Optional[str] = None,
     ) -> dict:
         """Run ``max_steps`` over ``data_iter`` (an iterable of host batches
-        with at least ``input_ids``/``labels``). Returns the last metrics."""
+        with at least ``input_ids``/``labels``). Returns the last metrics.
+
+        Raises :class:`TrainerHalted` when the anomaly or dispatch-retry
+        budget is exhausted (after writing an emergency checkpoint and
+        running every callback's ``on_train_end``)."""
         from neuronx_distributed_tpu.parallel import mesh as mesh_lib
 
         if not mesh_lib.model_parallel_is_initialized():
             # data-parallel-only default (reference neuronx_distributed_config
             # initializes parallel state the same way when sizes are 1)
             mesh_lib.initialize_model_parallel()
+        # exact-resume data protocol: the SOURCE object carries the cursor
+        self._data_source = (
+            data_iter
+            if hasattr(data_iter, "state") and hasattr(data_iter, "restore")
+            else None
+        )
+        self._mid_step = False
+        self._data_state_prepull = (
+            self._data_source.state() if self._data_source is not None else None
+        )
         data_iter = iter(data_iter)
         self.steps_run = 0  # per-fit counter (profiler window + throughput)
         self._eval_step = None  # rebuilt lazily against this fit's wiring
         self._eval_prepare = None
+        self.halt_reason = None
+        self.preempted = False
+        self._rng_base = rng_key
+        self._dispatch_attempts = 0
+        self._consecutive_dispatch_failures = 0
+        self._last_fault_step = None
+        self._pending_guard = None
+        self._dispatch_policy = self.dispatch_retry or RetryPolicy(
+            max_attempts=3, first_wait=0.05, min_wait=0.01
+        )
+        self._tl = tl = self.timeline or Timeline(None)
+        inj = self.fault_injector
         first = sample_batch if sample_batch is not None else next(data_iter)
         optimizer = make_optimizer(self.optimizer_config)
         if self.pipeline is not None and self.optimizer_config.grad_accum_steps > 1:
@@ -231,6 +760,15 @@ class Trainer:
                 "grad_accum_steps does not apply under a pipeline adapter — "
                 "pipeline microbatches already accumulate; raise "
                 "num_microbatches instead"
+            )
+        guard_cfg = self.anomaly_guard if self.pipeline is None else None
+        if self.pipeline is not None and not hasattr(jax, "shard_map"):
+            # fail fast with the compat gate's message instead of burning
+            # dispatch retries on a deterministic trace-time error
+            raise RuntimeError(
+                "pipeline parallelism requires jax >= 0.5 (this jax's "
+                "partial-manual CollectivePermute lowering crashes XLA); "
+                "run with pp=1 on this installation"
             )
         if self.pipeline is not None:
             self.state, train_step, engine = self.pipeline.build_state_and_step(
@@ -251,7 +789,10 @@ class Trainer:
                 max_grad_norm=self.optimizer_config.max_grad_norm,
                 loss_fn=self.loss_fn,
                 grad_accum_steps=accum,
+                anomaly_guard=guard_cfg,
             )
+            if guard_cfg is not None:
+                self.state = self.state.replace(guard=init_anomaly_guard_state())
             if accum > 1:
                 from neuronx_distributed_tpu.pipeline.model import (
                     microbatch,
@@ -262,55 +803,182 @@ class Trainer:
                     return shard_microbatched_batch(microbatch(batch, accum))
             else:
                 prepare = shard_batch
+        # exposed for the compile-budget guard (one program must serve clean
+        # AND anomalous batches — tests/trainer/test_faults.py)
+        self._train_step = train_step
+        pending = first if sample_batch is None else None
+        # the probe pull advanced the cursor past a batch nothing has
+        # trained on yet — checkpoints written before it is consumed must
+        # save the pre-pull cursor (checkpoint_user_content)
+        self._pending_untrained = pending is not None
         if resume_from is not None:
             from neuronx_distributed_tpu.trainer.checkpoint import (
                 latest_checkpoint_tag,
                 load_checkpoint,
             )
 
-            if latest_checkpoint_tag(resume_from) is not None:
+            # resolve the newest COMPLETED tag once (this walk also repairs
+            # a corrupt `newest` pointer) and hand it to load_checkpoint —
+            # passing no tag would redo the same walk
+            tag = latest_checkpoint_tag(resume_from)
+            if tag is not None:
                 items, user_content, tag = load_checkpoint(
                     resume_from,
+                    tag=tag,
                     items_target={
                         "model": self.state.params,
                         "optimizer": self.state.opt_state,
                     },
                 )
+                if not hasattr(jax, "shard_map"):
+                    # jax < 0.5 only: a persistent-cache-deserialized CPU
+                    # executable corrupts the heap when it DONATES buffers
+                    # that tensorstore materialized (reproduced: resume +
+                    # warm compilation cache + first dispatch). Re-own the
+                    # restored trees in fresh XLA buffers — jnp.copy is
+                    # bit-exact, so resume stays bit-identical.
+                    items = jax.tree.map(jnp.copy, items)
                 self.state = self.state.replace(
                     params=items["model"], opt_state=items["optimizer"]
                 )
-                self.step = int((user_content or {}).get("step", 0))
+                uc = user_content or {}
+                self.step = int(uc.get("step", 0))
+                # the device step scalar drives nothing numerically (the LR
+                # schedule reads the optimizer count) but must agree for
+                # bit-identical bookkeeping
+                self.state = self.state.replace(
+                    step=jax.device_put(
+                        jnp.asarray(self.step, self.state.step.dtype),
+                        jax.sharding.NamedSharding(
+                            mesh_lib.get_mesh(),
+                            jax.sharding.PartitionSpec(),
+                        ),
+                    )
+                )
+                if uc.get("rng_key") is not None:
+                    self._rng_base = jnp.asarray(uc["rng_key"], jnp.uint32)
+                self.tokens_seen = int(uc.get("tokens_seen", self.tokens_seen))
+                self.train_seconds = float(
+                    uc.get("train_seconds", self.train_seconds)
+                )
+                gc = uc.get("guard")
+                if gc is not None and self.state.guard is not None:
+                    # restore the anomaly-guard carry (EMA, warmup count,
+                    # device skips counter) so spike detection and budget
+                    # accounting continue exactly where the interrupted
+                    # run left off — built by the same owner as the fresh
+                    # tree so the layout always matches what the jitted
+                    # step was traced with
+                    self.state = self.state.replace(
+                        guard=init_anomaly_guard_state(gc)
+                    )
+                    self.anomaly_skips = int(gc["skips"])
+                self.dispatch_retries = int(
+                    uc.get("dispatch_retries", self.dispatch_retries)
+                )
+                ds = uc.get("data_state")
+                if ds is not None and self._data_source is not None:
+                    self._data_source.restore(ds)
+                    self._data_state_prepull = ds
+                    # the shape-probe batch was drawn from the PRE-restore
+                    # cursor — drop it; the next pull follows the cursor
+                    pending = None
+                    self._pending_untrained = False
                 logger.info("resumed from '%s' at step %d", tag, self.step)
         meter = ThroughputMeter(batch_size=first["input_ids"].shape[0])
+        batch_tokens = int(np.prod(np.asarray(first["input_ids"]).shape))
         for cb in self.callbacks:
-            cb.on_train_start(self)
-        tl = self.timeline or Timeline(None)
+            self._safe_callback(cb, "on_train_start", self)
         metrics = {}
-        pending = first if sample_batch is None else None
         profiling = False
-        while self.step < max_steps:
-            batch = pending if pending is not None else next(data_iter)
-            pending = None
-            if self.profile_dir is not None:
-                if self.steps_run == 2 and not profiling:
-                    jax.profiler.start_trace(self.profile_dir)
-                    profiling = True
-                elif self.steps_run == 5 and profiling:
-                    jax.profiler.stop_trace()
-                    profiling = False
-            with tl.event("train_step"):
-                self.state, metrics = train_step(self.state, prepare(batch))
-            self.step += 1
-            self.steps_run += 1
-            metrics = dict(metrics)
-            metrics["throughput_seq_s"] = meter.update()
-            for cb in self.callbacks:
-                cb.on_step_end(self, metrics)
-        if profiling:
-            jax.profiler.stop_trace()
+        self._fit_t0 = time.perf_counter()
+        orig_handlers = self._install_signal_handlers()
+        halted: Optional[TrainerHalted] = None
+        error: Optional[BaseException] = None
+        try:
+            while self.step < max_steps:
+                if inj is not None:
+                    inj.on_step_start(self.step)
+                if self._preempt_signum is not None:
+                    self._graceful_preempt()
+                    break
+                if pending is not None:
+                    batch = pending
+                    pending = None
+                    # the probe batch is now entering training; from here
+                    # _mid_step/_data_state_prepull carry the truth
+                    self._pending_untrained = False
+                else:
+                    if self._data_source is not None:
+                        self._data_state_prepull = self._data_source.state()
+                    batch = next(data_iter)
+                # the batch has left the iterator: from here until the
+                # dispatch lands, any exit (corrupt_batch raising, profiler
+                # failure, dispatch halt) must checkpoint the PRE-pull
+                # cursor or resume would silently skip this batch
+                self._mid_step = True
+                if inj is not None:
+                    batch = inj.corrupt_batch(self.step, batch)
+                if self.profile_dir is not None:
+                    if self.steps_run == 2 and not profiling:
+                        jax.profiler.start_trace(self.profile_dir)
+                        profiling = True
+                    elif self.steps_run == 5 and profiling:
+                        jax.profiler.stop_trace()
+                        profiling = False
+                with tl.event("train_step"):
+                    self.state, metrics = self._dispatch(
+                        train_step, prepare(batch)
+                    )
+                self._mid_step = False
+                self.step += 1
+                self.steps_run += 1
+                self.tokens_seen += batch_tokens
+                # budget-check the PREVIOUS step's guard flags now that this
+                # step is dispatched — the readback overlaps device compute
+                self._account_guard()
+                metrics = dict(metrics)
+                metrics["throughput_seq_s"] = meter.update()
+                metrics["dispatch_retries"] = self.dispatch_retries
+                metrics["emergency_checkpoints"] = self.emergency_checkpoints
+                metrics["callback_errors"] = self.callback_errors
+                if guard_cfg is not None:
+                    self._pending_guard = (
+                        self.step - 1,
+                        metrics["good_step"],
+                        metrics["anomaly_skips"],
+                    )
+                for cb in self.callbacks:
+                    self._safe_callback(cb, "on_step_end", self, metrics)
+                if self._preempt_signum is not None:
+                    self._graceful_preempt()
+                    break
+            self._account_guard()  # the final step's flags
+        except TrainerHalted as e:
+            halted = e
+        except KeyboardInterrupt:
+            raise  # force-exit: skip the epilogue, the user wants OUT now
+        except BaseException as e:
+            # any other failure (preemption-save IOError, deterministic
+            # dispatch error, ...) still owes the callbacks their
+            # on_train_end (TensorBoard flush, async-save drain) and the
+            # timeline its save — run the epilogue, then re-raise
+            error = e
+        finally:
+            self.train_seconds += time.perf_counter() - self._fit_t0
+            # re-anchor so later checkpoint_user_content calls (e.g. the
+            # save_on_end path) don't double-count the elapsed wall
+            self._fit_t0 = time.perf_counter()
+            self._restore_signal_handlers(orig_handlers)
+            if profiling:
+                jax.profiler.stop_trace()
         for cb in self.callbacks:
-            cb.on_train_end(self)
+            self._safe_callback(cb, "on_train_end", self)
         tl.save()
+        if error is not None:
+            raise error
+        if halted is not None:
+            raise halted
         return metrics
 
     def evaluate(self, data_iter: Iterable[dict], max_steps: int) -> dict:

@@ -60,6 +60,53 @@ class OptimizerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AnomalyGuardConfig:
+    """On-device anomaly detection for the jitted train step (reference
+    analogue: the reference's loss-NaN guards around NxDOptimizer.step;
+    here the *skip decision* lives inside the step so the clean path pays
+    no host round-trip).
+
+    A step is anomalous when its loss or pre-clip grad norm is non-finite,
+    or — after ``warmup_steps`` good steps have warmed the running norm —
+    when the pre-clip grad norm exceeds ``spike_factor ×`` the EMA of past
+    good steps' norms. Anomalous steps keep params AND optimizer state
+    bit-identical (per-leaf ``where`` select on device); the EMA only
+    learns from good steps, so one spike cannot normalize the next.
+
+    ``budget`` bounds TOTAL skipped steps on the host side: exceeding it
+    halts training with an emergency checkpoint instead of silently
+    skipping forever (``None`` = unbounded). The guard carry — EMA, warmup
+    count, and the device skips counter the budget reads — rides every
+    checkpoint, so a resumed run detects and budgets exactly as the
+    uninterrupted run would (preemption cycling cannot reset the budget)."""
+
+    spike_factor: float = 10.0
+    warmup_steps: int = 10
+    ema_decay: float = 0.95
+    budget: Optional[int] = 25
+
+
+def init_anomaly_guard_state(values=None):
+    """Replicated guard-state tree carried inside ``TrainState.guard`` —
+    zeros for a fresh run, or the checkpointed carry (``values`` keyed like
+    the tree) on resume. The one owner of the tree's structure/sharding:
+    loop.py's resume path must build the exact layout the jitted step was
+    traced with."""
+    mesh = mesh_lib.get_mesh()
+    repl = NamedSharding(mesh, P())
+    values = values or {}
+
+    def leaf(name, dtype):
+        return jax.device_put(jnp.asarray(values.get(name, 0), dtype), repl)
+
+    return {
+        "gnorm_ema": leaf("gnorm_ema", jnp.float32),
+        "good_steps": leaf("good_steps", jnp.int32),
+        "skips": leaf("skips", jnp.int32),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainingConfig:
     """Typed replacement for the reference's normalized config dict
     (trainer/trainer.py:32-144)."""
@@ -75,6 +122,10 @@ class TrainState(struct.PyTreeNode):
     step: jax.Array
     params: Any
     opt_state: Any
+    # anomaly-guard carry ({"gnorm_ema", "good_steps", "skips"} replicated
+    # scalars) when build_train_step runs with an AnomalyGuardConfig; None
+    # (an empty pytree) otherwise — existing constructors stay valid.
+    guard: Any = None
 
 
 def neuronx_distributed_tpu_config(
@@ -247,11 +298,21 @@ def build_train_step(
     loss_fn: Optional[Callable] = None,
     value_and_grad_fn: Optional[Callable] = None,
     grad_accum_steps: int = 1,
+    anomaly_guard: Optional[AnomalyGuardConfig] = None,
 ):
     """One jitted SPMD train step: fwd → bwd → clip → update
     (reference: the whole NxDOptimizer.step pipeline, trainer/optimizer.py:122).
     State is donated; shardings are pinned so ZeRO-1 layout persists across
     steps instead of being renegotiated by the partitioner.
+
+    With ``anomaly_guard`` set, the step additionally computes a
+    ``good_step`` flag ON DEVICE (finite loss/grad-norm, no grad-norm spike
+    vs the EMA carried in ``state.guard``) and selects the applied update
+    per-leaf with it — an anomalous step leaves params and optimizer state
+    bit-identical with NO host round-trip and NO recompile (one program
+    serves clean and anomalous batches; the clean path's only cost is the
+    selects). Metrics gain ``good_step`` and the cumulative device
+    ``anomaly_skips`` counter the host budgets against.
     """
     from neuronx_distributed_tpu.optim.zero1 import (
         build_explicit_zero1_update,
@@ -283,8 +344,14 @@ def build_train_step(
             value_and_grad_fn = jax.value_and_grad(loss_fn)
     mesh = mesh_lib.get_mesh()
     repl = NamedSharding(mesh, P())
+    guard_shardings = (
+        {"gnorm_ema": repl, "good_steps": repl, "skips": repl}
+        if anomaly_guard is not None
+        else None
+    )
     state_shardings = TrainState(
-        step=repl, params=params_shardings, opt_state=opt_state_shardings
+        step=repl, params=params_shardings, opt_state=opt_state_shardings,
+        guard=guard_shardings,
     )
     # Under pipeline parallelism the GSPMD zero-1 formulation crashes the XLA
     # partitioner (see build_explicit_zero1_update); route the update through
@@ -309,10 +376,46 @@ def build_train_step(
                 grads, state.opt_state, state.params
             )
             new_params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
-        )
         metrics = {"loss": loss, "grad_norm": grad_norm}
+        new_guard = state.guard
+        if anomaly_guard is not None:
+            g = state.guard
+            finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            warmed = g["good_steps"] >= anomaly_guard.warmup_steps
+            # spike check on the PRE-clip norm (clipping would mask it)
+            spike = warmed & (
+                grad_norm > anomaly_guard.spike_factor * g["gnorm_ema"]
+            )
+            good = finite & ~spike
+            # anomalous step: keep params AND opt state bit-identical —
+            # the whole decision stays on device, no host sync, and one
+            # program serves both outcomes (no recompile on anomaly)
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(good, n, o), new_params, state.params
+            )
+            new_opt_state = jax.tree.map(
+                lambda n, o: jnp.where(good, n, o),
+                new_opt_state, state.opt_state,
+            )
+            d = anomaly_guard.ema_decay
+            ema = jnp.where(
+                g["good_steps"] == 0,
+                grad_norm,
+                d * g["gnorm_ema"] + (1.0 - d) * grad_norm,
+            )
+            new_guard = {
+                # the EMA learns only from good steps — a spike that got
+                # skipped must not normalize the next one
+                "gnorm_ema": jnp.where(good, ema, g["gnorm_ema"]),
+                "good_steps": g["good_steps"] + good.astype(jnp.int32),
+                "skips": g["skips"] + (1 - good.astype(jnp.int32)),
+            }
+            metrics["good_step"] = good
+            metrics["anomaly_skips"] = new_guard["skips"]
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state,
+            guard=new_guard,
+        )
         return new_state, metrics
 
     return jax.jit(
@@ -323,11 +426,20 @@ def build_train_step(
     )
 
 
+def committed_step0() -> jax.Array:
+    """The initial step scalar, COMMITTED-replicated like every later step's
+    output — an uncommitted ``zeros()`` makes the second call's signature
+    differ and silently recompiles the whole train step once."""
+    return jax.device_put(
+        jnp.zeros((), jnp.int32), NamedSharding(mesh_lib.get_mesh(), P())
+    )
+
+
 def create_train_state(model, optimizer, rng_key, *sample_args, zero1: bool = True):
     """Convenience: materialize params + opt state, return (state, train_step_builder_args)."""
     params, p_shardings = initialize_parallel_model(model, rng_key, *sample_args)
     opt_state, s_shardings = initialize_parallel_optimizer(
         optimizer, params, p_shardings, zero1=zero1
     )
-    state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+    state = TrainState(step=committed_step0(), params=params, opt_state=opt_state)
     return state, p_shardings, s_shardings

@@ -81,6 +81,29 @@ def test_corrupted_tag_cleanup(tp4_mesh, tmp_path):
     assert latest_checkpoint_tag(d) == "step_3"
 
 
+def test_newest_pointer_fallback_cleans_corrupt_tag(tp4_mesh, tmp_path):
+    """Satellite: a ``newest`` pointer whose tag lost its done marker
+    (killed mid-save) falls back to the newest COMPLETED tag, removes the
+    corrupt leftover, and repoints ``newest`` — load_checkpoint never
+    trusts the pointer blindly."""
+    d = str(tmp_path)
+    tree = _tree(tp4_mesh)
+    save_checkpoint(d, "step_2", items={"model": tree}, user_content={"step": 2})
+    save_checkpoint(d, "step_4", items={"model": tree}, user_content={"step": 4})
+    # kill-mid-save: step_4 committed, then its done marker vanishes while
+    # `newest` still points at it
+    os.remove(os.path.join(d, "step_4", DONE_MARKER))
+    assert latest_checkpoint_tag(d) == "step_2"
+    assert not os.path.isdir(os.path.join(d, "step_4"))  # corrupt tag removed
+    with open(os.path.join(d, "newest")) as f:
+        assert f.read().strip() == "step_2"  # pointer repaired
+    items, user, tag = load_checkpoint(d)
+    assert tag == "step_2" and user == {"step": 2}
+    np.testing.assert_array_equal(
+        np.asarray(items["model"]["w"]), np.asarray(tree["w"])
+    )
+
+
 def test_async_save(tp4_mesh, tmp_path):
     d = str(tmp_path)
     tree = _tree(tp4_mesh)

@@ -96,6 +96,53 @@ def test_packed_corpus_prepacked_2d(tmp_path):
         PackedCorpus(str(path), seq_len=8, batch_size=2)
 
 
+def test_packed_corpus_state_restore_exact(tmp_path):
+    """Exact-resume protocol: the cursor round-trips mid-epoch and across
+    the epoch boundary, and a restore repositions an ALREADY-CREATED
+    iterator (the trainer restores after pulling a shape-probe batch)."""
+    path = _write_stream(tmp_path, n=600)  # 35 windows → 8 batches/epoch
+    a = PackedCorpus(path, seq_len=16, batch_size=4, seed=7)
+    it = iter(a)
+    seen = [next(it) for _ in range(5)]
+    st = a.state()
+    assert st == {"epoch": 0, "batch": 5}
+    expect = [next(it) for _ in range(7)]  # crosses into epoch 1
+
+    b = PackedCorpus(path, seq_len=16, batch_size=4, seed=7)
+    it_b = iter(b)
+    next(it_b)  # shape-probe pull from the WRONG position...
+    b.restore(st)  # ...then the trainer restores the checkpointed cursor
+    got = [next(it_b) for _ in range(7)]
+    for e, g in zip(expect, got):
+        np.testing.assert_array_equal(e["input_ids"], g["input_ids"])
+        np.testing.assert_array_equal(e["labels"], g["labels"])
+    assert b.state() == a.state()
+    assert b.state()["epoch"] == 1  # crossed the boundary identically
+
+
+def test_synthetic_tokens_state_restore(tmp_path):
+    """SyntheticTokens: seeded infinite stream, O(1) cursor restore, and
+    the always-on loss_mask the chaos injector relies on."""
+    from neuronx_distributed_tpu.trainer.data import SyntheticTokens
+
+    a = SyntheticTokens(vocab_size=97, batch_size=4, seq_len=8, seed=5)
+    it = iter(a)
+    for _ in range(3):
+        next(it)
+    st = a.state()
+    want = next(it)
+    b = SyntheticTokens(vocab_size=97, batch_size=4, seq_len=8, seed=5)
+    b.restore(st)
+    got = next(iter(b))
+    np.testing.assert_array_equal(want["input_ids"], got["input_ids"])
+    np.testing.assert_array_equal(want["labels"], got["labels"])
+    assert got["loss_mask"].shape == (4, 8) and (got["loss_mask"] == 1).all()
+    # labels are the shifted ids (same window)
+    np.testing.assert_array_equal(
+        got["labels"][:, :-1], got["input_ids"][:, 1:]
+    )
+
+
 def test_train_example_on_packed_corpus(tmp_path):
     """Loss-curve sanity (the 'done' criterion): the example trains from a
     packed corpus file and the loss drops fast on a highly regular stream."""

@@ -1,0 +1,553 @@
+"""Trainer chaos suite: deterministic fault injection against the training
+loop's unattended-safety contract (the serving suite's training-side twin —
+tests/serving/test_faults.py proves the same contract for the decode path).
+
+Proves the ISSUE's acceptance triad: (a) kill-and-resume reproduces the
+uninterrupted run's loss sequence bit-identically (RNG + data cursor
+restored), (b) an injected NaN/spike step is skipped with params and
+optimizer state unchanged while training continues, (c) bounded dispatch
+failures recover with no step lost, and exceeding the budget halts with a
+valid emergency checkpoint that loads — plus the compile/host-sync budget:
+one program serves clean and anomalous batches, and the guard's only host
+traffic is one tiny deferred scalar readback per step."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.trainer import AnomalyGuardConfig, OptimizerConfig
+from neuronx_distributed_tpu.trainer.checkpoint import (
+    DONE_MARKER,
+    latest_checkpoint_tag,
+    load_checkpoint,
+)
+from neuronx_distributed_tpu.trainer.data import SyntheticTokens
+from neuronx_distributed_tpu.trainer.faults import FaultInjector
+from neuronx_distributed_tpu.trainer.loop import (
+    CheckpointCallback,
+    Callback,
+    Trainer,
+    TrainerHalted,
+    TrainerHealth,
+)
+from neuronx_distributed_tpu.utils.retry import RetryPolicy
+from neuronx_distributed_tpu.utils.timeline import Timeline
+
+pytestmark = pytest.mark.chaos
+
+BS, SEQ, STEPS = 8, 16, 6
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama(num_layers=2, max_seq_len=32)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    return cfg, model
+
+
+def _data(cfg, seed=3):
+    return SyntheticTokens(cfg.vocab_size, BS, SEQ, seed=seed)
+
+
+class Recorder(Callback):
+    """Loss/flag stream + per-step param/opt snapshots (host numpy copies)."""
+
+    def __init__(self, snapshot=False):
+        self.losses, self.good, self.events = [], [], []
+        self.snapshot = snapshot
+        self.params, self.opts = [], []
+
+    def on_train_start(self, trainer):
+        self.events.append("start")
+
+    def on_step_end(self, trainer, metrics):
+        self.losses.append(float(metrics["loss"]))
+        if "good_step" in metrics:
+            self.good.append(bool(metrics["good_step"]))
+        if self.snapshot:
+            self.params.append(
+                jax.tree.map(lambda a: np.asarray(a).copy(), trainer.state.params)
+            )
+            self.opts.append(
+                jax.tree.map(lambda a: np.asarray(a).copy(), trainer.state.opt_state)
+            )
+
+    def on_train_end(self, trainer):
+        self.events.append("end")
+
+
+def _trainer(model, cb=None, **kw):
+    kw.setdefault("optimizer_config", OptimizerConfig(zero1=False))
+    return Trainer(model=model, callbacks=[cb] if cb else [], **kw)
+
+
+def _trees_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+_CLEAN_RUNS = {}
+
+
+def _run_clean(cfg, model, steps=STEPS, seed=3):
+    """The fault-free reference loss stream. Training is deterministic and
+    every caller wants a PREFIX of the same stream, so one memoized 8-step
+    fit serves the whole suite."""
+    if seed not in _CLEAN_RUNS or len(_CLEAN_RUNS[seed]) < steps:
+        rec = Recorder()
+        tr = _trainer(model, rec)
+        tr.fit(_data(cfg, seed), jax.random.PRNGKey(0),
+               max_steps=max(steps, 8))
+        _CLEAN_RUNS[seed] = rec.losses
+    return list(_CLEAN_RUNS[seed][:steps])
+
+
+# --- (b) anomaly guard ---------------------------------------------------------
+
+
+def test_nan_step_skipped_params_and_opt_unchanged(setup):
+    """An injected NaN loss is skipped ON DEVICE: params AND optimizer
+    state after the anomalous step are bit-identical to before it, the
+    flag/counters fire, and training continues to max_steps."""
+    cfg, model = setup
+    inj = FaultInjector().nan_loss(at=2)
+    rec = Recorder(snapshot=True)
+    tr = _trainer(model, rec, fault_injector=inj)
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+
+    assert inj.counters["nan_losses"] == 1
+    assert np.isnan(rec.losses[2]) and rec.good[2] is False
+    assert all(g for i, g in enumerate(rec.good) if i != 2)
+    # the skipped step changed NOTHING (bit-identical select on device)
+    assert _trees_equal(rec.params[2], rec.params[1])
+    assert _trees_equal(rec.opts[2], rec.opts[1])
+    # ...and the run went on training afterwards
+    assert tr.step == STEPS
+    assert not _trees_equal(rec.params[3], rec.params[2])
+    assert tr.anomaly_skips == 1
+    assert tr.health() is TrainerHealth.DEGRADED  # within the cooldown window
+
+
+def test_grad_spike_skipped(setup):
+    """A finite-but-huge gradient (scaled loss) trips the EMA spike
+    detector after warmup; the step is skipped like a NaN."""
+    cfg, model = setup
+    inj = FaultInjector().spike_grads(at=4, factor=1e6)
+    rec = Recorder(snapshot=True)
+    tr = _trainer(
+        model, rec, fault_injector=inj,
+        anomaly_guard=AnomalyGuardConfig(warmup_steps=2, spike_factor=10.0),
+    )
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+    assert inj.counters["spiked_grads"] == 1
+    assert rec.good[4] is False and all(g for i, g in enumerate(rec.good) if i != 4)
+    assert np.isfinite(rec.losses[4])  # a spike is finite — the EMA caught it
+    assert _trees_equal(rec.params[4], rec.params[3])
+    assert tr.anomaly_skips == 1
+
+
+def test_anomaly_budget_halts_with_emergency_checkpoint(setup, tmp_path):
+    """Open-ended NaN injection exhausts the anomaly budget: the run HALTS
+    (params frozen at the last good step) with an emergency checkpoint
+    that loads and carries the exact-resume payload."""
+    cfg, model = setup
+    d = str(tmp_path / "ck")
+    inj = FaultInjector().nan_loss(at=2, times=None)
+    rec = Recorder(snapshot=True)
+    tr = _trainer(
+        model, rec, fault_injector=inj, emergency_dir=d,
+        anomaly_guard=AnomalyGuardConfig(budget=2),
+    )
+    with pytest.raises(TrainerHalted) as ei:
+        tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=50)
+    assert "anomaly budget" in str(ei.value)
+    assert tr.health() is TrainerHealth.HALTED
+    assert tr.emergency_checkpoints == 1
+    assert rec.events[-1] == "end"  # on_train_end still ran for callbacks
+    # budget=2 → halt once the deferred accounting sees the 3rd skip
+    assert tr.anomaly_skips == 3
+    items, uc, tag = load_checkpoint(d, tag=ei.value.emergency_tag)
+    assert uc["emergency"].startswith("anomaly budget")
+    assert uc["step"] == tr.step and "rng_key" in uc and "data_state" in uc
+    # the checkpointed params are the last GOOD params (every anomalous
+    # update was skipped) — compare against the last good snapshot
+    assert _trees_equal(items["model"], rec.params[1])
+
+
+# --- (c) dispatch recovery -----------------------------------------------------
+
+
+def test_dispatch_failure_recovers_no_step_lost(setup, tmp_path):
+    """One injected dispatch failure: the retry runs against the last
+    known-good state, the loss stream is bit-identical to the clean run
+    (zero steps lost or duplicated), and the timeline records
+    failure+recovery."""
+    cfg, model = setup
+    clean = _run_clean(cfg, model)
+    trace = str(tmp_path / "trace.json")
+    inj = FaultInjector().fail_dispatch(at=3, times=1)
+    rec = Recorder()
+    tr = _trainer(
+        model, rec, fault_injector=inj, timeline=Timeline(trace),
+        dispatch_retry=RetryPolicy(max_attempts=3, first_wait=0.0, min_wait=0.0),
+    )
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+    assert inj.counters["dispatch_failures"] == 1
+    assert tr.dispatch_retries == 1
+    assert rec.losses == clean
+    assert tr.health() is TrainerHealth.DEGRADED
+    names = [e["name"] for e in json.load(open(trace))["traceEvents"]]
+    assert "dispatch_failure" in names and "recovery" in names
+
+
+def test_dispatch_budget_halts_then_emergency_resume(setup, tmp_path):
+    """Open-ended dispatch failures exhaust the retry budget: HALTED with
+    the state checkpointed (donated buffers survived the host-side
+    failures), and a fresh trainer resumes FROM the emergency checkpoint
+    and finishes the run bit-identically to an uninterrupted one."""
+    cfg, model = setup
+    d = str(tmp_path / "ck")
+    clean = _run_clean(cfg, model, steps=5)
+    inj = FaultInjector().fail_dispatch(at=3, times=None)
+    rec = Recorder()
+    tr = _trainer(
+        model, rec, fault_injector=inj, emergency_dir=d,
+        dispatch_retry=RetryPolicy(max_attempts=3, first_wait=0.0, min_wait=0.0),
+    )
+    with pytest.raises(TrainerHalted) as ei:
+        tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=5)
+    assert "consecutive dispatch failures" in str(ei.value)
+    assert ei.value.emergency_tag == f"emergency_step_{tr.step}"
+    assert rec.losses == clean[: tr.step]  # no garbage steps before the halt
+    # resume from the emergency checkpoint: picks up at the halted step and
+    # the continued losses match the uninterrupted run exactly
+    rec2 = Recorder()
+    tr2 = _trainer(model, rec2)
+    tr2.fit(_data(cfg), jax.random.PRNGKey(9), max_steps=5, resume_from=d)
+    assert rec.losses + rec2.losses == clean
+
+
+# --- (a) exact resume ----------------------------------------------------------
+
+
+def test_kill_and_resume_bit_identical(setup, tmp_path):
+    """Kill at step 4 (periodic checkpoint), resume with a FRESH trainer,
+    fresh data source, and a DIFFERENT fit key: the combined loss stream
+    equals the uninterrupted run bit-for-bit (params/opt restored exactly,
+    RNG base and data cursor from the checkpoint)."""
+    cfg, model = setup
+    clean = _run_clean(cfg, model, steps=8)
+    d = str(tmp_path / "ck")
+    rec_b = Recorder()
+    tr_b = _trainer(model, rec_b)
+    tr_b.callbacks.append(CheckpointCallback(d, every=2, async_save=False))
+    tr_b.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=4)
+    rec_c = Recorder()
+    tr_c = _trainer(model, rec_c)
+    # PRNGKey(123): the resumed stream must come from the CHECKPOINT's key
+    tr_c.fit(_data(cfg), jax.random.PRNGKey(123), max_steps=8, resume_from=d)
+    assert rec_b.losses + rec_c.losses == clean
+    assert tr_c.steps_run == 4 and tr_c.step == 8
+    assert tr_c.tokens_seen == 8 * BS * SEQ  # bookkeeping restored + extended
+
+
+def test_guard_carry_rides_checkpoints_spike_after_resume(setup, tmp_path):
+    """The anomaly-guard carry (EMA, warmup count, device skips counter)
+    is part of the exact-resume payload: a spike landing AFTER a resume is
+    detected exactly as in the uninterrupted run (a fresh guard would still
+    be inside warmup and APPLY it), and the skip counter continues instead
+    of restarting — preemption cycling cannot reset the budget."""
+    cfg, model = setup
+    guard = AnomalyGuardConfig(warmup_steps=2, spike_factor=10.0)
+    # uninterrupted reference: spikes at steps 3 and 5, both skipped
+    rec_u = Recorder()
+    tr_u = _trainer(
+        model, rec_u, anomaly_guard=guard,
+        fault_injector=FaultInjector().spike_grads(at=3).spike_grads(at=5),
+    )
+    tr_u.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=8)
+    assert rec_u.good[3] is False and rec_u.good[5] is False
+    assert tr_u.anomaly_skips == 2
+    # same schedule, killed at the step-4 checkpoint, resumed fresh
+    d = str(tmp_path / "ck")
+    rec_b = Recorder()
+    tr_b = _trainer(
+        model, rec_b, anomaly_guard=guard,
+        fault_injector=FaultInjector().spike_grads(at=3),
+    )
+    tr_b.callbacks.append(CheckpointCallback(d, every=2, async_save=False))
+    tr_b.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=4)
+    rec_c = Recorder()
+    tr_c = _trainer(
+        model, rec_c, anomaly_guard=guard,
+        fault_injector=FaultInjector().spike_grads(at=5),
+    )
+    tr_c.fit(_data(cfg), jax.random.PRNGKey(42), max_steps=8, resume_from=d)
+    # the restored carry is warmed (good_steps=3 > warmup) — the post-resume
+    # spike is skipped, and the combined stream matches bit-for-bit
+    assert rec_c.good[5 - 4] is False
+    assert rec_b.losses + rec_c.losses == rec_u.losses
+    assert tr_c.anomaly_skips == 2  # 1 restored from the checkpoint + 1 new
+
+
+def test_sigterm_finishes_step_checkpoints_and_resumes(setup, tmp_path):
+    """A REAL SIGTERM mid-run: the in-flight step completes, a final
+    ``step_N`` checkpoint commits through the done-marker protocol, fit
+    returns cleanly (``preempted``), and resuming reproduces the
+    uninterrupted run bit-identically."""
+    cfg, model = setup
+    clean = _run_clean(cfg, model, steps=STEPS)
+    d = str(tmp_path / "ck")
+    inj = FaultInjector().deliver_sigterm(at=3)
+    rec = Recorder()
+    tr = _trainer(model, rec, fault_injector=inj, emergency_dir=d)
+    metrics = tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+    assert inj.counters["sigterms"] == 1
+    assert tr.preempted and tr.step == 3 and len(rec.losses) == 3
+    assert "loss" in metrics  # returned cleanly with the last step's metrics
+    assert rec.events[-1] == "end"
+    assert latest_checkpoint_tag(d) == "step_3"
+    assert os.path.exists(os.path.join(d, "step_3", DONE_MARKER))
+    rec2 = Recorder()
+    tr2 = _trainer(model, rec2)
+    tr2.fit(_data(cfg), jax.random.PRNGKey(7), max_steps=STEPS, resume_from=d)
+    assert rec.losses + rec2.losses == clean
+
+
+def test_sigterm_before_first_step_loses_no_batch(setup, tmp_path):
+    """Preemption BEFORE the first dispatch: the shape-probe batch was
+    already pulled (cursor is one ahead) but never trained — the step_0
+    checkpoint must carry the PRE-pull cursor so the resumed run trains
+    batch 0 and reproduces the clean stream from the very first step."""
+    cfg, model = setup
+    clean = _run_clean(cfg, model, steps=STEPS)
+    d = str(tmp_path / "ck")
+    inj = FaultInjector().deliver_sigterm(at=0)
+    rec = Recorder()
+    tr = _trainer(model, rec, fault_injector=inj, emergency_dir=d)
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+    assert tr.preempted and tr.step == 0 and rec.losses == []
+    assert latest_checkpoint_tag(d) == "step_0"
+    rec2 = Recorder()
+    tr2 = _trainer(model, rec2)
+    tr2.fit(_data(cfg), jax.random.PRNGKey(7), max_steps=STEPS, resume_from=d)
+    assert rec2.losses == clean  # batch 0 was NOT skipped
+
+
+def test_failure_between_pull_and_dispatch_loses_no_batch(setup, tmp_path):
+    """A failure AFTER the batch left the iterator but BEFORE its dispatch
+    (here: ``corrupt_batch`` itself raising) reaches the epilogue with the
+    cursor one ahead of the truth — the ``save_on_end`` checkpoint must
+    carry the PRE-pull cursor so the resumed run retrains the batch that
+    never made it into a step."""
+
+    class ExplodingInjector(FaultInjector):
+        def corrupt_batch(self, step, batch):
+            if step == 3:
+                raise RuntimeError("injected pre-dispatch failure")
+            return super().corrupt_batch(step, batch)
+
+    cfg, model = setup
+    clean = _run_clean(cfg, model, steps=8)
+    d = str(tmp_path / "ck")
+    rec = Recorder()
+    tr = _trainer(model, rec, fault_injector=ExplodingInjector())
+    tr.callbacks.append(CheckpointCallback(d, every=100, async_save=False))
+    with pytest.raises(RuntimeError, match="pre-dispatch"):
+        tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=8)
+    assert rec.losses == clean[:3]  # steps 0-2 trained, step 3 never ran
+    assert latest_checkpoint_tag(d) == "step_3"
+    rec2 = Recorder()
+    tr2 = _trainer(model, rec2)
+    tr2.fit(_data(cfg), jax.random.PRNGKey(9), max_steps=8, resume_from=d)
+    assert rec.losses + rec2.losses == clean  # batch 3 was NOT skipped
+
+
+def test_step_rng_rides_resume(setup, tmp_path):
+    """The checkpointed base RNG key is live state: a resumed trainer's
+    per-step ``step_rng()`` stream matches the uninterrupted run's even
+    when the resuming ``fit`` was handed a different key."""
+    cfg, model = setup
+    d = str(tmp_path / "ck")
+    tr = _trainer(model)
+    tr.callbacks.append(CheckpointCallback(d, every=3, async_save=False))
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=3)
+    tr2 = _trainer(model)
+    tr2.fit(_data(cfg), jax.random.PRNGKey(9), max_steps=3, resume_from=d)
+    assert tr2.step == tr.step == 3
+    assert np.array_equal(np.asarray(tr.step_rng()), np.asarray(tr2.step_rng()))
+
+
+def test_corrupt_checkpoint_falls_back_and_retrains(setup, tmp_path):
+    """A checkpoint whose done marker vanished (killed mid-save) is never
+    resumed from: resume falls back to the previous completed tag, cleans
+    up the corrupt one, and re-training the lost steps reproduces the
+    uninterrupted stream."""
+    cfg, model = setup
+    clean = _run_clean(cfg, model, steps=STEPS)
+    d = str(tmp_path / "ck")
+    inj = FaultInjector().corrupt_checkpoint("step_4")
+    tr = _trainer(model, fault_injector=inj)
+    tr.callbacks.append(
+        CheckpointCallback(d, every=2, async_save=False, save_on_end=False)
+    )
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=4)
+    assert inj.counters["corrupted_checkpoints"] == 1
+    assert not os.path.exists(os.path.join(d, "step_4", DONE_MARKER))
+    rec2 = Recorder()
+    tr2 = _trainer(model, rec2)
+    tr2.fit(_data(cfg), jax.random.PRNGKey(5), max_steps=STEPS, resume_from=d)
+    assert tr2.steps_run == 4  # resumed at step 2, not 4
+    assert not os.path.isdir(os.path.join(d, "step_4"))  # corrupt tag removed
+    assert rec2.losses == clean[2:]
+
+
+def test_corrupt_checkpoint_fires_under_async_save(setup, tmp_path):
+    """The default CheckpointCallback saves asynchronously; a scheduled
+    corruption must still hit a COMMITTED checkpoint (the save path drains
+    the async commit first), not race the background marker write and
+    silently corrupt nothing."""
+    cfg, model = setup
+    d = str(tmp_path / "ck")
+    inj = FaultInjector().corrupt_checkpoint("step_4")
+    tr = _trainer(model, fault_injector=inj)
+    tr.callbacks.append(
+        CheckpointCallback(d, every=2, async_save=True, save_on_end=False)
+    )
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=4)
+    assert inj.counters["corrupted_checkpoints"] == 1
+    # the tensors committed, then the marker was removed — exactly the
+    # on-disk state of a run killed between flush and marker write
+    assert os.path.isdir(os.path.join(d, "step_4"))
+    assert not os.path.exists(os.path.join(d, "step_4", DONE_MARKER))
+
+
+def test_execution_failure_at_readback_halts_for_cause(setup):
+    """Async dispatch means a DEVICE-side execution failure surfaces at the
+    deferred guard readback, not at the dispatch call — it must land in the
+    halt machinery (reasoned halt, on_train_end still runs), not escape as
+    a raw backend error."""
+    cfg, model = setup
+    rec = Recorder()
+    tr = _trainer(model, rec)
+    real_get = jax.device_get
+    fired = {"n": 0}
+
+    def failing_get(x):
+        if fired["n"] == 0:
+            fired["n"] += 1
+            raise RuntimeError("DEVICE_ERROR: simulated async execution fault")
+        return real_get(x)
+
+    jax.device_get = failing_get
+    try:
+        with pytest.raises(TrainerHalted) as ei:
+            tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+    finally:
+        jax.device_get = real_get
+    assert "execution failed" in str(ei.value)
+    assert ei.value.emergency_tag is None  # poisoned state: nothing to save
+    assert tr.health() is TrainerHealth.HALTED
+    assert rec.events[-1] == "end"  # on_train_end still reached callbacks
+
+
+# --- compile / host-sync budget -----------------------------------------------
+
+
+def test_one_program_serves_clean_and_anomalous_steps(setup):
+    """Compile-count guard (the serving suite's discipline): the guarded
+    train step compiles EXACTLY once across clean, NaN, and spiked
+    batches — anomaly handling is data, not control flow."""
+    cfg, model = setup
+    inj = FaultInjector().nan_loss(at=2).spike_grads(at=4, factor=1e6)
+    tr = _trainer(
+        model, fault_injector=inj,
+        anomaly_guard=AnomalyGuardConfig(warmup_steps=2),
+    )
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+    assert tr.anomaly_skips == 2
+    assert tr._train_step._cache_size() == 1
+
+
+def test_guard_host_traffic_is_one_tiny_deferred_readback(setup):
+    """Host-sync budget: with the guard ON, the steady loop's only host
+    readback is ONE deferred scalar pair per step (read after the next
+    step was dispatched — it never stalls the device); params and metrics
+    stay device-resident. With the guard OFF, the loop performs ZERO
+    readbacks."""
+    cfg, model = setup
+
+    counts = {"calls": 0, "leaves": 0}
+    real_get = jax.device_get
+
+    def counting_get(x):
+        counts["calls"] += 1
+        leaves = jax.tree.leaves(x)
+        counts["leaves"] += len(leaves)
+        for leaf in leaves:
+            assert np.ndim(leaf) == 0, "guard readback must be scalars only"
+        return real_get(x)
+
+    tr = _trainer(model)
+    jax.device_get = counting_get
+    try:
+        tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+    finally:
+        jax.device_get = real_get
+    assert counts["calls"] == STEPS  # one deferred flag-pair fetch per step
+    assert counts["leaves"] == 2 * STEPS
+
+    counts2 = {"calls": 0}
+
+    def counting_get2(x):
+        counts2["calls"] += 1
+        return real_get(x)
+
+    tr2 = _trainer(model, anomaly_guard=None)
+    jax.device_get = counting_get2
+    try:
+        tr2.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=STEPS)
+    finally:
+        jax.device_get = real_get
+    assert counts2["calls"] == 0
+
+
+# --- soak ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_interleaved_faults(setup, tmp_path):
+    """Longer interleaved chaos: NaNs, spikes, and dispatch failures woven
+    through 20 steps — every fault fires, the run survives, and the final
+    params equal a clean run with the same anomalous steps excluded is NOT
+    required (anomalies shift the stream); what must hold is: no crash,
+    exact counters, health recovers to OK after the cooldown."""
+    cfg, model = setup
+    inj = (
+        FaultInjector()
+        .nan_loss(at=3)
+        .spike_grads(at=7, factor=1e6)
+        .fail_dispatch(at=10, times=2)
+        .nan_loss(at=12)
+    )
+    rec = Recorder()
+    tr = _trainer(
+        model, rec, fault_injector=inj,
+        anomaly_guard=AnomalyGuardConfig(warmup_steps=2, budget=10),
+        degraded_cooldown_steps=3,
+        dispatch_retry=RetryPolicy(max_attempts=5, first_wait=0.0, min_wait=0.0),
+    )
+    tr.fit(_data(cfg), jax.random.PRNGKey(0), max_steps=24)
+    assert tr.step == 24
+    assert inj.counters["nan_losses"] == 2
+    assert inj.counters["spiked_grads"] == 1
+    assert inj.counters["dispatch_failures"] == 2
+    assert tr.anomaly_skips == 3
+    assert tr.dispatch_retries == 2
+    assert tr.health() is TrainerHealth.OK  # cooled down by step 24
