@@ -1437,6 +1437,36 @@ def builder_main() -> None:
     _emit({"metric": "builder_capture", "relay_ok": relay_ok, "path": path})
 
 
+def _graftlint_summary():
+    """Repo-wide graftlint run (pure-AST, sub-second) for the artifact:
+    rule counts + baseline size, so the ratchet's trajectory toward (and
+    at) zero is visible across PRs without digging through CI logs."""
+    try:
+        from neuronx_distributed_tpu.scripts.graftlint import baseline as bl
+        from neuronx_distributed_tpu.scripts.graftlint import runner as gl_runner
+
+        root = os.path.dirname(os.path.abspath(__file__))
+        report = gl_runner.run(
+            [os.path.join(root, "neuronx_distributed_tpu")], root=root
+        )
+        diff = report.diff
+        return {
+            "files_scanned": report.files_scanned,
+            "violations": len(report.violations),
+            "by_rule": report.by_rule(),
+            "new": len(diff.new) if diff is not None else len(report.violations),
+            "baselined": len(diff.grandfathered) if diff is not None else 0,
+            "stale": len(diff.stale) if diff is not None else 0,
+            "baseline_size": len(
+                bl.load(os.path.join(root, bl.DEFAULT_NAME))
+            ),
+            "pragma_suppressed": len(report.suppressed),
+            "clean": not report.failed,
+        }
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+
 def _load_builder_artifact():
     """Committed in-session capture, merged into extras as attested history."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1494,6 +1524,7 @@ def main() -> None:
             if train_faults_result is not None
             else {"error": "train-faults child did not finish"}
         )
+        extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
         builder = _load_builder_artifact()
         if builder is not None:

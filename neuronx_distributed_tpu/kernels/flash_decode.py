@@ -255,7 +255,7 @@ def flash_decode_attention(
         return replicated_over_tp()
 
     def per_rank(a, k_, v_, p_, kv):
-        rank = jax.lax.axis_index(mesh_lib.TP_AXIS)
+        rank = mesh_lib.compat_axis_index(mesh_lib.TP_AXIS)
         l_off = rank * (L // tp)
         o, lse = _flash_decode_call(a, k_, v_, p_, kv, l_off, interpret, block_l)
         # exp-weighted merge over the tp axis: partials with lse≈-inf (rows

@@ -125,7 +125,7 @@ def _ring_flash_fwd_pass(q, k, v, q_seg, k_seg, axis_name, bq, bk, interpret):
     from neuronx_distributed_tpu.kernels.flash_attention import _flash_fwd
 
     cp = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    rank = mesh_lib.compat_axis_index(axis_name)
     b, s_loc, h, d = q.shape
     qt = jnp.swapaxes(q, 1, 2)  # (B, H, S, D)
     segs = q_seg is not None
@@ -191,7 +191,7 @@ def _ring_flash_bwd_rule(axis_name, bq, bk, interpret, res, g):
 
     q, k, v, q_seg, k_seg, out, lse = res
     cp = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    rank = mesh_lib.compat_axis_index(axis_name)
     b, s_loc, h, d = q.shape
     segs = q_seg is not None
     ks0 = k_seg if segs else jnp.zeros((b, s_loc), jnp.int32)
@@ -299,7 +299,7 @@ def ring_attention(
     documents per-document isolation at ring scale. Returns
     (B, S_local, H, D)."""
     cp = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    rank = mesh_lib.compat_axis_index(axis_name)
     b, s_loc, h, d = q.shape
     hkv = k.shape[2]
     g = h // hkv

@@ -1,0 +1,35 @@
+"""graftlint — repo-native static analysis for the jax_graft invariants.
+
+An AST-based lint suite whose rule classes are distilled from this repo's
+own incident history (each ``--explain RULE`` names the PR that bled for
+it):
+
+* **GL01 donation-aliasing** — host reads of ``donate_argnums`` trees
+  (silently demote donation to a copy / read consumed buffers; PR 2).
+* **GL02 host-sync-in-hot-path** — implicit device->host syncs in the
+  modules whose sync counts are performance contracts (PR 2/PR 5).
+* **GL03 recompile-hazard** — uncommitted long-lived scalars, module-level
+  jit objects, mutable closure capture under jit (PR 4/PR 5).
+* **GL04 compat-layer-bypass** — raw ``shard_map``/``axis_index``/
+  ``get_abstract_mesh`` outside ``parallel/mesh.py`` (hard-SIGABRTs old
+  XLA; PR 5).
+* **GL05 nondeterminism** — unseeded/wall-clock RNG in library code
+  (breaks bit-identical chaos/resume; PR 3/PR 5).
+
+Run it::
+
+    python -m neuronx_distributed_tpu.scripts.graftlint [paths]
+
+Suppress ONE finding with a documented reason::
+
+    x = thing()  # graftlint: ok[GL02] the per-chunk sync the tests pin
+
+Grandfathered debt lives in ``graftlint_baseline.json`` (ratchet: new
+violations fail, fixed ones must be removed via ``--write-baseline``).
+The repo-wide run is a tier-1 test (``tests/scripts/test_graftlint.py``).
+"""
+
+from neuronx_distributed_tpu.scripts.graftlint.core import Violation
+from neuronx_distributed_tpu.scripts.graftlint.runner import Report, run, scan
+
+__all__ = ["Violation", "Report", "run", "scan"]

@@ -1,0 +1,7 @@
+"""``python -m neuronx_distributed_tpu.scripts.graftlint`` entry point."""
+
+import sys
+
+from neuronx_distributed_tpu.scripts.graftlint.cli import main
+
+sys.exit(main())

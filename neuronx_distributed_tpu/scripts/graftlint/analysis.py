@@ -1,0 +1,307 @@
+"""Shared AST analysis for the graftlint rules.
+
+Three layers, all intentionally *module-local* (graftlint never follows
+imports — cross-module resolution would make the tool slow and flaky, and
+every incident in the repo's history was visible within one module):
+
+* **Alias resolution** — import tracking so ``jnp.zeros``, ``lax.axis_index``
+  and ``from jax import lax`` all resolve to canonical dotted paths
+  (``jax.numpy.zeros``, ``jax.lax.axis_index``); rules match on those, never
+  on surface spellings.
+* **Jit index** — every callable the module binds through ``jax.jit`` (bare
+  ``f = jax.jit(...)``, ``self._fn = jax.jit(...)``, ``@jax.jit`` /
+  ``@partial(jax.jit, ...)`` decorators), with its literal
+  ``donate_argnums`` when present. GL01 uses the donation positions; GL02's
+  taint layer treats any jitted call's result as device-resident.
+* **Taint flow** — a statement-ordered, per-function walk classifying
+  expression roots as ``device`` (came from jnp/jax.random/jax.lax/a jitted
+  call), ``host`` (came from ``jax.device_get``/numpy/builtin coercions) or
+  unknown. Deliberately conservative: UNKNOWN is never flagged, so the
+  false-positive surface stays small enough for a near-empty baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+DEVICE = "device"
+HOST = "host"
+
+# Call prefixes whose results live on device.
+_DEVICE_CALL_PREFIXES = ("jax.numpy.", "jax.random.", "jax.lax.")
+_DEVICE_CALLS = ("jax.device_put",)
+# jnp/jax calls that return host metadata (python scalars/dtypes), not
+# device arrays — coercing these is free
+_METADATA_CALLS = (
+    "jax.numpy.issubdtype", "jax.numpy.dtype", "jax.numpy.shape",
+    "jax.numpy.ndim", "jax.numpy.result_type", "jax.numpy.iinfo",
+    "jax.numpy.finfo", "jax.dtypes.issubdtype", "jax.dtypes.result_type",
+)
+# Calls that land on host.
+_HOST_CALL_PREFIXES = ("numpy.",)
+_HOST_CALLS = ("jax.device_get",)
+_HOST_BUILTINS = ("int", "float", "bool", "str", "len", "list", "tuple", "range")
+
+
+class AliasMap:
+    """name -> canonical dotted module/object path for this module."""
+
+    def __init__(self, tree: ast.Module):
+        self.names: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.names[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.names[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a Name/Attribute chain, or None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.names.get(node.id)
+        if base is None:
+            # unimported bare name (builtin or module-local) — return as-is
+            base = node.id
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+
+def root_of(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Canonical root of an lvalue/rvalue chain: ``self._state['keys'][0]``
+    → ``('self', '_state')``; ``cache_in.k`` → ``('cache_in',)`` unless the
+    chain starts at ``self`` (then the first attribute is kept — per-slot
+    instance state is the granularity the donation rules reason at)."""
+    while isinstance(node, (ast.Subscript, ast.Call, ast.Starred)):
+        node = node.value if not isinstance(node, ast.Call) else node.func
+    chain: List[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    if node.id == "self" and chain:
+        return ("self", chain[-1])
+    return (node.id,)
+
+
+def call_key(func: ast.AST) -> Optional[Tuple[str, ...]]:
+    """STRICT key for a call target: a bare name or a direct ``self.x``
+    attribute — nothing deeper. ``self._fn._cache_size`` must NOT resolve
+    to the ``self._fn`` jit binding (calling a method ON a jitted object
+    is host metadata, not a dispatch)."""
+    if isinstance(func, ast.Name):
+        return (func.id,)
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "self"
+    ):
+        return ("self", func.attr)
+    return None
+
+
+def _literal_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+class JitBinding:
+    """One jit-wrapped callable the module binds to a name."""
+
+    def __init__(self, key: Tuple[str, ...], donate: Tuple[int, ...],
+                 node: ast.AST):
+        self.key = key  # ('self', '_decode_chunk') or ('fn',)
+        self.donate = donate
+        self.node = node
+
+
+def is_jit_call(node: ast.AST, aliases: AliasMap) -> bool:
+    """Whether ``node`` is a ``jax.jit(...)`` call (directly, or through a
+    ``functools.partial(jax.jit, ...)`` indirection)."""
+    if not isinstance(node, ast.Call):
+        return False
+    path = aliases.resolve(node.func)
+    if path == "jax.jit":
+        return True
+    if path in ("functools.partial", "partial") and node.args:
+        return aliases.resolve(node.args[0]) == "jax.jit"
+    return False
+
+
+def jit_donate_argnums(node: ast.Call, aliases: AliasMap) -> Tuple[int, ...]:
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            lit = _literal_int_tuple(kw.value)
+            if lit is not None:
+                return lit
+    return ()
+
+
+class JitIndex:
+    """Module-wide map of jit-bound callables, keyed by the simplified root
+    the call sites use (``self._decode_chunk(...)`` / ``fn(...)``)."""
+
+    def __init__(self, tree: ast.Module, aliases: AliasMap):
+        self.aliases = aliases
+        self.bindings: Dict[Tuple[str, ...], JitBinding] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and is_jit_call(node.value, aliases):
+                donate = jit_donate_argnums(node.value, aliases)
+                for tgt in node.targets:
+                    key = root_of(tgt)
+                    if key is not None:
+                        self.bindings[key] = JitBinding(key, donate, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    donate: Tuple[int, ...] = ()
+                    if isinstance(dec, ast.Call) and is_jit_call(dec, aliases):
+                        donate = jit_donate_argnums(dec, aliases)
+                    elif aliases.resolve(dec) == "jax.jit":
+                        pass
+                    else:
+                        continue
+                    self.bindings[(node.name,)] = JitBinding(
+                        (node.name,), donate, node
+                    )
+
+    def lookup_call(self, call: ast.Call) -> Optional[JitBinding]:
+        key = call_key(call.func)
+        if key is None:
+            return None
+        return self.bindings.get(key)
+
+
+def iter_function_defs(tree: ast.Module):
+    """Every FunctionDef in the module (including nested and methods)."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def decorated_with_jit(fn: ast.FunctionDef, aliases: AliasMap) -> bool:
+    for dec in fn.decorator_list:
+        if aliases.resolve(dec) == "jax.jit":
+            return True
+        if isinstance(dec, ast.Call) and is_jit_call(dec, aliases):
+            return True
+    return False
+
+
+class TaintEnv:
+    """Statement-ordered device/host taint over roots within one function."""
+
+    def __init__(self, aliases: AliasMap, jits: JitIndex):
+        self.aliases = aliases
+        self.jits = jits
+        self.env: Dict[Tuple[str, ...], str] = {}
+
+    # --- expression classification -----------------------------------------
+
+    def taint(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            return self._call_taint(node)
+        if isinstance(node, ast.Name):
+            return self.env.get((node.id,))
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("shape", "ndim", "dtype", "size"):
+                # array metadata lives host-side on jax.Array too — reading
+                # (or coercing) it never blocks on the device
+                return HOST
+            r = root_of(node)
+            if r is not None and r in self.env:
+                return self.env[r]
+            return None
+        if isinstance(node, ast.Subscript):
+            return self.taint(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            lt, rt = self.taint(node.left), self.taint(node.right)
+            if DEVICE in (lt, rt):
+                return DEVICE
+            return None
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand)
+        if isinstance(node, ast.Compare):
+            ts = [self.taint(node.left)] + [self.taint(c) for c in node.comparators]
+            return DEVICE if DEVICE in ts else None
+        if isinstance(node, ast.BoolOp):
+            ts = [self.taint(v) for v in node.values]
+            return DEVICE if DEVICE in ts else None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            ts = [self.taint(e) for e in node.elts]
+            if DEVICE in ts:
+                return DEVICE
+            if ts and all(t == HOST for t in ts):
+                return HOST
+            return None
+        if isinstance(node, ast.IfExp):
+            ts = (self.taint(node.body), self.taint(node.orelse))
+            return DEVICE if DEVICE in ts else None
+        if isinstance(node, ast.NamedExpr):
+            return self.taint(node.value)
+        return None
+
+    def _call_taint(self, node: ast.Call) -> Optional[str]:
+        path = self.aliases.resolve(node.func)
+        if path is not None:
+            if path in _HOST_CALLS or path in _HOST_BUILTINS:
+                return HOST
+            if path in _METADATA_CALLS:
+                return HOST
+            if any(path.startswith(p) for p in _HOST_CALL_PREFIXES):
+                return HOST
+            if path in _DEVICE_CALLS or any(
+                path.startswith(p) for p in _DEVICE_CALL_PREFIXES
+            ):
+                return DEVICE
+        if self.jits.lookup_call(node) is not None:
+            return DEVICE
+        # method calls on a tainted base keep its taint (x.copy(), x.sum(),
+        # x.astype(...)) — the receiver's residence does not change
+        if isinstance(node.func, ast.Attribute):
+            base_t = self.taint(node.func.value)
+            if base_t is not None:
+                return base_t
+        return None
+
+    # --- statement effects ---------------------------------------------------
+
+    def assign(self, target: ast.AST, value_taint: Optional[str],
+               value: Optional[ast.AST] = None) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            # unpack: every element inherits the value's taint — for a call
+            # result or a device_get of a tuple that is exact; element-wise
+            # precision is not worth the machinery
+            for e in target.elts:
+                self.assign(e, value_taint)
+            return
+        if isinstance(target, ast.Starred):
+            self.assign(target.value, value_taint)
+            return
+        r = root_of(target)
+        if r is None:
+            return
+        if isinstance(target, ast.Subscript):
+            return  # writing INTO a container does not change its residence
+        if value_taint is None:
+            self.env.pop(r, None)
+        else:
+            self.env[r] = value_taint

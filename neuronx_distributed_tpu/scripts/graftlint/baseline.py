@@ -1,0 +1,115 @@
+"""The graftlint baseline ratchet (``graftlint_baseline.json``).
+
+Grandfathered violations are enumerated by fingerprint (rule + file +
+normalized source line + occurrence — line-number independent, see
+``core.Violation.fingerprint``). The contract:
+
+* a violation whose fingerprint is in the baseline passes (grandfathered);
+* a NEW violation fails the run;
+* a baseline entry no match consumed is STALE — the run fails until the
+  baseline is regenerated (``--write-baseline``), so fixing a violation
+  permanently shrinks the debt and nobody can silently re-spend it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List
+
+from neuronx_distributed_tpu.scripts.graftlint.core import Violation
+
+VERSION = 1
+DEFAULT_NAME = "graftlint_baseline.json"
+
+
+@dataclasses.dataclass
+class BaselineDiff:
+    new: List[Violation]
+    grandfathered: List[Violation]
+    stale: List[dict]  # baseline entries nothing matched
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.stale
+
+
+def load(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry. A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} in {path}"
+        )
+    return {e["fingerprint"]: e for e in data.get("violations", [])}
+
+
+def _entry(v: Violation) -> dict:
+    return {
+        "fingerprint": v.fingerprint,
+        "rule": v.rule,
+        "path": v.path,
+        "snippet": v.snippet,
+        "occurrence": v.occurrence,
+        "message": v.message,
+    }
+
+
+def _write_entries(path: str, entries: List[dict]) -> None:
+    entries = sorted(
+        entries, key=lambda e: (e["path"], e["rule"], e["fingerprint"])
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": VERSION, "violations": entries}, f, indent=2)
+        f.write("\n")
+
+
+def save(path: str, violations: List[Violation]) -> None:
+    _write_entries(path, [_entry(v) for v in violations])
+
+
+def save_merged(path: str, violations: List[Violation],
+                scanned_relpaths: List[str], select=None,
+                root: str = None) -> int:
+    """Scope-aware ``--write-baseline``: a partial run (subset paths or
+    ``--select``) must not erase grandfathered debt it never looked at.
+    Entries for (scanned file, selected rule) pairs are REFRESHED from this
+    run's violations (fixing one shrinks the file); entries outside the
+    run's scope are PRESERVED verbatim; entries whose file no longer
+    exists are dropped. Returns the number of entries written."""
+    existing = load(path) if os.path.exists(path) else {}
+    scanned = set(scanned_relpaths)
+    merged: dict = {}
+    for e in existing.values():
+        checked = e["path"] in scanned and (
+            select is None or e["rule"] in select
+        )
+        if checked:
+            continue  # this run re-derived (or retired) it
+        if root is not None and not os.path.exists(
+            os.path.join(root, e["path"])
+        ):
+            continue  # the file is gone — so is its debt
+        merged[e["fingerprint"]] = e
+    for v in violations:
+        merged[v.fingerprint] = _entry(v)
+    _write_entries(path, list(merged.values()))
+    return len(merged)
+
+
+def diff(violations: List[Violation], baseline: Dict[str, dict]) -> BaselineDiff:
+    unmatched = dict(baseline)
+    new: List[Violation] = []
+    grandfathered: List[Violation] = []
+    for v in violations:
+        if unmatched.pop(v.fingerprint, None) is not None:
+            grandfathered.append(v)
+        else:
+            new.append(v)
+    return BaselineDiff(
+        new=new, grandfathered=grandfathered, stale=list(unmatched.values())
+    )

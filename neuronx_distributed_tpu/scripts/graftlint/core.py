@@ -1,0 +1,104 @@
+"""graftlint core data model: parsed source files and violations.
+
+A :class:`Violation` is identified across runs by a *fingerprint* that hashes
+the rule id, the file's repo-relative path, the stripped source line, and an
+occurrence index among identical (rule, line-text) pairs in the same file —
+NOT the line number, so unrelated edits above a baselined violation do not
+churn the baseline (the ratchet in ``baseline.py`` depends on this
+stability).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import tokenize
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding. ``snippet`` is the stripped source line the finding
+    anchors to (the fingerprint basis); ``occurrence`` disambiguates
+    repeated identical lines within one file."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    occurrence: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        basis = f"{self.rule}::{self.path}::{self.snippet}::{self.occurrence}"
+        return hashlib.sha256(basis.encode("utf-8")).hexdigest()[:16]
+
+    def format(self) -> str:
+        """``path:line:col: RULE message`` — the clickable report line."""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def assign_occurrences(violations: List[Violation]) -> List[Violation]:
+    """Number identical (rule, path, snippet) findings in report order so
+    every fingerprint in a file is unique."""
+    seen: Dict[tuple, int] = {}
+    out = []
+    for v in violations:
+        key = (v.rule, v.path, v.snippet)
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        out.append(dataclasses.replace(v, occurrence=n))
+    return out
+
+
+class SourceFile:
+    """One parsed python file: AST, raw lines, and the comment map the
+    pragma layer reads (``ast`` drops comments, so they come from
+    ``tokenize``)."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath.replace("\\", "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):  # parse succeeded;
+            pass  # comments are best-effort
+
+    @classmethod
+    def load(cls, path: str, relpath: str) -> Optional["SourceFile"]:
+        """Parse ``path``; returns None for unreadable/unparsable files
+        (a syntax error is the test suite's problem, not the linter's)."""
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+            return cls(path, relpath, text)
+        except (OSError, SyntaxError, ValueError):
+            return None
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def contains_marker(self, marker: str) -> bool:
+        """Whether any comment carries ``marker`` (e.g. the GL02
+        ``graftlint: hot-path`` opt-in)."""
+        return any(marker in c for c in self.comments.values())
+
+    def violation(self, rule: str, node: ast.AST, message: str) -> Violation:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Violation(
+            rule=rule, path=self.relpath, line=line, col=col,
+            message=message, snippet=self.line_text(line),
+        )

@@ -1,0 +1,121 @@
+"""Pragma suppression: ``# graftlint: ok[RULE] <reason>``.
+
+A pragma suppresses matching violations on its own line, or — when the
+comment stands alone on a line — on the next STATEMENT (intervening
+comment-only/blank lines are skipped, and a multi-line statement is covered
+through its last line, so the justification can sit above a call too long
+to share a line with). The reason is MANDATORY: a
+suppression without a documented why is itself reported (rule ``GL00``),
+because "trust me" pragmas are how the incident classes these rules encode
+crept in the first time.
+
+Multiple rules may share one pragma: ``# graftlint: ok[GL01,GL02] reason``.
+"""
+
+from __future__ import annotations
+
+import ast as _ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*ok\[(?P<rules>[A-Za-z0-9_,\s]*)\]\s*(?P<reason>.*)$"
+)
+_PRAGMA_HINT_RE = re.compile(r"#\s*graftlint:\s*ok\b")
+
+
+class Pragma:
+    def __init__(self, line: int, rules: Set[str], reason: str,
+                 own_line: bool):
+        self.line = line
+        self.rules = rules
+        self.reason = reason
+        self.own_line = own_line  # comment-only line: applies to line + 1
+
+
+def collect(src: SourceFile) -> Tuple[List[Pragma], List[Violation]]:
+    """Parse every pragma comment; malformed ones (unparsable ``ok[...]``
+    form, empty rule list, or missing reason) come back as GL00
+    violations instead of silently suppressing nothing."""
+    pragmas: List[Pragma] = []
+    bad: List[Violation] = []
+    for line, comment in sorted(src.comments.items()):
+        if not _PRAGMA_HINT_RE.search(comment):
+            continue
+        snippet = src.line_text(line)
+        m = _PRAGMA_RE.search(comment)
+        if m is None:
+            bad.append(Violation(
+                rule="GL00", path=src.relpath, line=line, col=0,
+                message=(
+                    "malformed graftlint pragma — expected "
+                    "'# graftlint: ok[RULE] <reason>'"
+                ),
+                snippet=snippet,
+            ))
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        reason = m.group("reason").strip()
+        if not rules:
+            bad.append(Violation(
+                rule="GL00", path=src.relpath, line=line, col=0,
+                message="graftlint pragma names no rules (ok[] is empty)",
+                snippet=snippet,
+            ))
+            continue
+        if not reason:
+            bad.append(Violation(
+                rule="GL00", path=src.relpath, line=line, col=0,
+                message=(
+                    "graftlint pragma is missing its mandatory reason — "
+                    f"say WHY {'/'.join(sorted(rules))} is acceptable here"
+                ),
+                snippet=snippet,
+            ))
+            continue
+        own_line = src.line_text(line).startswith("#")
+        pragmas.append(Pragma(line, rules, reason, own_line))
+    return pragmas, bad
+
+
+def apply(src: SourceFile,
+          violations: List[Violation]) -> Tuple[List[Violation], List[Violation]]:
+    """Split ``violations`` into (kept, suppressed) per the file's pragmas;
+    malformed pragmas are appended to the kept list as GL00."""
+    pragmas, bad = collect(src)
+    # statement extents: first line -> last line, for covering multi-line
+    # statements from an own-line pragma above them
+    stmt_end: Dict[int, int] = {}
+    for node in _ast.walk(src.tree):
+        if isinstance(node, _ast.stmt):
+            end = getattr(node, "end_lineno", node.lineno)
+            stmt_end[node.lineno] = max(stmt_end.get(node.lineno, 0), end)
+    by_line: Dict[int, List[Pragma]] = {}
+    for p in pragmas:
+        by_line.setdefault(p.line, []).append(p)
+        if p.own_line:
+            # extend over the comment block to the first CODE line below,
+            # then through that statement's full extent; bail out after a
+            # screenful so a stray pragma at the end of a file cannot
+            # blanket half of it
+            line = p.line + 1
+            limit = p.line + 25
+            while line <= min(len(src.lines), limit):
+                by_line.setdefault(line, []).append(p)
+                text = src.line_text(line)
+                if text and not text.startswith("#"):
+                    for cont in range(line + 1, stmt_end.get(line, line) + 1):
+                        by_line.setdefault(cont, []).append(p)
+                    break
+                line += 1
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
+    for v in violations:
+        if any(v.rule in p.rules for p in by_line.get(v.line, ())):
+            suppressed.append(v)
+        else:
+            kept.append(v)
+    kept.extend(bad)
+    return kept, suppressed

@@ -1,0 +1,56 @@
+"""graftlint rule registry.
+
+Each rule module exports ``RULE`` (the id), ``TITLE``, ``EXPLAIN`` (the
+``--explain`` / README catalog text) and ``check(SourceFile) ->
+list[Violation]``. ``GL00`` (malformed pragma) is owned by the pragma layer
+but documented here so ``--explain GL00`` works.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+from neuronx_distributed_tpu.scripts.graftlint.rules import (
+    gl01_donation,
+    gl02_host_sync,
+    gl03_recompile,
+    gl04_compat,
+    gl05_determinism,
+)
+
+RULE_MODULES = (
+    gl01_donation,
+    gl02_host_sync,
+    gl03_recompile,
+    gl04_compat,
+    gl05_determinism,
+)
+
+RULES: Dict[str, object] = {m.RULE: m for m in RULE_MODULES}
+
+GL00_EXPLAIN = """\
+GL00 pragma hygiene
+
+Emitted by the pragma layer itself, not a scanner: a
+`# graftlint: ok[RULE]` suppression that is malformed, names no rules, or
+is missing its MANDATORY reason. A suppression without a documented why is
+how the incident classes GL01-GL05 encode crept into the codebase the
+first time — the pragma exists to leave the rationale next to the code.
+"""
+
+EXPLAINS: Dict[str, str] = {"GL00": GL00_EXPLAIN}
+EXPLAINS.update({m.RULE: m.EXPLAIN for m in RULE_MODULES})
+
+TITLES: Dict[str, str] = {"GL00": "pragma hygiene"}
+TITLES.update({m.RULE: m.TITLE for m in RULE_MODULES})
+
+
+def run_rules(src: SourceFile, select=None) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in RULE_MODULES:
+        if select is not None and mod.RULE not in select:
+            continue
+        out.extend(mod.check(src))
+    out.sort(key=lambda v: (v.line, v.col, v.rule))
+    return out

@@ -1,0 +1,251 @@
+"""GL01 — host access to donated buffers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from neuronx_distributed_tpu.scripts.graftlint.analysis import (
+    AliasMap,
+    JitIndex,
+    call_key,
+    decorated_with_jit,
+    is_jit_call,
+    jit_donate_argnums,
+    root_of,
+)
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL01"
+TITLE = "donation aliasing"
+
+EXPLAIN = """\
+GL01 donation-aliasing
+
+Incident: PR 2 made the serving decode chunk donate its KV cache and slot
+state (`donate_argnums`) so XLA updates the (num_slots, max_seq_len) pytree
+in place. A `jax.device_get` on a donated state LEAF (to mirror the PRNG
+keys host-side) caches a host value on that array — and the NEXT dispatch
+silently demotes the donation to a full copy: no error, no warning, just
+the cache-copy-per-chunk cost the donation existed to remove
+(regression-tested in tests/serving/test_decode_chunking.py). Reading a
+donated tree AFTER dispatch is the mirror bug: the buffer is consumed, and
+on old jax that is a heap corruption, not an exception (PR 5's resume
+SIGABRT).
+
+Flagged, per function, for every argument ROOT passed in a donated
+position of a module-visible `jax.jit(..., donate_argnums=...)` callable
+(and for the donated parameters inside a donate-decorated function):
+  * `jax.device_get` / `np.asarray` / `float` / `int` / `bool` / `.item()`
+    applied to that root — before the dispatch it demotes the donation to
+    a copy; after it, it reads a consumed buffer
+  * passing the same donated root into a SECOND jitted dispatch in the
+    same function — the first dispatch consumed it
+
+The correct pattern is PR 2's: thread a COPY out of the jitted program as
+an output (`keys.copy()` in the chunk) and read THAT.
+"""
+
+_READ_COERCIONS = {"jax.device_get", "numpy.asarray", "numpy.array",
+                   "float", "int", "bool"}
+
+
+def _donated_param_names(fn: ast.FunctionDef, donate: Tuple[int, ...]) -> Set[str]:
+    args = fn.args.posonlyargs + fn.args.args
+    names = set()
+    for i in donate:
+        if 0 <= i < len(args):
+            names.add(args[i].arg)
+    return names
+
+
+def check(src: SourceFile) -> List[Violation]:
+    aliases = AliasMap(src.tree)
+    jits = JitIndex(src.tree, aliases)
+    donating = {
+        key: b for key, b in jits.bindings.items() if b.donate
+    }
+    out: List[Violation] = []
+
+    def fn_nodes():
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    # `self.X` roots donated ANYWHERE in the module are donated everywhere:
+    # the instance attribute outlives the function that dispatched it, so a
+    # host read in a sibling method (PR 2's `_pull_key`) is the same bug
+    module_self_donated: Set[Tuple[str, ...]] = set()
+    for fn in fn_nodes():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            key = call_key(node.func)
+            b = donating.get(key) if key is not None else None
+            if b is None:
+                continue
+            for i in b.donate:
+                if i < len(node.args):
+                    r = root_of(node.args[i])
+                    if r is not None and r[0] == "self":
+                        module_self_donated.add(r)
+
+    def collect_dispatches(fn):
+        """Donating dispatch calls with their BRANCH FRAMES — the chain of
+        (if/try node, arm) choices enclosing each call, so two calls in
+        mutually exclusive arms (if vs else, try-body vs except) are never
+        treated as sequential."""
+        calls = []
+
+        def walk(node, frames):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                return  # nested scope: analyzed as its own function
+            if isinstance(node, ast.Call):
+                key = call_key(node.func)
+                b = donating.get(key) if key is not None else None
+                if b is not None:
+                    calls.append((node, b, frames))
+            if isinstance(node, ast.If):
+                walk(node.test, frames)
+                for s in node.body:
+                    walk(s, frames + ((id(node), 0),))
+                for s in node.orelse:
+                    walk(s, frames + ((id(node), 1),))
+                return
+            if isinstance(node, ast.Try):
+                # orelse runs right after a completed body (same arm);
+                # each handler excludes the body's completion and the
+                # other handlers
+                for s in node.body + node.orelse:
+                    walk(s, frames + ((id(node), 0),))
+                for i, h in enumerate(node.handlers):
+                    for s in h.body:
+                        walk(s, frames + ((id(node), 2 + i),))
+                for s in node.finalbody:
+                    walk(s, frames)
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, frames)
+
+        walk(fn, ())
+        return calls
+
+    def mutually_exclusive(frames_a, frames_b) -> bool:
+        arms_a = dict(frames_a)
+        return any(
+            nid in arms_a and arms_a[nid] != arm for nid, arm in frames_b
+        )
+
+    for fn in fn_nodes():
+        # roots this function passes into donated positions, with the line
+        # of the (first) donating dispatch per root
+        donated: Dict[Tuple[str, ...], int] = {}
+        dispatch_calls = collect_dispatches(fn)
+        for node, b, _frames in dispatch_calls:
+            for i in b.donate:
+                if i < len(node.args):
+                    r = root_of(node.args[i])
+                    if r is not None:
+                        donated.setdefault(r, node.lineno)
+        # donate-decorated function bodies: the donated params themselves
+        if decorated_with_jit(fn, aliases):
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call) and is_jit_call(dec, aliases):
+                    for name in _donated_param_names(
+                        fn, jit_donate_argnums(dec, aliases)
+                    ):
+                        donated.setdefault((name,), fn.lineno)
+        for r in module_self_donated:
+            donated.setdefault(r, 0)
+        if not donated:
+            continue
+
+        def is_donated(expr: ast.AST) -> Optional[Tuple[str, ...]]:
+            r = root_of(expr)
+            return r if r is not None and r in donated else None
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            path = aliases.resolve(node.func)
+            if path in _READ_COERCIONS and node.args:
+                r = is_donated(node.args[0])
+                if r is not None:
+                    out.append(src.violation(
+                        RULE, node,
+                        f"host read ({path}) of donated tree "
+                        f"'{'.'.join(r)}' — before its dispatch this "
+                        "caches a host value and silently demotes the "
+                        "donation to a copy; after it, the buffer is "
+                        "consumed. Thread a device-side COPY out of the "
+                        "jitted program instead (PR 2 key-snapshot "
+                        "pattern)",
+                    ))
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                r = is_donated(node.func.value)
+                if r is not None:
+                    out.append(src.violation(
+                        RULE, node,
+                        f".item() on donated tree '{'.'.join(r)}' — a "
+                        "host read of a donated buffer (demotes the "
+                        "donation / reads consumed storage)",
+                    ))
+        # a donated root dispatched twice in one function WITHOUT being
+        # rebound in between: the second call consumes a consumed buffer
+        rebind_lines: Dict[Tuple[str, ...], List[int]] = {}
+
+        def _flatten_targets(t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    yield from _flatten_targets(e)
+            elif isinstance(t, ast.Starred):
+                yield from _flatten_targets(t.value)
+            else:
+                yield t
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for el in _flatten_targets(t):
+                        r = root_of(el)
+                        if r is not None:
+                            rebind_lines.setdefault(r, []).append(node.lineno)
+
+        def rebound_between(r, lo, hi):
+            return any(lo <= ln <= hi for ln in rebind_lines.get(r, ()))
+
+        seen_roots: Dict[Tuple[str, ...], List] = {}
+        calls_in_order = sorted(dispatch_calls, key=lambda nb: nb[0].lineno)
+        for node, b, frames in calls_in_order:
+            for i in b.donate:
+                if i >= len(node.args):
+                    continue
+                r = root_of(node.args[i])
+                if r is None:
+                    continue
+                prior = seen_roots.setdefault(r, [])
+                hit = next(
+                    (
+                        (ln, fr) for ln, fr in prior
+                        if ln != node.lineno
+                        and not rebound_between(r, ln, node.lineno)
+                        and not mutually_exclusive(fr, frames)
+                    ),
+                    None,
+                )
+                if hit is not None:
+                    out.append(src.violation(
+                        RULE, node,
+                        f"donated tree '{'.'.join(r)}' passed to a second "
+                        f"donating dispatch (first at line {hit[0]}) "
+                        "without rebinding — the first dispatch consumed "
+                        "it",
+                    ))
+                else:
+                    prior.append((node.lineno, frames))
+    return out

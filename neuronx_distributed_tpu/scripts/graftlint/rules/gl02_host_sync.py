@@ -1,0 +1,232 @@
+"""GL02 — host synchronization in a hot-path module."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from neuronx_distributed_tpu.scripts.graftlint.analysis import (
+    DEVICE,
+    AliasMap,
+    JitIndex,
+    TaintEnv,
+)
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL02"
+TITLE = "host sync in hot path"
+
+EXPLAIN = """\
+GL02 host-sync-in-hot-path
+
+Incident: the serving decode path's throughput win (PR 2: 8x fewer host
+syncs, 3.4x decode under host load) and the trainer's deferred-guard overlap
+(PR 5) are contracts about EXACTLY how many times the host blocks on the
+device per chunk/step. One stray `float(x)`, `int(x)`, `np.asarray(x)` or
+data-dependent `if` on a device value silently re-serializes the pipeline —
+wall-clock regresses with zero functional symptoms (pjit-on-TPU scaling,
+arXiv 2204.06514: implicit transfers and retraces dominate long before the
+compiler does).
+
+Scope: the modules whose host-sync counts are pinned by tests —
+serving/engine.py, serving/cache_manager.py, inference/generate.py,
+trainer/loop.py — plus any module carrying a `# graftlint: hot-path`
+comment marker (the opt-in for future hot paths and for fixtures).
+
+Flagged inside hot modules:
+  * `float/int/bool` coercion of a device-resident value (`len()` and
+    `.shape`/`.ndim`/`.dtype` are host-side metadata and stay legal)
+  * `.item()` on a (possibly) device value
+  * `np.asarray`/`np.array` of a device-resident value
+  * `if`/`while` branching on a device-resident value
+  * `jax.device_get(...)` — EVERY explicit sync must either be the
+    documented one (pragma with reason: `# graftlint: ok[GL02] ...`) or not
+    exist
+
+"Device-resident" is decided by a conservative per-function taint walk
+(came from jnp/jax.random/jax.lax/a jitted callable; laundered back to host
+only by jax.device_get or numpy) — unknown provenance is never flagged, so
+intentional host math stays quiet.
+"""
+
+HOT_SUFFIXES = (
+    "serving/engine.py",
+    "serving/cache_manager.py",
+    "inference/generate.py",
+    "trainer/loop.py",
+)
+HOT_MARKER = "graftlint: hot-path"
+
+# NOTE: len() is NOT here — len/.shape/.ndim/.dtype on a jax.Array are
+# host-side metadata reads, no device transfer happens
+_COERCIONS = {"float", "int", "bool"}
+_NP_COERCIONS = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+
+def is_hot(src: SourceFile) -> bool:
+    return any(src.relpath.endswith(s) for s in HOT_SUFFIXES) or (
+        src.contains_marker(HOT_MARKER)
+    )
+
+
+class _FnChecker:
+    def __init__(self, src: SourceFile, aliases: AliasMap, jits: JitIndex,
+                 out: List[Violation]):
+        self.src = src
+        self.aliases = aliases
+        self.jits = jits
+        self.out = out
+        self.env = TaintEnv(aliases, jits)
+
+    # --- expression checks ---------------------------------------------------
+
+    def check_expr(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            path = self.aliases.resolve(sub.func)
+            if path == "jax.device_get":
+                self.out.append(self.src.violation(
+                    RULE, sub,
+                    "explicit jax.device_get in a hot-path module — every "
+                    "sync here must be an accounted-for part of the "
+                    "per-chunk/per-step budget (pragma with the reason if "
+                    "it is)",
+                ))
+                continue
+            if path in _COERCIONS and len(sub.args) == 1:
+                if self.env.taint(sub.args[0]) == DEVICE:
+                    self.out.append(self.src.violation(
+                        RULE, sub,
+                        f"{path}() of a device value blocks the host on "
+                        "the device (an implicit transfer no profiler "
+                        "labels) — read it through the path's single "
+                        "explicit device_get, or keep it on device",
+                    ))
+                continue
+            if path in _NP_COERCIONS and sub.args:
+                if self.env.taint(sub.args[0]) == DEVICE:
+                    self.out.append(self.src.violation(
+                        RULE, sub,
+                        "np.asarray of a device value is an implicit "
+                        "device->host transfer — make it explicit "
+                        "(jax.device_get) or keep it on device",
+                    ))
+                continue
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "item"
+                and not sub.args
+            ):
+                base_t = self.env.taint(sub.func.value)
+                if base_t != "host":
+                    self.out.append(self.src.violation(
+                        RULE, sub,
+                        ".item() is a host sync — route it through the "
+                        "hot path's explicit device_get",
+                    ))
+
+    def check_branch(self, test: ast.AST, kind: str) -> None:
+        if self.env.taint(test) == DEVICE:
+            self.out.append(self.src.violation(
+                RULE, test,
+                f"`{kind}` on a device value forces a blocking sync at "
+                "trace boundaries (and a TracerError under jit) — compute "
+                "the predicate on device (jnp.where/lax.cond) or on "
+                "host-read state",
+            ))
+
+    # --- ordered statement walk ---------------------------------------------
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        self._block(fn.body)
+
+    def _block(self, stmts) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested scope: fresh checker sharing the current env snapshot
+            sub = _FnChecker(self.src, self.aliases, self.jits, self.out)
+            sub.env.env = dict(self.env.env)
+            sub.run(stmt)
+            return
+        if isinstance(stmt, ast.Assign):
+            self.check_expr(stmt.value)
+            t = self.env.taint(stmt.value)
+            for tgt in stmt.targets:
+                self.env.assign(tgt, t, stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.check_expr(stmt.value)
+            self.env.assign(stmt.target, self.env.taint(stmt.value), stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self.check_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.If):
+            self.check_branch(stmt.test, "if")
+            self.check_expr(stmt.test)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self.check_branch(stmt.test, "while")
+            self.check_expr(stmt.test)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self.check_expr(stmt.iter)
+            self.env.assign(stmt.target, self.env.taint(stmt.iter))
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self.check_expr(item.context_expr)
+            self._block(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._block(stmt.body)
+            for h in stmt.handlers:
+                self._block(h.body)
+            self._block(stmt.orelse)
+            self._block(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self.check_expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self.check_expr(stmt.exc)
+            return
+        if isinstance(stmt, ast.Assert):
+            self.check_expr(stmt.test)
+            return
+        # Pass/Break/Continue/Import/Global/... — nothing to check
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self.check_expr(sub)
+
+
+def check(src: SourceFile) -> List[Violation]:
+    if not is_hot(src):
+        return []
+    aliases = AliasMap(src.tree)
+    jits = JitIndex(src.tree, aliases)
+    out: List[Violation] = []
+    # top-level functions and methods; nested defs are handled in-walk so
+    # they see the enclosing taint env
+    def top_level_fns(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child
+            elif isinstance(child, ast.ClassDef):
+                yield from top_level_fns(child)
+
+    for fn in top_level_fns(src.tree):
+        _FnChecker(src, aliases, jits, out).run(fn)
+    return out

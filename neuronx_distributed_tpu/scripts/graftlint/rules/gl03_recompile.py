@@ -1,0 +1,211 @@
+"""GL03 — recompile hazards."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from neuronx_distributed_tpu.scripts.graftlint.analysis import (
+    AliasMap,
+    decorated_with_jit,
+    is_jit_call,
+)
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL03"
+TITLE = "recompile hazard"
+
+EXPLAIN = """\
+GL03 recompile-hazard
+
+Incidents this rule descends from:
+  * PR 5: `create_train_state` built the step scalar as a bare `jnp.zeros()`
+    — an UNCOMMITTED array whose placement differs from the committed output
+    of the first step, so the second `fit()` call silently recompiled the
+    entire train step (one wasted multi-second compile per run). Fix:
+    `committed_step0()` routes through `jax.device_put` with an explicit
+    sharding.
+  * PR 4: module-level jitted helpers cross-polluted pjit caches between
+    engines — in this jax, two `jax.jit(f)` wrappers of the same function
+    OBJECT share a cache, so per-engine compile counters lied and a second
+    engine's shapes could evict the first's entries. Fix: per-instance
+    lambda wrappers.
+
+Flagged:
+  * module-scope `NAME = jax.jit(...)` bindings (per-instance state reaches
+    them through closure or args and retraces/cross-pollutes; bind per
+    instance, or keep the jit inside a function)
+  * `@jax.jit` on a method (the `self` argument is hashed by object
+    identity: one compile per instance, stale instance state baked into the
+    trace)
+  * a jit-decorated nested function capturing a closure variable that the
+    enclosing scope REASSIGNS after the definition, or reading `self.*`
+    (the traced value is frozen at first call; later mutations silently
+    don't apply)
+  * long-lived `step=`/`.step` state built from a bare jnp constructor
+    (`jnp.zeros/asarray/...`) instead of a `jax.device_put`-committed array
+    — the uncommitted-placement recompile above
+"""
+
+_JNP_CONSTRUCTORS = {
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+    "jax.numpy.asarray", "jax.numpy.array", "jax.numpy.arange",
+}
+
+
+def _free_loads(fn: ast.FunctionDef) -> set:
+    """Names read inside ``fn`` that it neither binds nor takes as params."""
+    bound = {a.arg for a in fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs}
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    loads, stores = set(), set(bound)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                loads.add(node.id)
+            else:
+                stores.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            stores.add(node.name)
+    return loads - stores
+
+
+def _names_rebound_after(scope: ast.FunctionDef, after_line: int) -> set:
+    """Names ``scope`` ITSELF rebinds after ``after_line``. Does not
+    descend into nested function/class bodies — their assignments are
+    locals of a different scope, not rebindings of the captured name."""
+    out = set()
+
+    def visit(stmts):
+        for node in stmts:
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                # the def/class NAME is a binding in this scope; its body
+                # is not
+                if node.lineno > after_line:
+                    out.add(node.name)
+                continue
+            if getattr(node, "lineno", 0) > after_line:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    targets = [node.target]
+                elif isinstance(node, (ast.With, ast.AsyncWith)):
+                    targets = [
+                        item.optional_vars for item in node.items
+                        if item.optional_vars is not None
+                    ]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            out.add(sub.id)
+            for field in ("body", "orelse", "finalbody"):
+                visit(getattr(node, field, None) or [])
+            for h in getattr(node, "handlers", None) or []:
+                visit(h.body)
+
+    visit(scope.body)
+    return out
+
+
+def _is_committed(value: ast.AST, aliases: AliasMap) -> bool:
+    """True when the expression routes through jax.device_put (directly or
+    as the outer call)."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call) and aliases.resolve(sub.func) == "jax.device_put":
+            return True
+    return False
+
+
+def check(src: SourceFile) -> List[Violation]:
+    aliases = AliasMap(src.tree)
+    out: List[Violation] = []
+
+    # (a) module-scope jit bindings
+    for stmt in src.tree.body:
+        if isinstance(stmt, ast.Assign) and is_jit_call(stmt.value, aliases):
+            out.append(src.violation(
+                RULE, stmt,
+                "module-level jax.jit object — pjit caches key on the "
+                "function object, so instances sharing this wrapper cross-"
+                "pollute compile caches/counters; bind it per instance "
+                "(lambda wrapper) or inside a function",
+            ))
+
+    # walk functions for (b)/(c): every FunctionDef with its enclosing
+    # FunctionDef (None at module/class scope)
+    def iter_fns(node, enclosing):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, enclosing
+                yield from iter_fns(child, child)
+            else:
+                yield from iter_fns(child, enclosing)
+
+    for fn, enclosing in iter_fns(src.tree, None):
+        if not decorated_with_jit(fn, aliases):
+            continue
+        args = fn.args.posonlyargs + fn.args.args
+        if args and args[0].arg == "self":
+            out.append(src.violation(
+                RULE, fn,
+                f"@jax.jit on method '{fn.name}' — `self` is hashed by "
+                "identity (one compile per instance, instance state baked "
+                "into the trace); jit a pure function and pass state "
+                "explicitly",
+            ))
+        if enclosing is None:
+            continue
+        free = _free_loads(fn)
+        if "self" in free:
+            out.append(src.violation(
+                RULE, fn,
+                f"jit-decorated closure '{fn.name}' reads `self.*` — "
+                "captured instance state is frozen into the first "
+                "trace; pass it as an argument",
+            ))
+        rebound = _names_rebound_after(enclosing, fn.lineno) & free
+        rebound.discard(fn.name)
+        for name in sorted(rebound):
+            out.append(src.violation(
+                RULE, fn,
+                f"jit-decorated closure '{fn.name}' captures '{name}', "
+                "which the enclosing scope reassigns after the "
+                "definition — the trace keeps the OLD value; pass it "
+                "as an argument",
+            ))
+
+    # (d) uncommitted long-lived step scalars
+    for node in ast.walk(src.tree):
+        value = None
+        where = None
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "step":
+                    value, where = kw.value, kw.value
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "step":
+                    value, where = node.value, node
+        if value is None:
+            continue
+        if (
+            isinstance(value, ast.Call)
+            and aliases.resolve(value.func) in _JNP_CONSTRUCTORS
+            and not _is_committed(value, aliases)
+        ):
+            out.append(src.violation(
+                RULE, where,
+                "long-lived `step` state from a bare jnp constructor — "
+                "uncommitted placement differs from the jitted step's "
+                "committed output and silently recompiles the whole "
+                "program on the next call (PR 5); route through "
+                "jax.device_put (see trainer.committed_step0)",
+            ))
+    return out

@@ -1,0 +1,86 @@
+"""GL04 — compat-layer bypass."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from neuronx_distributed_tpu.scripts.graftlint.analysis import AliasMap
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL04"
+TITLE = "compat-layer bypass"
+
+EXPLAIN = """\
+GL04 compat-layer bypass
+
+Incident: PR 5's jax<0.5 compat layer exists because this container's XLA
+hard-SIGABRTs (not a catchable error — the process dies) on the lowering of
+raw `jax.experimental.shard_map` partial-manual regions and on the
+PartitionId op `lax.axis_index` emits there, and old jax lacks
+`jax.sharding.get_abstract_mesh` entirely. Every explicit-SPMD entry point
+must therefore route through parallel/mesh.py:
+
+    jax.(experimental.)shard_map   -> mesh.compat_shard_map / manual_shard_map
+    lax.axis_index                 -> mesh.compat_axis_index
+    jax.sharding.get_abstract_mesh -> mesh.ctx_abstract_mesh
+
+A raw call works on the code path a test happens to take and SIGABRTs the
+whole run on another — which is why this is a lint rule, not a code review
+note. parallel/mesh.py itself is the one exempt module (it IS the layer).
+"""
+
+_EXEMPT_SUFFIX = "parallel/mesh.py"
+
+_BANNED_IMPORT_MODULES = ("jax.experimental.shard_map",)
+_BANNED_PATHS = {
+    "jax.shard_map": "use mesh.compat_shard_map (or mesh.manual_shard_map)",
+    "jax.experimental.shard_map": "use mesh.compat_shard_map",
+    "jax.experimental.shard_map.shard_map": "use mesh.compat_shard_map",
+    "jax.lax.axis_index": "use mesh.compat_axis_index",
+    "jax.sharding.get_abstract_mesh": "use mesh.ctx_abstract_mesh",
+}
+
+
+def check(src: SourceFile) -> List[Violation]:
+    if src.relpath.endswith(_EXEMPT_SUFFIX):
+        return []
+    aliases = AliasMap(src.tree)
+    out: List[Violation] = []
+
+    def flag(node: ast.AST, what: str, fix: str) -> None:
+        out.append(src.violation(
+            RULE, node,
+            f"raw {what} bypasses the jax<0.5 compat layer — {fix} "
+            "(parallel/mesh.py)",
+        ))
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in _BANNED_IMPORT_MODULES:
+                    flag(node, f"import of {a.name}", "use mesh.compat_shard_map")
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module in _BANNED_IMPORT_MODULES:
+                flag(node, f"import from {node.module}",
+                     "use mesh.compat_shard_map")
+            for a in node.names:
+                full = f"{node.module}.{a.name}"
+                if full in _BANNED_PATHS:
+                    flag(node, full, _BANNED_PATHS[full])
+        elif isinstance(node, (ast.Attribute, ast.Name)):
+            path = aliases.resolve(node)
+            if path in _BANNED_PATHS:
+                # skip the inner Name/Attribute of a chain we already
+                # flagged at the outermost matching node
+                flag(node, path, _BANNED_PATHS[path])
+
+    # one finding per source line: the Attribute walk sees both the outer
+    # chain and pieces of it when aliased imports overlap
+    seen = set()
+    deduped = []
+    for v in out:
+        if (v.line, v.rule) not in seen:
+            seen.add((v.line, v.rule))
+            deduped.append(v)
+    return deduped

@@ -1,0 +1,109 @@
+"""GL05 — nondeterminism in library code."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from neuronx_distributed_tpu.scripts.graftlint.analysis import AliasMap
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL05"
+TITLE = "nondeterminism"
+
+EXPLAIN = """\
+GL05 nondeterminism
+
+Incident: the fault-tolerance contract (PR 3/PR 5) promises BIT-identical
+kill-and-resume and chaos replays. That only holds if every random draw in
+library code is seeded from checkpointable state: one `np.random.randint()`
+on the process-global RNG, one `random.random()`, or a wall-clock-seeded
+PRNGKey, and the resumed run silently diverges from the uninterrupted one —
+the hardest class of bug to bisect because each run looks individually fine.
+
+Flagged:
+  * process-global RNG draws: `np.random.<draw>` / stdlib `random.<draw>`
+  * generator construction with no seed: `np.random.default_rng()`,
+    `random.Random()`
+  * wall-clock seeding: `time.time()` / `time.time_ns()` inside the
+    arguments of PRNGKey/default_rng/SeedSequence/Random/seed
+
+Fine as-is: `np.random.default_rng(seed)`, `np.random.SeedSequence([...])`,
+`jax.random.*` keyed from checkpointed state.
+"""
+
+_NP_GLOBAL_DRAWS = {
+    "rand", "randn", "randint", "random", "random_sample", "choice",
+    "shuffle", "permutation", "seed", "normal", "uniform", "standard_normal",
+    "bytes", "sample",
+}
+_STDLIB_DRAWS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "uniform", "sample", "seed", "getrandbits", "gauss", "normalvariate",
+    "betavariate", "expovariate",
+}
+_SEED_SINKS = {
+    "jax.random.PRNGKey", "jax.random.key", "numpy.random.default_rng",
+    "numpy.random.SeedSequence", "random.Random", "random.seed",
+    "numpy.random.seed",
+}
+
+
+def _contains_wall_clock(node: ast.AST, aliases: AliasMap) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            path = aliases.resolve(sub.func)
+            if path in ("time.time", "time.time_ns", "datetime.datetime.now"):
+                return True
+    return False
+
+
+def check(src: SourceFile) -> List[Violation]:
+    aliases = AliasMap(src.tree)
+    out: List[Violation] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        path = aliases.resolve(node.func)
+        if path is None:
+            continue
+        if path.startswith("numpy.random."):
+            fn = path.rsplit(".", 1)[1]
+            if fn in _NP_GLOBAL_DRAWS:
+                out.append(src.violation(
+                    RULE, node,
+                    f"np.random.{fn} draws from the process-global RNG — "
+                    "seed an explicit np.random.default_rng(seed) so chaos/"
+                    "resume replays stay bit-identical",
+                ))
+            elif fn == "default_rng" and not node.args and not node.keywords:
+                out.append(src.violation(
+                    RULE, node,
+                    "np.random.default_rng() without a seed is entropy-"
+                    "seeded — pass a seed derived from checkpointable state",
+                ))
+        elif path.startswith("random."):
+            fn = path.rsplit(".", 1)[1]
+            if fn in _STDLIB_DRAWS:
+                out.append(src.violation(
+                    RULE, node,
+                    f"stdlib random.{fn} uses the process-global RNG — "
+                    "use a seeded random.Random(seed) (or np default_rng)",
+                ))
+            elif fn == "Random" and not node.args and not node.keywords:
+                out.append(src.violation(
+                    RULE, node,
+                    "random.Random() without a seed is entropy-seeded — "
+                    "pass an explicit seed",
+                ))
+        if path in _SEED_SINKS and any(
+            _contains_wall_clock(a, aliases)
+            for a in list(node.args) + [kw.value for kw in node.keywords]
+        ):
+            out.append(src.violation(
+                RULE, node,
+                f"{path} seeded from the wall clock — every run gets a "
+                "different stream and resume can never replay it; derive "
+                "the seed from config/checkpoint state",
+            ))
+    return out

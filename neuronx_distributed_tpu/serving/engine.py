@@ -194,6 +194,11 @@ def _key_data(key) -> np.ndarray:
     dt = getattr(key, "dtype", None)
     if dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key):
         key = jax.random.key_data(key)
+    if isinstance(key, jax.Array):
+        # graftlint: ok[GL02] submit-time key capture — one tiny read on
+        # the admission API, not the decode loop; explicit so a
+        # transfer-guarded run can tell it from an accidental sync
+        key = jax.device_get(key)
     return np.asarray(key, np.uint32)
 
 
@@ -931,11 +936,20 @@ class ServingEngine:
             req.admit_time = now
         if not req.tokens:
             # fresh request: sample the first token exactly as generate()
-            # does — split the request key, sample with the sub-key
+            # does — split the request key, sample with the sub-key. The
+            # sampled token and the advanced key ride ONE explicit
+            # device_get (they used to be two implicit syncs — an int()
+            # coercion plus an np.asarray — which GL02 now forbids;
+            # tests/serving/test_host_sync.py pins the count at 1)
             carry, sub = jax.random.split(jnp.asarray(req.key))
             temp, topk, topp = _config_sentinels(req.config)
-            tok0 = int(self._first_token(logits, sub, temp, topk, topp))
-            req.key = np.asarray(carry, np.uint32)
+            # graftlint: ok[GL02] the admission path's single documented
+            # sync: first token + advanced request key in one readback
+            tok0_h, carry_h = jax.device_get(
+                (self._first_token(logits, sub, temp, topk, topp), carry)
+            )
+            tok0 = int(tok0_h)
+            req.key = np.asarray(carry_h, np.uint32)
             self._emit_token(req, tok0, now, first=True)
             if req.state is RequestState.CANCELLED:
                 # the on_token callback cancelled on the FIRST token (while
@@ -1027,12 +1041,16 @@ class ServingEngine:
             if shapes != entry.shapes:
                 return False
             # entry.fingerprint is a device scalar computed asynchronously
-            # at insert time — long settled by now, so its float() is a
+            # at insert time — long settled by now, so reading it is a
             # plain copy; the recomputation's readback is the validation
-            # sync (the admission path syncs for the first token anyway)
-            return float(self._fingerprint_fn(entry.tree)) == float(
-                entry.fingerprint
+            # sync (the admission path syncs for the first token anyway).
+            # Both scalars ride one explicit device_get.
+            # graftlint: ok[GL02] reuse-time integrity check: one scalar
+            # pair readback per prefix hit, the documented validation sync
+            fp_new, fp_stored = jax.device_get(
+                (self._fingerprint_fn(entry.tree), entry.fingerprint)
             )
+            return float(fp_new) == float(fp_stored)
         except Exception:
             return False
 
@@ -1121,6 +1139,8 @@ class ServingEngine:
         # snapshot is a chunk OUTPUT, not the state leaf: device_get on the
         # leaf would cache a host value on it and silently demote the next
         # chunk's keys donation to a copy
+        # graftlint: ok[GL02] THE one per-chunk sync of the fused decode
+        # contract (tests/serving/test_decode_chunking.py pins it at 1)
         toks, counts, used, chunk_keys = jax.device_get(
             (toks, counts, used, key_snap)
         )

@@ -271,6 +271,25 @@ def _reset_parallel_state():
 
 
 @pytest.fixture
+def transfer_guard_disallow():
+    """Opt-in dynamic witness for graftlint's GL02 (host-sync-in-hot-path):
+    runs the test under ``jax.transfer_guard_device_to_host("disallow")``,
+    so any IMPLICIT device->host read (``float()``/``int()``/``np.asarray``
+    on a device array) raises while the hot paths' explicit, documented
+    ``jax.device_get`` syncs stay legal. Used by the ``sanitize``-marked
+    engine/trainer hot-loop tests (pyproject registers the marker).
+
+    Honesty note for this container: jax 0.4.37's CPU backend serves
+    device->host reads zero-copy without consulting the context guard, so
+    the guard is inert HERE and bites on real accelerator backends (and on
+    newer jax) — the static GL02 pass is the primary enforcement either
+    way; this fixture is its runtime witness where the runtime can witness.
+    """
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+@pytest.fixture
 def tp4_mesh():
     """pp=1, dp=2, cp=1, tp=4 over the 8 virtual devices."""
     state = mesh_lib.initialize_model_parallel(

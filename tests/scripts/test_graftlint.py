@@ -1,0 +1,691 @@
+"""graftlint: the repo-native static-analysis suite (scripts/graftlint/).
+
+Covers: at least one true positive AND one clean negative per rule
+GL01-GL05, pragma suppression (incl. the mandatory-reason contract),
+the baseline ratchet (add / fix-shrinks / stale-fails), the repo-wide
+tier-1 run (zero non-baselined violations — fast, pure AST), and the
+acceptance re-injection checks: the PR 2 donated-leaf ``device_get`` bug
+or a raw ``jax.experimental.shard_map`` import in ``serving/`` must make
+the lint fail."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from neuronx_distributed_tpu.scripts.graftlint import baseline as baseline_mod
+from neuronx_distributed_tpu.scripts.graftlint import runner
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+PKG = os.path.join(REPO_ROOT, "neuronx_distributed_tpu")
+
+
+def lint(tmp_path, code, name="snippet.py"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(code))
+    return runner.scan([str(p)], root=str(tmp_path)).violations
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# --- GL01 donation-aliasing ---------------------------------------------------
+
+GL01_POSITIVE = """\
+    import jax
+    import jax.numpy as jnp
+
+    class Engine:
+        def __init__(self):
+            self._decode = jax.jit(lambda p, c, s: (c, s), donate_argnums=(1, 2))
+
+        def step(self, params):
+            cache, self._state = self._decode(params, self._cache, self._state)
+            jax.device_get(self._state["keys"])  # the PR 2 bug, verbatim
+"""
+
+
+def test_gl01_donated_leaf_device_get(tmp_path):
+    v = lint(tmp_path, GL01_POSITIVE)
+    assert "GL01" in rules_of(v)
+    assert any("_state" in x.message for x in v if x.rule == "GL01")
+
+
+def test_gl01_cross_method_read_of_donated_attr(tmp_path):
+    # PR 2's actual shape: the device_get lived in a SIBLING method
+    # (`_pull_key`), not next to the dispatch
+    v = lint(tmp_path, """\
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._decode = jax.jit(lambda p, s: s, donate_argnums=(1,))
+
+            def step(self, params):
+                self._state = self._decode(params, self._state)
+
+            def pull_key(self, slot):
+                return jax.device_get(self._state["keys"])[slot]
+    """)
+    assert "GL01" in rules_of(v)
+
+
+def test_gl01_decorated_donated_param(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def update(state, x):
+            bad = float(state["loss"])
+            return state, bad
+    """)
+    assert "GL01" in rules_of(v)
+
+
+def test_gl01_negative_copy_output_pattern(tmp_path):
+    # the CORRECT pattern: read the chunk's copied output, not the donated
+    # tree; rebinding between two dispatches is also fine
+    v = lint(tmp_path, """\
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._decode = jax.jit(lambda p, s: (s, s["k"]), donate_argnums=(1,))
+
+            def step(self, params):
+                self._state, snap = self._decode(params, self._state)
+                self._state, snap = self._decode(params, self._state)
+                return jax.device_get(snap)
+    """)
+    assert [x for x in v if x.rule == "GL01"] == []
+
+
+def test_gl01_second_dispatch_without_rebinding(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+
+        def run(params, state):
+            step = jax.jit(lambda p, s: s, donate_argnums=(1,))
+            a = step(params, state)
+            b = step(params, state)  # state was consumed by the first call
+            return a, b
+    """)
+    assert any(
+        "second donating dispatch" in x.message for x in v if x.rule == "GL01"
+    )
+
+
+def test_gl01_branch_exclusive_dispatches_not_flagged(tmp_path):
+    # if/else (and try-body/except) arms are mutually exclusive — only one
+    # dispatch runs, no buffer is consumed twice (review round 1)
+    v = lint(tmp_path, """\
+        import jax
+
+        def run(params, state, fast):
+            step = jax.jit(lambda p, s: s, donate_argnums=(1,))
+            if fast:
+                out = step(params, state)
+            else:
+                out = step(params, state)
+            return out
+    """)
+    assert [x for x in v if x.rule == "GL01"] == []
+
+
+# --- GL02 host-sync-in-hot-path ----------------------------------------------
+
+GL02_POSITIVE = """\
+    # graftlint: hot-path
+    import jax
+    import jax.numpy as jnp
+
+    def hot_loop(xs):
+        total = jnp.sum(xs)
+        n = int(total)              # implicit sync
+        if total > 0:               # branch on device value
+            n += 1
+        host = jax.device_get(total)  # undocumented explicit sync
+        return n, host
+"""
+
+
+def test_gl02_hot_module_syncs(tmp_path):
+    v = [x for x in lint(tmp_path, GL02_POSITIVE) if x.rule == "GL02"]
+    msgs = " | ".join(x.message for x in v)
+    assert len(v) == 3
+    assert "int()" in msgs and "`if`" in msgs and "device_get" in msgs
+
+
+def test_gl02_quiet_outside_hot_modules(tmp_path):
+    # same code without the hot-path marker (and not one of the four named
+    # hot modules): GL02 does not apply
+    code = GL02_POSITIVE.replace("# graftlint: hot-path\n", "")
+    assert [x for x in lint(tmp_path, code) if x.rule == "GL02"] == []
+
+
+def test_gl02_host_values_not_flagged(tmp_path):
+    # laundering through device_get makes later coercions free — the taint
+    # layer must not flag host math (the readback-unpack pattern in
+    # engine._decode)
+    v = lint(tmp_path, """\
+        # graftlint: hot-path
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def chunk(step, state):
+            toks, counts = step(state)
+            toks, counts = jax.device_get((toks, counts))  # graftlint: ok[GL02] the one per-chunk sync
+            total = int(counts.sum())
+            flat = np.asarray(toks)
+            if total > 0:
+                return flat
+            return None
+    """)
+    assert [x for x in v if x.rule == "GL02"] == []
+
+
+def test_gl02_named_hot_module_path(tmp_path):
+    # the four contract modules are hot by PATH, no marker needed
+    v = lint(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        def f(x):
+            return float(jnp.sum(x))
+        """,
+        name="serving/engine.py",
+    )
+    assert "GL02" in rules_of(v)
+
+
+def test_gl02_metadata_reads_not_flagged(tmp_path):
+    # len()/.shape/.ndim/.dtype on a jax.Array are host-side metadata, not
+    # syncs (review round 1)
+    v = lint(tmp_path, """\
+        # graftlint: hot-path
+        import jax.numpy as jnp
+
+        def f(xs):
+            y = jnp.cumsum(xs)
+            n = len(y)
+            m = int(y.shape[0])
+            k = int(y.ndim)
+            return n + m + k
+    """)
+    assert [x for x in v if x.rule == "GL02"] == []
+
+
+# --- GL03 recompile-hazard ----------------------------------------------------
+
+
+def test_gl03_module_level_jit(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+
+        _shared = jax.jit(lambda x: x + 1)
+    """)
+    assert any("module-level" in x.message for x in v if x.rule == "GL03")
+
+
+def test_gl03_jit_on_method(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+
+        class M:
+            @jax.jit
+            def forward(self, x):
+                return x * self.scale
+    """)
+    assert any("method" in x.message for x in v if x.rule == "GL03")
+
+
+def test_gl03_closure_capture_reassigned(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+
+        def build(scale):
+            @jax.jit
+            def f(x):
+                return x * scale
+            scale = scale + 1  # f's trace keeps the OLD value
+            return f
+    """)
+    assert any("captures 'scale'" in x.message for x in v if x.rule == "GL03")
+
+
+def test_gl03_uncommitted_step_scalar(tmp_path):
+    v = lint(tmp_path, """\
+        import jax.numpy as jnp
+
+        def make_state(cls, params):
+            return cls(step=jnp.zeros((), jnp.int32), params=params)
+    """)
+    assert any("step" in x.message for x in v if x.rule == "GL03")
+
+
+def test_gl03_negative_committed_and_local(tmp_path):
+    # committed_step0 pattern + function-local jit + stable closure capture:
+    # all clean
+    v = lint(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        def committed_step0():
+            return jax.device_put(jnp.zeros((), jnp.int32))
+
+        def make_state(cls, params):
+            return cls(step=committed_step0(), params=params)
+
+        def build(model):
+            clone = model.clone()
+
+            @jax.jit
+            def f(params, x):
+                return clone.apply(params, x)
+
+            return f
+    """)
+    assert [x for x in v if x.rule == "GL03"] == []
+
+
+def test_gl03_sibling_function_locals_not_flagged(tmp_path):
+    # a helper closure's LOCAL reusing the captured name is a different
+    # scope, not a rebinding of what the jitted closure traced (review
+    # round 1)
+    v = lint(tmp_path, """\
+        import jax
+
+        def build(scale):
+            @jax.jit
+            def f(x):
+                return x * scale
+
+            def helper():
+                scale = 2
+                return scale
+
+            return f, helper
+    """)
+    assert [x for x in v if x.rule == "GL03"] == []
+
+
+# --- GL04 compat-layer bypass -------------------------------------------------
+
+
+def test_gl04_raw_shard_map_import(tmp_path):
+    v = lint(tmp_path, """\
+        from jax.experimental.shard_map import shard_map
+
+        def f(fn, mesh, specs):
+            return shard_map(fn, mesh=mesh, in_specs=specs, out_specs=specs)
+    """)
+    assert "GL04" in rules_of(v)
+
+
+def test_gl04_raw_axis_index(tmp_path):
+    v = lint(tmp_path, """\
+        from jax import lax
+
+        def ring_step(x, axis_name):
+            rank = lax.axis_index(axis_name)
+            return x + rank
+    """)
+    assert "GL04" in rules_of(v)
+
+
+def test_gl04_get_abstract_mesh(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+
+        def ctx():
+            return jax.sharding.get_abstract_mesh()
+    """)
+    assert "GL04" in rules_of(v)
+
+
+def test_gl04_mesh_module_exempt_and_compat_clean(tmp_path):
+    mesh_code = """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def compat(fn, **kw):
+            return shard_map(fn, **kw)
+    """
+    assert lint(tmp_path, mesh_code, name="parallel/mesh.py") == []
+    v = lint(tmp_path, """\
+        from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+        def ring_step(x, axis_name):
+            return x + mesh_lib.compat_axis_index(axis_name)
+    """)
+    assert [x for x in v if x.rule == "GL04"] == []
+
+
+# --- GL05 nondeterminism ------------------------------------------------------
+
+
+def test_gl05_global_rng_and_wall_clock(tmp_path):
+    v = lint(tmp_path, """\
+        import random
+        import time
+
+        import jax
+        import numpy as np
+
+        def pick(items):
+            np.random.shuffle(items)          # process-global numpy RNG
+            noise = random.random()           # stdlib global RNG
+            rng = np.random.default_rng()     # entropy-seeded
+            key = jax.random.PRNGKey(int(time.time()))  # wall clock
+            return items, noise, rng, key
+    """)
+    gl05 = [x for x in v if x.rule == "GL05"]
+    assert len(gl05) == 4
+    assert any("wall clock" in x.message for x in gl05)
+
+
+def test_gl05_seeded_rng_clean(tmp_path):
+    v = lint(tmp_path, """\
+        import numpy as np
+
+        def epoch_order(seed, epoch, n):
+            rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+            return rng.permutation(n)
+    """)
+    assert [x for x in v if x.rule == "GL05"] == []
+
+
+# --- pragmas ------------------------------------------------------------------
+
+
+def test_pragma_suppresses_with_reason(tmp_path):
+    v = lint(tmp_path, """\
+        from jax import lax
+
+        def f(x, axis):
+            return x + lax.axis_index(axis)  # graftlint: ok[GL04] fixture: compat verified by hand
+    """)
+    assert v == []
+
+
+def test_pragma_own_line_covers_multiline_statement(tmp_path):
+    v = lint(tmp_path, """\
+        # graftlint: hot-path
+        import jax
+
+        def readback(step, state):
+            # graftlint: ok[GL02] the one documented per-chunk sync
+            # (continuation of the justification)
+            toks = jax.device_get(
+                step(state)
+            )
+            return toks
+    """)
+    assert [x for x in v if x.rule == "GL02"] == []
+
+
+def test_pragma_missing_reason_is_gl00_and_does_not_suppress(tmp_path):
+    v = lint(tmp_path, """\
+        from jax import lax
+
+        def f(x, axis):
+            return x + lax.axis_index(axis)  # graftlint: ok[GL04]
+    """)
+    assert "GL00" in rules_of(v)
+    assert "GL04" in rules_of(v)  # the naked pragma suppresses nothing
+
+
+def test_pragma_wrong_rule_does_not_suppress(tmp_path):
+    v = lint(tmp_path, """\
+        from jax import lax
+
+        def f(x, axis):
+            return x + lax.axis_index(axis)  # graftlint: ok[GL05] wrong rule id
+    """)
+    assert "GL04" in rules_of(v)
+
+
+# --- baseline ratchet ---------------------------------------------------------
+
+
+def _write(tmp_path, code):
+    p = tmp_path / "mod.py"
+    p.write_text(code)
+    return p
+
+
+BAD_TWO = textwrap.dedent("""\
+    from jax import lax
+
+    def f(x, a):
+        return x + lax.axis_index(a)
+
+    def g(x, a):
+        return x - lax.axis_index(a)
+""")
+
+
+def test_baseline_ratchet(tmp_path):
+    f = _write(tmp_path, BAD_TWO)
+    bl = str(tmp_path / "bl.json")
+
+    # 1. no baseline yet: everything is new, run fails
+    rep = runner.run([str(f)], root=str(tmp_path), baseline_path=bl)
+    assert rep.failed and len(rep.diff.new) == 2
+
+    # 2. grandfather the debt: clean run, nothing new
+    baseline_mod.save(bl, rep.violations)
+    rep = runner.run([str(f)], root=str(tmp_path), baseline_path=bl)
+    assert not rep.failed
+    assert len(rep.diff.grandfathered) == 2 and rep.diff.new == []
+
+    # 3. a NEW violation fails even though the old two are baselined
+    _write(tmp_path, BAD_TWO + "\n\ndef h(x, a):\n    return lax.axis_index(a)\n")
+    rep = runner.run([str(f)], root=str(tmp_path), baseline_path=bl)
+    assert rep.failed and len(rep.diff.new) == 1
+    assert len(rep.diff.grandfathered) == 2
+
+    # 4. fixing a violation leaves a STALE entry — the run fails until the
+    #    baseline is regenerated (the ratchet can only shrink explicitly)
+    _write(tmp_path, BAD_TWO.replace("x - lax.axis_index(a)", "x - 1"))
+    rep = runner.run([str(f)], root=str(tmp_path), baseline_path=bl)
+    assert rep.failed
+    assert len(rep.diff.stale) == 1 and rep.diff.new == []
+
+    # 5. regenerating shrinks the debt and goes green
+    baseline_mod.save(bl, rep.violations)
+    rep = runner.run([str(f)], root=str(tmp_path), baseline_path=bl)
+    assert not rep.failed and len(rep.diff.grandfathered) == 1
+    assert len(baseline_mod.load(bl)) == 1
+
+
+def test_baseline_fingerprints_survive_line_moves(tmp_path):
+    f = _write(tmp_path, BAD_TWO)
+    bl = str(tmp_path / "bl.json")
+    rep = runner.run([str(f)], root=str(tmp_path), baseline_path=bl)
+    baseline_mod.save(bl, rep.violations)
+    # unrelated edits above the findings must not churn the baseline
+    _write(tmp_path, "import os\n\nPAD = os.sep\n\n" + BAD_TWO)
+    rep = runner.run([str(f)], root=str(tmp_path), baseline_path=bl)
+    assert not rep.failed and len(rep.diff.grandfathered) == 2
+
+
+# --- repo-wide run (the tier-1 gate) ------------------------------------------
+
+
+def test_repo_wide_zero_non_baselined_violations():
+    """`python -m ...graftlint neuronx_distributed_tpu/` must exit 0: every
+    violation fixed, pragma'd with a reason, or explicitly baselined — and
+    the checked-in baseline must not be stale."""
+    rep = runner.run([PKG], root=REPO_ROOT)
+    assert rep.files_scanned > 80
+    new = "\n".join(v.format() for v in rep.diff.new)
+    assert rep.diff.new == [], f"new graftlint violations:\n{new}"
+    assert rep.diff.stale == [], (
+        "stale baseline entries — shrink the debt with --write-baseline: "
+        f"{json.dumps(rep.diff.stale, indent=2)}"
+    )
+
+
+def _engine_copy_with(tmp_path, needle, insertion):
+    src = open(os.path.join(PKG, "serving", "engine.py")).read()
+    assert needle in src
+    i = src.index(needle)
+    line_start = src.rindex("\n", 0, i) + 1
+    indent = " " * (i - line_start)
+    patched = src.replace(needle, needle + "\n" + indent + insertion, 1)
+    out = tmp_path / "serving" / "engine.py"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(patched)
+    return out
+
+
+def test_reintroducing_pr2_donated_leaf_bug_fails(tmp_path):
+    """Acceptance: the PR 2 bug — device_get on the donated slot state —
+    re-inserted into the real engine source must trip GL01."""
+    out = _engine_copy_with(
+        tmp_path,
+        "cache_in = self.cache.take()",
+        'jax.device_get(self._state["keys"])  # reintroduced PR 2 bug',
+    )
+    rep = runner.scan([str(out)], root=str(tmp_path))
+    assert "GL01" in rules_of(rep.violations)
+
+
+def test_raw_shard_map_import_in_serving_fails(tmp_path):
+    """Acceptance: a raw jax.experimental.shard_map import appearing in
+    serving/ must trip GL04."""
+    src = open(os.path.join(PKG, "serving", "engine.py")).read()
+    patched = src.replace(
+        "import jax\n",
+        "import jax\nfrom jax.experimental.shard_map import shard_map\n",
+        1,
+    )
+    out = tmp_path / "serving" / "engine.py"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(patched)
+    rep = runner.scan([str(out)], root=str(tmp_path))
+    assert "GL04" in rules_of(rep.violations)
+
+
+def test_real_engine_scan_is_clean_in_isolation(tmp_path):
+    """The shipped engine (pragmas and all) carries zero findings even
+    without the baseline — the debt really was driven to zero."""
+    out = tmp_path / "serving" / "engine.py"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(open(os.path.join(PKG, "serving", "engine.py")).read())
+    rep = runner.scan([str(out)], root=str(tmp_path))
+    assert rep.violations == []
+    assert len(rep.suppressed) >= 4  # the documented intentional syncs
+
+
+# --- CLI ----------------------------------------------------------------------
+
+
+def _cli(args, capsys):
+    """Run the CLI in-process (the subprocess form pays a full jax import
+    per call; one real `python -m` invocation is kept below)."""
+    from neuronx_distributed_tpu.scripts.graftlint.cli import main
+
+    rc = main(args)
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+def test_cli_report_format_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import lax\n\ndef f(a):\n    return lax.axis_index(a)\n")
+    rc, out, _ = _cli([str(bad), "--no-baseline"], capsys)
+    assert rc == 1
+    # clickable path:line:col convention
+    assert f"{os.path.relpath(bad, tmp_path)}:4:11: GL04" in out
+    ok = tmp_path / "ok.py"
+    ok.write_text("X = 1\n")
+    rc, out, _ = _cli([str(ok), "--no-baseline"], capsys)
+    assert rc == 0
+    assert "0 violation(s)" in out
+    rc, out, _ = _cli(["--explain", "GL02"], capsys)
+    assert rc == 0 and "host-sync-in-hot-path" in out
+    rc, _, err = _cli(["--explain", "GL99"], capsys)
+    assert rc == 2 and "unknown rule" in err
+    rc, _, err = _cli([str(tmp_path / "missing.py"), "--no-baseline"], capsys)
+    assert rc == 2 and "no such path" in err
+    rc, _, err = _cli([str(ok), "--select", "GL77"], capsys)
+    assert rc == 2
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import lax\n\ndef f(a):\n    return lax.axis_index(a)\n")
+    bl = tmp_path / "bl.json"
+    rc, _, _ = _cli([str(bad), "--baseline", str(bl), "--write-baseline"], capsys)
+    assert rc == 0 and bl.exists()
+    rc, out, _ = _cli([str(bad), "--baseline", str(bl)], capsys)
+    assert rc == 0
+    assert "1 baselined" in out
+
+
+def test_write_baseline_partial_scope_preserves_out_of_scope_debt(tmp_path):
+    """A subset-path or --select --write-baseline must not erase
+    grandfathered entries it never re-checked (review round 1)."""
+    a_dir = tmp_path / "a"
+    b_dir = tmp_path / "b"
+    a_dir.mkdir()
+    b_dir.mkdir()
+    bad = "from jax import lax\n\ndef f(x):\n    return lax.axis_index(x)\n"
+    (a_dir / "mod_a.py").write_text(bad)
+    (b_dir / "mod_b.py").write_text(bad)
+    bl = str(tmp_path / "bl.json")
+
+    # grandfather BOTH files' debt from a full-scope run
+    rep = runner.run([str(tmp_path)], root=str(tmp_path), baseline_path=bl)
+    baseline_mod.save(bl, rep.violations)
+    assert len(baseline_mod.load(bl)) == 2
+
+    # fix a/ and regenerate from a PARTIAL run over a/ only: a's entry is
+    # retired, b's untouched entry survives
+    (a_dir / "mod_a.py").write_text("X = 1\n")
+    rep = runner.run([str(a_dir)], root=str(tmp_path), baseline_path=bl)
+    baseline_mod.save_merged(
+        bl, rep.violations, rep.scanned_relpaths, root=str(tmp_path)
+    )
+    remaining = baseline_mod.load(bl)
+    assert len(remaining) == 1
+    assert all(e["path"].startswith("b/") for e in remaining.values())
+
+    # the full run is green against the merged baseline
+    rep = runner.run([str(tmp_path)], root=str(tmp_path), baseline_path=bl)
+    assert not rep.failed and len(rep.diff.grandfathered) == 1
+
+    # a deleted file's debt is dropped on the next merged write
+    (b_dir / "mod_b.py").unlink()
+    rep = runner.run([str(a_dir)], root=str(tmp_path), baseline_path=bl)
+    baseline_mod.save_merged(
+        bl, rep.violations, rep.scanned_relpaths, root=str(tmp_path)
+    )
+    assert baseline_mod.load(bl) == {}
+
+
+def test_python_dash_m_entry_point(tmp_path):
+    """The documented invocation — `python -m
+    neuronx_distributed_tpu.scripts.graftlint` — works end to end."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import lax\n\ndef f(a):\n    return lax.axis_index(a)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "neuronx_distributed_tpu.scripts.graftlint",
+         str(bad), "--no-baseline"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 1
+    assert "GL04" in r.stdout

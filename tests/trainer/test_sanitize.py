@@ -1,0 +1,42 @@
+"""Trainer hot-loop transfer-guard witness (graftlint GL02, training side).
+
+The train loop's only per-step sync is the PR 5 deferred guard readback —
+an explicit ``jax.device_get`` of the previous step's flag pair, issued
+after the next step dispatched (tests/trainer/test_faults.py pins the
+count). Under ``jax.transfer_guard_device_to_host("disallow")`` any
+IMPLICIT device->host read in the loop would raise where the backend
+enforces guards; this run is the standing proof none exist on the clean
+path (fit + evaluate)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.trainer import OptimizerConfig
+from neuronx_distributed_tpu.trainer.loop import Trainer
+
+
+def _batches(cfg, n=20, bs=8, seq=16):
+    key = jax.random.PRNGKey(0)
+    for i in range(n):
+        ids = jax.random.randint(
+            jax.random.fold_in(key, i), (bs, seq), 0, cfg.vocab_size
+        )
+        yield {"input_ids": ids, "labels": jnp.roll(ids, -1, 1)}
+
+
+@pytest.mark.sanitize
+def test_trainer_fit_under_transfer_guard(transfer_guard_disallow):
+    cfg = tiny_llama()
+    trainer = Trainer(
+        model=LlamaForCausalLM(cfg, attention_impl="xla"),
+        optimizer_config=OptimizerConfig(learning_rate=1e-3, zero1=False),
+        callbacks=[],  # MetricsLogger floats device scalars by design
+    )
+    metrics = trainer.fit(_batches(cfg), jax.random.PRNGKey(1), max_steps=3)
+    assert trainer.step == 3
+    # read the device scalar OUTSIDE any hot-loop sync accounting
+    assert float(jax.device_get(metrics["loss"])) > 0
+    ev = trainer.evaluate(_batches(cfg, n=2), max_steps=2)
+    assert ev["eval_steps"] == 2
