@@ -56,6 +56,7 @@ SERVING_TIMEOUT_S = 420
 FAULTS_TIMEOUT_S = 300
 PREFIX_TIMEOUT_S = 420
 TRAIN_FAULTS_TIMEOUT_S = 420
+OBSERVE_TIMEOUT_S = 300
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -933,6 +934,132 @@ def _measure_gqa(base_cfg, batch, seq, attention_impl):
     }
 
 
+def _measure_observability(devs):
+    """Instrumentation overhead (``--child-observe``): the SAME request
+    workload through the continuous-batching engine BARE vs fully
+    instrumented (timeline + request-flow tracer + flight recorder +
+    registry TTFT/TPOT histograms). The decode wall reads the engine's
+    dispatch+readback hot-path counters, min over interleaved waves so
+    compile time and scheduler drift cancel; the overhead budget the
+    tier-1 test pins is ≤2%. Also replays a deterministic latency stream
+    through the log-bucketed histogram vs an exact sorted list, reporting
+    the percentile error the fixed-memory representation costs."""
+    import math
+    import random
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.observability import MetricsRegistry
+    from neuronx_distributed_tpu.serving import ServingEngine
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+    tmp = tempfile.mkdtemp(prefix="observe_bench_")
+    bare = ServingEngine(
+        model, params, num_slots=4, decode_chunk_size=8,
+        timeline=None, flight_recorder=None, prefix_cache=None,
+    )
+    inst = ServingEngine(
+        model, params, num_slots=4, decode_chunk_size=8,
+        timeline=Timeline(os.path.join(tmp, "trace.json")),
+        registry=MetricsRegistry(), flight_dir=tmp, prefix_cache=None,
+    )
+    gcfg = GenerationConfig(max_new_tokens=64, temperature=0.8, top_k=20)
+
+    def wave(engine):
+        wrng = np.random.RandomState(7)  # same prompts every wave/engine
+        m = engine.metrics
+        wall0 = m.decode_dispatch_s + m.decode_readback_s
+        tok0 = m.decode_tokens
+        for i, plen in enumerate(wrng.randint(6, 18, size=8)):
+            engine.submit(
+                wrng.randint(1, cfg.vocab_size, size=int(plen)).astype(np.int32),
+                gcfg, key=jax.random.PRNGKey(100 + i),
+            )
+        engine.run()
+        return (
+            (m.decode_dispatch_s + m.decode_readback_s) - wall0,
+            m.decode_tokens - tok0,
+        )
+
+    wave(bare)  # warmup: compiles prefill buckets + the decode program
+    wave(inst)
+    # paired rounds, order alternating: this shared box's wall-clock noise
+    # (neighbor load, thermal) drifts 3-10% on second scales — far above
+    # the sub-1% effect under measurement — but a bare/instrumented pair
+    # run back-to-back shares the same drift, so the PER-ROUND ratio is
+    # clean; the median over rounds then drops the fast-jitter outliers
+    # the ordering alternation hasn't already cancelled
+    ratios = []
+    walls = {"bare": [], "inst": []}
+    toks = {"bare": [], "inst": []}
+    for rnd in range(8):
+        order = (("bare", bare), ("inst", inst))
+        if rnd % 2:
+            order = order[::-1]
+        got = {}
+        for name, engine in order:
+            w, t = wave(engine)
+            got[name] = w
+            walls[name].append(w)
+            toks[name].append(t)
+        if got["bare"] > 0:
+            ratios.append(got["inst"] / got["bare"])
+    ratios.sort()
+    med_ratio = ratios[len(ratios) // 2]
+    w_bare, w_inst = sum(walls["bare"]), sum(walls["inst"])
+    tok = sum(toks["bare"])
+    bare_tok_s = tok / w_bare if w_bare > 0 else 0.0
+    inst_tok_s = tok / w_inst if w_inst > 0 else 0.0
+    overhead_pct = (med_ratio - 1.0) * 100.0
+
+    # histogram-vs-sorted-list percentile error on a replayed stream
+    reg = MetricsRegistry()
+    h = reg.histogram("replay_latency_s")
+    r = random.Random(0)
+    stream = [r.lognormvariate(-4, 1.2) for _ in range(20_000)]
+    for v in stream:
+        h.observe(v)
+    stream.sort()
+    pct_err = {}
+    for q in (0.50, 0.95, 0.99):
+        true = stream[max(0, math.ceil(q * len(stream)) - 1)]
+        est = h.percentile(q)
+        pct_err[f"p{int(q * 100)}_rel_err"] = round(est / true - 1.0, 5)
+    return {
+        "decode_wall_bare_s": round(w_bare, 4),
+        "decode_wall_instrumented_s": round(w_inst, 4),
+        "decode_tok_s_bare": round(bare_tok_s, 2),
+        "decode_tok_s_instrumented": round(inst_tok_s, 2),
+        "overhead_pct": round(overhead_pct, 3),
+        "round_ratios": [round(r, 4) for r in ratios],
+        "within_budget": bool(overhead_pct <= 2.0),
+        "tokens_measured": int(tok),
+        "trace_events": len(inst.timeline._events),
+        "flight_events_recorded": inst.flight._seq,
+        "histogram": {
+            "samples": len(stream),
+            "buckets_touched": len(h._buckets),
+            "max_rel_err_bound": round(h.relative_error, 4),
+            **pct_err,
+        },
+    }
+
+
 def child_sweep() -> None:
     """Remat-policy × batch MFU sweep on the real chip (VERDICT r4 next #1b):
     the r2 record (MFU 0.492) ran full per-layer remat; this measures the
@@ -1143,6 +1270,31 @@ def child_train_faults() -> None:
         _emit(
             {
                 "metric": "train_faults",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
+def child_observe() -> None:
+    """Observability-overhead child (``--child-observe``): instrumented vs
+    bare serving decode wall + histogram-vs-sorted-list percentile error.
+    Prints one JSON line; merged into the BENCH artifact as
+    ``extras.observability``."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "observability",
+                "unit": "instrumentation overhead",
+                "platform": devs[0].platform,
+                **_measure_observability(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "observability",
                 "error": f"{type(e).__name__}: {str(e)[:400]}",
             }
         )
@@ -1489,6 +1641,7 @@ def main() -> None:
     faults_result = None
     prefix_result = None
     train_faults_result = None
+    observe_result = None
 
     import signal
 
@@ -1523,6 +1676,11 @@ def main() -> None:
             train_faults_result
             if train_faults_result is not None
             else {"error": "train-faults child did not finish"}
+        )
+        extras["observability"] = (
+            observe_result
+            if observe_result is not None
+            else {"error": "observe child did not finish"}
         )
         extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
@@ -1660,6 +1818,16 @@ def main() -> None:
     else:
         train_faults_result = {"error": f"train-faults child: {err}"}
 
+    # 9. Observability-overhead child: instrumented vs bare decode wall +
+    #    histogram percentile error (serialized last for the same
+    #    core-contention reason — it is itself a wall-clock comparison).
+    observe, err = _run_child("--child-observe", OBSERVE_TIMEOUT_S)
+    if observe is not None:
+        observe.pop("metric", None)
+        observe_result = observe
+    else:
+        observe_result = {"error": f"observe child: {err}"}
+
     _finalize()
 
 
@@ -1678,6 +1846,8 @@ if __name__ == "__main__":
         child_faults()
     elif "--child-prefix" in sys.argv:
         child_prefix()
+    elif "--child-observe" in sys.argv:
+        child_observe()
     elif "--child" in sys.argv:
         child(tiny=False)
     elif "--probe" in sys.argv:

@@ -101,6 +101,21 @@ def parse_args(argv=None):
                         "→ shed before prefill)")
     p.add_argument("--timeline", default=None,
                    help="write a chrome://tracing JSON of the serving loop")
+    p.add_argument("--trace", default=None,
+                   help="like --timeline, spelled as the observability "
+                        "knob: the trace carries per-request Perfetto "
+                        "FLOW events (one connected arrow chain per "
+                        "request: submit -> admission -> prefill -> "
+                        "decode chunks -> retire) — open in ui.perfetto.dev")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler device trace of decode "
+                        "chunks [2, 5) into DIR (open with TensorBoard/"
+                        "XProf) — the device-level truth to pair with "
+                        "--trace's host-side view")
+    p.add_argument("--prometheus", action="store_true",
+                   help="print the metrics registry in Prometheus text "
+                        "exposition format after the run (what a scrape "
+                        "endpoint would serve)")
     p.add_argument("--force-cpu-devices", type=int, default=None)
     return p.parse_args(argv)
 
@@ -152,7 +167,8 @@ def main(argv=None):
         rng.randint(1, cfg.vocab_size, size=args.shared_prefix).astype(np.int32)
         if args.shared_prefix > 0 else None
     )
-    timeline = Timeline(args.timeline) if args.timeline else None
+    trace_path = args.trace or args.timeline
+    timeline = Timeline(trace_path) if trace_path else None
     engine = ServingEngine(
         model, params,
         num_slots=args.slots,
@@ -162,6 +178,7 @@ def main(argv=None):
         prefix_cache=None if args.no_prefix_cache else "auto",
         fault_injector=injector,
         timeline=timeline,
+        profile_dir=args.profile,
     )
 
     from neuronx_distributed_tpu.serving import RejectedError
@@ -246,9 +263,18 @@ def main(argv=None):
     for k, v in snap.items():
         print(f"  {k:>28s}: {v:.4f}" if isinstance(v, float) else
               f"  {k:>28s}: {v}")
+    if args.prometheus:
+        print("\n=== prometheus exposition ===")
+        print(engine.metrics.registry.prometheus_text())
     if timeline is not None:
         timeline.save()
-        print(f"\ntimeline written to {args.timeline}")
+        print(f"\ntimeline written to {trace_path} "
+              "(open in ui.perfetto.dev; request flows in the 'request' "
+              "category)")
+    if args.profile:
+        print(f"device profile dir: {args.profile} (captures decode "
+              "chunks [2, 5) — a run short enough to finish in under 3 "
+              "chunks records nothing)")
     return snap
 
 

@@ -98,6 +98,12 @@ def parse_args(argv=None):
     io.add_argument("--log-every", type=int, default=1)
     io.add_argument("--timeline", default=None,
                     help="write a chrome-trace timeline JSON here")
+    io.add_argument("--trace", default=None,
+                    help="like --timeline, spelled as the observability "
+                         "knob (open in ui.perfetto.dev)")
+    io.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler device trace of steps "
+                         "[2, 5) into DIR (open with TensorBoard/XProf)")
 
     f = p.add_argument_group("fault injection (chaos demo)")
     f.add_argument("--inject-fault", default=None,
@@ -277,8 +283,15 @@ def main(argv=None):
             num_chunks=args.chunks if args.schedule == "interleaved" else 1,
         )
 
+    from neuronx_distributed_tpu.observability import MetricsCallback
+
+    # the unified metrics registry: the per-step dict lands in log-bucketed
+    # histograms/gauges (step-time percentiles printed at the end;
+    # registry.prometheus_text() is the scrape payload)
+    metrics_cb = MetricsCallback()
     callbacks = [MetricsLogger(log_every=args.log_every,
-                               tensorboard_dir=args.tensorboard_dir)]
+                               tensorboard_dir=args.tensorboard_dir),
+                 metrics_cb]
     if args.ckpt_dir:
         callbacks.append(
             CheckpointCallback(args.ckpt_dir, every=args.ckpt_every,
@@ -319,12 +332,14 @@ def main(argv=None):
 
     from neuronx_distributed_tpu.trainer import AnomalyGuardConfig
 
+    trace_path = args.trace or args.timeline
     trainer = Trainer(
         model=model,
         optimizer_config=opt_cfg,
         callbacks=callbacks,
         pipeline=pipeline,
-        timeline=Timeline(args.timeline) if args.timeline else None,
+        timeline=Timeline(trace_path) if trace_path else None,
+        profile_dir=args.profile,
         fault_injector=injector,
         # chaos-demo warmup: under --inject-fault the spike detector arms
         # after 2 good steps so a spike at the default --fault-at 2 is
@@ -392,6 +407,13 @@ def main(argv=None):
         f"avg throughput {steps_run * tokens_per_step / wall:.0f} tokens/s "
         f"({metrics.get('throughput_seq_s', 0.0):.2f} seqs/s moving avg)"
     )
+    st = metrics_cb.registry.get("train_step_time_s")
+    if st is not None and st.count:
+        print(
+            f"step time p50 {st.percentile(0.5) * 1e3:.1f}ms / "
+            f"p95 {st.percentile(0.95) * 1e3:.1f}ms over {st.count} steps "
+            "(log-bucketed registry histogram)"
+        )
     return metrics
 
 

@@ -39,6 +39,7 @@ def __getattr__(name):
         "modules",
         "operators",
         "inference",
+        "observability",
         "optim",
         "pipeline",
         "serving",

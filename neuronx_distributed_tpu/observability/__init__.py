@@ -1,0 +1,57 @@
+"""Unified observability layer (ISSUE 8): one registry, request-scoped
+traces, a flight recorder, and device profiler hooks shared by serving and
+training.
+
+* :mod:`registry` — :class:`MetricsRegistry` with counter/gauge/histogram
+  primitives. Histograms are log-bucketed (fixed memory over unbounded
+  streams, quantiles exact to the bucket — ≤5% relative error at the
+  default growth), exported as a JSON ``snapshot()`` or Prometheus text
+  (``prometheus_text()``). Serving's ``ServingMetrics`` is backed by one;
+  the trainer's per-step dict flows in through :class:`MetricsCallback`.
+* :mod:`tracing` — :class:`RequestTracer`: every serving request gets a
+  trace id at ``submit()`` and emits causally-linked Perfetto flow events
+  (queue wait → admission → prefix lookup → prefill → decode chunks →
+  retire/shed/quarantine/recovery) on the shared ``utils.timeline.
+  Timeline``, so one trace explains a single request's whole life.
+* :mod:`flight_recorder` — :class:`FlightRecorder`: bounded ring of recent
+  structured events, auto-dumped as a redacted JSON post-mortem on serving
+  ``HALTED``, ``TrainerHalted``, and emergency checkpoints.
+* :mod:`profiler` — :func:`profile_window` (``jax.profiler`` start/stop
+  around a block), :func:`install_compile_listener` (compile-event
+  counter/duration histogram), :func:`record_device_memory` (per-device
+  memory gauges).
+
+Hard constraint carried by the whole package (and enforced by graftlint
+GL02, whose hot-path list covers the emit paths here): instrumentation
+adds **zero** device→host syncs on the serving/training hot paths — the
+pinned budgets in ``tests/serving/test_host_sync.py`` hold with full
+instrumentation enabled.
+"""
+
+from neuronx_distributed_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from neuronx_distributed_tpu.observability.tracing import RequestTracer
+from neuronx_distributed_tpu.observability.flight_recorder import FlightRecorder
+from neuronx_distributed_tpu.observability.profiler import (
+    install_compile_listener,
+    profile_window,
+    record_device_memory,
+)
+from neuronx_distributed_tpu.observability.callback import MetricsCallback
+
+__all__ = [
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsCallback",
+    "MetricsRegistry",
+    "RequestTracer",
+    "install_compile_listener",
+    "profile_window",
+    "record_device_memory",
+]

@@ -1,0 +1,96 @@
+"""MetricsCallback: the trainer's per-step dict absorbed into a registry.
+
+The trainer used to keep its step metrics in a bare dict handed to
+callbacks and dropped; serving kept its own counters. This callback is the
+unification point: attach one to ``Trainer(callbacks=[...])`` (optionally
+sharing the registry with a serving engine) and the per-step dict lands in
+the same :class:`~neuronx_distributed_tpu.observability.registry.
+MetricsRegistry` the rest of the system exports — step-time histogram for
+MFU/step-time accounting, throughput/robustness gauges, and the loss.
+
+Zero-sync contract (the trainer's host-sync budget — exactly one deferred
+scalar-pair ``device_get`` per step, pinned in tests/trainer/test_faults.py
+— must hold with this callback attached): device scalars in the metrics
+dict (``loss``, ``grad_norm``, guard flags) are stored RAW into gauges and
+coerced only when the registry is exported (``Gauge.set`` semantics), so
+``on_step_end`` never blocks on the device. Host scalars (throughput,
+counters, wall time this callback measures itself) go straight into
+histograms/counters.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from neuronx_distributed_tpu.observability.registry import MetricsRegistry
+
+__all__ = ["MetricsCallback"]
+
+# metrics-dict keys that are plain host floats/ints (safe to histogram/
+# accumulate immediately); everything else is gauged raw (device scalars
+# included — resolved lazily at export)
+_HOST_KEYS = (
+    "throughput_seq_s",
+    "dispatch_retries",
+    "emergency_checkpoints",
+    "callback_errors",
+)
+
+
+class MetricsCallback:
+    """Trainer callback exporting the per-step metrics dict into a
+    ``MetricsRegistry`` (duck-typed against ``trainer.loop.Callback`` so
+    the observability package never imports the trainer)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 prefix: str = "train"):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.prefix = prefix
+        self._t_last: Optional[float] = None
+        p = prefix
+        self._h_step = self.registry.histogram(
+            f"{p}_step_time_s", help="wall time between step completions (s)"
+        )
+        self._c_steps = self.registry.counter(
+            f"{p}_steps", help="train steps completed"
+        )
+        self._g_tokens = self.registry.gauge(
+            f"{p}_tokens_seen", help="cumulative tokens trained on"
+        )
+        self._g_skips = self.registry.gauge(
+            f"{p}_anomaly_skips", help="device-skipped anomalous steps"
+        )
+
+    def on_train_start(self, trainer) -> None:
+        self._t_last = time.perf_counter()
+        self.registry.gauge(
+            f"{self.prefix}_health", help="0=ok 1=degraded 2=halted"
+        ).set_fn(
+            lambda: {"ok": 0, "degraded": 1, "halted": 2}.get(
+                trainer.health().value, -1
+            )
+        )
+
+    def on_step_end(self, trainer, metrics: dict) -> None:
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._h_step.observe(now - self._t_last)
+        self._t_last = now
+        self._c_steps.inc()
+        self._g_tokens.set(trainer.tokens_seen)
+        self._g_skips.set(trainer.anomaly_skips)
+        p = self.prefix
+        for key, value in metrics.items():
+            if key in _HOST_KEYS:
+                self.registry.gauge(f"{p}_{key}").set(float(value))
+            else:
+                # possibly a device scalar (loss, grad_norm, guard flags):
+                # stored raw, coerced at export — never a sync here
+                self.registry.gauge(f"{p}_{key}").set(value)
+
+    def on_train_end(self, trainer) -> None:
+        self.registry.gauge(
+            f"{self.prefix}_train_seconds",
+            help="cumulative fit() wall time (s)",
+        ).set(trainer.train_seconds)

@@ -1,0 +1,124 @@
+"""Device profiler hooks: ``jax.profiler`` windows + compile/memory gauges.
+
+The reference library activates the Neuron profiler around a step window
+(SNIPPETS.md shows the exact ``jax.profiler.start_trace``/``stop_trace``
+activation pattern); this module is that pattern as a safe, reusable
+surface:
+
+* :func:`profile_window` — context manager starting/stopping a
+  ``jax.profiler`` trace around a block. Exception-safe (the trace is
+  stopped even when the block raises), nestable-safe (a second concurrent
+  window is refused with a clear error instead of jax's internal one),
+  and a no-op when ``path`` is falsy — so ``--profile`` flags can pass
+  their argument straight through.
+* :func:`install_compile_listener` — counts XLA compile events and
+  histograms their durations into a registry via ``jax.monitoring``
+  (recompiles on a supposedly-steady path are the classic silent
+  regression — GL03's dynamic twin).
+* :func:`record_device_memory` — per-device ``bytes_in_use``/
+  ``peak_bytes_in_use`` gauges from ``Device.memory_stats()`` (backends
+  without stats — e.g. this container's CPU — are skipped quietly).
+
+Everything degrades to a no-op on jax versions/backends lacking the
+underlying hook; nothing here runs on the serving/training hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+__all__ = [
+    "profile_window",
+    "install_compile_listener",
+    "record_device_memory",
+]
+
+_active = threading.Lock()  # one live profiler window per process
+
+
+@contextlib.contextmanager
+def profile_window(path: Optional[str]):
+    """Profile the enclosed block into ``path`` (a trace directory opened
+    with TensorBoard/Perfetto/XProf). Falsy ``path`` disables — the knob
+    pattern: ``with profile_window(args.profile): run()``."""
+    if not path:
+        yield
+        return
+    import jax
+
+    if not _active.acquire(blocking=False):
+        raise RuntimeError(
+            "a jax.profiler trace window is already active in this "
+            "process; close it before opening another"
+        )
+    started = False
+    try:
+        jax.profiler.start_trace(path)
+        started = True
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                _active.release()
+        else:
+            _active.release()
+
+
+def install_compile_listener(registry) -> bool:
+    """Wire XLA compile events into ``registry`` (counter
+    ``jax_compile_events`` + histogram ``jax_compile_time_s``). Returns
+    whether the listener could be installed (``jax.monitoring`` present).
+    Listeners are process-global in jax — install once per registry you
+    actually export."""
+    try:
+        from jax import monitoring
+    except Exception:
+        return False
+    register = getattr(monitoring, "register_event_duration_secs_listener", None)
+    if register is None:
+        return False
+    count = registry.counter(
+        "jax_compile_events", help="XLA compile/backend-compile events"
+    )
+    hist = registry.histogram(
+        "jax_compile_time_s", help="XLA compile event durations (s)"
+    )
+
+    def _listener(event: str, duration: float, **kw) -> None:
+        if "compil" not in event:  # compile / compilation keys only
+            return
+        count.inc()
+        hist.observe(duration)
+
+    register(_listener)
+    return True
+
+
+def record_device_memory(registry) -> int:
+    """Snapshot per-device memory stats into gauges
+    (``device{i}_bytes_in_use`` / ``device{i}_peak_bytes_in_use``).
+    Returns how many devices reported stats (0 on backends without them —
+    the CPU proxy — so callers can tell 'no memory pressure' from 'no
+    data')."""
+    import jax
+
+    reported = 0
+    for i, dev in enumerate(jax.local_devices()):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        reported += 1
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                registry.gauge(
+                    f"device{i}_{key}",
+                    help=f"jax Device.memory_stats()[{key!r}]",
+                ).set(int(stats[key]))
+    return reported

@@ -1,0 +1,299 @@
+"""Metrics registry: counter / gauge / log-bucketed histogram primitives.
+
+One registry both subsystems report into (ISSUE 8 tentpole): the serving
+engine's :class:`~neuronx_distributed_tpu.serving.metrics.ServingMetrics`
+is backed by one, and the trainer's per-step dict flows into one through
+:class:`~neuronx_distributed_tpu.observability.callback.MetricsCallback`,
+so MFU/step-time accounting and SLO percentiles read off a single surface
+(JSON ``snapshot()`` for tests/dashboards, ``prometheus_text()`` for a
+scrape endpoint).
+
+Design constraints (this module is on graftlint GL02's hot-path list —
+record functions run inside the engine/trainer inner loops):
+
+* **Zero device->host syncs on any record path.** ``Counter.inc`` /
+  ``Histogram.observe`` take host scalars the caller already owns.
+  ``Gauge.set`` stores the value RAW and coerces only at export time, so a
+  gauge may legally hold a device scalar (e.g. the trainer's loss) without
+  the hot loop ever blocking on the device — the one ``float()`` happens
+  when an operator reads the snapshot.
+* **Fixed memory over unbounded streams.** Histograms are log-bucketed:
+  ``bucket(v) = floor(log(v) / log(growth))``, stored sparsely, so a
+  week-long latency stream costs one int per *touched* bucket (~300
+  buckets span 1ns..1000s at the default growth) instead of a sample
+  window. Quantiles are **exact to the bucket**: ``percentile(q)``
+  returns the upper edge of the bucket holding the q-th sample, so the
+  reported value overestimates the true quantile by at most ``growth``
+  (relative error ``growth - 1``, default 5%) — and, unlike the previous
+  recent-window p95, never drifts with stream length or phase.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_GROWTH",
+]
+
+# relative bucket width of histograms: percentile error <= 5%
+DEFAULT_GROWTH = 1.05
+
+
+def _sanitize(name: str) -> str:
+    """Prometheus metric-name charset: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isalnum() or ch in "_:":
+            out.append(ch)
+        else:
+            out.append("_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+class Counter:
+    """Monotone accumulator (int or float increments)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0
+
+    def inc(self, n=1) -> None:
+        self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+    def prometheus_lines(self) -> List[str]:
+        n = _sanitize(self.name)
+        return [
+            f"# HELP {n} {self.help}",
+            f"# TYPE {n} counter",
+            f"{n} {_fmt(self._value)}",
+        ]
+
+
+class Gauge:
+    """Last-value metric. ``set`` stores the value RAW — coercion to float
+    happens at export (``value``/``snapshot``), so the hot path may hand a
+    gauge a device scalar without syncing; the transfer (if any) lands on
+    the operator reading the snapshot, not the inner loop. ``set_fn``
+    registers a zero-cost callable evaluated at export instead (e.g. the
+    engine's compile counters)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._raw = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value) -> None:
+        self._raw = value
+        self._fn = None
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        raw = self._fn() if self._fn is not None else self._raw
+        return float(raw)
+
+    def snapshot(self) -> float:
+        return self.value
+
+    def prometheus_lines(self) -> List[str]:
+        n = _sanitize(self.name)
+        return [
+            f"# HELP {n} {self.help}",
+            f"# TYPE {n} gauge",
+            f"{n} {_fmt(self.value)}",
+        ]
+
+
+class Histogram:
+    """Sparse log-bucketed histogram with exact-to-bucket quantiles.
+
+    Values ``<= 0`` land in a dedicated zero bucket (deadline slack and
+    latency streams legitimately contain zeros under fake clocks); the
+    zero bucket reports as value ``0.0`` in quantiles. ``count``/``sum``/
+    ``min``/``max`` are tracked exactly, so means and totals carry no
+    bucketing error — only the quantiles are bucket-quantized."""
+
+    def __init__(self, name: str, help: str = "", growth: float = DEFAULT_GROWTH):
+        if growth <= 1.0:
+            raise ValueError(f"growth must be > 1, got {growth}")
+        self.name = name
+        self.help = help
+        self.growth = growth
+        self._log_growth = math.log(growth)
+        self._buckets: Dict[int, int] = {}
+        self._zero = 0  # observations <= 0
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    @property
+    def relative_error(self) -> float:
+        """Worst-case relative overestimate of any quantile."""
+        return self.growth - 1.0
+
+    def bucket_index(self, value: float) -> int:
+        return math.floor(math.log(value) / self._log_growth)
+
+    def bucket_edges(self, index: int) -> Tuple[float, float]:
+        """``[lo, hi)`` edges of bucket ``index`` (hi = lo * growth)."""
+        return (self.growth ** index, self.growth ** (index + 1))
+
+    def observe(self, value) -> None:
+        v = value
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if v <= 0.0:
+            self._zero += 1
+            return
+        i = math.floor(math.log(v) / self._log_growth)
+        self._buckets[i] = self._buckets.get(i, 0) + 1
+
+    def percentile(self, q: float) -> float:
+        """Upper edge of the bucket holding the ``q``-quantile sample
+        (rank ``ceil(q * count)``, the same nearest-rank convention the
+        old sorted-window p95 used). Exact to the bucket: the true sample
+        lies in ``[result / growth, result]``. Returns 0.0 when empty."""
+        if self.count == 0:
+            return 0.0
+        rank = max(1, math.ceil(q * self.count))
+        seen = self._zero
+        if rank <= seen:
+            return 0.0
+        for i in sorted(self._buckets):
+            seen += self._buckets[i]
+            if rank <= seen:
+                # never report past the exactly-tracked max (the top
+                # bucket's upper edge can overshoot it)
+                return min(self.growth ** (i + 1), self.max)
+        return self.max  # unreachable unless counts drifted
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": self.mean,
+            "min": self.min if self.count else 0.0,
+            "max": self.max if self.count else 0.0,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+        }
+
+    def prometheus_lines(self) -> List[str]:
+        """Cumulative ``le`` buckets over the touched range + the
+        standard ``_sum``/``_count`` series."""
+        n = _sanitize(self.name)
+        lines = [
+            f"# HELP {n} {self.help}",
+            f"# TYPE {n} histogram",
+        ]
+        cum = self._zero
+        if self._zero:
+            lines.append(f'{n}_bucket{{le="0"}} {self._zero}')
+        for i in sorted(self._buckets):
+            cum += self._buckets[i]
+            lines.append(
+                f'{n}_bucket{{le="{_fmt(self.growth ** (i + 1))}"}} {cum}'
+            )
+        lines.append(f'{n}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{n}_sum {_fmt(self.sum)}")
+        lines.append(f"{n}_count {self.count}")
+        return lines
+
+
+def _fmt(v) -> str:
+    """Prometheus float formatting: integers stay integral."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class MetricsRegistry:
+    """Get-or-create home for named metrics.
+
+    Creation is locked (callbacks may run on checkpoint/watcher threads);
+    the record paths themselves are lock-free — CPython's atomic int ops
+    are exact for counters, and a torn histogram read only skews a
+    scrape, never the stream."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, cls, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help=help)
+
+    def histogram(
+        self, name: str, help: str = "", growth: float = DEFAULT_GROWTH
+    ) -> Histogram:
+        return self._get_or_create(name, Histogram, help=help, growth=growth)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._metrics)
+
+    def snapshot(self) -> dict:
+        """JSON-serializable {name: value-or-histogram-dict}. Export-time
+        only — this is where lazily-held gauge values (possibly device
+        scalars) are finally coerced."""
+        return {name: m.snapshot() for name, m in sorted(self._metrics.items())}
+
+    def snapshot_json(self, **dumps_kwargs) -> str:
+        return json.dumps(self.snapshot(), **dumps_kwargs)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (0.0.4) of every metric."""
+        lines: List[str] = []
+        for _, m in sorted(self._metrics.items()):
+            lines.extend(m.prometheus_lines())
+        return "\n".join(lines) + "\n"

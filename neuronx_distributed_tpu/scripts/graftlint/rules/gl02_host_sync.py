@@ -30,8 +30,13 @@ compiler does).
 
 Scope: the modules whose host-sync counts are pinned by tests —
 serving/engine.py, serving/cache_manager.py, inference/generate.py,
-trainer/loop.py — plus any module carrying a `# graftlint: hot-path`
-comment marker (the opt-in for future hot paths and for fixtures).
+trainer/loop.py — plus the observability emit paths those loops call into
+(serving/metrics.py, observability/registry.py, observability/tracing.py,
+observability/flight_recorder.py, utils/timeline.py: a metric record or
+trace emit that implicitly synced would re-serialize the pipeline from
+INSIDE the instrumentation, invisible to the per-module budget tests) —
+plus any module carrying a `# graftlint: hot-path` comment marker (the
+opt-in for future hot paths and for fixtures).
 
 Flagged inside hot modules:
   * `float/int/bool` coercion of a device-resident value (`len()` and
@@ -54,6 +59,14 @@ HOT_SUFFIXES = (
     "serving/cache_manager.py",
     "inference/generate.py",
     "trainer/loop.py",
+    # observability emit paths (ISSUE 8): record/trace functions are called
+    # from the engine/trainer inner loops, so an implicit sync here would
+    # silently reintroduce the very stalls the budgets above pin
+    "serving/metrics.py",
+    "observability/registry.py",
+    "observability/tracing.py",
+    "observability/flight_recorder.py",
+    "utils/timeline.py",
 )
 HOT_MARKER = "graftlint: hot-path"
 

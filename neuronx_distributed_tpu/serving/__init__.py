@@ -28,6 +28,16 @@ Layers:
   (``FaultInjector``) for the engine's chaos hooks: dispatch failures,
   poisoned readbacks, prefill faults, clock skew.
 
+Observability (ISSUE 8, ``neuronx_distributed_tpu/observability``): the
+metrics above live in a shared ``MetricsRegistry`` (Prometheus/JSON
+export, log-bucketed latency histograms incl. TTFT/TPOT percentiles);
+with a ``Timeline`` attached every request renders as one connected
+Perfetto flow (submit → admission → prefill → decode chunks → retire);
+a ``FlightRecorder`` auto-dumps a redacted post-mortem on ``HALTED``
+(``flight_dir=``); ``profile_dir=`` captures a ``jax.profiler`` trace of
+decode chunks [2, 5). All of it adds ZERO device→host syncs on the hot
+path (tests/serving/test_host_sync.py pins the budgets).
+
 Robustness contract (chaos-tested in ``tests/serving/test_faults.py``):
 deadlines and queue timeouts shed to ``TIMED_OUT``; a failed donated decode
 dispatch recovers through the preemption machinery (streams bit-identical)

@@ -109,6 +109,22 @@ unattended (chaos-tested in ``tests/serving/test_faults.py``):
   (dispatch raise at attempt k, poisoned readback for slot s, prefill
   OOM-like error, clock skew); with no injector the hooks are no-ops.
 
+Observability (ISSUE 8 — zero syncs added; the pinned budgets above hold
+with everything enabled):
+
+* Metrics live in a shared ``MetricsRegistry`` (``metrics.registry``,
+  injectable via ``registry=``) with log-bucketed TTFT/TPOT/prefill
+  histograms and Prometheus/JSON export.
+* With a ``timeline``, every request emits a connected Perfetto flow
+  (submit → admission → prefix lookup → prefill → first token → decode
+  chunks → retire/shed/quarantine/recovery) via ``self.tracer``; the
+  timeline auto-saves (atomically) on halt.
+* ``self.flight`` (a ``FlightRecorder``, ``flight_dir=`` for the dump
+  location) records health transitions and fault events and writes a
+  redacted JSON post-mortem the moment the engine HALTs.
+* ``profile_dir=`` captures a ``jax.profiler`` device trace of decode
+  chunks [2, 5).
+
 Cache capacity: all slots share one write cursor (see
 ``serving/cache_manager.py``), which advances every decode step while ANY
 slot is active. The fused chunk clamps itself against ``max_seq_len`` on
@@ -129,6 +145,7 @@ from __future__ import annotations
 
 import enum
 import time
+import weakref
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -149,6 +166,8 @@ from neuronx_distributed_tpu.modules.attention import (
     extract_cache_prefix,
     seed_cache_prefix,
 )
+from neuronx_distributed_tpu.observability.flight_recorder import FlightRecorder
+from neuronx_distributed_tpu.observability.tracing import RequestTracer
 from neuronx_distributed_tpu.serving.cache_manager import (
     PrefixCache,
     SlotCacheManager,
@@ -312,6 +331,10 @@ class ServingEngine:
         quarantine_policy: str = "requeue",
         fault_injector=None,
         timeline=None,
+        registry=None,
+        flight_recorder="auto",
+        flight_dir: Optional[str] = None,
+        profile_dir: Optional[str] = None,
         time_fn: Callable[[], float] = time.monotonic,
         sleep_fn: Callable[[float], None] = time.sleep,
     ):
@@ -368,7 +391,21 @@ class ServingEngine:
         self._prefill_model, self._decode_model = serving_clones(model)
         self.scheduler = Scheduler(max_tokens_in_flight)
         self.cache = SlotCacheManager(num_slots)
-        self.metrics = ServingMetrics(num_slots)
+        self.metrics = ServingMetrics(num_slots, registry=registry)
+        # observability layer (ISSUE 8): request-scoped flow tracing on the
+        # shared timeline, and an always-on flight recorder whose ring is
+        # dumped as a redacted post-mortem the moment the engine HALTs.
+        # Every emit below takes host scalars the loop already owns — the
+        # pinned host-sync budgets (tests/serving/test_host_sync.py) hold
+        # with all of this enabled
+        self.tracer = RequestTracer(timeline)
+        if flight_recorder == "auto":
+            flight_recorder = FlightRecorder(
+                dump_dir=flight_dir, subsystem="serving"
+            )
+        self.flight = flight_recorder  # None disables
+        self._profile_dir = profile_dir
+        self._profiling = False
         # host-side slot bookkeeping (scheduling only — the decode-visible
         # per-slot state lives on device in self._state)
         self._active = np.zeros((num_slots,), bool)
@@ -424,6 +461,30 @@ class ServingEngine:
             static_argnums=(3,),
         )
         self._fingerprint_fn = jax.jit(lambda tree: cache_fingerprint(tree))
+        # compile-event gauges: evaluated lazily at registry export (a
+        # _cache_size read is host metadata), zero cost per step. WEAK
+        # self-reference: a registry an operator keeps for a final scrape
+        # must not pin a retired engine (model, params, KV cache)
+        ref = weakref.ref(self)
+
+        def _export(attr):
+            def fn():
+                engine = ref()
+                return getattr(engine, attr) if engine is not None else -1
+            return fn
+
+        reg = self.metrics.registry
+        reg.gauge(
+            "serving_decode_compilations",
+            help="distinct decode programs XLA compiled (invariant: 1)",
+        ).set_fn(_export("decode_compilations"))
+        reg.gauge(
+            "serving_prefill_compilations",
+            help="distinct full+suffix prefill programs compiled",
+        ).set_fn(_export("prefill_compilations"))
+        reg.gauge(
+            "serving_queue_depth", help="queued (unfinished) requests"
+        ).set_fn(_export("queue_depth"))
 
     def _fresh_slot_state(self):
         b = self.num_slots
@@ -564,6 +625,9 @@ class ServingEngine:
         self.metrics.record_submit(req, req.submit_time)
         if self.timeline is not None:
             self.timeline.instant(f"submit r{rid}", "serving")
+        # open the request's trace flow: every later lifecycle event links
+        # back to this id, so one Perfetto flow is the request's whole life
+        self.tracer.begin(rid, args={"prompt_len": int(prompt.size)})
         return req
 
     def cancel(self, rid: int) -> bool:
@@ -576,6 +640,7 @@ class ServingEngine:
         ok = self.scheduler.cancel(rid)
         if ok and was_queued:
             self.metrics.record_cancel(req, self._now())
+            self.tracer.end(rid, "cancelled", args={"where": "queued"})
             # queued requests never reach _release_slot — drop the callback
             # here or it leaks for the engine's lifetime
             self._on_token.pop(rid, None)
@@ -638,7 +703,25 @@ class ServingEngine:
         self._halt_reason = reason
         if self.timeline is not None:
             self.timeline.instant("halted", "serving", args={"reason": reason})
+        if self._profiling:
+            # never leave a device trace open across a halt — the window
+            # below [2, 5) can only close here once the loop stops
+            self._stop_profile()
         self._sync_health()
+        # post-mortem: the flight ring (recent transitions/faults) plus the
+        # metrics snapshot, written atomically BEFORE control returns to the
+        # operator; the timeline flushes too so the trace survives a crash
+        if self.flight is not None:
+            self.flight.record("halt", reason=reason)
+            self.flight.dump(
+                reason,
+                extra={
+                    "requeued": len(requeued),
+                    "metrics": self.metrics.snapshot(),
+                },
+            )
+        if self.timeline is not None:
+            self.timeline.save()
 
     def _sync_health(self) -> None:
         h = self.health()
@@ -646,6 +729,10 @@ class ServingEngine:
         if h is not self._last_health:
             if self.timeline is not None:
                 self.timeline.instant(f"health {h.value}", "serving")
+            if self.flight is not None:
+                self.flight.record(
+                    "health", value=h.value, was=self._last_health.value
+                )
             self._last_health = h
 
     @property
@@ -660,6 +747,11 @@ class ServingEngine:
                 for r in self.scheduler.queued_requests
             )
         return self.scheduler.queued > 0 or any(self._active)
+
+    @property
+    def queue_depth(self) -> int:
+        """Queued (unfinished) requests — the export-gauge source."""
+        return self.scheduler.queued
 
     @property
     def decode_compilations(self) -> int:
@@ -712,6 +804,10 @@ class ServingEngine:
         if self.timeline is not None:
             self.timeline.counter("slots_active", int(self._active.sum()), "serving")
             self.timeline.counter("queue_depth", self.scheduler.queued, "serving")
+        if self._profiling and not self.has_work:
+            # a short run can drain before the [2, 5) window's closing
+            # chunk — flush the device trace rather than dropping it
+            self._stop_profile()
         self._sync_health()
         return self.has_work
 
@@ -742,6 +838,10 @@ class ServingEngine:
                     f"shed r{req.rid}", "serving",
                     args={"where": "queue", "reason": req.error},
                 )
+            self.tracer.end(req.rid, "shed", args={"where": "queue"})
+            if self.flight is not None:
+                self.flight.record("shed", rid=req.rid, where="queue",
+                                   reason=req.error)
         for req in list(self._slot_req):
             if req is None or req.deadline is None or now < req.deadline:
                 continue
@@ -754,6 +854,13 @@ class ServingEngine:
                     f"shed r{req.rid}", "serving",
                     args={"where": "inflight", "tokens": len(req.tokens)},
                 )
+            self.tracer.end(
+                req.rid, "shed",
+                args={"where": "inflight", "tokens": len(req.tokens)},
+            )
+            if self.flight is not None:
+                self.flight.record("shed", rid=req.rid, where="inflight",
+                                   tokens=len(req.tokens))
             self._release_slot(req)
 
     # --- admission ----------------------------------------------------------
@@ -845,7 +952,12 @@ class ServingEngine:
         ctx = req.context_ids
         p = len(ctx)
         padded = _bucket(p, self.max_seq_len, req.remaining_new_tokens)
+        self.tracer.step(req.rid, "admission", args={"slot": slot})
         plan = self._plan_prefix_reuse(ctx, p, padded)
+        self.tracer.step(
+            req.rid, "prefix_lookup",
+            args={"matched": plan[1] if plan is not None else 0},
+        )
         if self.timeline is not None:
             self.timeline.mark_event_start("prefill", "serving")
         call = self._prefill_calls
@@ -903,6 +1015,10 @@ class ServingEngine:
             req.error = f"prefill failed: {e}"
             req.finish_time = now
             self.metrics.record_failed(req, now, kind="prefill")
+            self.tracer.end(req.rid, "failed", args={"kind": "prefill"})
+            if self.flight is not None:
+                self.flight.record("prefill_failure", rid=req.rid,
+                                   error=str(e))
             self._on_token.pop(req.rid, None)
             self._consecutive_prefill_failures += 1
             if (
@@ -926,6 +1042,12 @@ class ServingEngine:
                     "reused": plan[1] if plan is not None else 0,
                 },
             )
+        self.tracer.step(
+            req.rid,
+            "suffix_prefill" if plan is not None else "full_prefill",
+            args={"padded": padded,
+                  "reused": plan[1] if plan is not None else 0},
+        )
         self._remember_prefix(
             ctx, p, padded, row_cache,
             matched=plan[1] if plan is not None else 0,
@@ -950,6 +1072,7 @@ class ServingEngine:
             )
             tok0 = int(tok0_h)
             req.key = np.asarray(carry_h, np.uint32)
+            self.tracer.step(req.rid, "first_token")
             self._emit_token(req, tok0, now, first=True)
             if req.state is RequestState.CANCELLED:
                 # the on_token callback cancelled on the FIRST token (while
@@ -1095,6 +1218,36 @@ class ServingEngine:
 
     # --- decode -------------------------------------------------------------
 
+    def _maybe_profile(self) -> None:
+        """``profile_dir`` knob: capture a ``jax.profiler`` device trace of
+        decode chunks [2, 5) — past the compile/warmup chunks, bounded so
+        an unattended server never accumulates an unbounded trace (the
+        trainer's ``profile_dir`` profiles steps [2, 5) the same way)."""
+        if self._profile_dir is None:
+            return
+        chunks = self.metrics.chunks  # successful chunks so far
+        if not self._profiling and chunks == 2:
+            try:
+                jax.profiler.start_trace(self._profile_dir)
+            except Exception as e:
+                # a profiler that cannot start (another trace already
+                # active, unwritable dir) must cost the serving loop its
+                # profile, never its requests — disable and move on
+                self._profile_dir = None
+                if self.flight is not None:
+                    self.flight.record("profile_start_failed", error=str(e))
+                return
+            self._profiling = True
+        elif self._profiling and chunks >= 5:
+            self._stop_profile()
+
+    def _stop_profile(self) -> None:
+        self._profiling = False
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass  # a failed stop must never take the serving loop down
+
     def _decode(self) -> None:
         """One fused decode chunk: dispatch the donated jitted scan, then a
         SINGLE host synchronization for the whole token block. Between here
@@ -1103,6 +1256,7 @@ class ServingEngine:
         crashing the loop."""
         tl = self.timeline
         active_at_dispatch = int(self._active.sum())
+        self._maybe_profile()
         if tl is not None:
             tl.mark_event_start("decode_dispatch", "serving")
         t0 = self._clock()
@@ -1185,6 +1339,12 @@ class ServingEngine:
             # it current at every chunk boundary costs nothing (the snapshot
             # already rode the chunk's single sync)
             req.key = np.array(chunk_keys[slot], np.uint32)
+            # per-request flow waypoint: the chunk's already-host token
+            # count — links this chunk into the request's trace for free
+            self.tracer.step(
+                req.rid, "decode_chunk",
+                args={"tokens": int(counts[slot]), "steps": used},
+            )
             for tok in toks[: int(counts[slot]), slot]:
                 self._emit_token(req, int(tok), now)
                 delivered += 1
@@ -1221,7 +1381,13 @@ class ServingEngine:
                 "dispatch_failure", "serving",
                 args={"error": str(exc)[:200], "consecutive": n},
             )
+        if self.flight is not None:
+            self.flight.record("dispatch_failure", error=str(exc),
+                               consecutive=n)
         requeued = self._vacate_active()
+        for r in requeued:
+            self.tracer.step(r.rid, "recovery_requeue",
+                             args={"tokens": len(r.tokens)})
         self.scheduler.requeue_front(requeued)
         self.cache.release_all_slots()
         self.cache.recover(cache_in)
@@ -1243,6 +1409,9 @@ class ServingEngine:
             self.timeline.instant(
                 "recovery", "serving", args={"requeued": len(requeued)}
             )
+        if self.flight is not None:
+            self.flight.record("recovery", requeued=len(requeued),
+                               consecutive=n)
         # shared decrementing-jitter wait before the next attempt (attempt
         # index is 0-based): ride out a transient burst without hammering
         self._sleep(self._dispatch_retry.wait(n - 1))
@@ -1262,6 +1431,9 @@ class ServingEngine:
                 f"quarantine slot {slot}", "serving",
                 args={"reason": reason, "rid": req.rid if req else None},
             )
+        if self.flight is not None:
+            self.flight.record("quarantine", slot=slot,
+                               rid=req.rid if req else None, reason=reason)
         self._slot_req[slot] = None
         self._active[slot] = False
         self._state = self._slot_clear(self._state, np.int32(slot))
@@ -1270,12 +1442,16 @@ class ServingEngine:
         if req is not None:
             req.slot = None
             if self._quarantine_policy == "requeue" and not req.finished:
+                self.tracer.step(req.rid, "quarantine_requeue",
+                                 args={"slot": slot})
                 self.scheduler.requeue_front([req])
             else:
                 req.state = RequestState.FAILED
                 req.error = f"slot {slot} quarantined: {reason}"
                 req.finish_time = now
                 self.metrics.record_failed(req, now, kind="quarantine")
+                self.tracer.end(req.rid, "failed",
+                                args={"kind": "quarantine", "slot": slot})
                 self._on_token.pop(req.rid, None)
         if self.cache.usable_slots == 0:
             self._halt("all slots quarantined")
@@ -1306,6 +1482,8 @@ class ServingEngine:
             self._release_slot(req)
             if self.timeline is not None:
                 self.timeline.instant(f"done r{req.rid}", "serving")
+            self.tracer.end(req.rid, "retire",
+                            args={"tokens": len(req.tokens)})
 
     def _release_slot(self, req: Request) -> None:
         slot = req.slot
@@ -1323,6 +1501,8 @@ class ServingEngine:
             if req is not None and req.state is RequestState.CANCELLED:
                 self.metrics.record_cancel(req, now)
                 req.finish_time = now
+                self.tracer.end(req.rid, "cancelled",
+                                args={"where": "slot", "slot": slot})
                 self._release_slot(req)
 
     def _vacate_active(self) -> List[Request]:
@@ -1347,6 +1527,8 @@ class ServingEngine:
         for req in preempted:
             req.preemptions += 1
             self.metrics.record_preemption(req)
+            self.tracer.step(req.rid, "preempt",
+                             args={"tokens": len(req.tokens)})
         self.scheduler.requeue_front(preempted)
         # ONE device reset invalidates every row — per-slot free() dispatches
         # here would be N redundant full-cache programs; only the host

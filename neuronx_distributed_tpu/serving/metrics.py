@@ -5,74 +5,133 @@ deterministic tests) exported as a dict ``snapshot()``; when the engine holds
 a ``utils.timeline.Timeline``, per-step occupancy and queue depth also land
 on counter tracks next to the prefill/decode duration events, so one Perfetto
 view shows the whole scheduling story.
+
+Since ISSUE 8 the counters live in a shared
+:class:`~neuronx_distributed_tpu.observability.registry.MetricsRegistry`
+(pass one in to co-export serving and trainer metrics from a single
+Prometheus surface; ``metrics.registry.prometheus_text()`` is the scrape
+payload). The attribute surface is unchanged — ``metrics.steps`` etc. read
+through to the registry — and the ``snapshot()`` keys are preserved
+bit-for-bit in name and type.
+
+Latency percentiles come from log-bucketed histograms (exact to the bucket,
+fixed memory): the prefill p95 no longer reads a 512-sample recent window —
+whose value drifted with stream phase on long runs — and new TTFT/TPOT
+histograms (``ttft_p50_s``..``tpot_p99_s`` in the snapshot) feed the SLO
+scheduling work the ROADMAP names. Recording stays sync-free: every sample
+is a host scalar the engine already owned.
 """
 
 from __future__ import annotations
 
-import math
-from collections import deque
 from typing import Dict, Optional
+
+from neuronx_distributed_tpu.observability.registry import MetricsRegistry
 
 
 def _mean(xs):
     return sum(xs) / len(xs) if xs else 0.0
 
 
-def _p95(xs):
-    if not xs:
-        return 0.0
-    ordered = sorted(xs)
-    return ordered[max(0, math.ceil(0.95 * len(ordered)) - 1)]
+# (attribute, registry metric name, int-valued) — the engine/test-visible
+# counter surface, all backed by registry counters
+_COUNTERS = (
+    ("steps", "serving_steps", True),
+    ("chunks", "serving_chunks", True),
+    ("prefills", "serving_prefills", True),
+    ("decode_tokens", "serving_decode_tokens", True),
+    ("completed", "serving_completed", True),
+    ("cancelled", "serving_cancelled", True),
+    ("preemptions", "serving_preemptions", True),
+    ("sheds", "serving_sheds", True),
+    ("rejects", "serving_rejects", True),
+    ("quarantines", "serving_quarantines", True),
+    ("dispatch_retries", "serving_dispatch_retries", True),
+    ("recoveries", "serving_recoveries", True),
+    ("prefill_failures", "serving_prefill_failures", True),
+    ("failed", "serving_failed", True),
+    ("timed_out", "serving_timed_out", True),
+    ("prefix_hits", "serving_prefix_hits", True),
+    ("prefix_misses", "serving_prefix_misses", True),
+    ("prefix_tokens_reused", "serving_prefix_tokens_reused", True),
+    ("prefix_evictions", "serving_prefix_evictions", True),
+    ("prefix_validation_failures", "serving_prefix_validation_failures", True),
+    ("occupied_slot_steps", "serving_occupied_slot_steps", True),
+    ("prefill_full_wall_s", "serving_prefill_full_wall_s", False),
+    ("prefill_suffix_wall_s", "serving_prefill_suffix_wall_s", False),
+    ("decode_dispatch_s", "serving_decode_dispatch_s", False),
+    ("decode_readback_s", "serving_decode_readback_s", False),
+)
+
+_HEALTH_CODES = {"ok": 0, "degraded": 1, "draining": 2, "halted": 3}
 
 
 class ServingMetrics:
-    """Aggregates the engine's request lifecycle events."""
+    """Aggregates the engine's request lifecycle events into a registry."""
 
-    def __init__(self, num_slots: int = 0):
+    def __init__(self, num_slots: int = 0,
+                 registry: Optional[MetricsRegistry] = None):
         self.num_slots = num_slots
-        # engine counters
-        self.steps = 0  # decode steps executed (chunks × their used steps)
-        self.chunks = 0  # decode dispatches (== host syncs on the hot path)
-        self.prefills = 0
-        self.decode_tokens = 0  # tokens produced by decode steps
-        self.completed = 0
-        self.cancelled = 0
-        self.preemptions = 0
-        # fault-tolerance counters (serving robustness layer)
-        self.sheds = 0  # deadline/queue-timeout expiries (queued + in-flight)
-        self.rejects = 0  # backpressure / drain / halt submit refusals
-        self.quarantines = 0  # slots pulled from rotation for bad readbacks
-        self.dispatch_retries = 0  # failed decode dispatches that recovered
-        self.recoveries = 0  # completed requeue-and-resume recoveries
-        self.prefill_failures = 0
-        self.failed = 0  # requests terminated in FAILED (for cause)
-        self.timed_out = 0  # requests terminated in TIMED_OUT
+        if registry is not None and registry.get(_COUNTERS[0][1]) is not None:
+            # registries have no instance labels, so a second engine on the
+            # same registry would SILENTLY merge its counters into the
+            # first's (and last-writer-wins the export gauges). Refuse
+            # loudly: one registry per engine; sharing across SUBSYSTEMS
+            # (serving_ + train_ prefixes) is the supported pattern, and
+            # multi-replica aggregation belongs to the scrape layer
+            raise ValueError(
+                "registry already holds serving metrics (another "
+                "ServingEngine registered into it) — pass a distinct "
+                "MetricsRegistry per engine"
+            )
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._c = {}
+        for attr, name, is_int in _COUNTERS:
+            self._c[attr] = (self.registry.counter(name), is_int)
+        # latency histograms: log-bucketed, fixed memory, quantiles exact
+        # to the bucket (observability/registry.py) — prefill feeds the
+        # legacy prefill_p95_s key; TTFT/TPOT feed the SLO roadmap item
+        self._h_prefill = self.registry.histogram(
+            "serving_prefill_latency_s",
+            help="wall time of one successful prefill dispatch (s)",
+        )
+        self._h_ttft = self.registry.histogram(
+            "serving_ttft_s", help="submit -> first token (s)"
+        )
+        self._h_tpot = self.registry.histogram(
+            "serving_tpot_s",
+            help="per-request mean time per output token after the first (s)",
+        )
+        self._h_queue_wait = self.registry.histogram(
+            "serving_queue_wait_s", help="submit -> first admission (s)"
+        )
+        self._g_cursor = self.registry.gauge(
+            "serving_cursor_high_water", help="highest shared cache cursor seen"
+        )
+        self._g_health = self.registry.gauge(
+            "serving_health", help="0=ok 1=degraded 2=draining 3=halted"
+        )
+        self._g_health.set_fn(lambda: _HEALTH_CODES.get(self.health, -1))
+        self.registry.gauge("serving_num_slots").set(num_slots)
         self.health = "ok"  # engine-owned mirror of ServingEngine.health()
-        # prefix-cache counters (shared-prompt KV reuse on the admission path)
-        self.prefix_hits = 0  # admissions that reused a stored prefix
-        self.prefix_misses = 0  # admissions that ran the full prefill
-        self.prefix_tokens_reused = 0  # Σ matched prefix lengths over hits
-        self.prefix_evictions = 0  # LRU + validation/poison evictions
-        self.prefix_validation_failures = 0  # reuses rejected by checksum/shape
-        # prefill latency (full AND suffix admissions): count/total ride
-        # scalars; the p95 reads a bounded window of recent samples so a
-        # long-lived engine neither grows without bound nor pays an O(n)
-        # sort per snapshot. The per-kind wall split is the bench's
-        # "prefill wall saved" source
-        self.prefill_count = 0
-        self.prefill_wall_s = 0.0
-        self._prefill_recent = deque(maxlen=512)
-        self.prefill_full_wall_s = 0.0
-        self.prefill_suffix_wall_s = 0.0
         self.cursor_high_water = 0
-        self.occupied_slot_steps = 0  # Σ active slots over decode steps
-        # decode hot-path wall time, split at the host-sync boundary:
-        # dispatch = enqueue the jitted chunk (donated, async), readback =
-        # block on the chunk's token block (the ONE sync per chunk)
-        self.decode_dispatch_s = 0.0
-        self.decode_readback_s = 0.0
         # per-request
         self._requests: Dict[int, dict] = {}
+
+    def __getattr__(self, name):
+        # counter attributes (``metrics.steps`` etc.) read through to the
+        # registry; only consulted when no instance attribute exists
+        c = self.__dict__.get("_c")
+        if c is not None and name in c:
+            counter, is_int = c[name]
+            v = counter.value
+            return int(v) if is_int else float(v)
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+        )
+
+    def _inc(self, attr: str, n=1) -> None:
+        self._c[attr][0].inc(n)
 
     # --- request lifecycle --------------------------------------------------
 
@@ -88,13 +147,16 @@ class ServingMetrics:
         # first admission sets the queue wait; re-admissions after preemption
         # keep the original (the request never left the engine's care)
         r.setdefault("admit_time", now)
-        r.setdefault("queue_wait", now - r["submit_time"])
-        self.prefills += 1
+        if "queue_wait" not in r:
+            r["queue_wait"] = now - r["submit_time"]
+            self._h_queue_wait.observe(r["queue_wait"])
+        self._inc("prefills")
 
     def record_first_token(self, req, now: float) -> None:
         r = self._requests[req.rid]
         r["first_token_time"] = now
         r["ttft"] = now - r["submit_time"]
+        self._h_ttft.observe(r["ttft"])
 
     def record_finish(self, req, now: float) -> None:
         r = self._requests[req.rid]
@@ -106,18 +168,20 @@ class ServingMetrics:
         r["decode_tokens_per_sec"] = (
             (len(req.tokens) - 1) / decode_span if decode_span > 0 else 0.0
         )
+        if len(req.tokens) > 1:
+            self._h_tpot.observe(decode_span / (len(req.tokens) - 1))
         r["preemptions"] = req.preemptions
-        self.completed += 1
+        self._inc("completed")
 
     def record_cancel(self, req, now: float) -> None:
         r = self._requests.get(req.rid)
         if r is not None:
             r["finish_time"] = now
             r["cancelled"] = True
-        self.cancelled += 1
+        self._inc("cancelled")
 
     def record_preemption(self, req) -> None:
-        self.preemptions += 1
+        self._inc("preemptions")
 
     # --- fault tolerance ----------------------------------------------------
 
@@ -130,20 +194,20 @@ class ServingMetrics:
             r["timed_out"] = True
             r["shed_where"] = where
             r["tokens"] = len(req.tokens)
-        self.sheds += 1
-        self.timed_out += 1
+        self._inc("sheds")
+        self._inc("timed_out")
 
     def record_reject(self, queue_depth: int, reason: str) -> None:
-        self.rejects += 1
+        self._inc("rejects")
 
     def record_quarantine(self, slot: int, rid) -> None:
-        self.quarantines += 1
+        self._inc("quarantines")
 
     def record_dispatch_retry(self) -> None:
-        self.dispatch_retries += 1
+        self._inc("dispatch_retries")
 
     def record_recovery(self, requeued: int) -> None:
-        self.recoveries += 1
+        self._inc("recoveries")
 
     def record_failed(self, req, now: float, kind: str = "engine") -> None:
         """A request the engine failed for cause (``req.error`` has the
@@ -154,40 +218,38 @@ class ServingMetrics:
             r["finish_time"] = now
             r["failed"] = True
             r["failed_kind"] = kind
-        self.failed += 1
+        self._inc("failed")
         if kind == "prefill":
-            self.prefill_failures += 1
+            self._inc("prefill_failures")
 
     # --- prefix cache -------------------------------------------------------
 
     def record_prefix_hit(self, matched: int, prompt_len: int) -> None:
         """An admission reused ``matched`` stored prefix tokens of a
         ``prompt_len``-token context (only the tail was prefilled)."""
-        self.prefix_hits += 1
-        self.prefix_tokens_reused += matched
+        self._inc("prefix_hits")
+        self._inc("prefix_tokens_reused", matched)
 
     def record_prefix_miss(self) -> None:
-        self.prefix_misses += 1
+        self._inc("prefix_misses")
 
     def record_prefix_eviction(self, n: int = 1) -> None:
-        self.prefix_evictions += n
+        self._inc("prefix_evictions", n)
 
     def record_prefix_validation_failure(self) -> None:
         """A stored entry failed its reuse-time checksum/shape validation —
         it was evicted and the admission fell back to a full prefill."""
-        self.prefix_validation_failures += 1
+        self._inc("prefix_validation_failures")
 
     def record_prefill_wall(self, seconds: float, kind: str = "full") -> None:
         """Wall time of one successful prefill dispatch (``kind`` is
-        ``"full"`` or ``"suffix"``); feeds the count/mean/p95 latency stats
-        and the per-kind split in :meth:`snapshot`."""
-        self.prefill_count += 1
-        self.prefill_wall_s += seconds
-        self._prefill_recent.append(seconds)
+        ``"full"`` or ``"suffix"``); feeds the latency histogram (count/
+        mean/p95 in :meth:`snapshot`) and the per-kind wall split."""
+        self._h_prefill.observe(seconds)
         if kind == "suffix":
-            self.prefill_suffix_wall_s += seconds
+            self._inc("prefill_suffix_wall_s", seconds)
         else:
-            self.prefill_full_wall_s += seconds
+            self._inc("prefill_full_wall_s", seconds)
 
     # --- engine step --------------------------------------------------------
 
@@ -211,15 +273,26 @@ class ServingMetrics:
         boundary, so it occupies all ``steps``. ``dispatch_s``/
         ``readback_s`` split the wall time around the chunk's single host
         sync."""
-        self.chunks += 1
-        self.steps += steps
-        self.decode_tokens += tokens
-        self.occupied_slot_steps += active_slots * steps
-        self.cursor_high_water = max(self.cursor_high_water, cursor)
-        self.decode_dispatch_s += dispatch_s
-        self.decode_readback_s += readback_s
+        self._inc("chunks")
+        self._inc("steps", steps)
+        self._inc("decode_tokens", tokens)
+        self._inc("occupied_slot_steps", active_slots * steps)
+        if cursor > self.cursor_high_water:
+            self.cursor_high_water = cursor
+            self._g_cursor.set(cursor)
+        self._inc("decode_dispatch_s", dispatch_s)
+        self._inc("decode_readback_s", readback_s)
 
     # --- export -------------------------------------------------------------
+
+    @property
+    def prefill_count(self) -> int:
+        """Successful prefill dispatches (full + suffix)."""
+        return self._h_prefill.count
+
+    @property
+    def prefill_wall_s(self) -> float:
+        return float(self._h_prefill.sum)
 
     @property
     def mean_occupancy(self) -> float:
@@ -231,7 +304,10 @@ class ServingMetrics:
         return dict(r) if r is not None else None
 
     def snapshot(self) -> dict:
-        """Plain-dict export (log lines, tests, dashboards)."""
+        """Plain-dict export (log lines, tests, dashboards). Every key of
+        the pre-registry snapshot is preserved in name and type; the
+        percentile keys now read bucket-exact histogram quantiles, and the
+        ``ttft_*``/``tpot_*`` families are new."""
         done = [r for r in self._requests.values() if "latency" in r]
         ttfts = [r["ttft"] for r in self._requests.values() if "ttft" in r]
         waits = [
@@ -270,11 +346,8 @@ class ServingMetrics:
             "prefix_validation_failures": self.prefix_validation_failures,
             "prefill_count": self.prefill_count,
             "prefill_wall_s": self.prefill_wall_s,
-            "prefill_mean_s": (
-                self.prefill_wall_s / self.prefill_count
-                if self.prefill_count else 0.0
-            ),
-            "prefill_p95_s": _p95(self._prefill_recent),
+            "prefill_mean_s": self._h_prefill.mean,
+            "prefill_p95_s": self._h_prefill.percentile(0.95),
             "prefill_full_wall_s": self.prefill_full_wall_s,
             "prefill_suffix_wall_s": self.prefill_suffix_wall_s,
             "failed": self.failed,
@@ -289,4 +362,13 @@ class ServingMetrics:
             "mean_decode_tokens_per_sec": _mean(
                 [r["decode_tokens_per_sec"] for r in done]
             ),
+            # SLO-facing percentile families (log-bucketed histograms:
+            # exact to the bucket, stable over unbounded streams)
+            "ttft_p50_s": self._h_ttft.percentile(0.50),
+            "ttft_p95_s": self._h_ttft.percentile(0.95),
+            "ttft_p99_s": self._h_ttft.percentile(0.99),
+            "tpot_p50_s": self._h_tpot.percentile(0.50),
+            "tpot_p95_s": self._h_tpot.percentile(0.95),
+            "tpot_p99_s": self._h_tpot.percentile(0.99),
+            "queue_wait_p95_s": self._h_queue_wait.percentile(0.95),
         }

@@ -323,6 +323,11 @@ class Trainer:
     emergency_dir: Optional[str] = None
     # DEGRADED window after an anomaly skip / dispatch retry.
     degraded_cooldown_steps: int = 20
+    # Flight recorder (observability/flight_recorder.py): bounded ring of
+    # recent structured events, auto-dumped as a redacted JSON post-mortem
+    # when the run halts. None = fit() builds one whose dumps land next to
+    # the checkpoints (memory-only when no checkpoint dir is known).
+    flight_recorder: Optional[Any] = None
     # Install SIGTERM/SIGINT graceful-preemption handlers during fit()
     # (main thread only; a second signal falls through to the original
     # handler).
@@ -455,6 +460,7 @@ class Trainer:
         tl = getattr(self, "_tl", None)
         if tl is not None:
             tl.instant("checkpoint", "trainer", args={"tag": tag})
+        self._flight_record("checkpoint", tag=tag, step=self.step)
         if self.fault_injector is not None:
             self.fault_injector.on_checkpoint_saved(checkpoint_dir, tag)
 
@@ -469,6 +475,11 @@ class Trainer:
 
     # --- fault machinery ----------------------------------------------------
 
+    def _flight_record(self, kind: str, **fields) -> None:
+        fl = getattr(self, "_flight", None)
+        if fl is not None:
+            fl.record(kind, **fields)
+
     def _save_emergency_checkpoint(self, reason: str) -> Optional[str]:
         d = self._checkpoint_dir()
         if d is None:
@@ -481,6 +492,7 @@ class Trainer:
         self.save_tagged_checkpoint(d, tag, extra={"emergency": reason})
         self.emergency_checkpoints += 1
         self._tl.instant("emergency_checkpoint", "trainer", args={"tag": tag})
+        self._flight_record("emergency_checkpoint", tag=tag, dir=d)
         logger.warning("emergency checkpoint '%s' written to %s", tag, d)
         return tag
 
@@ -488,6 +500,25 @@ class Trainer:
         self.halt_reason = reason
         tag = self._save_emergency_checkpoint(reason) if save else None
         self._tl.instant("halted", "trainer", args={"reason": reason})
+        # post-mortem: the last N structured events (anomaly skips, dispatch
+        # failures, checkpoints, callback errors) + the halt context, dumped
+        # atomically next to the checkpoints BEFORE TrainerHalted unwinds —
+        # the unattended-death record PRs 3/5 left missing
+        fl = getattr(self, "_flight", None)
+        if fl is not None:
+            fl.record("halt", reason=reason, step=self.step,
+                      emergency_tag=tag)
+            fl.dump(
+                reason,
+                extra={
+                    "step": self.step,
+                    "emergency_tag": tag,
+                    "anomaly_skips": self.anomaly_skips,
+                    "dispatch_retries": self.dispatch_retries,
+                    "callback_errors": self.callback_errors,
+                    "tokens_seen": self.tokens_seen,
+                },
+            )
         logger.error("training HALTED: %s", reason)
         raise TrainerHalted(reason, emergency_tag=tag)
 
@@ -519,6 +550,10 @@ class Trainer:
                         args={"after_failures": self._consecutive_dispatch_failures,
                               "step": self.step},
                     )
+                    self._flight_record(
+                        "recovery", step=self.step,
+                        after_failures=self._consecutive_dispatch_failures,
+                    )
                     self._consecutive_dispatch_failures = 0
                 return out
             except KeyboardInterrupt:
@@ -536,6 +571,8 @@ class Trainer:
                     args={"error": str(e)[:200], "consecutive": n,
                           "step": self.step},
                 )
+                self._flight_record("dispatch_failure", step=self.step,
+                                    error=str(e), consecutive=n)
                 logger.warning(
                     "train-step dispatch failed at step %d (%s: %s) — "
                     "consecutive failure %d/%d",
@@ -599,6 +636,7 @@ class Trainer:
                 "anomaly_skip", "trainer",
                 args={"step": at_step, "skips": skips},
             )
+            self._flight_record("anomaly_skip", step=at_step, skips=skips)
             logger.warning(
                 "anomalous step %d skipped on device (%d skips total)",
                 at_step, skips,
@@ -680,6 +718,8 @@ class Trainer:
             "preempted", "trainer",
             args={"signal": int(self._preempt_signum or 0), "step": self.step},
         )
+        self._flight_record("preempted", step=self.step,
+                            signal=int(self._preempt_signum or 0))
         logger.warning(
             "preempted by signal %s at step %d — checkpoint %s; exiting "
             "cleanly", self._preempt_signum, self.step,
@@ -709,6 +749,8 @@ class Trainer:
                 args={"callback": type(cb).__name__, "hook": method,
                       "error": str(e)[:200]},
             )
+            self._flight_record("callback_error", callback=type(cb).__name__,
+                                hook=method, error=str(e))
             logger.exception(
                 "callback %s.%s raised (%s: %s) — training continues",
                 type(cb).__name__, method, type(e).__name__, e,
@@ -761,6 +803,19 @@ class Trainer:
             max_attempts=3, first_wait=0.05, min_wait=0.01
         )
         self._tl = tl = self.timeline or Timeline(None)
+        if self.flight_recorder is not None:
+            self._flight = self.flight_recorder
+        else:
+            from neuronx_distributed_tpu.observability.flight_recorder import (
+                FlightRecorder,
+            )
+
+            # default recorder: post-mortems land next to the checkpoints
+            # (memory-only when no directory is known — last_postmortem
+            # still carries the ring for an operator holding the object)
+            self._flight = FlightRecorder(
+                dump_dir=self._checkpoint_dir(), subsystem="trainer"
+            )
         inj = self.fault_injector
         first = sample_batch if sample_batch is not None else next(data_iter)
         optimizer = make_optimizer(self.optimizer_config)

@@ -7,14 +7,40 @@ slice, so the gather disappears: events append locally and dump straight to
 the ``chrome://tracing`` / Perfetto JSON format. For device-side profiling use
 ``jax.profiler`` (reference used the Neuron profiler); this timeline covers
 the host-side scheduling view the reference's tool provided.
+
+Durability (ISSUE 8 satellite): ``save()`` writes atomically (tmp +
+rename), so a crash mid-dump never leaves a truncated trace over a good
+one; an ``atexit`` hook flushes whatever accumulated if the process dies
+without an explicit save (the engine/trainer halt paths also save
+eagerly). Thread ids are stable small integers in first-seen order —
+``threading.get_ident() % 10000`` collided across thread churn and
+scattered one logical actor over several Perfetto tracks.
+
+Request-scoped flows: ``flow()`` emits Chrome flow events (``ph`` s/t/f
+keyed by ``id``), the arrows Perfetto draws between the spans of one
+request's life across scheduler, cache manager, and engine — see
+``observability/tracing.py`` for the request-lifecycle emitter.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
+import os
 import threading
 import time
+import weakref
 from typing import Optional
+
+
+def _atexit_flush(ref: "weakref.ref") -> None:
+    """Module-level atexit target holding only a WEAK reference: a
+    Timeline (and its event list) stays collectable over a long-lived
+    process that churns engines/trainers — an atexit-registered bound
+    method would pin every instance for process lifetime."""
+    tl = ref()
+    if tl is not None:
+        tl._atexit_save()
 
 
 class Timeline:
@@ -27,6 +53,15 @@ class Timeline:
         self._open: dict = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter_ns()
+        # stable per-thread track ids, assigned in first-seen order
+        self._tids: dict = {}
+        self._dirty = False
+        if self.enabled:
+            # crash durability: whatever accumulated still lands on disk.
+            # Registered through a weakref so the hook never keeps a
+            # discarded Timeline (or its events) alive; save() clears the
+            # dirty flag so a clean exit writes nothing twice.
+            atexit.register(_atexit_flush, weakref.ref(self))
 
     @property
     def enabled(self) -> bool:
@@ -34,6 +69,21 @@ class Timeline:
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def _tid(self) -> int:
+        """Stable small track id for the calling thread (first-seen
+        order). Caller must hold ``_lock``."""
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            tid = len(self._tids)
+            self._tids[ident] = tid
+        return tid
+
+    def _append(self, ev: dict) -> None:
+        """Caller must hold ``_lock``."""
+        self._events.append(ev)
+        self._dirty = True
 
     def mark_event_start(self, name: str, category: str = "host") -> None:
         if not self.enabled:
@@ -61,11 +111,11 @@ class Timeline:
                 "ts": start,
                 "dur": self._now_us() - start,
                 "pid": self.rank,
-                "tid": threading.get_ident() % 10000,
+                "tid": self._tid(),
             }
             if args:
                 ev["args"] = dict(args)
-            self._events.append(ev)
+            self._append(ev)
 
     def event(self, name: str, category: str = "host", args: Optional[dict] = None):
         """Context manager form."""
@@ -88,7 +138,7 @@ class Timeline:
         if not self.enabled:
             return
         with self._lock:
-            self._events.append(
+            self._append(
                 {"name": name, "cat": category, "ph": "C",
                  "ts": self._now_us(), "pid": self.rank,
                  "args": {name: value}}
@@ -104,16 +154,67 @@ class Timeline:
             return
         with self._lock:
             ev = {"name": name, "cat": category, "ph": "i",
-                  "ts": self._now_us(), "pid": self.rank, "s": "g"}
+                  "ts": self._now_us(), "pid": self.rank, "s": "g",
+                  "tid": self._tid()}
             if args:
                 ev["args"] = dict(args)
-            self._events.append(ev)
+            self._append(ev)
+
+    def flow(
+        self,
+        name: str,
+        flow_id,
+        phase: str,
+        category: str = "flow",
+        args: Optional[dict] = None,
+    ) -> None:
+        """One Chrome flow event: ``phase`` is ``"s"`` (start), ``"t"``
+        (step) or ``"f"`` (end); every event of one flow shares ``name``,
+        ``cat`` and ``flow_id``, and Perfetto draws the arrows between the
+        slices they land on. ``bp: "e"`` binds to the enclosing slice (the
+        modern binding Perfetto expects for same-ts association)."""
+        if not self.enabled:
+            return
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+        with self._lock:
+            ev = {
+                "name": name, "cat": category, "ph": phase,
+                "id": flow_id, "bp": "e",
+                "ts": self._now_us(), "pid": self.rank,
+                "tid": self._tid(),
+            }
+            if args:
+                ev["args"] = dict(args)
+            self._append(ev)
 
     def save(self) -> None:
-        """Dump accumulated events (reference per-step JSON dump)."""
+        """Dump accumulated events atomically (tmp + rename): a crash
+        mid-write can never truncate an existing good trace, and the halt/
+        atexit auto-saves can run at arbitrary interrupt points safely."""
         if not self.enabled:
             return
         with self._lock:
             payload = {"traceEvents": list(self._events)}
-        with open(self.trace_file_path, "w") as f:
-            json.dump(payload, f)
+            self._dirty = False
+        tmp = f"{self.trace_file_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.trace_file_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _atexit_save(self) -> None:
+        """Best-effort final flush: only writes when events accumulated
+        since the last explicit save (a clean shutdown that already saved
+        does nothing)."""
+        if self._dirty:
+            try:
+                self.save()
+            except Exception:
+                pass  # interpreter teardown: nothing sane left to do

@@ -1,0 +1,187 @@
+"""Flight recorder: ring/redaction unit level, plus the chaos-driven
+post-mortem contract — a serving dispatch-halt and a trainer
+anomaly-budget halt each auto-dump a redacted JSON post-mortem (ISSUE 8
+acceptance criterion; the observability twin of the PR 3/5 chaos suites)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.observability.flight_recorder import (
+    FlightRecorder,
+    redact,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# --- unit level ----------------------------------------------------------------
+
+def test_ring_is_bounded_and_ordered():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record("ev", i=i)
+    assert len(fr) == 4
+    evs = fr.events()
+    assert [e["i"] for e in evs] == [6, 7, 8, 9]
+    assert [e["seq"] for e in evs] == [7, 8, 9, 10]  # ring position anchor
+    pm = fr.build_postmortem("why")
+    assert pm["events_recorded"] == 10 and pm["events_kept"] == 4
+
+
+def test_redaction_strips_payload_content():
+    """Token ids, prompts, tensors, and long strings never survive into a
+    dump — only shapes of them."""
+    assert redact("x" * 500).endswith("…") and len(redact("x" * 500)) < 250
+    assert redact(list(range(100))) == {"len": 100}
+    assert redact((1, 2, 3)) == [1, 2, 3]  # short numeric tuples pass
+    assert redact(np.arange(12).reshape(3, 4)) == {
+        "type": "ndarray", "shape": [3, 4]
+    }
+    assert redact(float("nan")) == "nan"  # JSON-safe
+    nested = redact({"a": {"b": {"c": {"d": 1}}}})
+    assert nested == {"a": {"b": {"c": {"keys": 1}}}}
+    fr = FlightRecorder(capacity=2)
+    fr.record("ev", prompt=np.arange(64), note="n" * 400)
+    ev = fr.events()[0]
+    assert ev["prompt"] == {"type": "ndarray", "shape": [64]}
+    assert len(ev["note"]) < 250
+    json.dumps(fr.build_postmortem("r"))  # fully serializable
+
+
+def test_dump_writes_atomic_json(tmp_path):
+    fr = FlightRecorder(capacity=8, dump_dir=str(tmp_path), subsystem="unit")
+    fr.record("a", x=1)
+    path = fr.dump("first", extra={"k": "v"})
+    assert path is not None and path.endswith(".json")
+    payload = json.load(open(path))
+    assert payload["reason"] == "first" and payload["extra"] == {"k": "v"}
+    assert payload["subsystem"] == "unit"
+    path2 = fr.dump("second")
+    assert path2 != path  # sequenced, never clobbers the first post-mortem
+    assert not [p for p in tmp_path.iterdir() if ".tmp" in p.name]
+
+
+def test_restarted_run_never_clobbers_prior_postmortem(tmp_path):
+    """A restarted process (fresh recorder, counter back at 0) dumping
+    into the same directory skips past the previous life's files — the
+    crash record the module exists to preserve survives the resume-and-
+    crash-again cycle."""
+    first = FlightRecorder(dump_dir=str(tmp_path), subsystem="trainer")
+    first.record("halt", run=1)
+    p1 = first.dump("first crash")
+    fresh = FlightRecorder(dump_dir=str(tmp_path), subsystem="trainer")
+    fresh.record("halt", run=2)
+    p2 = fresh.dump("second crash")
+    assert p2 != p1
+    assert json.load(open(p1))["reason"] == "first crash"
+    assert json.load(open(p2))["reason"] == "second crash"
+
+
+def test_memory_only_recorder_keeps_last_postmortem():
+    fr = FlightRecorder(capacity=8)
+    fr.record("a")
+    assert fr.dump("r") is None
+    assert fr.last_postmortem["reason"] == "r"
+
+
+# --- serving: dispatch-halt post-mortem ----------------------------------------
+
+def test_serving_dispatch_halt_dumps_postmortem(tmp_path):
+    """Every dispatch fails → the engine exhausts its retry budget and
+    HALTs → a redacted post-mortem lands in flight_dir with the failure
+    history and the metrics snapshot, and the timeline auto-saves."""
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+    from neuronx_distributed_tpu.serving import (
+        EngineHealth,
+        FaultInjector,
+        ServingEngine,
+    )
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    trace_path = tmp_path / "trace.json"
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        fault_injector=FaultInjector().fail_dispatch(at=0, times=None),
+        flight_dir=str(tmp_path), timeline=Timeline(str(trace_path)),
+        sleep_fn=lambda s: None,
+    )
+    req = engine.submit(
+        np.arange(1, 8, dtype=np.int32),
+        GenerationConfig(max_new_tokens=6, temperature=0.0),
+        key=jax.random.PRNGKey(3),
+    )
+    engine.run()  # halts, never raises
+    assert engine.health() is EngineHealth.HALTED
+
+    dumps = sorted(tmp_path.glob("postmortem_serving_*.json"))
+    assert len(dumps) == 1
+    pm = json.load(open(dumps[0]))
+    assert "dispatch failures" in pm["reason"]
+    kinds = [e["kind"] for e in pm["events"]]
+    assert kinds.count("dispatch_failure") == 3  # the whole retry budget
+    assert "halt" in kinds and "health" in kinds
+    assert pm["extra"]["metrics"]["dispatch_retries"] == 3
+    assert pm["extra"]["requeued"] == 0  # work requeued before the dump
+    # the victim's work survived in the queue (the PR 3 halt contract)
+    assert not req.finished
+    # timeline auto-saved at the halt — the trace survives with no explicit
+    # save() call from the operator
+    events = json.load(open(trace_path))["traceEvents"]
+    assert any(e["name"] == "halted" for e in events)
+
+
+# --- trainer: anomaly-budget halt post-mortem ----------------------------------
+
+def test_trainer_anomaly_budget_halt_dumps_postmortem(tmp_path):
+    """Open-ended NaN injection exhausts the anomaly budget → TrainerHalted
+    → a post-mortem lands next to the emergency checkpoint with the skip
+    history and the emergency tag."""
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+    from neuronx_distributed_tpu.trainer import (
+        AnomalyGuardConfig,
+        OptimizerConfig,
+    )
+    from neuronx_distributed_tpu.trainer.data import SyntheticTokens
+    from neuronx_distributed_tpu.trainer.faults import FaultInjector
+    from neuronx_distributed_tpu.trainer.loop import Trainer, TrainerHalted
+
+    cfg = tiny_llama(num_layers=2, max_seq_len=32)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    trainer = Trainer(
+        model=model,
+        optimizer_config=OptimizerConfig(zero1=False),
+        fault_injector=FaultInjector().nan_loss(at=2, times=None),
+        anomaly_guard=AnomalyGuardConfig(budget=2),
+        emergency_dir=str(tmp_path),
+    )
+    with pytest.raises(TrainerHalted) as ei:
+        trainer.fit(
+            SyntheticTokens(cfg.vocab_size, 8, 16, seed=3),
+            jax.random.PRNGKey(0), max_steps=12,
+        )
+    assert "anomaly budget" in str(ei.value)
+
+    dumps = sorted(tmp_path.glob("postmortem_trainer_*.json"))
+    assert len(dumps) == 1
+    pm = json.load(open(dumps[0]))
+    assert "anomaly budget" in pm["reason"]
+    kinds = [e["kind"] for e in pm["events"]]
+    assert kinds.count("anomaly_skip") == 3  # budget=2 → 3rd skip halts
+    assert "emergency_checkpoint" in kinds and "halt" in kinds
+    halt_ev = [e for e in pm["events"] if e["kind"] == "halt"][-1]
+    assert halt_ev["emergency_tag"] == ei.value.emergency_tag
+    assert pm["extra"]["anomaly_skips"] == 3

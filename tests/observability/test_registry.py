@@ -1,0 +1,210 @@
+"""MetricsRegistry primitives: log-bucketed histogram bucket edges and
+percentile exactness (deterministic streams, no clocks), counter/gauge
+semantics, JSON snapshot, and Prometheus text exposition."""
+
+import json
+import math
+import random
+
+import pytest
+
+from neuronx_distributed_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+# --- histogram: bucket geometry ------------------------------------------------
+
+def test_bucket_edges_are_log_spaced():
+    h = Histogram("h", growth=2.0)
+    # bucket i covers [2^i, 2^(i+1)); an exact power of two is the LOWER
+    # edge of its own bucket
+    assert h.bucket_index(1.0) == 0
+    assert h.bucket_index(2.0) == 1
+    assert h.bucket_index(3.9) == 1
+    assert h.bucket_index(0.5) == -1
+    lo, hi = h.bucket_edges(3)
+    assert lo == 8.0 and hi == 16.0
+
+
+def test_bucket_memory_is_fixed_not_per_sample():
+    h = Histogram("h", growth=1.05)
+    rng = random.Random(7)
+    for _ in range(200_000):
+        h.observe(rng.lognormvariate(-3, 1.5))
+    # samples spanning ~9 decades land in <= log_growth(range) buckets,
+    # not 200k entries
+    assert len(h._buckets) < 600
+    assert h.count == 200_000
+
+
+def test_zero_and_negative_observations():
+    h = Histogram("h")
+    for v in (0.0, -1.0, 0.5):
+        h.observe(v)
+    assert h.count == 3
+    assert h.min == -1.0 and h.max == 0.5
+    # zeros sort below every positive bucket: p50 of (-1, 0, 0.5) is 0
+    assert h.percentile(0.50) == 0.0
+    assert h.percentile(1.0) >= 0.5
+
+
+# --- histogram: percentile exactness ------------------------------------------
+
+def _nearest_rank(sorted_vals, q):
+    return sorted_vals[max(0, math.ceil(q * len(sorted_vals)) - 1)]
+
+
+def test_percentile_exact_to_bucket_on_deterministic_stream():
+    """The histogram quantile overestimates the true (nearest-rank) sorted-
+    list quantile by at most the bucket growth — the 'exact to bucket'
+    contract, independent of stream length."""
+    h = Histogram("h", growth=1.05)
+    rng = random.Random(0)
+    vals = [rng.lognormvariate(-2, 1) for _ in range(20_000)]
+    for v in vals:
+        h.observe(v)
+    vals.sort()
+    for q in (0.5, 0.9, 0.95, 0.99, 0.999):
+        true = _nearest_rank(vals, q)
+        est = h.percentile(q)
+        assert true <= est <= true * h.growth * (1 + 1e-12), (q, true, est)
+
+
+def test_percentile_of_max_is_exact():
+    """When the quantile rank lands in the top bucket the reported value
+    clamps to the exactly-tracked max — so small-sample p95s (where p95 ==
+    max) are EXACT, which keeps the serving snapshot's legacy
+    ``prefill_p95_s`` pins bit-stable."""
+    h = Histogram("h")
+    for v in (0.5, 0.1, 0.2, 0.3, 0.05):
+        h.observe(v)
+    assert h.percentile(0.95) == 0.5
+    assert h.percentile(1.0) == 0.5
+
+
+def test_count_sum_min_max_mean_are_exact():
+    h = Histogram("h")
+    vals = [0.125, 3.5, 0.25, 9.0]
+    for v in vals:
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == sum(vals)
+    assert h.mean == sum(vals) / 4
+    assert h.min == 0.125 and h.max == 9.0
+    snap = h.snapshot()
+    assert snap["count"] == 4 and snap["sum"] == sum(vals)
+    assert set(snap) == {"count", "sum", "mean", "min", "max",
+                         "p50", "p95", "p99"}
+
+
+def test_empty_histogram_snapshot():
+    snap = Histogram("h").snapshot()
+    assert snap["count"] == 0 and snap["p99"] == 0.0
+    assert snap["min"] == 0.0 and snap["max"] == 0.0
+
+
+def test_bad_growth_rejected():
+    with pytest.raises(ValueError):
+        Histogram("h", growth=1.0)
+
+
+# --- counter / gauge ----------------------------------------------------------
+
+def test_counter_int_and_float_increments():
+    c = Counter("c")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    c.inc(0.5)
+    assert c.value == 5.5
+
+
+def test_gauge_defers_coercion_to_export():
+    """``set`` stores the value RAW; ``value`` coerces. A value whose
+    float() raises would therefore fail at EXPORT, never at set time —
+    the property the zero-sync hot-path contract rides (a device scalar
+    parks in the gauge without a transfer)."""
+    g = Gauge("g")
+
+    class Lazy:
+        coerced = 0
+
+        def __float__(self):
+            Lazy.coerced += 1
+            return 2.5
+
+    g.set(Lazy())
+    assert Lazy.coerced == 0  # set() did not touch it
+    assert g.value == 2.5
+    assert Lazy.coerced == 1
+
+
+def test_gauge_set_fn_evaluated_at_export():
+    g = Gauge("g")
+    box = {"v": 1}
+    g.set_fn(lambda: box["v"])
+    assert g.value == 1.0
+    box["v"] = 7
+    assert g.value == 7.0
+    g.set(3)  # a later set replaces the fn
+    assert g.value == 3.0
+
+
+# --- registry -----------------------------------------------------------------
+
+def test_registry_get_or_create_identity_and_type_conflict():
+    r = MetricsRegistry()
+    assert r.counter("x") is r.counter("x")
+    with pytest.raises(TypeError):
+        r.gauge("x")
+    assert r.get("x").value == 0
+    assert r.get("missing") is None
+
+
+def test_snapshot_is_json_serializable():
+    r = MetricsRegistry()
+    r.counter("reqs").inc(3)
+    r.gauge("depth").set(2)
+    h = r.histogram("lat_s")
+    for v in (0.01, 0.02, 0.04):
+        h.observe(v)
+    payload = json.loads(r.snapshot_json())
+    assert payload["reqs"] == 3
+    assert payload["depth"] == 2.0
+    assert payload["lat_s"]["count"] == 3
+
+
+def test_prometheus_text_exposition():
+    r = MetricsRegistry()
+    r.counter("served_total", help="requests served").inc(2)
+    r.gauge("queue_depth").set(4)
+    h = r.histogram("latency_seconds", growth=2.0)
+    for v in (0.5, 1.5, 1.5, 6.0):
+        h.observe(v)
+    text = r.prometheus_text()
+    assert "# TYPE served_total counter" in text
+    assert "served_total 2" in text
+    assert "# TYPE queue_depth gauge" in text
+    assert "# TYPE latency_seconds histogram" in text
+    assert 'latency_seconds_bucket{le="+Inf"} 4' in text
+    assert "latency_seconds_count 4" in text
+    # cumulative bucket counts are monotone non-decreasing
+    counts = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("latency_seconds_bucket")
+    ]
+    assert counts == sorted(counts)
+    assert counts[-1] == 4
+
+
+def test_prometheus_name_sanitization():
+    r = MetricsRegistry()
+    r.counter("serving/decode-tokens").inc()
+    text = r.prometheus_text()
+    assert "serving_decode_tokens 1" in text
+    assert "serving/decode-tokens" not in text

@@ -1,0 +1,110 @@
+"""Timeline durability satellites (ISSUE 8): atomic saves, stable thread
+ids, and the crash-flush paths (atexit hook, engine-halt auto-save)."""
+
+import json
+import threading
+
+import pytest
+
+from neuronx_distributed_tpu.utils import timeline as timeline_mod
+from neuronx_distributed_tpu.utils.timeline import Timeline
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_save_is_atomic_tmp_plus_rename(tmp_path, monkeypatch):
+    """A crash mid-dump never truncates an existing good trace: the write
+    goes to a tmp file and replaces the target only on success."""
+    path = tmp_path / "trace.json"
+    tl = Timeline(str(path))
+    tl.instant("first")
+    tl.save()
+    good = _load(path)
+    assert len(good["traceEvents"]) == 1
+
+    tl.instant("second")
+    boom = RuntimeError("disk full mid-write")
+
+    def exploding_dump(*a, **k):
+        raise boom
+
+    monkeypatch.setattr(timeline_mod.json, "dump", exploding_dump)
+    with pytest.raises(RuntimeError):
+        tl.save()
+    monkeypatch.undo()
+    # the original trace survived intact and no tmp litter remains
+    assert _load(path) == good
+    assert list(tmp_path.iterdir()) == [path]
+    tl.save()
+    assert len(_load(path)["traceEvents"]) == 2
+
+
+def test_thread_ids_are_stable_small_ints(tmp_path):
+    """tids are assigned in first-seen order (0, 1, ...) — not
+    ``get_ident() % 10000``, which collided across thread churn and split
+    one actor over several Perfetto tracks."""
+    path = tmp_path / "trace.json"
+    tl = Timeline(str(path))
+    tl.instant("main-1")
+    tl.instant("main-2")
+
+    def worker():
+        tl.instant("worker-1")
+        tl.instant("worker-2")
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    tl.instant("main-3")
+    tl.save()
+    events = {e["name"]: e["tid"] for e in _load(path)["traceEvents"]}
+    assert events["main-1"] == events["main-2"] == events["main-3"] == 0
+    assert events["worker-1"] == events["worker-2"] == 1
+
+
+def test_atexit_flush_writes_only_when_dirty(tmp_path):
+    path = tmp_path / "trace.json"
+    tl = Timeline(str(path))
+    tl.instant("ev")
+    tl._atexit_save()
+    assert len(_load(path)["traceEvents"]) == 1
+    # clean state: the hook must not rewrite (mtime/content untouched even
+    # if the file were deleted meanwhile)
+    path.unlink()
+    tl._atexit_save()
+    assert not path.exists()
+
+
+def test_disabled_timeline_never_touches_disk(tmp_path):
+    tl = Timeline(None)
+    tl.instant("x")
+    tl.counter("c", 1)
+    with tl.event("e"):
+        pass
+    tl.flow("f", 0, "s")
+    tl.save()
+    tl._atexit_save()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_flow_phase_validation(tmp_path):
+    tl = Timeline(str(tmp_path / "t.json"))
+    with pytest.raises(ValueError):
+        tl.flow("f", 1, "x")
+
+
+def test_events_preserved_across_saves(tmp_path):
+    """save() exports a snapshot without draining: later saves carry the
+    full history (the halt auto-save followed by an explicit save must not
+    lose the pre-halt events)."""
+    path = tmp_path / "t.json"
+    tl = Timeline(str(path))
+    tl.instant("a")
+    tl.save()
+    tl.instant("b")
+    tl.save()
+    names = [e["name"] for e in _load(path)["traceEvents"]]
+    assert names == ["a", "b"]

@@ -225,6 +225,38 @@ def test_gl02_metadata_reads_not_flagged(tmp_path):
     assert [x for x in v if x.rule == "GL02"] == []
 
 
+def test_gl02_observability_emit_paths_are_hot(tmp_path):
+    """ISSUE 8 satellite: the observability emit paths (metric record /
+    trace emit functions called from engine/trainer inner loops) are on
+    the hot-path list BY PATH — an implicit sync smuggled into future
+    instrumentation trips GL02 with no marker needed."""
+    code = """\
+        import jax.numpy as jnp
+
+        def observe(h, x):
+            h.observe(float(jnp.sum(x)))
+        """
+    for name in (
+        "observability/registry.py",
+        "observability/tracing.py",
+        "observability/flight_recorder.py",
+        "serving/metrics.py",
+        "utils/timeline.py",
+    ):
+        assert "GL02" in rules_of(lint(tmp_path, code, name=name)), name
+    # ...and the shipped emit modules themselves scan clean
+    targets = [
+        os.path.join(PKG, "observability", "registry.py"),
+        os.path.join(PKG, "observability", "tracing.py"),
+        os.path.join(PKG, "observability", "flight_recorder.py"),
+        os.path.join(PKG, "serving", "metrics.py"),
+        os.path.join(PKG, "utils", "timeline.py"),
+    ]
+    assert all(os.path.exists(t) for t in targets)
+    report = runner.scan(targets, root=REPO_ROOT)
+    assert report.violations == []
+
+
 # --- GL03 recompile-hazard ----------------------------------------------------
 
 

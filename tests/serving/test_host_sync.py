@@ -97,6 +97,39 @@ def test_sync_budget_streams_unchanged(setup):
     assert req.tokens == ref
 
 
+def test_instrumented_sync_budget_matches_bare(setup, tmp_path):
+    """ISSUE 8 regression pin: FULL observability — timeline + request-flow
+    tracer + flight recorder + shared registry + TTFT/TPOT histograms —
+    adds ZERO device_get calls. The budgets are the same numbers the bare
+    engine pins above: submit=1, admission step=2, steady chunk=1."""
+    from neuronx_distributed_tpu.observability import MetricsRegistry
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    cfg, model, params = setup
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None,
+        timeline=Timeline(str(tmp_path / "trace.json")),
+        registry=MetricsRegistry(), flight_dir=str(tmp_path),
+    )
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    with _SyncCounter() as c:
+        req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(7))
+    assert c.calls == 1, f"instrumented submit must stay 1 sync, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 2, f"instrumented admission must stay 2 syncs, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 1, f"instrumented steady chunk must stay 1 sync, saw {c.calls}"
+    # exporting the registry AFTER the run may sync (gauges resolve lazily
+    # there by design) — the hot loop above must not have
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 12
+    snap = engine.metrics.snapshot()
+    assert snap["ttft_p95_s"] > 0.0 and snap["completed"] == 1
+
+
 @pytest.mark.sanitize
 def test_engine_hot_loop_under_transfer_guard(setup, transfer_guard_disallow):
     """Dynamic GL02 witness: a full serve cycle — submit, prefill (with the

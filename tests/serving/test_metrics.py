@@ -98,6 +98,21 @@ def test_chunk_occupancy_counts_held_slots():
     assert abs(snap["chunk_tokens_per_sec"] - 10 / 0.6) < 1e-9
 
 
+def test_second_engine_on_shared_registry_rejected():
+    """Registries carry no instance labels, so two ServingMetrics on one
+    registry would silently merge counters — refused loudly instead
+    (cross-SUBSYSTEM sharing, serving_ + train_ prefixes, stays fine)."""
+    import pytest
+
+    from neuronx_distributed_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    ServingMetrics(num_slots=2, registry=reg)
+    with pytest.raises(ValueError, match="distinct MetricsRegistry"):
+        ServingMetrics(num_slots=2, registry=reg)
+    reg.counter("train_steps").inc()  # other-subsystem names coexist
+
+
 def test_cancel_counts():
     m = ServingMetrics()
     r = _req(3)
