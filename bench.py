@@ -57,6 +57,7 @@ FAULTS_TIMEOUT_S = 300
 PREFIX_TIMEOUT_S = 420
 TRAIN_FAULTS_TIMEOUT_S = 420
 OBSERVE_TIMEOUT_S = 300
+SPEC_TIMEOUT_S = 540
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -934,6 +935,162 @@ def _measure_gqa(base_cfg, batch, seq, attention_impl):
     }
 
 
+def _measure_serving_spec(devs):
+    """Speculative serving (``--child-spec``): engine decode tokens/s,
+    spec-OFF vs spec-ON, at a CONTROLLED synthetic acceptance rate on the
+    CPU proxy.
+
+    The acceptance knob is an early-exit draft: the target is a 6-layer
+    model whose layers 1..5 have their residual contributions (``o_proj``/
+    ``down_proj`` kernels) scaled by ``eps``, and the draft is the SAME
+    weights truncated to layer 0. At ``eps=0`` the two functions are
+    identical (acceptance exactly 1.0); growing ``eps`` degrades agreement
+    smoothly — a deterministic acceptance dial with a genuinely ~6x
+    cheaper draft, which is the regime speculation is for. The sweep shows
+    BOTH sides of the trade: high acceptance wins >=1.5x, low acceptance
+    (eps=0.3, ~0.2 accept) is a measured LOSS — speculation is not free.
+
+    Every leg proves streams bit-identical to its spec-off twin
+    (speculation is a transport, not an approximation), and the chaos leg
+    injects a draft-dispatch failure mid-run: tokens_lost must be 0
+    through the non-speculative fallback + draft-cache resync."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        early_exit_draft_params,
+    )
+    from neuronx_distributed_tpu.serving import FaultInjector, ServingEngine
+
+    n_layers = 6
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=n_layers, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    draft_cfg = LlamaConfig(**{**cfg.__dict__, "num_layers": 1})
+    draft = LlamaForCausalLM(draft_cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    base_params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+
+    def make_params(eps: float):
+        """Target params with eps-scaled late layers + the layer-0
+        early-exit draft subset (shared embed/norm/head)."""
+        return early_exit_draft_params(base_params, n_layers, 1, eps)
+
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=int(rng.randint(6, 18))).astype(np.int32)
+        for _ in range(8)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=64, temperature=0.0)
+
+    def run(t_params, d_params=None, gamma=4, injector=None):
+        kw = {}
+        if d_params is not None:
+            kw = dict(
+                draft_model=draft, draft_params=d_params, gamma=gamma,
+                fault_injector=injector, sleep_fn=lambda s: None,
+            )
+        engine = ServingEngine(
+            model, t_params, num_slots=4, decode_chunk_size=4,
+            prefix_cache=None, **kw,
+        )
+        # warmup wave compiles prefill buckets + the decode program
+        for i, p in enumerate(prompts[:4]):
+            engine.submit(
+                p, GenerationConfig(max_new_tokens=10, temperature=0.0),
+                key=jax.random.PRNGKey(i),
+            )
+        engine.run()
+        m = engine.metrics
+        base_tok = m.decode_tokens
+        base_wall = m.decode_dispatch_s + m.decode_readback_s
+        t0 = _t.perf_counter()
+        reqs = [
+            engine.submit(p, gcfg, key=jax.random.PRNGKey(100 + i))
+            for i, p in enumerate(prompts)
+        ]
+        engine.run()
+        wall = _t.perf_counter() - t0
+        dtok = m.decode_tokens - base_tok
+        dwall = (m.decode_dispatch_s + m.decode_readback_s) - base_wall
+        return {
+            "streams": [r.tokens for r in reqs],
+            "decode_tok_s": dtok / dwall if dwall > 0 else 0.0,
+            "e2e_tok_s": dtok / wall if wall > 0 else 0.0,
+            "snap": m.snapshot(),
+            "decode_compilations": engine.decode_compilations,
+        }
+
+    sweep = []
+    headline = None
+    for eps in (0.0, 0.02, 0.1, 0.3):
+        t_params, d_params = make_params(eps)
+        off = run(t_params)
+        on = run(t_params, d_params, gamma=4)
+        lost = sum(
+            _divergence_lost(c, s)
+            for c, s in zip(off["streams"], on["streams"])
+        )
+        row = {
+            "eps": eps,
+            "accept_rate": round(on["snap"]["spec_accept_rate"], 4),
+            "accept_len_p50": on["snap"]["spec_accept_len_p50"],
+            "draft_tokens_wasted": on["snap"]["draft_tokens_wasted"],
+            "off_decode_tok_s": round(off["decode_tok_s"], 2),
+            "on_decode_tok_s": round(on["decode_tok_s"], 2),
+            "decode_speedup": round(
+                on["decode_tok_s"] / max(off["decode_tok_s"], 1e-9), 3
+            ),
+            "e2e_speedup": round(
+                on["e2e_tok_s"] / max(off["e2e_tok_s"], 1e-9), 3
+            ),
+            "streams_bit_identical": off["streams"] == on["streams"],
+            "tokens_lost": int(lost),
+        }
+        sweep.append(row)
+        if eps == 0.02:
+            headline = dict(row)
+            headline["decode_compilations"] = on["decode_compilations"]
+            # chaos leg at the headline operating point: a draft-dispatch
+            # failure mid-run must cost zero tokens through the fallback
+            inj = FaultInjector().fail_draft_dispatch(at=3, times=1)
+            chaos = run(t_params, d_params, gamma=4, injector=inj)
+            headline["chaos_draft_dispatch"] = {
+                "fired": inj.counters["draft_dispatch_failures"],
+                "spec_fallbacks": chaos["snap"]["spec_fallbacks"],
+                "tokens_lost": int(sum(
+                    _divergence_lost(c, s)
+                    for c, s in zip(off["streams"], chaos["streams"])
+                )),
+                "streams_bit_identical": chaos["streams"] == off["streams"],
+            }
+    return {
+        "gamma": 4,
+        "requests": len(prompts),
+        "max_new_tokens": 64,
+        "target_layers": n_layers,
+        "draft_layers": 1,
+        **{f"headline_{k}": v for k, v in headline.items()},
+        "accept_sweep": sweep,
+        "speedup_ok": bool(
+            headline["decode_speedup"] >= 1.5
+            and headline["accept_rate"] >= 0.7
+            and headline["streams_bit_identical"]
+            and headline["chaos_draft_dispatch"]["tokens_lost"] == 0
+        ),
+    }
+
+
 def _measure_observability(devs):
     """Instrumentation overhead (``--child-observe``): the SAME request
     workload through the continuous-batching engine BARE vs fully
@@ -1243,6 +1400,32 @@ def child_prefix() -> None:
         _emit(
             {
                 "metric": "serving_prefix",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
+def child_spec() -> None:
+    """Speculative-serving child (``--child-spec``): spec-off vs spec-on
+    engine decode tokens/s across a synthetic-acceptance sweep (early-exit
+    eps-draft), streams bit-identical, tokens_lost=0 under draft-dispatch
+    chaos. Prints one JSON line; merged into the BENCH artifact as
+    ``extras.serving_spec``."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_spec",
+                "unit": "decode tokens/s (spec-on / spec-off)",
+                "platform": devs[0].platform,
+                **_measure_serving_spec(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_spec",
                 "error": f"{type(e).__name__}: {str(e)[:400]}",
             }
         )
@@ -1642,6 +1825,7 @@ def main() -> None:
     prefix_result = None
     train_faults_result = None
     observe_result = None
+    spec_result = None
 
     import signal
 
@@ -1681,6 +1865,11 @@ def main() -> None:
             observe_result
             if observe_result is not None
             else {"error": "observe child did not finish"}
+        )
+        extras["serving_spec"] = (
+            spec_result
+            if spec_result is not None
+            else {"error": "spec child did not finish"}
         )
         extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
@@ -1828,6 +2017,16 @@ def main() -> None:
     else:
         observe_result = {"error": f"observe child: {err}"}
 
+    # 10. Speculative-serving child: spec-off vs spec-on decode tokens/s
+    #     across the synthetic acceptance sweep (another wall-clock
+    #     comparison — serialized for the same core-contention reason).
+    spec, err = _run_child("--child-spec", SPEC_TIMEOUT_S)
+    if spec is not None:
+        spec.pop("metric", None)
+        spec_result = spec
+    else:
+        spec_result = {"error": f"spec child: {err}"}
+
     _finalize()
 
 
@@ -1840,6 +2039,8 @@ if __name__ == "__main__":
         child_sweep()
     elif "--child-serving" in sys.argv:
         child_serving()
+    elif "--child-spec" in sys.argv:
+        child_spec()
     elif "--child-train-faults" in sys.argv:
         child_train_faults()
     elif "--child-faults" in sys.argv:

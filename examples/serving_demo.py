@@ -39,6 +39,18 @@ loop survives). ``--deadline``/``--queue-timeout`` attach per-request
 deadlines so sheds show up in the summary (pair with ``--inject-fault
 skew`` to jump the engine clock past them without waiting).
 
+``--draft-layers N`` turns on SPECULATIVE serving: the draft model is the
+target's first N layers (early-exit weight sharing — the smaller N, the
+cheaper the draft; the later layers are eps-scaled so the draft actually
+agrees with the target and acceptance is visibly high). Every decode chunk
+becomes ``--decode-chunk`` fused draft–verify rounds, each emitting up to
+``--gamma`` tokens per slot (per-slot variable advance). Greedy streams
+are bit-identical to the non-speculative engine; the summary gains
+``spec_accept_rate`` / ``spec_accept_len_p50`` / ``draft_tokens_wasted``.
+``--inject-fault draft`` injects a speculative-dispatch failure: the
+affected chunk decodes non-speculatively (stream intact) and the draft
+cache resyncs.
+
 CPU-runnable out of the box:
 
   python examples/serving_demo.py
@@ -46,6 +58,8 @@ CPU-runnable out of the box:
   python examples/serving_demo.py --decode-chunk 1   # per-token stepping
   python examples/serving_demo.py --shared-prefix 24 # system-prompt reuse
   python examples/serving_demo.py --shared-prefix 24 --no-prefix-cache
+  python examples/serving_demo.py --draft-layers 1 --gamma 4  # speculative
+  python examples/serving_demo.py --draft-layers 1 --inject-fault draft
   python examples/serving_demo.py --inject-fault dispatch
   python examples/serving_demo.py --inject-fault poison --slots 4
   python examples/serving_demo.py --deadline 0.5 --inject-fault skew
@@ -83,10 +97,18 @@ def parse_args(argv=None):
                    help="disable the prefix cache (full prefill for every "
                         "admission — today's legacy path; streams are "
                         "bit-identical either way)")
+    p.add_argument("--draft-layers", type=int, default=0,
+                   help="speculative serving: draft = the target's first N "
+                        "layers (0 disables). Greedy streams stay "
+                        "bit-identical; acceptance stats land in the "
+                        "summary")
+    p.add_argument("--gamma", type=int, default=4,
+                   help="draft tokens proposed per speculative round (each "
+                        "round emits 1..gamma tokens per slot)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--inject-fault", default="none",
                    choices=["none", "dispatch", "halt", "poison", "prefill",
-                            "skew"],
+                            "skew", "draft"],
                    help="drive a recovery path through the FaultInjector: "
                         "one dispatch failure (recover), all dispatches "
                         "(HALTED), a poisoned readback (quarantine), a "
@@ -145,9 +167,41 @@ def main(argv=None):
     init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
     params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
 
+    draft_model, draft_params = None, None
+    if args.draft_layers > 0:
+        from neuronx_distributed_tpu.models.llama import (
+            early_exit_draft_params,
+        )
+
+        if not 0 < args.draft_layers < cfg.num_layers:
+            raise SystemExit(
+                f"--draft-layers must be in [1, {cfg.num_layers - 1}]"
+            )
+        # early-exit draft: the target's first N layers (shared embed/
+        # norm/head), with the target's LATER layers eps-scaled so draft
+        # and target actually agree — the synthetic-acceptance dial
+        # (random tiny-model weights would accept ~nothing and show
+        # speculation at its worst, which is the bench's job, not the
+        # demo's). eps=0.02 gives ~0.8 per-round acceptance on GREEDY
+        # slots; the demo's mixed workload also carries sampled requests,
+        # which accept nothing BY DESIGN (one exactly-sampled token per
+        # round) and dilute the headline rate
+        params, draft_params = early_exit_draft_params(
+            params, cfg.num_layers, args.draft_layers, eps=0.02
+        )
+        draft_model = LlamaForCausalLM(
+            tiny_llama(num_layers=args.draft_layers), attention_impl="xla"
+        )
+
     injector = None
     if args.inject_fault != "none":
         injector = FaultInjector()
+        if args.inject_fault == "draft":
+            if draft_model is None:
+                raise SystemExit(
+                    "--inject-fault draft needs --draft-layers > 0"
+                )
+            injector.fail_draft_dispatch(at=2, times=1)
         if args.inject_fault == "dispatch":
             injector.fail_dispatch(at=2, times=1)  # one mid-run failure
         elif args.inject_fault == "halt":
@@ -175,6 +229,9 @@ def main(argv=None):
         max_tokens_in_flight=args.max_tokens_in_flight,
         admission=args.admission,
         decode_chunk_size=args.decode_chunk,
+        draft_model=draft_model,
+        draft_params=draft_params,
+        gamma=args.gamma,
         prefix_cache=None if args.no_prefix_cache else "auto",
         fault_injector=injector,
         timeline=timeline,

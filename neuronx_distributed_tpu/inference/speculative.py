@@ -64,6 +64,7 @@ def speculative_generate(
     gamma: int = 4,
     temperature: float = 0.0,
     key: jax.Array | None = None,
+    registry=None,
 ) -> Tuple[jax.Array, float]:
     """Speculative decoding for ``prompt_ids`` (B, S) — any batch size.
     ``temperature=0`` is greedy; ``temperature>0`` runs the exact
@@ -74,7 +75,17 @@ def speculative_generate(
     mean_accepted_per_round)`` — the mean over rounds AND rows of each row's
     own accepted length (a draft-quality metric comparable across batch
     sizes); at B>1 the REALIZED advance per round is ``min over rows + 1``
-    tokens, so wall-clock tokens/s is bounded by the worst row."""
+    tokens, so wall-clock tokens/s is bounded by the worst row.
+
+    ``registry`` (a ``MetricsRegistry``) routes the per-row acceptance
+    statistics through the SAME ``SpecStats`` recorder the serving engine's
+    speculative path reports into — identical metric names
+    (``spec_accept_len`` histogram, drafted/accepted/wasted counters) and
+    snapshot keys, at full per-row-per-round resolution, instead of the
+    ad-hoc host-array aggregation that existed before. The wasted-draft
+    counter here includes the batch-min schedule's re-drafted tail (rows
+    that accepted more than the batch minimum re-draft those tokens next
+    round) — the cost the engine's per-slot variable advance eliminates."""
     B = prompt_ids.shape[0]
     if temperature > 0.0 and key is None:
         raise ValueError("sampled speculative decoding needs a PRNG key")
@@ -192,6 +203,12 @@ def speculative_generate(
         out = jnp.where(idx[None] == fix_pos, fix_val, out)
         return t_cache, d_cache, out, n_acc
 
+    stats = None
+    if registry is not None:
+        from neuronx_distributed_tpu.observability.spec_stats import SpecStats
+
+        stats = SpecStats(registry)
+
     key = key if key is not None else jax.random.PRNGKey(0)
     key, k0 = jax.random.split(key)
     first, t_cache, d_cache = _prefills(
@@ -213,6 +230,15 @@ def speculative_generate(
         # prefix (+1 for its correction); see module docstring
         n_min = int(n_acc_h.min())
         emit = min(n_min + 1, gamma)
+        if stats is not None:
+            # per-row, per-round — the same resolution (and recorder) as
+            # the engine path. Consumed is capped at the batch advance:
+            # the accepted-beyond-minimum tail is re-drafted next round,
+            # which the wasted counter must surface
+            for n_row in n_acc_h.tolist():
+                stats.record_round(
+                    int(n_row), gamma, consumed=min(int(n_row), emit)
+                )
         tokens.append(np.asarray(out[:, :emit]))
         last = out[:, emit - 1]
         count += emit

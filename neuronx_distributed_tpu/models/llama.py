@@ -101,6 +101,51 @@ def _remat_layer_cls(cfg: "LlamaConfig"):
     return nn.remat(LlamaDecoderLayer, policy=policy)
 
 
+def early_exit_draft_params(params, num_layers: int, draft_layers: int,
+                            eps: float):
+    """Build the EARLY-EXIT draft pair for speculative serving demos and
+    benches: returns ``(target_params, draft_params)`` where the target is
+    ``params`` with layers ``draft_layers..num_layers-1``'s residual
+    contributions (``o_proj``/``down_proj`` kernels) scaled by ``eps``, and
+    the draft is the SAME weights truncated to the first ``draft_layers``
+    layers (shared embed/final_norm/lm_head).
+
+    At ``eps=0`` draft and target are the same function (acceptance exactly
+    1.0); growing ``eps`` degrades their agreement smoothly — a
+    deterministic synthetic-acceptance dial with a genuinely
+    ``num_layers/draft_layers``-cheaper draft. Requires the unscanned
+    ``layers_i`` param naming (``scan_layers=False``)."""
+    if not 0 < draft_layers < num_layers:
+        raise ValueError(
+            f"draft_layers must be in [1, {num_layers - 1}], got {draft_layers}"
+        )
+    mdl = dict(params["params"]["model"])
+    if "layers_0" not in mdl:
+        raise ValueError(
+            "early_exit_draft_params needs scan_layers=False (per-layer "
+            "'layers_i' params)"
+        )
+    for i in range(draft_layers, num_layers):
+        def scale(path, leaf):
+            keys = [getattr(k, "key", str(k)) for k in path]
+            if "o_proj" in keys or "down_proj" in keys:
+                return leaf * eps
+            return leaf
+        mdl[f"layers_{i}"] = jax.tree_util.tree_map_with_path(
+            scale, mdl[f"layers_{i}"]
+        )
+    target_params = {"params": {**params["params"], "model": mdl}}
+    draft_params = {"params": {
+        "model": {
+            "embed": mdl["embed"],
+            **{f"layers_{i}": mdl[f"layers_{i}"] for i in range(draft_layers)},
+            "final_norm": mdl["final_norm"],
+        },
+        "lm_head": params["params"]["lm_head"],
+    }}
+    return target_params, draft_params
+
+
 def tiny_llama(**over) -> LlamaConfig:
     """4-layer full-width-style shrunk config for tests (the reference's
     integration trick: tiny depth, real structure —

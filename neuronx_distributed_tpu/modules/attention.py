@@ -407,6 +407,50 @@ def seed_cache_prefix(prefix, m, start, length: int):
     return jax.tree_util.tree_map_with_path(fn, prefix)
 
 
+def invalidate_cache_window(cache, start, keep):
+    """Per-row post-hoc invalidation of a just-written column window — the
+    speculative-decode acceptance primitive. A verify/draft pass writes
+    ``width`` columns starting at ``start`` optimistically valid for every
+    live row; acceptance then decides, PER ROW, how many of them belong to
+    the final stream. This clears ``kv_valid`` for columns
+    ``[start + keep[b], start + width)`` of each row ``b`` (``width`` is
+    implied by the caller clamping ``keep``; columns at or beyond
+    ``start + keep[b]`` up to the row end are ANDed against the keep
+    window, which only ever narrows validity — columns outside
+    ``[start, ∞)`` are untouched).
+
+    Rejected draft columns become permanent invalid GAP columns: the
+    attention math already runs off per-row validity counts
+    (``valid_count_below`` positions, ``kv_valid`` masking), so a row's
+    LOGICAL cursor advances by its own accepted length while the physical
+    write cursor stays shared — this is what lets slots at different
+    acceptance depths share one fused program with no per-slot cache
+    reshaping. ``start`` is a traced scalar, ``keep`` a traced (B,) int32;
+    K/V storage is untouched (masked columns are invisible)."""
+
+    def fn(path, leaf):
+        name = cache_leaf_name(path)
+        if name != "kv_valid":
+            return leaf
+        ax = cache_batch_axis(name, leaf.ndim)
+        col = ax + 1
+        length = leaf.shape[col]
+        cols = jnp.arange(length, dtype=jnp.int32)
+        # broadcast keep over the batch axis, cols over the column axis;
+        # any leading layer axis (nn.scan stacking) broadcasts for free
+        kshape = [1] * leaf.ndim
+        kshape[ax] = keep.shape[0]
+        cshape = [1] * leaf.ndim
+        cshape[col] = length
+        cut = (
+            cols.reshape(cshape)
+            >= (start + keep.astype(jnp.int32)).reshape(kshape)
+        ) & (cols.reshape(cshape) >= start)
+        return leaf & jnp.logical_not(cut)
+
+    return jax.tree_util.tree_map_with_path(fn, cache)
+
+
 def cache_fingerprint(cache):
     """Cheap integrity fingerprint of a cache(-prefix) tree: a float32
     reduction over every leaf, position-weighted along the column axis so a

@@ -42,6 +42,7 @@ from neuronx_distributed_tpu.observability.profiler import (
     record_device_memory,
 )
 from neuronx_distributed_tpu.observability.callback import MetricsCallback
+from neuronx_distributed_tpu.observability.spec_stats import SpecStats
 
 __all__ = [
     "Counter",
@@ -51,6 +52,7 @@ __all__ = [
     "MetricsCallback",
     "MetricsRegistry",
     "RequestTracer",
+    "SpecStats",
     "install_compile_listener",
     "profile_window",
     "record_device_memory",

@@ -1,0 +1,100 @@
+"""Shared speculative-decoding acceptance statistics.
+
+One recorder serves BOTH speculation consumers — the serving engine's fused
+draft–verify chunks and the solo
+:func:`~neuronx_distributed_tpu.inference.speculative.speculative_generate`
+path — so acceptance is reported identically everywhere: the same metric
+names, the same per-row-per-round resolution, the same snapshot keys. (The
+solo path used to aggregate acceptance through ad-hoc full-resolution host
+arrays; routing it through the registry replaced that with fixed-memory
+log-bucketed histograms and made the two paths comparable.)
+
+Semantics: one ``record_round`` observation is ONE slot's (row's) accepted
+draft length in ONE speculative round — ``0..gamma`` (``gamma`` = full
+acceptance). The histogram feeds ``spec_accept_len_p50/p95``; the counters
+feed ``spec_accept_rate`` (accepted / drafted) and ``draft_tokens_wasted``
+(drafted − accepted: draft compute that bought nothing). A sampled
+(``temperature > 0``) slot riding a speculative engine accepts nothing by
+construction, so its rounds report as fully wasted draft work — acceptance
+here measures draft *utility*, not correctness (emission is exact either
+way).
+"""
+
+from __future__ import annotations
+
+from neuronx_distributed_tpu.observability.registry import MetricsRegistry
+
+
+class SpecStats:
+    """Registry-backed acceptance recorder (see module docstring).
+
+    ``registry`` metrics are get-or-create, so an engine's metrics object
+    and a solo ``speculative_generate(..., registry=)`` call pointed at the
+    same registry aggregate into one surface."""
+
+    def __init__(self, registry: MetricsRegistry, prefix: str = "spec"):
+        self.registry = registry
+        self.accept_len = registry.histogram(
+            f"{prefix}_accept_len",
+            help="per-slot accepted draft length per speculative round "
+                 "(0..gamma)",
+        )
+        self.drafted = registry.counter(
+            f"{prefix}_draft_tokens", help="draft tokens proposed"
+        )
+        self.accepted = registry.counter(
+            f"{prefix}_accepted_tokens",
+            help="draft tokens the target accepted",
+        )
+        self.wasted = registry.counter(
+            f"{prefix}_draft_tokens_wasted",
+            help="draft tokens rejected (drafted - accepted)",
+        )
+        self.rounds = registry.counter(
+            f"{prefix}_rounds", help="per-slot speculative rounds executed"
+        )
+        self.fallbacks = registry.counter(
+            f"{prefix}_fallbacks",
+            help="chunks decoded non-speculatively after a failed "
+                 "speculative dispatch",
+        )
+
+    def record_round(self, accepted: int, gamma: int,
+                     consumed: int = None) -> None:
+        """One slot's acceptance in one round: ``accepted`` of ``gamma``
+        proposed drafts survived verification. ``consumed`` (default:
+        ``accepted``) is how many draft tokens actually ADVANCED the
+        stream — the solo batch-min schedule consumes only up to the batch
+        minimum and re-drafts the rest, so its wasted count exceeds
+        ``gamma - accepted``; the engine's per-slot variable advance
+        consumes everything it accepts."""
+        accepted = int(accepted)
+        if consumed is None:
+            consumed = accepted
+        self.accept_len.observe(accepted)
+        self.drafted.inc(gamma)
+        self.accepted.inc(accepted)
+        self.wasted.inc(gamma - int(consumed))
+        self.rounds.inc()
+
+    def record_fallback(self) -> None:
+        self.fallbacks.inc()
+
+    @property
+    def accept_rate(self) -> float:
+        d = self.drafted.value
+        return float(self.accepted.value) / d if d else 0.0
+
+    def snapshot(self) -> dict:
+        """The spec keys merged into consumers' snapshots — identical for
+        the engine and the solo path."""
+        return {
+            "spec_rounds": int(self.rounds.value),
+            "spec_draft_tokens": int(self.drafted.value),
+            "spec_accepted_tokens": int(self.accepted.value),
+            "draft_tokens_wasted": int(self.wasted.value),
+            "spec_accept_rate": self.accept_rate,
+            "spec_accept_len_p50": self.accept_len.percentile(0.50),
+            "spec_accept_len_p95": self.accept_len.percentile(0.95),
+            "spec_fallbacks": int(self.fallbacks.value),
+        }

@@ -58,6 +58,11 @@ HOT_SUFFIXES = (
     "serving/engine.py",
     "serving/cache_manager.py",
     "inference/generate.py",
+    # speculative serving (ISSUE 9): the fused draft–verify chunk builder
+    # runs inside the engine's donated decode dispatch — a host read of
+    # either cache's cursor (or any implicit coercion) here would stall
+    # every speculative round
+    "inference/spec_decode.py",
     "trainer/loop.py",
     # observability emit paths (ISSUE 8): record/trace functions are called
     # from the engine/trainer inner loops, so an implicit sync here would

@@ -9,7 +9,12 @@ Layers:
 * :mod:`engine` — ``ServingEngine``: the host-side loop interleaving prefill
   of admitted requests with ONE jitted fixed-shape decode program over all
   active slots — ``decode_chunk_size`` fused steps per dispatch, donated
-  device-resident cache/slot-state, one host sync per chunk.
+  device-resident cache/slot-state, one host sync per chunk. With
+  ``draft_model=`` bound, each chunk becomes that many fused draft–verify
+  ROUNDS (speculative decoding, ISSUE 9): 1..gamma tokens per slot per
+  round with per-slot variable advance, greedy streams bit-identical to
+  the spec-off engine and the solo speculative path, still one sync per
+  chunk.
 * :mod:`scheduler` — FIFO + longest-prefill-first admission with a
   token-budget guard and the request lifecycle
   (QUEUED→PREFILL→DECODE→DONE/CANCELLED).
@@ -61,6 +66,7 @@ from neuronx_distributed_tpu.serving.engine import (
 from neuronx_distributed_tpu.serving.faults import (
     FaultInjector,
     InjectedDispatchError,
+    InjectedDraftError,
     InjectedFault,
     InjectedPrefillError,
 )
@@ -75,6 +81,7 @@ __all__ = [
     "EngineHealth",
     "FaultInjector",
     "InjectedDispatchError",
+    "InjectedDraftError",
     "InjectedFault",
     "InjectedPrefillError",
     "PrefixCache",

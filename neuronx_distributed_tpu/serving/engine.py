@@ -66,6 +66,37 @@ traffic (system prompts, few-shot templates, multi-turn histories):
   pins. ``prefix_cache=None`` (or size 0) disables everything and
   restores the exact legacy admission path.
 
+Speculative decoding (ISSUE 9) — the decode path's multi-token transport:
+
+* With ``draft_model=``/``draft_params=`` bound, each decode chunk runs
+  ``decode_chunk_size`` fused draft–verify ROUNDS
+  (:func:`~neuronx_distributed_tpu.inference.spec_decode.
+  speculative_decode_chunk`): the draft proposes ``gamma`` tokens through
+  its own donated cache, the target verifies the window in one forward,
+  and each slot accepts its own longest matching prefix + correction —
+  1..gamma tokens per slot per round, still ONE ``device_get`` per chunk.
+* Per-slot variable advance rides the validity machinery: both caches
+  write each round's window at the shared cursor, acceptance invalidates
+  each row's rejected suffix, and per-row valid counts make the gap
+  columns invisible — one fixed-shape program for every acceptance
+  pattern (``decode_compilations`` stays 1).
+* The draft side mirrors the slot lifecycle 1:1: a second
+  ``SlotCacheManager`` (admit at the same cursor, free/quarantine/
+  recover/reset in lockstep), per-bucket draft prefill programs (the
+  draft always full-prefills — prefix-cache hits compose on the target
+  side only), and the same donation regime.
+* Greedy streams are bit-identical to the spec-off engine and to solo
+  ``generate()``/``speculative_generate``; sampled slots emit one
+  exactly-sampled token per round (same key evolution), also
+  bit-identical. ``draft_model=None`` is byte-for-byte today's engine.
+* A failed speculative dispatch with live buffers decodes that chunk
+  non-speculatively (the exact spec-off program — zero tokens lost),
+  then preempts to resync the draft cache; consumed buffers take the
+  bounded recovery/HALT path. Speculation consumes ``gamma`` columns per
+  round whatever it accepts, so poor acceptance reaches the
+  preempt-and-rewind wall earlier — admission stays token-optimistic and
+  preemption keeps streams exact.
+
 Token-stream fidelity: a request served through the engine produces EXACTLY
 the tokens of a solo ``generate(prompt, key)`` call — same prefill math
 (left-padded prompts are already proven token-identical to unpadded ones),
@@ -159,6 +190,9 @@ from neuronx_distributed_tpu.inference.generate import (
     serving_clones,
     suffix_prefill_step,
     validate_generate_args,
+)
+from neuronx_distributed_tpu.inference.spec_decode import (
+    speculative_decode_chunk,
 )
 from neuronx_distributed_tpu.inference.utils import unwrap_logits
 from neuronx_distributed_tpu.modules.attention import (
@@ -291,6 +325,40 @@ def _validate_readback(toks, counts, chunk_size: int, vocab: Optional[int],
     return bad
 
 
+def _validate_spec_readback(toks, counts, gamma: int, vocab: Optional[int],
+                            slots) -> Dict[int, str]:
+    """Speculative edition of :func:`_validate_readback`: the token block
+    is ``(rounds, slots, gamma)`` ragged by per-round counts. A slot is
+    poisoned when any round's count leaves [0, gamma] or any EMITTED token
+    leaves the vocab."""
+    bad: Dict[int, str] = {}
+    rounds = counts.shape[0]
+    for slot in slots:
+        slot = int(slot)
+        for r in range(rounds):
+            c = int(counts[r, slot])
+            if c < 0 or c > gamma:
+                bad[slot] = (
+                    f"round {r} token count {c} outside [0, {gamma}]"
+                )
+                break
+            if c == 0:
+                continue
+            col = np.asarray(toks[r, slot, :c])
+            if (col < 0).any() or (
+                vocab is not None and (col >= vocab).any()
+            ):
+                offender = col[
+                    (col < 0) | ((col >= vocab) if vocab is not None else False)
+                ][0]
+                bad[slot] = (
+                    f"round {r} token {int(offender)} outside vocab "
+                    f"[0, {vocab})"
+                )
+                break
+    return bad
+
+
 def _slot_write(state, slot, tok, key, temp, topk, topp, remaining, eos):
     """One admission's device-side slot update. Every operand is a traced
     scalar/row, so slot churn reuses a single compiled program; jitted with
@@ -325,6 +393,9 @@ class ServingEngine:
         admission: str = "conservative",
         decode_chunk_size: int = 8,
         max_queue: Optional[int] = None,
+        draft_model=None,
+        draft_params=None,
+        gamma: int = 4,
         prefix_cache="auto",
         dispatch_retry: Optional[RetryPolicy] = None,
         degraded_cooldown_chunks: int = 8,
@@ -354,8 +425,40 @@ class ServingEngine:
                 "ServingEngine needs model.config.max_seq_len (the fixed "
                 "slot cache length)"
             )
+        # speculative decoding (ISSUE 9): a draft model turns every decode
+        # chunk into `decode_chunk_size` fused draft–verify ROUNDS, each
+        # emitting 1..gamma tokens per slot. draft_model=None is a strict
+        # no-op: every code path below is byte-for-byte today's
+        if draft_model is not None:
+            if draft_params is None:
+                raise ValueError("draft_model needs draft_params")
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+            d_cfg = getattr(draft_model, "config", None)
+            if getattr(d_cfg, "max_seq_len", None) != max_seq_len:
+                raise ValueError(
+                    "draft_model.config.max_seq_len "
+                    f"({getattr(d_cfg, 'max_seq_len', None)}) must equal the "
+                    f"target's ({max_seq_len}) — both caches share the slot "
+                    "row length"
+                )
+            t_vocab = getattr(getattr(model, "config", None), "vocab_size", None)
+            if (
+                t_vocab is not None
+                and getattr(d_cfg, "vocab_size", None) != t_vocab
+            ):
+                raise ValueError(
+                    "draft and target models must share a vocabulary "
+                    f"({getattr(d_cfg, 'vocab_size', None)} != {t_vocab})"
+                )
         self.model = model
         self.params = params  # property: binds self._params once per assign
+        self.draft_model = draft_model
+        self.gamma = gamma if draft_model is not None else 1
+        # columns one decode dispatch iteration consumes: gamma per
+        # speculative round, 1 per plain step — the unit of every cursor
+        # wall/admission computation below
+        self._round_cols = self.gamma
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len
         self.admission = admission
@@ -391,6 +494,22 @@ class ServingEngine:
         self._prefill_model, self._decode_model = serving_clones(model)
         self.scheduler = Scheduler(max_tokens_in_flight)
         self.cache = SlotCacheManager(num_slots)
+        # draft-side twins: mode clones, a SECOND donated cache collection
+        # (admit/free/recover/quarantine mirrored 1:1 with the target's),
+        # and per-bucket draft prefill programs. The draft cache cursor
+        # tracks the target's in lockstep — both consume gamma columns per
+        # executed round
+        if draft_model is not None:
+            self.draft_params = draft_params  # property: binds once
+            self._draft_prefill_model, self._draft_decode_model = (
+                serving_clones(draft_model)
+            )
+            self.draft_cache = SlotCacheManager(num_slots)
+        else:
+            self._draft_params_src = None
+            self._draft_params = None
+            self.draft_cache = None
+        self._draft_prefill_fns: Dict[int, Callable] = {}
         self.metrics = ServingMetrics(num_slots, registry=registry)
         # observability layer (ISSUE 8): request-scoped flow tracing on the
         # shared timeline, and an always-on flight recorder whose ring is
@@ -427,13 +546,28 @@ class ServingEngine:
         self._consecutive_prefill_failures = 0
         self._last_health = EngineHealth.OK
         # the fused decode chunk: cache AND slot state donated — XLA updates
-        # both in place instead of materializing a fresh cache pytree
-        self._decode_chunk = jax.jit(
-            chunked_decode_step(
-                self._decode_model, decode_chunk_size, max_seq_len
-            ),
-            donate_argnums=(1, 2),
-        )
+        # both in place instead of materializing a fresh cache pytree. With
+        # a draft model the SPECULATIVE chunk (both caches + state donated)
+        # is the hot program; the plain chunk is then built LAZILY, only if
+        # a failed speculative dispatch ever needs the non-speculative
+        # fallback
+        if draft_model is not None:
+            self._spec_chunk = jax.jit(
+                speculative_decode_chunk(
+                    self._decode_model, self._draft_decode_model,
+                    decode_chunk_size, gamma, max_seq_len,
+                ),
+                donate_argnums=(2, 3, 4),
+            )
+            self._decode_chunk = None
+        else:
+            self._spec_chunk = None
+            self._decode_chunk = jax.jit(
+                chunked_decode_step(
+                    self._decode_model, decode_chunk_size, max_seq_len
+                ),
+                donate_argnums=(1, 2),
+            )
         self._slot_write = jax.jit(_slot_write, donate_argnums=(0,))
         self._slot_clear = jax.jit(_slot_clear, donate_argnums=(0,))
         self._first_token = jax.jit(sample_row)
@@ -523,6 +657,23 @@ class ServingEngine:
             if dropped and metrics is not None:
                 metrics.record_prefix_eviction(dropped)
 
+    @property
+    def draft_params(self):
+        """The draft model's weights (speculative serving only). Assignment
+        rebinds the pytree the speculative chunk receives once, like
+        ``params``. A draft swap mid-flight is SAFE for correctness — the
+        emitted stream never depends on draft quality — but slots admitted
+        under the old draft keep old-draft KV until they retire, so
+        acceptance may dip until the fleet turns over."""
+        return self._draft_params_src
+
+    @draft_params.setter
+    def draft_params(self, value):
+        if value is None:
+            raise ValueError("draft_params cannot be unset on a live engine")
+        self._draft_params_src = value
+        self._draft_params = dict(value)
+
     def _now(self) -> float:
         """The engine's scheduling clock — the injected ``time_fn``,
         optionally skewed by the fault injector (chaos tests drive deadline
@@ -587,6 +738,21 @@ class ServingEngine:
         validate_generate_args(
             self.model, prompt[None], config.max_new_tokens, None
         )
+        if self.draft_model is not None and (
+            prompt.size + config.max_new_tokens + self.gamma - 1
+            > self.max_seq_len
+        ):
+            # the speculative twin of the solo guard: the LAST round's
+            # gamma-token verify window must fit the row even when the
+            # context has grown to prompt + max_new - 1 (otherwise the
+            # final token could never be emitted — rewind/preempt would
+            # livelock re-admitting a context whose window never fits)
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({config.max_new_tokens}) + gamma-1 ({self.gamma - 1}) "
+                f"exceeds max_seq_len ({self.max_seq_len}) — speculative "
+                "serving needs window headroom for the final round"
+            )
         budget = self.scheduler.max_tokens_in_flight
         if budget is not None and prompt.size + config.max_new_tokens > budget:
             raise ValueError(
@@ -692,6 +858,9 @@ class ServingEngine:
             self.scheduler.requeue_front(requeued)
             self.cache.release_all_slots()
             self.cache.reset()
+            if self.draft_cache is not None:
+                self.draft_cache.release_all_slots()
+                self.draft_cache.reset()
             self._state = self._fresh_slot_state()
         if self.prefix is not None:
             # PR 3 recovery contract, prefix edition: no in-flight suffix
@@ -756,19 +925,33 @@ class ServingEngine:
     @property
     def decode_compilations(self) -> int:
         """How many distinct decode programs XLA compiled. Stays 1 across
-        arbitrary slot churn — the continuous-batching invariant (one
-        program per engine, whatever the chunk size)."""
-        return int(self._decode_chunk._cache_size())
+        arbitrary slot churn AND arbitrary per-slot acceptance patterns —
+        the continuous-batching invariant (one program per engine, whatever
+        the chunk size or gamma; ragged speculative advance is data, not
+        shape). A speculative engine that ever exercised the
+        non-speculative fallback counts that program too (so the invariant
+        is 1 on any fault-free run)."""
+        n = 0
+        if self._decode_chunk is not None:
+            n += int(self._decode_chunk._cache_size())
+        if self._spec_chunk is not None:
+            n += int(self._spec_chunk._cache_size())
+        return n
 
     @property
     def prefill_compilations(self) -> int:
         """How many distinct prefill programs XLA compiled — full prefills
-        (one per padded ``_bucket`` length actually used) plus suffix
-        prefills (one per ``_suffix_bucket`` chunk length), so growth is
-        bounded by the two bucket counts (powers of two plus exact
-        fallbacks), never by request count or prefix-cache churn."""
+        (one per padded ``_bucket`` length actually used, target plus
+        draft), plus suffix prefills (one per ``_suffix_bucket`` chunk
+        length), so growth is bounded by the bucket counts (powers of two
+        plus exact fallbacks), never by request count or prefix-cache
+        churn."""
         return (
             sum(int(fn._cache_size()) for fn in self._prefill_fns.values())
+            + sum(
+                int(fn._cache_size())
+                for fn in self._draft_prefill_fns.values()
+            )
             + int(self._suffix_fn._cache_size())
         )
 
@@ -792,12 +975,22 @@ class ServingEngine:
         now = self._now()
         self._reap_cancelled(now)
         self._shed_expired(now)
-        if any(self._active) and self.cache.cursor >= self.max_seq_len:
+        # one more dispatch needs _round_cols columns (gamma per
+        # speculative round, 1 per plain step); preempt-and-rewind when the
+        # wall is closer than that. Speculation spends columns faster than
+        # tokens (rejected drafts leave gap columns), so this wall can
+        # arrive earlier than the token-based admission projected — the
+        # preemption machinery keeps streams bit-identical either way
+        if any(self._active) and (
+            self.cache.cursor + self._round_cols > self.max_seq_len
+        ):
             self._preempt_all()
         if not any(self._active) and self.cache.cursor > 0:
             # drained: rewind the shared cursor so the next wave starts at
             # column 0 (storage reused, nothing reallocated)
             self.cache.reset()
+            if self.draft_cache is not None:
+                self.draft_cache.reset()
         self._admit(now)
         if not self._halted and any(self._active):
             self._decode()
@@ -887,21 +1080,32 @@ class ServingEngine:
                 # so fresh requests behind them cannot starve them)
                 return False
             p = len(req.context_ids)
-            bucket = _bucket(p, self.max_seq_len, req.remaining_new_tokens)
+            # the padded prompt must leave room for the remaining
+            # generation AND (speculative engines) the final round's
+            # gamma-token window — _round_cols - 1 == 0 on the plain path
+            bucket = _bucket(
+                p, self.max_seq_len,
+                req.remaining_new_tokens + self._round_cols - 1,
+            )
             target = max(proj, bucket)
             if self.admission == "conservative":
                 # all slots step together, so the cursor's final resting
                 # place is the admission cursor plus the LONGEST remaining
                 # generation in flight — a long prompt's cursor jump must
                 # not strand the slots already running (they'd hit the
-                # preemption wall conservative mode promises to avoid)
+                # preemption wall conservative mode promises to avoid).
+                # Speculation is TOKEN-optimistic here: a column costs one
+                # token only when accepted, so a poor-acceptance run can
+                # still hit the preempt-rewind wall (documented trade; the
+                # all-accept case matches this projection exactly)
                 if (
                     target + max(maxrem, req.remaining_new_tokens)
+                    + self._round_cols - 1
                     > self.max_seq_len
                 ):
                     return False
-            elif target + 1 > self.max_seq_len:
-                # eager: just the prefill + one decode step must fit; the
+            elif target + self._round_cols > self.max_seq_len:
+                # eager: just the prefill + one decode round must fit; the
                 # preemption path recovers the rest
                 return False
             proj = target
@@ -948,10 +1152,28 @@ class ServingEngine:
             self._prefill_fns[padded_len] = fn
         return fn
 
+    def _draft_prefill_fn(self, padded_len: int):
+        fn = self._draft_prefill_fns.get(padded_len)
+        if fn is None:
+            prefill = self._draft_prefill_model
+
+            @jax.jit
+            def fn(params, ids, mask):
+                _, variables = prefill.apply(
+                    params, ids, padding_mask=mask, mutable=["cache"]
+                )
+                return variables["cache"]
+
+            self._draft_prefill_fns[padded_len] = fn
+        return fn
+
     def _prefill_into_slot(self, req: Request, slot: int, now: float) -> None:
         ctx = req.context_ids
         p = len(ctx)
-        padded = _bucket(p, self.max_seq_len, req.remaining_new_tokens)
+        padded = _bucket(
+            p, self.max_seq_len,
+            req.remaining_new_tokens + self._round_cols - 1,
+        )
         self.tracer.step(req.rid, "admission", args={"slot": slot})
         plan = self._plan_prefix_reuse(ctx, p, padded)
         self.tracer.step(
@@ -991,6 +1213,18 @@ class ServingEngine:
                     ids, mask = pack_padded_prompt(ctx, padded)
                     logits, row_cache = self._prefill_fn(padded)(
                         self._params, jnp.asarray(ids), jnp.asarray(mask)
+                    )
+                if self.draft_model is not None:
+                    # the draft context ALWAYS full-prefills (a target
+                    # prefix hit composes with it untouched: prefix
+                    # entries hold target KV only — the draft is cheap by
+                    # construction, so deduping its prefill is not worth a
+                    # second store). No readback: the row is consumed by
+                    # the donating draft admit below, zero added syncs
+                    d_ids, d_mask = pack_padded_prompt(ctx, padded)
+                    draft_row = self._draft_prefill_fn(padded)(
+                        self._draft_params,
+                        jnp.asarray(d_ids), jnp.asarray(d_mask),
                     )
             finally:
                 if plan is not None:
@@ -1053,6 +1287,13 @@ class ServingEngine:
             matched=plan[1] if plan is not None else 0,
         )
         self.cache.admit(row_cache, slot, padded)
+        if self.draft_model is not None:
+            # mirror the slot into the draft cache at the SAME cursor the
+            # target admit just set — the two cursors stay in lockstep, so
+            # every speculative round's windows line up column-for-column
+            self.draft_cache.admit(
+                draft_row, slot, padded, cursor=self.cache.cursor
+            )
         self.metrics.record_admit(req, now)
         if req.admit_time is None:
             req.admit_time = now
@@ -1254,6 +1495,209 @@ class ServingEngine:
         and the next admission/free event no per-slot host state moves. A
         failed dispatch routes through the recovery state machine instead of
         crashing the loop."""
+        if self.draft_model is not None:
+            self._decode_spec()
+        else:
+            self._decode_plain()
+
+    def _nonspec_chunk(self):
+        """The plain fused chunk — built lazily on a speculative engine
+        (only a failed speculative dispatch ever needs it)."""
+        if self._decode_chunk is None:
+            self._decode_chunk = jax.jit(
+                chunked_decode_step(
+                    self._decode_model, self.decode_chunk_size,
+                    self.max_seq_len,
+                ),
+                donate_argnums=(1, 2),
+            )
+        return self._decode_chunk
+
+    def _decode_spec(self) -> None:
+        """One fused SPECULATIVE chunk: ``decode_chunk_size`` draft–verify
+        rounds through both donated caches, one host sync for the ragged
+        per-slot token block. A failed dispatch falls back to a plain
+        non-speculative chunk for THIS chunk when the donated buffers
+        survived (streams bit-identical — the fallback is the very program
+        the spec-off engine runs), then preempts to resync the draft cache;
+        consumed buffers route through full dispatch recovery."""
+        tl = self.timeline
+        active_at_dispatch = int(self._active.sum())
+        self._maybe_profile()
+        if tl is not None:
+            tl.mark_event_start("decode_dispatch", "serving")
+        t0 = self._clock()
+        cache_in = self.cache.take()
+        draft_in = self.draft_cache.take()
+        attempt = self._dispatch_attempts
+        self._dispatch_attempts += 1
+        dparams = self._draft_params
+        try:
+            if self._faults is not None:
+                self._faults.on_dispatch(attempt)
+                self._faults.on_spec_dispatch(attempt)
+                dparams = self._faults.on_spec_params(attempt, dparams)
+            (new_cache, new_draft, self._state, toks, counts, accepts,
+             used, key_snap) = self._spec_chunk(
+                self._params, dparams, cache_in, draft_in, self._state
+            )
+        except BaseException as e:
+            if tl is not None:
+                tl.mark_event_end("decode_dispatch", "serving")
+            if not isinstance(e, Exception):
+                # KeyboardInterrupt/SystemExit are the operator's, not
+                # faults: restore both references and re-raise
+                self.cache.restore(cache_in)
+                self.draft_cache.restore(draft_in)
+                raise
+            self._spec_fallback(cache_in, draft_in, e)
+            return
+        t1 = self._clock()
+        self._consecutive_dispatch_failures = 0
+        self._chunks_since_failure += 1
+        if tl is not None:
+            tl.mark_event_end("decode_dispatch", "serving")
+            tl.mark_event_start("decode_readback", "serving")
+        # THE one host sync per speculative chunk: the ragged (rounds,
+        # slots, gamma) token block, per-round per-slot counts + accepted
+        # draft lengths, the executed round count, and the post-chunk key
+        # snapshot — whatever the per-slot acceptance pattern emitted
+        # graftlint: ok[GL02] THE one per-chunk sync of the fused
+        # speculative decode contract (pinned in test_host_sync.py)
+        toks, counts, accepts, used, chunk_keys = jax.device_get(
+            (toks, counts, accepts, used, key_snap)
+        )
+        t2 = self._clock()
+        readback = self._readbacks
+        self._readbacks += 1
+        if self._faults is not None:
+            toks, counts = self._faults.on_spec_readback(
+                readback, toks, counts, self._active
+            )
+        # executed ROUNDS drive cursor arithmetic (gamma columns per round
+        # in BOTH caches); clamp so corrupted output can never run away
+        used = max(0, min(int(used), self.decode_chunk_size))
+        self.cache.update_after_decode(new_cache, used * self.gamma)
+        self.draft_cache.update_after_decode(new_draft, used * self.gamma)
+        bad = _validate_spec_readback(
+            toks, counts, self.gamma, self._vocab,
+            np.flatnonzero(self._active),
+        )
+        emitted = int(
+            sum(
+                int(counts[:, s].sum())
+                for s in np.flatnonzero(self._active)
+                if int(s) not in bad
+            )
+        )
+        if tl is not None:
+            tl.mark_event_end(
+                "decode_readback", "serving",
+                args={"tokens": emitted, "rounds": used},
+            )
+        now = self._now()
+        delivered = 0
+        spec_accepts = []
+        for slot in np.flatnonzero(self._active):
+            req = self._slot_req[slot]
+            if int(slot) in bad:
+                self._quarantine_slot(int(slot), req, bad[int(slot)], now)
+                continue
+            req.key = np.array(chunk_keys[slot], np.uint32)
+            # acceptance stats at full per-slot-per-round resolution: a
+            # live round is one where the slot emitted (live slots emit
+            # >= 1 per round) — all host scalars off the single sync
+            live_rounds = [
+                r for r in range(counts.shape[0]) if int(counts[r, slot]) > 0
+            ]
+            spec_accepts.extend(int(accepts[r, slot]) for r in live_rounds)
+            self.tracer.step(
+                req.rid, "decode_chunk",
+                args={
+                    "tokens": int(counts[:, slot].sum()),
+                    "rounds": used,
+                    "accepted": int(accepts[:, slot].sum()),
+                },
+            )
+            for r in live_rounds:
+                for tok in toks[r, slot, : int(counts[r, slot])]:
+                    self._emit_token(req, int(tok), now)
+                    delivered += 1
+                    self._maybe_finish(req, now)
+                    if req.finished:
+                        break
+                if req.finished:
+                    break
+        if tl is not None:
+            tl.counter("chunk_tokens", delivered, "serving")
+            tl.instant(
+                "spec_accept", "serving",
+                args={
+                    "accepted": int(sum(spec_accepts)),
+                    "drafted": self.gamma * len(spec_accepts),
+                    "rounds": used,
+                    "tokens": delivered,
+                },
+            )
+        self.metrics.record_decode_chunk(
+            delivered, used, self.cache.cursor, active_at_dispatch,
+            dispatch_s=t1 - t0, readback_s=t2 - t1,
+            spec_accepts=spec_accepts, gamma=self.gamma,
+        )
+
+    def _spec_fallback(self, cache_in, draft_in, exc: Exception) -> None:
+        """A SPECULATIVE dispatch failed. When the donated buffers
+        survived (host-side failure — injected draft fault, enqueue
+        error), decode THIS chunk with the plain non-speculative program —
+        the exact program a spec-off engine runs, so streams continue
+        bit-identically and no token is lost — then preempt-and-resync:
+        the fallback chunk advanced the target cache without the draft
+        cache, and re-prefilling both on re-admission restores lockstep
+        (and full acceptance) at the cost of one re-prefill per slot.
+        Consumed buffers mean the failure happened inside XLA — nothing to
+        fall back ONTO — so it routes through full dispatch recovery."""
+        consumed = any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for tree in (cache_in, draft_in, self._state)
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+        if consumed:
+            # nothing to fall back ONTO — this is a full dispatch failure,
+            # counted/recorded as one by the recovery path (spec_fallbacks
+            # counts only chunks actually decoded non-speculatively)
+            self._recover_dispatch(cache_in, exc, draft_in=draft_in)
+            return
+        self.cache.restore(cache_in)
+        self.draft_cache.restore(draft_in)
+        # mark the failure window (DEGRADED until the cooldown elapses);
+        # NOT a consecutive-failure count — the fallback below makes
+        # progress, and ITS dispatch failure is what escalates to recovery
+        # and, bounded, to HALT
+        self._had_dispatch_failure = True
+        self._chunks_since_failure = 0
+        self._decode_plain()
+        if self._consecutive_dispatch_failures == 0:
+            # the plain chunk really decoded (its own failure would have
+            # routed through recovery and bumped the consecutive count):
+            # THIS is a fallback — the counter means "chunk decoded
+            # non-speculatively", never "speculative dispatch failed"
+            self.metrics.record_spec_fallback()
+            if self.timeline is not None:
+                self.timeline.instant(
+                    "spec_fallback", "serving", args={"error": str(exc)[:200]}
+                )
+            if self.flight is not None:
+                self.flight.record("spec_fallback", error=str(exc))
+        if any(self._active):
+            # the fallback chunk advanced only the target cache: preempt
+            # so re-admission rebuilds BOTH caches in lockstep (tokens and
+            # keys are host-current — the preemption contract)
+            self._preempt_all()
+        self._sync_health()
+
+    def _decode_plain(self) -> None:
+        """The non-speculative fused chunk (the pre-ISSUE-9 `_decode` body;
+        also the speculative engine's fallback program)."""
         tl = self.timeline
         active_at_dispatch = int(self._active.sum())
         self._maybe_profile()
@@ -1267,7 +1711,7 @@ class ServingEngine:
             if self._faults is not None:
                 self._faults.on_dispatch(attempt)
             (new_cache, self._state, toks, counts, used,
-             key_snap) = self._decode_chunk(
+             key_snap) = self._nonspec_chunk()(
                 self._params, cache_in, self._state
             )
         except BaseException as e:
@@ -1362,7 +1806,8 @@ class ServingEngine:
             dispatch_s=t1 - t0, readback_s=t2 - t1,
         )
 
-    def _recover_dispatch(self, cache_in, exc: Exception) -> None:
+    def _recover_dispatch(self, cache_in, exc: Exception,
+                          draft_in=None) -> None:
         """A decode dispatch FAILED. Recovery = the preemption machinery:
         every in-flight request goes back to the queue front with its
         host-current tokens and key (both exact as of the last chunk
@@ -1391,6 +1836,14 @@ class ServingEngine:
         self.scheduler.requeue_front(requeued)
         self.cache.release_all_slots()
         self.cache.recover(cache_in)
+        if self.draft_cache is not None:
+            # the draft twin recovers identically: salvage-or-drop its
+            # storage and rewind — every slot was vacated, so a lazy
+            # reallocation on the next admission is safe for both
+            self.draft_cache.release_all_slots()
+            self.draft_cache.recover(
+                draft_in if draft_in is not None else self.draft_cache.take()
+            )
         self._state = self._fresh_slot_state()
         if self.prefix is not None:
             # recovery never resurrects stale KV THROUGH the prefix store:
@@ -1439,6 +1892,10 @@ class ServingEngine:
         self._state = self._slot_clear(self._state, np.int32(slot))
         self.cache.quarantine(slot)
         self.cache.free(slot)  # clears the row; never rejoins the rotation
+        if self.draft_cache is not None:
+            # the draft row is equally suspect — quarantine it in lockstep
+            self.draft_cache.quarantine(slot)
+            self.draft_cache.free(slot)
         if req is not None:
             req.slot = None
             if self._quarantine_policy == "requeue" and not req.finished:
@@ -1494,6 +1951,8 @@ class ServingEngine:
         self._active[slot] = False
         self._state = self._slot_clear(self._state, np.int32(slot))
         self.cache.free(slot)
+        if self.draft_cache is not None:
+            self.draft_cache.free(slot)
         self._on_token.pop(req.rid, None)
 
     def _reap_cancelled(self, now: float) -> None:
@@ -1535,6 +1994,9 @@ class ServingEngine:
         # free-list needs per-slot bookkeeping
         self.cache.release_all_slots()
         self.cache.reset()
+        if self.draft_cache is not None:
+            self.draft_cache.release_all_slots()
+            self.draft_cache.reset()
         # every slot is empty now; re-admission re-uploads each row, so a
         # fresh zero state is cheaper than N per-slot clears
         self._state = self._fresh_slot_state()

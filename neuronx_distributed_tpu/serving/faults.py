@@ -32,6 +32,13 @@ Injection points (all indices are 0-based and deterministic):
   reads ``s`` seconds ahead (optionally only once real time passes
   ``after``), driving deadline/queue-timeout shedding paths without
   sleeping.
+* ``fail_draft_dispatch(at=k, times=t)`` — the k-th speculative dispatch
+  attempts raise ``InjectedDraftError`` before the fused draft–verify chunk
+  runs; the engine decodes the affected chunk non-speculatively (streams
+  bit-identical) and resyncs the draft cache.
+* ``poison_draft(at=k, times=t)`` — the k-th speculative dispatches run
+  with a corrupted COPY of the draft params (mid-chunk all-reject rounds:
+  every proposal garbage); the stream must stay bit-identical regardless.
 
 ``counters`` records every fault actually fired so chaos tests can assert
 the schedule ran (an injection that never fired proves nothing).
@@ -50,6 +57,12 @@ class InjectedDispatchError(InjectedFault):
     """Scheduled decode-dispatch failure."""
 
 
+class InjectedDraftError(InjectedFault):
+    """Scheduled SPECULATIVE decode-dispatch failure (the draft side of a
+    fused draft–verify chunk): the engine must fall back to non-speculative
+    decode for the affected chunk, streams bit-identical."""
+
+
 class InjectedPrefillError(InjectedFault):
     """Scheduled prefill failure (OOM-like admission fault)."""
 
@@ -63,6 +76,8 @@ class FaultInjector:
         self._poisons: Dict[int, List[Tuple[int, int]]] = {}  # readback -> [(slot, token)]
         self._prefill_windows: List[Tuple[int, Optional[int]]] = []
         self._prefix_windows: List[Tuple[int, Optional[int]]] = []
+        self._draft_dispatch_windows: List[Tuple[int, Optional[int]]] = []
+        self._draft_poison_windows: List[Tuple[int, Optional[int]]] = []
         self._skew: float = 0.0
         self._skew_after: Optional[float] = None
         self.counters: Dict[str, int] = {
@@ -70,6 +85,8 @@ class FaultInjector:
             "poisoned_readbacks": 0,
             "prefill_failures": 0,
             "poisoned_prefixes": 0,
+            "draft_dispatch_failures": 0,
+            "poisoned_drafts": 0,
         }
 
     # --- schedule construction ----------------------------------------------
@@ -91,6 +108,33 @@ class FaultInjector:
     def poison_prefix(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
         end = None if times is None else at + times
         self._prefix_windows.append((at, end))
+        return self
+
+    def fail_draft_dispatch(
+        self, at: int = 0, times: Optional[int] = 1
+    ) -> "FaultInjector":
+        """The ``at``-th..(at+times-1)-th SPECULATIVE dispatch attempts
+        raise :class:`InjectedDraftError` before the fused draft–verify
+        chunk runs (donated buffers unconsumed, mirroring a host-side
+        enqueue failure on the draft program). The engine must decode the
+        affected chunk NON-speculatively — streams bit-identical, zero
+        tokens lost — then resync the draft cache."""
+        end = None if times is None else at + times
+        self._draft_dispatch_windows.append((at, end))
+        return self
+
+    def poison_draft(
+        self, at: int = 0, times: Optional[int] = 1
+    ) -> "FaultInjector":
+        """Corrupt the DRAFT params the ``at``-th..(at+times-1)-th
+        speculative dispatches use (every float leaf perturbed on a copy —
+        the engine's bound pytree is untouched), driving mid-chunk
+        all-reject rounds: every proposal garbage, every round emitting
+        only its correction. The test this exists for: the stream must
+        stay bit-identical anyway (speculation's output never depends on
+        draft quality)."""
+        end = None if times is None else at + times
+        self._draft_poison_windows.append((at, end))
         return self
 
     def skew_clock(self, by: float, after: Optional[float] = None) -> "FaultInjector":
@@ -135,6 +179,57 @@ class FaultInjector:
             if counts[slot] <= 0:
                 counts[slot] = 1  # a poisoned slot claims at least one token
             toks[0, slot] = token
+            self.counters["poisoned_readbacks"] += 1
+        if deferred:
+            self._poisons.setdefault(readback + 1, []).extend(deferred)
+        return toks, counts
+
+    def on_spec_dispatch(self, attempt: int) -> None:
+        """Called with the 0-based dispatch ATTEMPT index before a
+        SPECULATIVE chunk dispatch (shares the attempt counter with
+        ``on_dispatch``, so mixed schedules stay deterministic)."""
+        if self._hit(self._draft_dispatch_windows, attempt):
+            self.counters["draft_dispatch_failures"] += 1
+            raise InjectedDraftError(
+                f"injected draft dispatch failure at attempt {attempt}"
+            )
+
+    def on_spec_params(self, attempt: int, draft_params):
+        """Called with the dispatch attempt index and the draft param
+        pytree the speculative chunk is about to receive. When the poison
+        schedule hits, returns a CORRUPTED COPY (every float leaf
+        perturbed) — proposals become garbage and every round all-rejects;
+        otherwise returns the tree untouched."""
+        if not self._hit(self._draft_poison_windows, attempt):
+            return draft_params
+        import jax
+        import jax.numpy as jnp
+
+        def corrupt(leaf):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(
+                leaf.dtype, jnp.floating
+            ):
+                return -leaf + jnp.asarray(3.7, leaf.dtype)
+            return leaf
+
+        self.counters["poisoned_drafts"] += 1
+        return jax.tree_util.tree_map(corrupt, draft_params)
+
+    def on_spec_readback(self, readback: int, toks, counts, active=None):
+        """Speculative edition of :meth:`on_readback`: the token block is
+        ``(rounds, slots, gamma)`` and counts ``(rounds, slots)``. A
+        scheduled poison lands in the victim slot's FIRST round (same
+        defer-until-active contract)."""
+        deferred = []
+        for slot, token in self._poisons.pop(readback, ()):
+            if active is not None and not bool(active[slot]):
+                deferred.append((slot, token))
+                continue
+            toks = toks.copy()
+            counts = counts.copy()
+            if counts[0, slot] <= 0:
+                counts[0, slot] = 1
+            toks[0, slot, 0] = token
             self.counters["poisoned_readbacks"] += 1
         if deferred:
             self._poisons.setdefault(readback + 1, []).extend(deferred)

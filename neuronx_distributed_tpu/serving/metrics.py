@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Dict, Optional
 
 from neuronx_distributed_tpu.observability.registry import MetricsRegistry
+from neuronx_distributed_tpu.observability.spec_stats import SpecStats
 
 
 def _mean(xs):
@@ -112,6 +113,11 @@ class ServingMetrics:
             "serving_health", help="0=ok 1=degraded 2=draining 3=halted"
         )
         self._g_health.set_fn(lambda: _HEALTH_CODES.get(self.health, -1))
+        # speculative-decoding acceptance stats: the SHARED recorder (solo
+        # speculative_generate reports through the same class, so both
+        # paths expose identical names/keys); always registered so the
+        # snapshot surface is stable whether or not a draft model is bound
+        self.spec = SpecStats(self.registry, prefix="spec")
         self.registry.gauge("serving_num_slots").set(num_slots)
         self.health = "ok"  # engine-owned mirror of ServingEngine.health()
         self.cursor_high_water = 0
@@ -265,6 +271,8 @@ class ServingMetrics:
         active_slots: int,
         dispatch_s: float = 0.0,
         readback_s: float = 0.0,
+        spec_accepts=None,
+        gamma: int = 0,
     ) -> None:
         """One fused decode chunk: ``tokens`` DELIVERED to requests across
         ``steps`` executed scan steps by ``active_slots`` slots held at
@@ -272,7 +280,13 @@ class ServingMetrics:
         mid-chunk (early EOS) still owns its cache row until the chunk
         boundary, so it occupies all ``steps``. ``dispatch_s``/
         ``readback_s`` split the wall time around the chunk's single host
-        sync."""
+        sync.
+
+        Speculative chunks (``steps`` = executed ROUNDS) additionally pass
+        ``spec_accepts`` — one accepted-draft length per (live round, slot)
+        pair, already host scalars from the chunk's single readback — and
+        ``gamma``; the draft/verify split (drafted vs accepted vs wasted
+        draft tokens) lands in the shared ``SpecStats`` recorder."""
         self._inc("chunks")
         self._inc("steps", steps)
         self._inc("decode_tokens", tokens)
@@ -282,6 +296,14 @@ class ServingMetrics:
             self._g_cursor.set(cursor)
         self._inc("decode_dispatch_s", dispatch_s)
         self._inc("decode_readback_s", readback_s)
+        if spec_accepts is not None:
+            for a in spec_accepts:
+                self.spec.record_round(int(a), gamma)
+
+    def record_spec_fallback(self) -> None:
+        """A speculative dispatch failed and the chunk was decoded
+        non-speculatively instead (streams unaffected)."""
+        self.spec.record_fallback()
 
     # --- export -------------------------------------------------------------
 
@@ -371,4 +393,8 @@ class ServingMetrics:
             "tpot_p95_s": self._h_tpot.percentile(0.95),
             "tpot_p99_s": self._h_tpot.percentile(0.99),
             "queue_wait_p95_s": self._h_queue_wait.percentile(0.95),
+            # speculative serving (ISSUE 9): identical keys to the solo
+            # speculative path's registry reporting — all zero without a
+            # draft model
+            **self.spec.snapshot(),
         }

@@ -610,6 +610,45 @@ def test_raw_shard_map_import_in_serving_fails(tmp_path):
     assert "GL04" in rules_of(rep.violations)
 
 
+def test_spec_decode_module_is_hot_by_path(tmp_path):
+    """ISSUE 9 satellite: the speculative chunk builder module is on the
+    GL02 hot-path list BY PATH — an implicit sync smuggled into a future
+    draft/verify edit trips with no marker needed — and the shipped module
+    scans clean."""
+    code = """\
+        import jax.numpy as jnp
+
+        def round_fn(kv_valid):
+            cursor = jnp.sum(kv_valid)
+            return int(cursor)  # host read of a device cursor
+        """
+    assert "GL02" in rules_of(
+        lint(tmp_path, code, name="inference/spec_decode.py")
+    )
+    shipped = os.path.join(PKG, "inference", "spec_decode.py")
+    out = tmp_path / "inference" / "spec_decode.py"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(open(shipped).read())
+    rep = runner.scan([str(out)], root=str(tmp_path))
+    assert rep.violations == []
+
+
+def test_draft_cache_cursor_host_read_in_chunk_loop_fails(tmp_path):
+    """Acceptance re-injection (ISSUE 9): a host read of the draft cache
+    inside the speculative chunk loop — the exact shape of the PR 2 bug,
+    draft edition — must trip BOTH GL01 (the tree is about to be donated
+    into the speculative chunk) and GL02 (an undocumented explicit sync in
+    the engine)."""
+    out = _engine_copy_with(
+        tmp_path,
+        "draft_in = self.draft_cache.take()",
+        "jax.device_get(draft_in)  # reintroduced: draft cursor host read",
+    )
+    rep = runner.scan([str(out)], root=str(tmp_path))
+    rules = rules_of(rep.violations)
+    assert "GL01" in rules and "GL02" in rules
+
+
 def test_real_engine_scan_is_clean_in_isolation(tmp_path):
     """The shipped engine (pragmas and all) carries zero findings even
     without the baseline — the debt really was driven to zero."""

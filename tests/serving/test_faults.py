@@ -271,6 +271,154 @@ def test_all_slots_quarantined_halts(setup):
 # --- deadlines, shedding, backpressure, drain --------------------------------
 
 
+def _draft(seed=7, **over):
+    draft_cfg = tiny_llama(num_layers=2, **over)
+    draft = LlamaForCausalLM(draft_cfg, attention_impl="xla")
+    ids = jax.random.randint(
+        jax.random.PRNGKey(0), (1, 8), 1, draft_cfg.vocab_size
+    )
+    return draft, draft.init(jax.random.PRNGKey(seed), ids)
+
+
+def test_draft_dispatch_failure_falls_back_bit_identical(setup):
+    """ISSUE 9 chaos: a failed SPECULATIVE dispatch (draft side, buffers
+    unconsumed) decodes the affected chunk non-speculatively — every
+    stream bit-identical to solo generate(), tokens_lost=0 — then resyncs
+    the draft cache through the preemption machinery and KEEPS
+    speculating."""
+    cfg, model, params = setup
+    draft, d_params = _draft()
+    prompts, gcfgs, keys = _workload(cfg)
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    inj = FaultInjector().fail_draft_dispatch(at=1, times=1)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3, prefix_cache=None,
+        draft_model=draft, draft_params=d_params, gamma=3,
+        fault_injector=inj, sleep_fn=lambda s: None,
+    )
+    reqs = [
+        engine.submit(p, c, key=k) for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run()
+    assert inj.counters["draft_dispatch_failures"] == 1
+    snap = engine.metrics.snapshot()
+    assert snap["spec_fallbacks"] == 1
+    assert engine.metrics.preemptions > 0  # the resync path ran
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE
+        assert req.tokens == ref, f"request {i} lost/corrupted tokens"
+    # speculation resumed after the resync: rounds kept accumulating
+    assert snap["spec_rounds"] > 0
+    assert engine.health() in (EngineHealth.OK, EngineHealth.DEGRADED)
+
+
+def test_poisoned_draft_all_reject_streams_bit_identical(setup):
+    """Mid-chunk all-reject poisoning: corrupted draft params make every
+    proposal garbage — rounds degrade to one corrected token per slot,
+    and the streams MUST stay bit-identical (emission never depends on
+    draft quality)."""
+    cfg, model, params = setup
+    prompts, gcfgs, keys = _workload(cfg)
+    # greedy-only: sampled slots accept nothing BY DESIGN, which would
+    # dilute the accept-rate contrast this test pins
+    gcfgs = [
+        GenerationConfig(max_new_tokens=c.max_new_tokens, temperature=0.0)
+        for c in gcfgs
+    ]
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    inj = FaultInjector().poison_draft(at=0, times=None)  # every chunk
+    # draft == target would accept everything; the poison must drive the
+    # acceptance to ~zero while changing NOTHING about the output
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3, prefix_cache=None,
+        draft_model=model, draft_params=params, gamma=3,
+        fault_injector=inj,
+    )
+    reqs = [
+        engine.submit(p, c, key=k) for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run()
+    assert inj.counters["poisoned_drafts"] > 0
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE
+        assert req.tokens == ref, f"request {i} diverged under poison"
+    snap = engine.metrics.snapshot()
+    assert snap["spec_accept_rate"] < 0.5  # the poison really landed
+    assert snap["draft_tokens_wasted"] > 0
+    # ...and the same engine WITHOUT poison accepts everything (control)
+    clean = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3, prefix_cache=None,
+        draft_model=model, draft_params=params, gamma=3,
+    )
+    creqs = [
+        clean.submit(p, c, key=k) for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    clean.run()
+    assert [r.tokens for r in creqs] == [r.tokens for r in reqs]
+    assert clean.metrics.snapshot()["spec_accept_rate"] > 0.9
+
+
+def test_spec_readback_poison_quarantines_slot(setup):
+    """A poisoned SPECULATIVE readback (garbage token in the victim's
+    ragged block) quarantines the slot in BOTH caches; the victim resumes
+    bit-identically elsewhere, neighbors untouched."""
+    cfg, model, params = setup
+    draft, d_params = _draft()
+    prompts, gcfgs, keys = _workload(cfg, n=3)
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    inj = FaultInjector().poison_readback(at=1, slot=0, token=-7)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3, prefix_cache=None,
+        draft_model=draft, draft_params=d_params, gamma=3,
+        fault_injector=inj,
+    )
+    reqs = [
+        engine.submit(p, c, key=k) for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run()
+    assert inj.counters["poisoned_readbacks"] == 1
+    assert engine.metrics.quarantines == 1
+    assert engine.cache.quarantined_slots == [0]
+    assert engine.draft_cache.quarantined_slots == [0]
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE
+        assert req.tokens == ref, f"request {i} corrupted by the poison"
+
+
+def test_spec_consecutive_total_failures_halt_with_work_requeued(setup):
+    """Draft fault + plain fallback BOTH failing, repeatedly: the engine
+    escalates through dispatch recovery and HALTs with the work requeued
+    (the speculative path inherits the bounded-retry contract)."""
+    cfg, model, params = setup
+    draft, d_params = _draft()
+    prompts, gcfgs, keys = _workload(cfg, n=2)
+    # every dispatch attempt fails — speculative AND fallback alike
+    inj = FaultInjector().fail_dispatch(at=0, times=None)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3, prefix_cache=None,
+        draft_model=draft, draft_params=d_params, gamma=3,
+        fault_injector=inj, sleep_fn=lambda s: None,
+    )
+    reqs = [
+        engine.submit(p, c, key=k) for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run(max_steps=50)
+    assert engine.health() is EngineHealth.HALTED
+    assert "dispatch failures" in engine.halt_reason
+    for req in reqs:
+        assert not req.finished  # requeued, not lost
+        assert req.state is RequestState.QUEUED
+
+
 def test_queue_timeout_sheds_before_prefill(setup):
     """Deterministic under a fake clock: a request whose queue timeout
     expires before a slot frees is shed BEFORE prefill (no compute spent),

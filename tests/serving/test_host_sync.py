@@ -130,6 +130,40 @@ def test_instrumented_sync_budget_matches_bare(setup, tmp_path):
     assert snap["ttft_p95_s"] > 0.0 and snap["completed"] == 1
 
 
+def test_sync_budget_unchanged_with_speculation(setup):
+    """ISSUE 9 pin: a DRAFT model changes what a chunk computes (gamma
+    draft steps + a verify window per round, ragged multi-token emission)
+    but not what the host pays — submit=1, admission step=2 (first-token
+    pair + chunk readback; the draft prefill adds NOTHING, its row is
+    consumed by the donating draft admit), steady chunk=1 (the five-output
+    speculative readback rides ONE device_get)."""
+    cfg, model, params = setup
+    draft_cfg = tiny_llama(num_layers=2)
+    draft = LlamaForCausalLM(draft_cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    d_params = draft.init(jax.random.PRNGKey(7), ids)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None,
+        draft_model=draft, draft_params=d_params, gamma=3,
+    )
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=24, temperature=0.0)
+    with _SyncCounter() as c:
+        req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(7))
+    assert c.calls == 1, f"spec submit must stay 1 sync, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()  # admit (target+draft prefill, first token) + chunk
+    assert c.calls == 2, (
+        "spec admission must stay 2 syncs (token+key pair + chunk "
+        f"readback), saw {c.calls}"
+    )
+    with _SyncCounter() as c:
+        engine.step()  # steady state: ONE ragged-block readback
+    assert c.calls == 1, f"spec steady chunk must be 1 sync, saw {c.calls}"
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 24
+
+
 @pytest.mark.sanitize
 def test_engine_hot_loop_under_transfer_guard(setup, transfer_guard_disallow):
     """Dynamic GL02 witness: a full serve cycle — submit, prefill (with the

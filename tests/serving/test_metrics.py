@@ -209,3 +209,37 @@ def test_fault_tolerance_counters_in_snapshot():
     assert m.request_snapshot(2)["failed_kind"] == "prefill"
     # shed/failed requests never count as completed
     assert snap["completed"] == 0
+
+
+def test_spec_chunk_stats_in_snapshot():
+    """ISSUE 9: speculative acceptance rides record_decode_chunk —
+    per-(round, slot) accepted lengths feed the shared SpecStats recorder
+    (histogram + drafted/accepted/wasted counters) and the snapshot keys."""
+    m = ServingMetrics(num_slots=2)
+    # two chunks: 3 live (round, slot) pairs accepting 4, 2, 0 of gamma=4,
+    # then one fully-accepted pair
+    m.record_decode_chunk(9, 3, 12, 2, spec_accepts=[4, 2, 0], gamma=4)
+    m.record_decode_chunk(5, 1, 16, 2, spec_accepts=[4], gamma=4)
+    m.record_spec_fallback()
+    snap = m.snapshot()
+    assert snap["spec_rounds"] == 4
+    assert snap["spec_draft_tokens"] == 16
+    assert snap["spec_accepted_tokens"] == 10
+    assert snap["draft_tokens_wasted"] == 6
+    assert snap["spec_accept_rate"] == 10 / 16
+    assert snap["spec_accept_len_p95"] == 4
+    assert snap["spec_fallbacks"] == 1
+    # the plain-chunk accounting is untouched by the spec kwargs
+    assert snap["chunks"] == 2 and snap["decode_tokens"] == 14
+    assert snap["steps"] == 4
+
+
+def test_spec_keys_zero_without_speculation():
+    m = ServingMetrics(num_slots=2)
+    m.record_decode_chunk(4, 4, 8, 1)
+    snap = m.snapshot()
+    assert snap["spec_rounds"] == 0
+    assert snap["spec_draft_tokens"] == 0
+    assert snap["draft_tokens_wasted"] == 0
+    assert snap["spec_accept_rate"] == 0.0
+    assert snap["spec_fallbacks"] == 0

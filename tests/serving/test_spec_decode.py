@@ -1,0 +1,388 @@
+"""Speculative decoding inside the serving engine (ISSUE 9): fused
+draft–verify chunks with per-slot variable advance.
+
+The invariant tower, strongest first: greedy streams through a speculative
+engine are bit-identical to the spec-off engine, to solo ``generate()``,
+and to solo ``speculative_generate`` — under staggered admission, EOS
+mid-window, preemption/resume, and prefix-cache-hit admission — because
+speculation is an acceptance-schedule-independent TRANSPORT for the target
+model's own stream, never a different generator. Sampled slots ride the
+same fused program one exactly-sampled token per round, also
+bit-identical. ``draft_model=None`` is byte-for-byte today's engine. The
+per-slot ragged advance is data, not shape: one decode compilation
+whatever the acceptance pattern."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import (
+    GenerationConfig,
+    generate,
+    speculative_generate,
+)
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.serving import RequestState, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    draft_cfg = tiny_llama(num_layers=2)
+    draft = LlamaForCausalLM(draft_cfg, attention_impl="xla")
+    d_params = draft.init(jax.random.PRNGKey(7), ids)
+    return cfg, model, params, draft, d_params
+
+
+def _solo(model, params, prompt, key, gcfg):
+    toks = np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], key, gcfg)
+    )[0].tolist()
+    if gcfg.eos_token_id is not None and gcfg.eos_token_id in toks:
+        toks = toks[: toks.index(gcfg.eos_token_id) + 1]
+    return toks
+
+
+def _workload(cfg, n=5, seed=31):
+    rng = np.random.RandomState(seed)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(3, 14)).astype(np.int32)
+        for _ in range(n)
+    ]
+    gcfgs = [
+        GenerationConfig(max_new_tokens=9, temperature=0.0),
+        GenerationConfig(max_new_tokens=12, temperature=0.8, top_k=17),
+        GenerationConfig(max_new_tokens=6, temperature=0.0, eos_token_id=5),
+        GenerationConfig(max_new_tokens=11, temperature=0.0),
+        GenerationConfig(max_new_tokens=8, temperature=1.1, top_p=0.9),
+    ][:n]
+    keys = [jax.random.PRNGKey(500 + i) for i in range(n)]
+    return prompts, gcfgs, keys
+
+
+def _serve(model, params, prompts, gcfgs, keys, upfront=2, num_slots=2,
+           chunk=3, **kw):
+    """Staggered open-loop run (admissions land at chunk boundaries)."""
+    engine = ServingEngine(
+        model, params, num_slots=num_slots, decode_chunk_size=chunk, **kw
+    )
+    reqs = [
+        engine.submit(prompts[i], gcfgs[i], key=keys[i])
+        for i in range(upfront)
+    ]
+    i = upfront
+    while engine.has_work or i < len(prompts):
+        engine.step()
+        if i < len(prompts):
+            reqs.append(engine.submit(prompts[i], gcfgs[i], key=keys[i]))
+            i += 1
+    engine.run()
+    return engine, reqs
+
+
+def test_spec_streams_bit_identical_staggered(setup):
+    """Acceptance: spec-on vs spec-off vs solo generate — token streams
+    bit-identical for a staggered mix of greedy/sampled/EOS requests, with
+    ONE decode compilation on the speculative engine."""
+    cfg, model, params, draft, d_params = setup
+    prompts, gcfgs, keys = _workload(cfg)
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    eng_off, reqs_off = _serve(
+        model, params, prompts, gcfgs, keys, prefix_cache=None
+    )
+    eng_on, reqs_on = _serve(
+        model, params, prompts, gcfgs, keys, prefix_cache=None,
+        draft_model=draft, draft_params=d_params, gamma=3,
+    )
+    for i, (off, on, ref) in enumerate(zip(reqs_off, reqs_on, refs)):
+        assert off.state is RequestState.DONE
+        assert on.state is RequestState.DONE
+        assert off.tokens == ref, f"spec-off request {i} diverged from solo"
+        assert on.tokens == ref, f"spec-on request {i} diverged from solo"
+    assert eng_on.decode_compilations == 1
+    snap = eng_on.metrics.snapshot()
+    assert snap["spec_rounds"] > 0 and snap["spec_draft_tokens"] > 0
+    assert snap["spec_fallbacks"] == 0
+
+
+def test_spec_engine_equals_solo_speculative_generate(setup):
+    """Engine-vs-solo equivalence: the engine's speculative stream equals
+    ``speculative_generate``'s greedy output (both equal plain greedy
+    generate — the schedule-independence invariant, now proven across the
+    per-slot-variable-advance vs batch-min-advance implementations)."""
+    cfg, model, params, draft, d_params = setup
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, cfg.vocab_size, size=8).astype(np.int32)
+    new = 12
+    solo_spec, _ = speculative_generate(
+        model, params, draft, d_params, jnp.asarray(prompt)[None],
+        max_new_tokens=new, gamma=3,
+    )
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None,
+        draft_model=draft, draft_params=d_params, gamma=3,
+    )
+    req = engine.submit(
+        prompt, GenerationConfig(max_new_tokens=new, temperature=0.0),
+        key=jax.random.PRNGKey(9),
+    )
+    engine.run()
+    assert req.tokens == np.asarray(solo_spec)[0].tolist()
+
+
+def test_eos_mid_accepted_window(setup):
+    """EOS landing INSIDE a multi-token accepted window (perfect draft →
+    every round accepts gamma) must cut the stream exactly where the
+    single-step engine would — no token after EOS leaks, none before it
+    is lost."""
+    cfg, model, params, _, _ = setup
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(1, cfg.vocab_size, size=7).astype(np.int32)
+    key = jax.random.PRNGKey(13)
+    base = GenerationConfig(max_new_tokens=10, temperature=0.0)
+    ref_full = _solo(model, params, prompt, key, base)
+    eos_tok = ref_full[5]  # force EOS mid-stream, mid-window at gamma=4
+    gcfg = GenerationConfig(
+        max_new_tokens=10, temperature=0.0, eos_token_id=eos_tok
+    )
+    ref = _solo(model, params, prompt, key, gcfg)
+    assert len(ref) < len(ref_full)  # the scenario actually cuts early
+    # draft == target: full acceptance, so EOS sits inside accepted blocks
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2, prefix_cache=None,
+        draft_model=model, draft_params=params, gamma=4,
+    )
+    req = engine.submit(prompt, gcfg, key=key)
+    engine.run()
+    assert req.tokens == ref
+    assert engine.metrics.snapshot()["spec_accept_rate"] > 0.5
+
+
+def test_preemption_resume_spec_streams_identical(setup):
+    """Eager admission against a small cache: speculation burns gamma
+    columns per round, hits the wall, preempts, re-prefills BOTH caches —
+    streams stay bit-identical to solo."""
+    cfg = tiny_llama(max_seq_len=48)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    draft_cfg = tiny_llama(num_layers=2, max_seq_len=48)
+    draft = LlamaForCausalLM(draft_cfg, attention_impl="xla")
+    d_params = draft.init(jax.random.PRNGKey(7), ids)
+    rng = np.random.RandomState(17)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=n).astype(np.int32)
+        for n in (9, 12)
+    ]
+    gcfgs = [
+        GenerationConfig(max_new_tokens=18, temperature=0.0),
+        GenerationConfig(max_new_tokens=16, temperature=0.0),
+    ]
+    keys = [jax.random.PRNGKey(60 + i) for i in range(2)]
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    engine = ServingEngine(
+        model, params, num_slots=2, admission="eager", decode_chunk_size=4,
+        prefix_cache=None, draft_model=draft, draft_params=d_params, gamma=4,
+    )
+    reqs = [
+        engine.submit(p, c, key=k) for p, c, k in zip(prompts, gcfgs, keys)
+    ]
+    engine.run(max_steps=500)
+    assert engine.metrics.preemptions > 0  # the scenario must preempt
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE
+        assert req.tokens == ref, f"request {i} diverged across preemption"
+
+
+def test_prefix_cache_hit_composes_with_speculation(setup):
+    """PR 4 composition: a prefix-cache HIT admission (suffix-only target
+    prefill) feeding the speculative chunk — streams bit-identical to the
+    cache-off spec-off engine, with real hits recorded."""
+    cfg, model, params, draft, d_params = setup
+    rng = np.random.RandomState(23)
+    shared = rng.randint(1, cfg.vocab_size, size=16).astype(np.int32)
+    prompts = [
+        np.concatenate(
+            [shared, rng.randint(1, cfg.vocab_size, size=3).astype(np.int32)]
+        )
+        for _ in range(4)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    keys = [jax.random.PRNGKey(70 + i) for i in range(4)]
+    refs = [_solo(model, params, p, k, gcfg) for p, k in zip(prompts, keys)]
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3, prefix_cache="auto",
+        draft_model=draft, draft_params=d_params, gamma=3,
+    )
+    reqs = []
+    for p, k in zip(prompts, keys):
+        reqs.append(engine.submit(p, gcfg, key=k))
+        engine.run()  # serialize so later admissions hit the stored prefix
+    snap = engine.metrics.snapshot()
+    assert snap["prefix_hits"] > 0
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.tokens == ref, f"prefix-hit request {i} diverged"
+
+
+def test_draft_none_is_a_noop(setup):
+    """draft_model=None preserves today's engine exactly: no speculative
+    program, no draft cache, spec metrics flat zero, plain-chunk program
+    built eagerly as before."""
+    cfg, model, params, _, _ = setup
+    engine = ServingEngine(model, params, num_slots=2, prefix_cache=None)
+    assert engine._spec_chunk is None
+    assert engine.draft_cache is None
+    assert engine._decode_chunk is not None
+    req = engine.submit(
+        np.arange(1, 7, dtype=np.int32),
+        GenerationConfig(max_new_tokens=6, temperature=0.0),
+        key=jax.random.PRNGKey(2),
+    )
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 6
+    snap = engine.metrics.snapshot()
+    assert snap["spec_rounds"] == 0 and snap["spec_draft_tokens"] == 0
+    assert snap["draft_tokens_wasted"] == 0 and snap["spec_fallbacks"] == 0
+
+
+def test_compile_budget_ragged_advance_no_retrace(setup):
+    """Per-slot ragged advance is DATA: serving slots whose acceptance
+    patterns differ wildly (a perfect-draft engine run next to weak-draft
+    traffic, EOS cuts, budget cuts) never retraces the speculative chunk —
+    decode_compilations stays 1 and prefill programs stay bucket-bounded."""
+    cfg, model, params, draft, d_params = setup
+    prompts, gcfgs, keys = _workload(cfg, n=5, seed=41)
+    engine, reqs = _serve(
+        model, params, prompts, gcfgs, keys, prefix_cache=None,
+        draft_model=draft, draft_params=d_params, gamma=3,
+    )
+    assert engine.decode_compilations == 1
+    # target + draft prefills: one program per padded bucket per side
+    buckets = set(engine._prefill_fns) | set(engine._draft_prefill_fns)
+    assert engine.prefill_compilations <= 2 * len(buckets)
+    # second wave, same shapes: zero new compiles anywhere
+    before = (engine.decode_compilations, engine.prefill_compilations)
+    prompts2, gcfgs2, keys2 = _workload(cfg, n=5, seed=43)
+    engine2_reqs = [
+        engine.submit(p, c, key=k)
+        for p, c, k in zip(prompts2, gcfgs2, keys2)
+    ]
+    engine.run()
+    assert all(r.finished for r in engine2_reqs)
+    assert (engine.decode_compilations, engine.prefill_compilations) == before
+
+
+def test_spec_acceptance_metrics(setup):
+    """Perfect draft → accept rate 1.0, zero waste; weak (random) draft →
+    waste recorded, histogram keys live. Identical key names to the solo
+    path's registry reporting."""
+    cfg, model, params, draft, d_params = setup
+    rng = np.random.RandomState(51)
+    prompt = rng.randint(1, cfg.vocab_size, size=8).astype(np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+
+    def run(dm, dp):
+        engine = ServingEngine(
+            model, params, num_slots=2, decode_chunk_size=3,
+            prefix_cache=None, draft_model=dm, draft_params=dp, gamma=4,
+        )
+        req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(3))
+        engine.run()
+        assert req.state is RequestState.DONE
+        return engine.metrics.snapshot()
+
+    perfect = run(model, params)
+    assert perfect["spec_accept_rate"] == 1.0
+    assert perfect["draft_tokens_wasted"] == 0
+    assert perfect["spec_accept_len_p50"] == 4
+    weak = run(draft, d_params)
+    assert weak["draft_tokens_wasted"] > 0
+    assert 0.0 <= weak["spec_accept_rate"] < 1.0
+    assert weak["spec_accept_len_p95"] <= 4
+
+
+def test_solo_speculative_reports_through_registry(setup):
+    """Small-fix satellite: speculative_generate(registry=) surfaces
+    per-row acceptance through the SAME SpecStats recorder/keys the
+    engine uses (batch-min re-draft waste included)."""
+    from neuronx_distributed_tpu.observability import MetricsRegistry, SpecStats
+
+    cfg, model, params, draft, d_params = setup
+    reg = MetricsRegistry()
+    ids = jax.random.randint(
+        jax.random.PRNGKey(9), (3, 8), 1, cfg.vocab_size
+    )
+    toks, mean_acc = speculative_generate(
+        model, params, draft, d_params, ids, max_new_tokens=10, gamma=3,
+        registry=reg,
+    )
+    stats = SpecStats(reg)  # get-or-create: reads the same metrics
+    snap = stats.snapshot()
+    assert snap["spec_rounds"] > 0
+    assert snap["spec_draft_tokens"] == 3 * snap["spec_rounds"]
+    # histogram count matches rows x rounds (full per-row resolution)
+    assert stats.accept_len.count == snap["spec_rounds"]
+    # the registry mean equals the returned mean_accepted
+    per_round_mean = (
+        snap["spec_accepted_tokens"] / snap["spec_rounds"]
+        if snap["spec_rounds"] else 0.0
+    )
+    np.testing.assert_allclose(per_round_mean, mean_acc, rtol=1e-6)
+    # a perfect draft wastes nothing even under the batch-min schedule
+    reg2 = MetricsRegistry()
+    speculative_generate(
+        model, params, model, params, ids, max_new_tokens=8, gamma=3,
+        registry=reg2,
+    )
+    assert SpecStats(reg2).snapshot()["draft_tokens_wasted"] == 0
+    assert SpecStats(reg2).snapshot()["spec_accept_rate"] == 1.0
+
+
+def test_submit_rejects_missing_gamma_headroom(setup):
+    """The final round's verify window must fit the row: prompt + max_new
+    + gamma - 1 > max_seq_len fails at the door (the livelock guard)."""
+    cfg, model, params, draft, d_params = setup
+    engine = ServingEngine(
+        model, params, num_slots=2, prefix_cache=None,
+        draft_model=draft, draft_params=d_params, gamma=4,
+    )
+    prompt = np.arange(1, 9, dtype=np.int32)
+    fits = GenerationConfig(
+        max_new_tokens=cfg.max_seq_len - 8 - 3, temperature=0.0
+    )
+    too_big = GenerationConfig(
+        max_new_tokens=cfg.max_seq_len - 8 - 2, temperature=0.0
+    )
+    with pytest.raises(ValueError, match="gamma"):
+        engine.submit(prompt, too_big, key=jax.random.PRNGKey(1))
+    engine.submit(prompt, fits, key=jax.random.PRNGKey(1))  # admissible
+
+
+def test_draft_config_validation(setup):
+    """Mismatched draft geometry fails loudly at construction."""
+    cfg, model, params, draft, d_params = setup
+    short = LlamaForCausalLM(
+        tiny_llama(num_layers=2, max_seq_len=64), attention_impl="xla"
+    )
+    with pytest.raises(ValueError, match="max_seq_len"):
+        ServingEngine(
+            model, params, num_slots=2,
+            draft_model=short, draft_params=d_params,
+        )
+    with pytest.raises(ValueError, match="draft_params"):
+        ServingEngine(model, params, num_slots=2, draft_model=draft)
+    with pytest.raises(ValueError, match="gamma"):
+        ServingEngine(
+            model, params, num_slots=2,
+            draft_model=draft, draft_params=d_params, gamma=0,
+        )
