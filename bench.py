@@ -58,6 +58,7 @@ PREFIX_TIMEOUT_S = 420
 TRAIN_FAULTS_TIMEOUT_S = 420
 OBSERVE_TIMEOUT_S = 300
 SPEC_TIMEOUT_S = 540
+PAGED_TIMEOUT_S = 540
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -861,6 +862,169 @@ def _measure_serving_prefix(devs):
     }
 
 
+def _measure_serving_paged(devs):
+    """Paged-KV payoff (``--child-paged``): the SAME mixed-length workload
+    (short shared-prefix chat + long-doc requests) through the engine with
+    the row-per-slot manager vs the paged manager, BOTH at the same fixed
+    KV HBM budget (cache columns per layer). The row manager can hold
+    ``budget // max_seq_len`` slots at that budget whatever the traffic
+    looks like; the paged manager packs by ACTUAL footprint (block tables
+    + free-page admission), so mixed-length traffic sustains more
+    concurrent slots and higher aggregate decode throughput. Also reports
+    page utilization and proves the CoW prefix-sharing contract: hits map
+    pool pages (``prefix_pages_shared``) and the allocator's ``copy_bytes``
+    stays 0 — zero-copy by accounting, not timing. Streams must be
+    bit-identical across managers (tokens_lost = 0)."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.serving import PrefixCache, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+
+    KV_BUDGET_COLS = 2048  # per-layer cache columns both managers may hold
+    PAGE = 16
+    # mixed-length traffic: 12 chat turns sharing a 32-token system prompt
+    # (2 whole pages -> CoW-shareable) + 3 long documents. The row manager
+    # at this budget holds 2048 // 512 = 4 slots, period; the paged
+    # manager packs by footprint.
+    system = rng.randint(1, cfg.vocab_size, size=32).astype(np.int32)
+    chats = [
+        np.concatenate([
+            system,
+            rng.randint(1, cfg.vocab_size,
+                        size=int(rng.randint(4, 17))).astype(np.int32),
+        ])
+        for _ in range(12)
+    ]
+    docs = [
+        rng.randint(1, cfg.vocab_size,
+                    size=int(rng.randint(180, 300))).astype(np.int32)
+        for _ in range(3)
+    ]
+    workload = []
+    for i, p in enumerate(chats):
+        workload.append((p, GenerationConfig(max_new_tokens=32,
+                                             temperature=0.8, top_k=20)))
+        if i % 4 == 3:
+            workload.append((docs[i // 4],
+                             GenerationConfig(max_new_tokens=32,
+                                              temperature=0.8, top_k=20)))
+
+    def run(paged: bool):
+        if paged:
+            engine = ServingEngine(
+                model, params, num_slots=16, decode_chunk_size=8,
+                kv_page_size=PAGE, kv_num_pages=KV_BUDGET_COLS // PAGE + 1,
+                prefix_cache=PrefixCache(min_match=PAGE),
+            )
+        else:
+            engine = ServingEngine(
+                model, params, num_slots=KV_BUDGET_COLS // cfg.max_seq_len,
+                decode_chunk_size=8, prefix_cache=PrefixCache(min_match=PAGE),
+            )
+        # warmup wave: compiles the decode program + the prefill buckets the
+        # measured run uses (store cleared after, so the run starts cold)
+        warm = [rng.randint(1, cfg.vocab_size, size=n).astype(np.int32)
+                for n in (40, 44, 48, 200, 260)]
+        for i, p in enumerate(warm):
+            engine.submit(
+                p, GenerationConfig(max_new_tokens=8, temperature=0.8,
+                                    top_k=20),
+                key=jax.random.PRNGKey(900 + i),
+            )
+        engine.run()
+        if engine.prefix is not None:
+            engine.prefix.clear()
+        m = engine.metrics
+        base = {
+            "tok": m.decode_tokens,
+            "wall": m.decode_dispatch_s + m.decode_readback_s,
+            "occ": m.occupied_slot_steps, "steps": m.steps,
+        }
+        reqs = [
+            engine.submit(p, g, key=jax.random.PRNGKey(100 + i))
+            for i, (p, g) in enumerate(workload)
+        ]
+        peak_active = 0
+        peak_pages = 0
+        t0 = _t.perf_counter()
+        while engine.has_work:
+            engine.step()
+            peak_active = max(peak_active, int(engine._active.sum()))
+            if paged:
+                peak_pages = max(peak_pages, engine.cache.pages_mapped)
+        wall = _t.perf_counter() - t0
+        snap = m.snapshot()
+        dtok = m.decode_tokens - base["tok"]
+        dwall = (m.decode_dispatch_s + m.decode_readback_s) - base["wall"]
+        dsteps = m.steps - base["steps"]
+        docc = m.occupied_slot_steps - base["occ"]
+        stats = {
+            "num_slots": engine.num_slots,
+            "mean_concurrent_slots": round(docc / dsteps, 3) if dsteps else 0.0,
+            "peak_concurrent_slots": peak_active,
+            "decode_tok_s": round(dtok / dwall, 2) if dwall > 0 else 0.0,
+            "e2e_tok_s": round(dtok / wall, 2) if wall > 0 else 0.0,
+            "decode_tokens": int(dtok),
+            "preemptions": int(snap["preemptions"]),
+            "prefix_hits": int(snap["prefix_hits"]),
+            "prefix_hit_rate": round(snap["prefix_hit_rate"], 4),
+            "decode_compilations": engine.decode_compilations,
+        }
+        if paged:
+            cap = engine.cache.alloc.capacity
+            engine.cache.check()  # leak invariant on the way out
+            stats.update(
+                page_size=PAGE,
+                kv_pages=cap,
+                peak_pages_mapped=peak_pages,
+                peak_page_utilization=round(peak_pages / cap, 4) if cap else 0.0,
+                prefix_pages_shared=int(snap["prefix_pages_shared"]),
+                copy_bytes_on_hit=int(engine.cache.alloc.copy_bytes),
+            )
+        return stats, [r.tokens for r in reqs]
+
+    row_stats, row_toks = run(False)
+    paged_stats, paged_toks = run(True)
+    tokens_lost = sum(
+        _divergence_lost(a, b) for a, b in zip(row_toks, paged_toks)
+    )
+    return {
+        "kv_budget_cols": KV_BUDGET_COLS,
+        "workload": {
+            "chat_requests": len(chats), "doc_requests": len(docs),
+            "shared_prefix_tokens": int(system.size),
+        },
+        "row": row_stats,
+        "paged": paged_stats,
+        "concurrent_slots_ratio": round(
+            paged_stats["mean_concurrent_slots"]
+            / max(row_stats["mean_concurrent_slots"], 1e-9), 3
+        ),
+        "e2e_tok_s_ratio": round(
+            paged_stats["e2e_tok_s"] / max(row_stats["e2e_tok_s"], 1e-9), 3
+        ),
+        "streams_bit_identical": row_toks == paged_toks,
+        "tokens_lost": int(tokens_lost),
+        "zero_copy_prefix": paged_stats.get("copy_bytes_on_hit", -1) == 0,
+    }
+
+
 def _flash_block_sweep(batch, seq):
     import jax
     import jax.numpy as jnp
@@ -1405,6 +1569,33 @@ def child_prefix() -> None:
         )
 
 
+def child_paged() -> None:
+    """Paged-KV serving child (``--child-paged``): row-per-slot vs paged
+    manager on a mixed-length (chat + long-doc) workload at a FIXED KV HBM
+    budget — sustainable concurrent slots, decode tok/s, page utilization,
+    zero-copy prefix hit accounting; streams bit-identical, tokens_lost=0.
+    Prints one JSON line; merged into the BENCH artifact as
+    ``extras.serving_paged``."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_paged",
+                "unit": "concurrent slots @ fixed KV budget",
+                "platform": devs[0].platform,
+                **_measure_serving_paged(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_paged",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
 def child_spec() -> None:
     """Speculative-serving child (``--child-spec``): spec-off vs spec-on
     engine decode tokens/s across a synthetic-acceptance sweep (early-exit
@@ -1826,6 +2017,7 @@ def main() -> None:
     train_faults_result = None
     observe_result = None
     spec_result = None
+    paged_result = None
 
     import signal
 
@@ -1870,6 +2062,11 @@ def main() -> None:
             spec_result
             if spec_result is not None
             else {"error": "spec child did not finish"}
+        )
+        extras["serving_paged"] = (
+            paged_result
+            if paged_result is not None
+            else {"error": "paged child did not finish"}
         )
         extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
@@ -2027,6 +2224,16 @@ def main() -> None:
     else:
         spec_result = {"error": f"spec child: {err}"}
 
+    # 11. Paged-KV child: row vs paged manager at a fixed KV budget on the
+    #     mixed-length workload (wall-clock comparison — serialized like
+    #     the rest).
+    paged, err = _run_child("--child-paged", PAGED_TIMEOUT_S)
+    if paged is not None:
+        paged.pop("metric", None)
+        paged_result = paged
+    else:
+        paged_result = {"error": f"paged child: {err}"}
+
     _finalize()
 
 
@@ -2039,6 +2246,8 @@ if __name__ == "__main__":
         child_sweep()
     elif "--child-serving" in sys.argv:
         child_serving()
+    elif "--child-paged" in sys.argv:
+        child_paged()
     elif "--child-spec" in sys.argv:
         child_spec()
     elif "--child-train-faults" in sys.argv:

@@ -58,6 +58,9 @@ CPU-runnable out of the box:
   python examples/serving_demo.py --decode-chunk 1   # per-token stepping
   python examples/serving_demo.py --shared-prefix 24 # system-prompt reuse
   python examples/serving_demo.py --shared-prefix 24 --no-prefix-cache
+  python examples/serving_demo.py --kv-page-size 16  # paged KV + CoW reuse
+  python examples/serving_demo.py --kv-page-size 16 --kv-pages 24 --slots 8
+  python examples/serving_demo.py --kv-page-size 16 --inject-fault page
   python examples/serving_demo.py --draft-layers 1 --gamma 4  # speculative
   python examples/serving_demo.py --draft-layers 1 --inject-fault draft
   python examples/serving_demo.py --inject-fault dispatch
@@ -105,10 +108,21 @@ def parse_args(argv=None):
     p.add_argument("--gamma", type=int, default=4,
                    help="draft tokens proposed per speculative round (each "
                         "round emits 1..gamma tokens per slot)")
+    p.add_argument("--kv-page-size", type=int, default=0,
+                   help="PAGED KV cache: pool page size in cache columns "
+                        "(0 = row-per-slot layout). Admission packs by "
+                        "actual page footprint, prefix hits share pages "
+                        "copy-on-write (zero KV bytes copied), poison "
+                        "quarantine is page-granular; streams are "
+                        "bit-identical either way")
+    p.add_argument("--kv-pages", type=int, default=None,
+                   help="pool size in pages (default: the row-equivalent "
+                        "HBM). Size it DOWN to see free-page admission "
+                        "packing and the page-pressure wall")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--inject-fault", default="none",
                    choices=["none", "dispatch", "halt", "poison", "prefill",
-                            "skew", "draft"],
+                            "skew", "draft", "page"],
                    help="drive a recovery path through the FaultInjector: "
                         "one dispatch failure (recover), all dispatches "
                         "(HALTED), a poisoned readback (quarantine), a "
@@ -202,6 +216,10 @@ def main(argv=None):
                     "--inject-fault draft needs --draft-layers > 0"
                 )
             injector.fail_draft_dispatch(at=2, times=1)
+        if args.inject_fault == "page":
+            if not args.kv_page_size:
+                raise SystemExit("--inject-fault page needs --kv-page-size")
+            injector.poison_page(at=2, slot=0)  # page-granular quarantine
         if args.inject_fault == "dispatch":
             injector.fail_dispatch(at=2, times=1)  # one mid-run failure
         elif args.inject_fault == "halt":
@@ -233,6 +251,8 @@ def main(argv=None):
         draft_params=draft_params,
         gamma=args.gamma,
         prefix_cache=None if args.no_prefix_cache else "auto",
+        kv_page_size=args.kv_page_size or None,
+        kv_num_pages=args.kv_pages,
         fault_injector=injector,
         timeline=timeline,
         profile_dir=args.profile,
@@ -311,6 +331,12 @@ def main(argv=None):
     snap = engine.metrics.snapshot()
     snap["decode_compilations"] = engine.decode_compilations
     snap["rejected_submits"] = rejected
+    if args.kv_page_size:
+        snap["kv_pages_usable"] = engine.cache.alloc.capacity
+        snap["kv_pages_free"] = engine.cache.alloc.free_pages
+        snap["kv_pages_quarantined"] = engine.cache.alloc.pages_quarantined
+        snap["prefix_copy_bytes"] = engine.cache.alloc.copy_bytes  # always 0
+        engine.cache.check()  # page-leak invariant on the way out
     if engine.halt_reason:
         snap["halt_reason"] = engine.halt_reason
     if injector is not None:

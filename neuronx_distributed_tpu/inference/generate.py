@@ -81,7 +81,8 @@ def decode_write_mask(done: jax.Array) -> jax.Array:
     return jnp.logical_not(done)[:, None]
 
 
-def chunked_decode_step(decode_model, chunk_size: int, max_seq_len: int):
+def chunked_decode_step(decode_model, chunk_size: int, max_seq_len: int,
+                        page_size: Optional[int] = None):
     """Build the fused multi-token decode step shared by the serving engine
     (and any other slot-based consumer): ``chunk_size`` decode steps run as
     ONE jitted ``lax.scan`` — the serving analogue of ``generate``'s
@@ -120,15 +121,39 @@ def chunked_decode_step(decode_model, chunk_size: int, max_seq_len: int):
     the only host synchronization a consumer needs per chunk — and it must
     read the ``keys`` COPY, never the state leaf itself: ``device_get`` on
     the leaf caches a host value on that array and silently turns the next
-    chunk's donation into a full copy."""
+    chunk's donation into a full copy.
+
+    ``page_size`` switches the cache argument to the serving engine's PAGED
+    layout (``{"pages": block_table, "pool": pool_tree}``): the chunk
+    gathers the logical view through the block table on entry, runs the
+    EXACT row-per-slot math above on it, and scatters back only the pages
+    its write window could have touched on exit — one program either way,
+    token streams bit-identical across layouts."""
     from neuronx_distributed_tpu.inference.utils import unwrap_logits
-    from neuronx_distributed_tpu.modules.attention import cache_cursor
+    from neuronx_distributed_tpu.modules.attention import (
+        cache_cursor,
+        gather_cache_pages,
+        scatter_cache_window,
+    )
     from neuronx_distributed_tpu.utils.sampling import sample_per_row
 
     if chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
 
     def chunk_fn(params, cache, state):
+        if page_size is not None:
+            paged = cache
+            start = cache_cursor(paged)
+            out = _row_chunk(params, gather_cache_pages(paged, page_size),
+                             state)
+            return (
+                scatter_cache_window(
+                    paged, out[0], page_size, start, chunk_size
+                ),
+            ) + out[1:]
+        return _row_chunk(params, cache, state)
+
+    def _row_chunk(params, cache, state):
         temp, topk, topp = state["temp"], state["topk"], state["topp"]
         eos = state["eos"]
         allowed = jnp.clip(max_seq_len - cache_cursor(cache), 0, chunk_size)

@@ -70,13 +70,24 @@ def speculative_decode_chunk(
     chunk_rounds: int,
     gamma: int,
     max_seq_len: int,
+    page_size=None,
 ):
-    """Build the fused speculative chunk (see module docstring)."""
+    """Build the fused speculative chunk (see module docstring).
+
+    ``page_size`` switches BOTH cache arguments to the paged layout
+    (``{"pages": block_table, "pool": tree}``, the ``chunked_decode_step``
+    contract): logical views are gathered through each cache's block table
+    on entry, the exact row-per-slot round math runs on them, and each
+    cache's write window (``chunk_rounds * gamma`` columns from its entry
+    cursor) is scattered back on exit — shared copy-on-write prefix pages
+    outside the window are never rewritten."""
     from neuronx_distributed_tpu.inference.generate import decode_write_mask
     from neuronx_distributed_tpu.inference.utils import unwrap_logits
     from neuronx_distributed_tpu.modules.attention import (
         cache_cursor,
+        gather_cache_pages,
         invalidate_cache_window,
+        scatter_cache_window,
     )
     from neuronx_distributed_tpu.utils.sampling import sample_per_row
 
@@ -86,6 +97,26 @@ def speculative_decode_chunk(
         raise ValueError(f"gamma must be >= 1, got {gamma}")
 
     def chunk_fn(params, draft_params, cache, draft_cache, state):
+        if page_size is not None:
+            paged, draft_paged = cache, draft_cache
+            width = chunk_rounds * gamma
+            c0 = cache_cursor(paged)
+            d0 = cache_cursor(draft_paged)
+            out = _row_chunk(
+                params, draft_params,
+                gather_cache_pages(paged, page_size),
+                gather_cache_pages(draft_paged, page_size),
+                state,
+            )
+            return (
+                scatter_cache_window(paged, out[0], page_size, c0, width),
+                scatter_cache_window(
+                    draft_paged, out[1], page_size, d0, width
+                ),
+            ) + out[2:]
+        return _row_chunk(params, draft_params, cache, draft_cache, state)
+
+    def _row_chunk(params, draft_params, cache, draft_cache, state):
         temp, topk, topp = state["temp"], state["topk"], state["topp"]
         eos = state["eos"]
         b = state["tok"].shape[0]

@@ -45,6 +45,95 @@ from neuronx_distributed_tpu.kernels.flash_attention import (
 NEG_INF = -1e30
 
 
+# --- paged KV: block-table gather/scatter -------------------------------------
+#
+# The serving engine's paged cache stores K/V as a POOL of fixed-size pages
+# (..., num_pages, page_size, Hkv, D) plus a per-slot block table (B, n_log)
+# mapping logical page j of slot b to a physical pool page. These two ops are
+# the whole paged transport: gather materializes the logical (..., B, L, Hkv,
+# D) view the attention math (and, on TPU, the flash-decode kernel above)
+# already speaks, and the window scatter writes back ONLY the pages a decode
+# chunk could have touched — shared copy-on-write prefix pages outside the
+# window are never rewritten. On TPU the gather feeds ``flash_decode_attention``
+# unchanged (the kernel is oblivious to where its cache slice came from); a
+# future step can fold the page lookup into the kernel's block index map.
+# Both ops are pure jnp (no pallas) so they trace inside the engine's donated
+# decode chunk on any backend.
+
+
+def paged_gather_leaf(pool: jax.Array, block_table: jax.Array,
+                      page_size: int) -> jax.Array:
+    """Materialize the logical cache view of one pool leaf.
+
+    ``pool`` (..., P, page_size, Hkv, D) — physical pages (leading axes are
+    nn.scan layer stacking); ``block_table`` (B, n_log) int32. Returns
+    (..., B, n_log*page_size, Hkv, D): slot b's logical columns
+    ``[j*page_size, (j+1)*page_size)`` read physical page
+    ``block_table[b, j]``. Unmapped logical pages point at the reserved null
+    page (id 0) — their columns surface as garbage and MUST be masked
+    invalid by the caller's ``kv_valid`` row (the serving contract)."""
+    pax = pool.ndim - 4
+    b, n_log = block_table.shape
+    out = jnp.take(pool, block_table, axis=pax)
+    # (..., B, n_log, page_size, Hkv, D) -> merge the page axes into L
+    shape = out.shape[:pax] + (b, n_log * page_size) + out.shape[pax + 3:]
+    return out.reshape(shape)
+
+
+def paged_scatter_window_leaf(pool: jax.Array, logical: jax.Array,
+                              block_table: jax.Array, page0: jax.Array,
+                              n_win: int, page_size: int) -> jax.Array:
+    """Write the ``n_win`` logical pages starting at page ``page0`` of every
+    slot back into the pool (the decode chunk's write window, statically
+    sized; ``page0`` is traced). Values outside the window are discarded —
+    they were read-only in the chunk, so the pool already holds them; this
+    is what keeps shared (ref > 1) prefix pages bit-stable under CoW.
+
+    Slots whose window pages are unmapped (block table 0) scatter into the
+    reserved null page; duplicate targets carry identical values everywhere
+    except that null page, whose content is never attendable."""
+    pax = pool.ndim - 4
+    b, n_log = block_table.shape
+    lead = pool.shape[:pax]
+    page0 = jnp.clip(page0, 0, max(n_log - n_win, 0))
+    bt_win = jax.lax.dynamic_slice(block_table, (0, page0), (b, n_win))
+    idx = bt_win.reshape(-1)  # (B*n_win,)
+    lg = logical.reshape(
+        lead + (b, n_log, page_size) + logical.shape[pax + 2:]
+    )
+    win = jax.lax.dynamic_slice_in_dim(lg, page0, n_win, axis=pax + 1)
+    vals = win.reshape(lead + (b * n_win, page_size) + win.shape[pax + 3:])
+    pool_flat = pool.reshape((-1,) + pool.shape[pax:])
+    vals_flat = vals.reshape((-1,) + vals.shape[len(lead):])
+    out = jax.vmap(lambda p, v: p.at[idx].set(v))(pool_flat, vals_flat)
+    return out.reshape(pool.shape)
+
+
+def paged_write_pages_leaf(pool: jax.Array, pages: jax.Array,
+                           page_ids: jax.Array) -> jax.Array:
+    """Scatter explicit page blocks into the pool: ``pages`` (..., n,
+    page_size, Hkv, D) land at physical ids ``page_ids`` (n,). The paged
+    admission roll-in uses this to place a prefill row's occupied pages;
+    unused tail ids point at the reserved null page 0."""
+    pax = pool.ndim - 4
+    lead = pool.shape[:pax]
+    pool_flat = pool.reshape((-1,) + pool.shape[pax:])
+    vals_flat = pages.reshape((-1,) + pages.shape[len(lead):])
+    out = jax.vmap(lambda p, v: p.at[page_ids].set(v))(pool_flat, vals_flat)
+    return out.reshape(pool.shape)
+
+
+def paged_read_pages_leaf(pool: jax.Array, page_ids: jax.Array) -> jax.Array:
+    """Read ``n`` physical pages as one contiguous block (..., n*page_size,
+    Hkv, D) — the zero-allocation view a copy-on-write prefix hit gathers
+    its shared pages through (compute-only; no pool page is written)."""
+    pax = pool.ndim - 4
+    out = jnp.take(pool, page_ids, axis=pax)
+    n, ps = page_ids.shape[0], pool.shape[pax + 1]
+    shape = out.shape[:pax] + (n * ps,) + out.shape[pax + 2:]
+    return out.reshape(shape)
+
+
 def _decode_kernel(pos_ref, bound_ref, valid_ref, q_ref, k_ref, v_ref,
                    o_ref, lse_ref, m_scr, l_scr, acc_scr, *, block_l,
                    num_l_blocks, l_off, use_valid):

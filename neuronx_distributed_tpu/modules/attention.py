@@ -451,6 +451,65 @@ def invalidate_cache_window(cache, start, keep):
     return jax.tree_util.tree_map_with_path(fn, cache)
 
 
+_PAGED_LEAVES = ("k", "v")
+
+
+def gather_cache_pages(paged, page_size: int):
+    """Materialize the LOGICAL cache collection from a paged cache pytree
+    ``{"pages": (B, n_log) int32 block table, "pool": tree}``: k/v pool
+    leaves (..., P, page_size, Hkv, D) become logical rows (..., B, L, Hkv,
+    D) via the block table; ``index``/``kv_valid`` (already logical) pass
+    through. The result is bit-indistinguishable — for every VALID column —
+    from the row-per-slot collection the same writes would have produced,
+    so the whole decode/attention stack runs on it unchanged; unmapped
+    logical pages surface null-page garbage in columns ``kv_valid`` already
+    masks. Gather routes through the flash-decode module's paged transport
+    (kernels/flash_decode.py), the same file the TPU decode kernel lives in."""
+    from neuronx_distributed_tpu.kernels.flash_decode import paged_gather_leaf
+
+    bt = paged["pages"]
+
+    def fn(path, leaf):
+        if cache_leaf_name(path) not in _PAGED_LEAVES:
+            return leaf
+        return paged_gather_leaf(leaf, bt, page_size)
+
+    return jax.tree_util.tree_map_with_path(fn, paged["pool"])
+
+
+def scatter_cache_window(paged, logical, page_size: int, start_col,
+                         width: int):
+    """Fold a decode chunk's writes back into the paged pytree: the k/v
+    pages overlapping columns ``[start_col, start_col + width)`` (the only
+    columns a chunk may write — ``width`` static, ``start_col`` the traced
+    entry cursor) are scattered through the block table; every other pool
+    page is left untouched, which is exactly what keeps shared
+    copy-on-write prefix pages bit-stable while their ref-holders decode.
+    ``index``/``kv_valid`` (logical, per-slot) are adopted wholesale from
+    ``logical``. Returns a fresh paged pytree (same treedef)."""
+    from neuronx_distributed_tpu.kernels.flash_decode import (
+        paged_scatter_window_leaf,
+    )
+
+    bt = paged["pages"]
+    n_log = bt.shape[1]
+    # pages a width-column window can overlap, wherever it starts
+    n_win = min((width - 1) // page_size + 2, n_log)
+    page0 = jnp.asarray(start_col, jnp.int32) // page_size
+
+    def fn(path, pool_leaf, logical_leaf):
+        if cache_leaf_name(path) not in _PAGED_LEAVES:
+            return logical_leaf  # index / kv_valid: logical IS the storage
+        return paged_scatter_window_leaf(
+            pool_leaf, logical_leaf, bt, page0, n_win, page_size
+        )
+
+    return {
+        "pages": bt,
+        "pool": jax.tree_util.tree_map_with_path(fn, paged["pool"], logical),
+    }
+
+
 def cache_fingerprint(cache):
     """Cheap integrity fingerprint of a cache(-prefix) tree: a float32
     reduction over every leaf, position-weighted along the column axis so a

@@ -57,6 +57,13 @@ intentional host math stays quiet.
 HOT_SUFFIXES = (
     "serving/engine.py",
     "serving/cache_manager.py",
+    # paged KV (ISSUE 10): the page allocator / block-table manager sits
+    # between every admission and every donated decode dispatch — block
+    # tables are HOST-authoritative (numpy mirrors uploaded host->device),
+    # so any device->host read here would be a stealth sync the pinned
+    # budgets (submit=1, admission=2, steady chunk=1, re-pinned with
+    # paging on in tests/serving/test_paged_faults.py) never accounted for
+    "serving/paging.py",
     "inference/generate.py",
     # speculative serving (ISSUE 9): the fused draft–verify chunk builder
     # runs inside the engine's donated decode dispatch — a host read of

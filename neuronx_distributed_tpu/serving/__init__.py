@@ -25,6 +25,15 @@ Layers:
   shared-prompt admission path (longest-match lookup → suffix prefill of
   the uncached tail → insert-on-miss; streams bit-identical to cache-off,
   disable with ``ServingEngine(prefix_cache=None)``).
+* :mod:`paging` — the PAGED KV layout (``ServingEngine(kv_page_size=)``,
+  ISSUE 10): :class:`PageAllocator` (ref-counted, free-listed page pool) +
+  :class:`PagedCacheManager` (per-slot device-resident block tables; the
+  decode chunk gathers the logical view, runs the exact row math, and
+  scatters back its write window). Buys free-page admission packing under
+  mixed-length traffic, ZERO-COPY copy-on-write prefix sharing (insert
+  pins pages, hits map them — ``copy_bytes`` stays 0), and page-granular
+  poison quarantine; streams stay bit-identical to the row layout and
+  ``decode_compilations`` stays 1.
 * :mod:`metrics` — TTFT / decode throughput / queue wait / occupancy /
   preemption counters plus the fault-tolerance counters (sheds, rejects,
   quarantines, dispatch retries, health), exported as a plain dict snapshot
@@ -71,6 +80,11 @@ from neuronx_distributed_tpu.serving.faults import (
     InjectedPrefillError,
 )
 from neuronx_distributed_tpu.serving.metrics import ServingMetrics
+from neuronx_distributed_tpu.serving.paging import (
+    PageAllocator,
+    PagedCacheManager,
+    PageExhausted,
+)
 from neuronx_distributed_tpu.serving.scheduler import (
     Request,
     RequestState,
@@ -84,6 +98,9 @@ __all__ = [
     "InjectedDraftError",
     "InjectedFault",
     "InjectedPrefillError",
+    "PageAllocator",
+    "PageExhausted",
+    "PagedCacheManager",
     "PrefixCache",
     "PrefixEntry",
     "RejectedError",

@@ -264,6 +264,10 @@ class PrefixEntry:
     fingerprint: Any
     shapes: Tuple[Tuple[int, ...], ...]
     refs: int = 0
+    # PAGED engines (serving/paging.py): the entry holds no KV copy at all
+    # (``tree`` is None) — just the ref-counted pool page ids its tokens
+    # live in, mapped copy-on-write into a hitting slot's block table
+    page_ids: Optional[Tuple[int, ...]] = None
 
     @property
     def m(self) -> int:
@@ -310,6 +314,10 @@ class PrefixCache:
         self.min_match = min_match
         self._root = _TrieNode()
         self._lru: "OrderedDict[Tuple[int, ...], PrefixEntry]" = OrderedDict()
+        # called with each entry as it leaves the store (LRU eviction,
+        # forced eviction, clear) — the PAGED engine releases the entry's
+        # pool page refs here so a dropped entry can never leak pages
+        self.on_evict: Optional[Any] = None
 
     # --- introspection ------------------------------------------------------
 
@@ -451,6 +459,8 @@ class PrefixCache:
         poison), pruning the trie chain it leaves behind."""
         if self._lru.pop(entry.tokens, None) is None:
             return False
+        if self.on_evict is not None:
+            self.on_evict(entry)
         path = [self._root]
         for t in entry.tokens:
             nxt = path[-1].children.get(t)
@@ -471,6 +481,9 @@ class PrefixCache:
         KV computed under old params must never serve new-params traffic).
         Returns how many entries were dropped."""
         n = len(self._lru)
+        if self.on_evict is not None:
+            for e in self._lru.values():
+                self.on_evict(e)
         self._root = _TrieNode()
         self._lru = OrderedDict()
         return n

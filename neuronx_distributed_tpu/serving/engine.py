@@ -206,6 +206,7 @@ from neuronx_distributed_tpu.serving.cache_manager import (
     PrefixCache,
     SlotCacheManager,
 )
+from neuronx_distributed_tpu.serving.paging import PagedCacheManager
 from neuronx_distributed_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_tpu.serving.scheduler import (
     Request,
@@ -396,6 +397,8 @@ class ServingEngine:
         draft_model=None,
         draft_params=None,
         gamma: int = 4,
+        kv_page_size: Optional[int] = None,
+        kv_num_pages: Optional[int] = None,
         prefix_cache="auto",
         dispatch_retry: Optional[RetryPolicy] = None,
         degraded_cooldown_chunks: int = 8,
@@ -490,10 +493,30 @@ class ServingEngine:
         if prefix_cache is not None and not prefix_cache.enabled:
             prefix_cache = None
         self.prefix = prefix_cache
+        if prefix_cache is not None:
+            # paged entries hold ref-counted pool pages instead of copies;
+            # whatever drops an entry (LRU churn, poison, clear-on-swap)
+            # must release those refs or the pool leaks
+            prefix_cache.on_evict = self._on_prefix_evict
         self._prefix_reuses = 0  # reuse-attempt index (poison-hook schedule)
         self._prefill_model, self._decode_model = serving_clones(model)
         self.scheduler = Scheduler(max_tokens_in_flight)
-        self.cache = SlotCacheManager(num_slots)
+        # paged KV (ISSUE 10): kv_page_size switches the cache path from
+        # row-per-slot to block/page granularity — a ref-counted page pool
+        # with per-slot device-resident block tables, free-page admission
+        # accounting, and ZERO-COPY copy-on-write prefix sharing. None is
+        # byte-for-byte the legacy row manager (streams are bit-identical
+        # either way; paged buys HBM packing under mixed-length traffic)
+        self._page_size = kv_page_size
+        if kv_page_size is not None:
+            self.cache = PagedCacheManager(
+                num_slots, max_seq_len, kv_page_size, kv_num_pages
+            )
+            self.cache.reclaim = self._reclaim_prefix_entry
+        else:
+            if kv_num_pages is not None:
+                raise ValueError("kv_num_pages needs kv_page_size")
+            self.cache = SlotCacheManager(num_slots)
         # draft-side twins: mode clones, a SECOND donated cache collection
         # (admit/free/recover/quarantine mirrored 1:1 with the target's),
         # and per-bucket draft prefill programs. The draft cache cursor
@@ -504,7 +527,15 @@ class ServingEngine:
             self._draft_prefill_model, self._draft_decode_model = (
                 serving_clones(draft_model)
             )
-            self.draft_cache = SlotCacheManager(num_slots)
+            # the draft twin rides the same manager class (and, when paged,
+            # its own pool of the same geometry — lifecycles mirror 1:1)
+            self.draft_cache = (
+                PagedCacheManager(
+                    num_slots, max_seq_len, kv_page_size, kv_num_pages
+                )
+                if kv_page_size is not None
+                else SlotCacheManager(num_slots)
+            )
         else:
             self._draft_params_src = None
             self._draft_params = None
@@ -556,6 +587,7 @@ class ServingEngine:
                 speculative_decode_chunk(
                     self._decode_model, self._draft_decode_model,
                     decode_chunk_size, gamma, max_seq_len,
+                    page_size=kv_page_size,
                 ),
                 donate_argnums=(2, 3, 4),
             )
@@ -564,7 +596,8 @@ class ServingEngine:
             self._spec_chunk = None
             self._decode_chunk = jax.jit(
                 chunked_decode_step(
-                    self._decode_model, decode_chunk_size, max_seq_len
+                    self._decode_model, decode_chunk_size, max_seq_len,
+                    page_size=kv_page_size,
                 ),
                 donate_argnums=(1, 2),
             )
@@ -619,6 +652,24 @@ class ServingEngine:
         reg.gauge(
             "serving_queue_depth", help="queued (unfinished) requests"
         ).set_fn(_export("queue_depth"))
+        if kv_page_size is not None:
+            def _page_export(fn):
+                def read():
+                    engine = ref()
+                    return fn(engine.cache) if engine is not None else -1
+                return read
+
+            reg.gauge(
+                "serving_kv_pages_total",
+                help="usable KV pool pages (reserved + quarantined excluded)",
+            ).set_fn(_page_export(lambda c: c.alloc.capacity))
+            reg.gauge(
+                "serving_kv_pages_free", help="KV pool pages on the free list"
+            ).set_fn(_page_export(lambda c: c.alloc.free_pages))
+            reg.gauge(
+                "serving_kv_pages_mapped",
+                help="KV pool pages mapped by some slot's block table",
+            ).set_fn(_page_export(lambda c: c.pages_mapped))
 
     def _fresh_slot_state(self):
         b = self.num_slots
@@ -632,6 +683,145 @@ class ServingEngine:
             "remaining": jnp.zeros((b,), jnp.int32),
             "eos": jnp.full((b,), -1, jnp.int32),
         }
+
+    # --- paged-KV helpers ---------------------------------------------------
+
+    def _on_prefix_evict(self, entry) -> None:
+        """PrefixCache eviction hook: a PAGED entry leaving the store (LRU
+        churn, poison, clear-on-swap) releases its pool page refs — pages
+        still mapped by a decoding slot's block table survive through that
+        slot's own refs (CoW), pages held only by the entry free now."""
+        if entry.page_ids:
+            self.cache.unpin_pages(entry.page_ids)
+            entry.page_ids = None
+
+    def _reclaim_prefix_entry(self) -> bool:
+        """Page-pressure valve (installed as ``cache.reclaim``): evict the
+        least-recently-used UNPINNED prefix entry so its pages can serve a
+        new admission. Never frees a still-mapped page — eviction only
+        drops the entry's refs."""
+        if self.prefix is None:
+            return False
+        for e in self.prefix.entries:  # LRU first
+            if e.refs == 0 and e.page_ids:
+                self.prefix.evict_entry(e)
+                self.metrics.record_prefix_eviction()
+                return True
+        return False
+
+    def _paged_layout(self, p: int, rem_cols: int, proj: int):
+        """(padded, cursor target) for a paged admission at projected
+        cursor ``proj``: the padded bucket as ever, with the target bumped
+        (< page_size gap columns) so the context START lands on a page
+        boundary — the alignment that makes whole context pages shareable.
+        When the bump would push the request past the row end that the
+        exact-length bucket avoids, fall back to ``padded = p`` (the same
+        keep-every-feasible-request-admittable trade ``_bucket`` makes)."""
+        padded = _bucket(p, self.max_seq_len, rem_cols)
+        target = self.cache.aligned_target(max(proj, padded), p)
+        if padded > p and target + rem_cols > self.max_seq_len:
+            padded = p
+            target = self.cache.aligned_target(max(proj, p), p)
+        return padded, target
+
+    def _chunk_width_cols(self, active) -> int:
+        """Columns the next chunk can actually WRITE: the fused chunk
+        freezes a slot when its budget runs out, so no more than the
+        largest remaining generation among active slots ever executes
+        (steps on the plain path, rounds — gamma columns each — on the
+        speculative path). Clamping the page demand to this keeps the
+        per-chunk window consistent with the admission/door accounting,
+        which sizes requests by their REMAINING tokens — an unclamped full
+        chunk window could demand pages the door check never charged and
+        livelock a tightly-sized pool at the page-pressure wall."""
+        max_rem = max(
+            (
+                self._slot_req[int(s)].remaining_new_tokens
+                for s in active
+                if self._slot_req[int(s)] is not None
+            ),
+            default=self.decode_chunk_size,
+        )
+        return min(self.decode_chunk_size, max(max_rem, 1)) * self._round_cols
+
+    def _ensure_decode_pages(self) -> bool:
+        """Map pool pages under every active slot's next write window (both
+        caches on a speculative engine). False = the page-pressure wall:
+        the caller preempts-and-rewinds, exactly like the cursor wall."""
+        active = np.flatnonzero(self._active)
+        width = self._chunk_width_cols(active)
+        if not self.cache.ensure_decode_window(active, width):
+            return False
+        if self.draft_cache is not None and not (
+            self.draft_cache.ensure_decode_window(active, width)
+        ):
+            return False
+        return True
+
+    def _apply_page_poison(self, readback: int) -> set:
+        """Consult the injector's page-poison schedule (paged engines):
+        each scheduled page is retired from the pool and every ACTIVE
+        request whose block table maps it is requeued from the last chunk
+        boundary (its chunk output discarded, tokens/keys host-current —
+        bit-identical resume in a different slot/pages). The slot indices
+        return to rotation; only the PAGE is lost. Prefix entries pinning a
+        poisoned page are evicted (their shared content is suspect).
+        Returns the victim slot set (the caller skips their readback)."""
+        victims: set = set()
+        if self._faults is None or self._page_size is None:
+            return victims
+        pages = self._faults.on_page_readback(
+            readback, lambda s: self.cache.slot_pages(int(s)), self._active
+        )
+        if not pages:
+            return victims
+        now = self._now()
+        for page in pages:
+            slots = self.cache.quarantine_page(int(page))
+            self.metrics.record_page_quarantine(int(page), len(slots))
+            if self.timeline is not None:
+                self.timeline.instant(
+                    f"quarantine page {int(page)}", "serving",
+                    args={"slots": [int(s) for s in slots]},
+                )
+            if self.flight is not None:
+                self.flight.record("page_quarantine", page=int(page),
+                                   slots=[int(s) for s in slots])
+            if self.prefix is not None:
+                for e in list(self.prefix.entries):
+                    if e.page_ids and int(page) in e.page_ids:
+                        self.prefix.evict_entry(e)
+                        self.metrics.record_prefix_eviction()
+            victims.update(int(s) for s in slots if self._active[int(s)])
+        requeue = []
+        for slot in sorted(victims):
+            req = self._slot_req[slot]
+            self._slot_req[slot] = None
+            self._active[slot] = False
+            self._state = self._slot_clear(self._state, np.int32(slot))
+            self.cache.free(slot)
+            if self.draft_cache is not None:
+                self.draft_cache.free(slot)
+            if req is None:
+                continue
+            req.slot = None
+            if self._quarantine_policy == "requeue" and not req.finished:
+                self.tracer.step(req.rid, "page_quarantine_requeue",
+                                 args={"slot": slot})
+                requeue.append(req)
+            else:
+                req.state = RequestState.FAILED
+                req.error = f"slot {slot} mapped a poisoned KV page"
+                req.finish_time = now
+                self.metrics.record_failed(req, now, kind="quarantine")
+                self.tracer.end(req.rid, "failed",
+                                args={"kind": "page_quarantine", "slot": slot})
+                self._on_token.pop(req.rid, None)
+        if requeue:
+            self.scheduler.requeue_front(requeue)
+        if self.cache.alloc.capacity == 0:
+            self._halt("all KV pages quarantined")
+        return victims
 
     # --- public API ---------------------------------------------------------
 
@@ -760,6 +950,22 @@ class ServingEngine:
                 f"exceeds max_tokens_in_flight ({budget}); it could never "
                 "be admitted"
             )
+        if self._page_size is not None:
+            # free-page twin of the seq-len-class guard, kept EXACT: the
+            # request's worst-case page footprint ALONE (empty engine,
+            # cursor rewound) must fit the pool or no admission round can
+            # ever select it — fail at the door, not livelocked at the head
+            rem_cols = config.max_new_tokens + self._round_cols - 1
+            _, t0 = self._paged_layout(prompt.size, rem_cols, 0)
+            span0 = self.cache.page_span(
+                t0 - prompt.size, min(self.max_seq_len, t0 + rem_cols)
+            )
+            if span0 > self.cache.alloc.capacity:
+                raise ValueError(
+                    f"request needs {span0} KV pages even alone; the pool "
+                    f"holds {self.cache.alloc.capacity} usable pages — it "
+                    "could never be placed"
+                )
         # backpressure: a bounded queue rejects loudly instead of absorbing
         # an unserviceable backlog
         depth = self.scheduler.queued
@@ -820,9 +1026,13 @@ class ServingEngine:
             return EngineHealth.HALTED
         if self._draining:
             return EngineHealth.DRAINING
-        if self.cache.usable_slots < self.num_slots or (
-            self._had_dispatch_failure
-            and self._chunks_since_failure < self._degraded_cooldown
+        if (
+            self.cache.usable_slots < self.num_slots
+            or getattr(self.cache, "degraded", False)
+            or (
+                self._had_dispatch_failure
+                and self._chunks_since_failure < self._degraded_cooldown
+            )
         ):
             return EngineHealth.DEGRADED
         return EngineHealth.OK
@@ -959,11 +1169,12 @@ class ServingEngine:
     def prefix_compilations(self) -> int:
         """Prefix-cache maintenance programs XLA compiled (extract + seed,
         one per storage bucket; fingerprint, one per entry shape) — bounded
-        by the ``_prefix_bucket`` count."""
+        by the ``_prefix_bucket`` count. Paged engines count their
+        seed-from-pages programs instead (one per shared page count)."""
         return sum(
             int(fn._cache_size())
             for fn in (self._extract_fn, self._seed_fn, self._fingerprint_fn)
-        )
+        ) + getattr(self.cache, "seed_compilations", 0)
 
     def step(self) -> bool:
         """One engine iteration: reap cancellations → shed expired deadlines
@@ -1072,8 +1283,16 @@ class ServingEngine:
             default=0,
         )
 
+        # paged: the free-page accounting that replaces seq-len-class-only
+        # gating — per-slot context starts feed the worst-case page spans
+        spans_starts = (
+            list(self.cache.active_spans())
+            if self._page_size is not None else []
+        )
+        eager_claimed = 0
+
         def fits(req: Request) -> bool:
-            nonlocal proj, maxrem
+            nonlocal proj, maxrem, eager_claimed
             if self._draining and req.admit_time is None:
                 # drain admits only work that was already in flight once
                 # (preempted/recovered requests rejoin at the queue FRONT,
@@ -1083,11 +1302,16 @@ class ServingEngine:
             # the padded prompt must leave room for the remaining
             # generation AND (speculative engines) the final round's
             # gamma-token window — _round_cols - 1 == 0 on the plain path
-            bucket = _bucket(
-                p, self.max_seq_len,
-                req.remaining_new_tokens + self._round_cols - 1,
-            )
-            target = max(proj, bucket)
+            if self._page_size is not None:
+                bucket, target = self._paged_layout(
+                    p, req.remaining_new_tokens + self._round_cols - 1, proj
+                )
+            else:
+                bucket = _bucket(
+                    p, self.max_seq_len,
+                    req.remaining_new_tokens + self._round_cols - 1,
+                )
+                target = max(proj, bucket)
             if self.admission == "conservative":
                 # all slots step together, so the cursor's final resting
                 # place is the admission cursor plus the LONGEST remaining
@@ -1104,12 +1328,54 @@ class ServingEngine:
                     > self.max_seq_len
                 ):
                     return False
-            elif target + self._round_cols > self.max_seq_len:
-                # eager: just the prefill + one decode round must fit; the
-                # preemption path recovers the rest
-                return False
+                if self._page_size is not None:
+                    # every in-flight + selected context's pages through
+                    # the projected final cursor must fit the pool (shared
+                    # pages double-counted, early retirement ignored —
+                    # strictly conservative, so the no-preemption promise
+                    # extends to the page-pressure wall)
+                    t_end = min(
+                        self.max_seq_len,
+                        target + max(maxrem, req.remaining_new_tokens)
+                        + self._round_cols - 1,
+                    )
+                    spans = self.cache.page_span(target - p, t_end) + sum(
+                        self.cache.page_span(s, t_end) for s in spans_starts
+                    )
+                    if spans > self.cache.alloc.capacity:
+                        return False
+            else:
+                if target + self._round_cols > self.max_seq_len:
+                    # eager: just the prefill + one decode round must fit;
+                    # the preemption path recovers the rest
+                    return False
+                if self._page_size is not None:
+                    # eager page gate: this round's prefill pages plus one
+                    # decode window (clamped to the request's remaining
+                    # work, matching _ensure_decode_pages — an unclamped
+                    # window would starve short-tail requests a small pool
+                    # can in fact serve), against what the pool can free
+                    # up (reclaimable prefix entries included)
+                    window = (
+                        min(
+                            self.decode_chunk_size,
+                            max(req.remaining_new_tokens, 1),
+                        ) * self._round_cols
+                    )
+                    need = self.cache.page_span(
+                        target - p,
+                        min(self.max_seq_len, target + window),
+                    )
+                    if (
+                        eager_claimed + need
+                        > self.cache.available_pages()
+                    ):
+                        return False
+                    eager_claimed += need
             proj = target
             maxrem = max(maxrem, req.remaining_new_tokens)
+            if self._page_size is not None:
+                spans_starts.append(target - p)
             return True
 
         cost = None
@@ -1170,10 +1436,17 @@ class ServingEngine:
     def _prefill_into_slot(self, req: Request, slot: int, now: float) -> None:
         ctx = req.context_ids
         p = len(ctx)
-        padded = _bucket(
-            p, self.max_seq_len,
-            req.remaining_new_tokens + self._round_cols - 1,
-        )
+        target = None
+        if self._page_size is not None:
+            padded, target = self._paged_layout(
+                p, req.remaining_new_tokens + self._round_cols - 1,
+                self.cache.cursor,
+            )
+        else:
+            padded = _bucket(
+                p, self.max_seq_len,
+                req.remaining_new_tokens + self._round_cols - 1,
+            )
         self.tracer.step(req.rid, "admission", args={"slot": slot})
         plan = self._plan_prefix_reuse(ctx, p, padded)
         self.tracer.step(
@@ -1192,16 +1465,25 @@ class ServingEngine:
                 if plan is not None:
                     entry, m_use, chunk = plan
                     s = p - m_use
-                    # seed a fresh row from the stored prefix COPY (the
-                    # entry is pinned, read, never aliased or donated),
-                    # then prefill only the uncached tail through the
-                    # decode-mode cache-write path at the prefix's cursor
-                    row = self._seed_fn(
-                        entry.tree,
-                        jnp.asarray(m_use, jnp.int32),
-                        jnp.asarray(padded - p, jnp.int32),
-                        self.max_seq_len,
-                    )
+                    if self._page_size is not None:
+                        # ZERO-COPY seed: gather the entry's shared pool
+                        # pages into the compute view directly — no entry
+                        # copy exists, no page is allocated or written
+                        row = self.cache.seed_row(
+                            entry.page_ids[:m_use // self._page_size],
+                            m_use, padded - p,
+                        )
+                    else:
+                        # seed a fresh row from the stored prefix COPY (the
+                        # entry is pinned, read, never aliased or donated),
+                        # then prefill only the uncached tail through the
+                        # decode-mode cache-write path at the prefix's cursor
+                        row = self._seed_fn(
+                            entry.tree,
+                            jnp.asarray(m_use, jnp.int32),
+                            jnp.asarray(padded - p, jnp.int32),
+                            self.max_seq_len,
+                        )
                     sfx_ids, _ = pack_padded_prompt(
                         ctx[m_use:], chunk, pad_side="right"
                     )
@@ -1282,18 +1564,58 @@ class ServingEngine:
             args={"padded": padded,
                   "reused": plan[1] if plan is not None else 0},
         )
-        self._remember_prefix(
-            ctx, p, padded, row_cache,
-            matched=plan[1] if plan is not None else 0,
-        )
-        self.cache.admit(row_cache, slot, padded)
-        if self.draft_model is not None:
-            # mirror the slot into the draft cache at the SAME cursor the
-            # target admit just set — the two cursors stay in lockstep, so
-            # every speculative round's windows line up column-for-column
-            self.draft_cache.admit(
-                draft_row, slot, padded, cursor=self.cache.cursor
+        if self._page_size is None:
+            self._remember_prefix(
+                ctx, p, padded, row_cache,
+                matched=plan[1] if plan is not None else 0,
             )
+            self.cache.admit(row_cache, slot, padded)
+            if self.draft_model is not None:
+                # mirror the slot into the draft cache at the SAME cursor
+                # the target admit just set — the two cursors stay in
+                # lockstep, so every speculative round's windows line up
+                # column-for-column
+                self.draft_cache.admit(
+                    draft_row, slot, padded, cursor=self.cache.cursor
+                )
+        else:
+            from neuronx_distributed_tpu.serving.paging import PageExhausted
+
+            m_shared = plan[1] if plan is not None else 0
+            shared = (
+                plan[0].page_ids[:m_shared // self._page_size]
+                if plan is not None else ()
+            )
+            try:
+                self.cache.admit(
+                    row_cache, slot, padded, cursor=target, p=p,
+                    shared_ids=shared, m_shared=m_shared,
+                )
+                if self.draft_model is not None:
+                    # the draft twin maps its own pool pages at the same
+                    # aligned cursor (it always full-prefills — no sharing)
+                    self.draft_cache.admit(
+                        draft_row, slot, padded, cursor=self.cache.cursor,
+                        p=p,
+                    )
+            except PageExhausted as e:
+                # eager-mode page pressure between fits() and admit (e.g. a
+                # reclaim raced dry): nothing is mapped — put the slot and
+                # the untouched request back; the wall/preempt machinery
+                # owns the rest
+                self.cache.free(slot)
+                if self.draft_cache is not None:
+                    self.draft_cache.free(slot)
+                self.scheduler.requeue_front([req])
+                if self.flight is not None:
+                    self.flight.record("page_exhausted", rid=req.rid,
+                                       error=str(e))
+                return
+            if m_shared:
+                self.metrics.record_prefix_pages_shared(
+                    m_shared // self._page_size
+                )
+            self._remember_prefix_paged(ctx, p, slot, matched=m_shared)
         self.metrics.record_admit(req, now)
         if req.admit_time is None:
             req.admit_time = now
@@ -1367,11 +1689,34 @@ class ServingEngine:
                 )
             return None
         entry, m_use = hit
+        if self._page_size is not None:
+            # zero-copy CoW reuse is PAGE-granular: only whole pinned pages
+            # are shareable, so the usable match floor-aligns to the page
+            # size (the unaligned tail re-prefills as part of the suffix)
+            ps = self._page_size
+            m_use = min(m_use // ps, len(entry.page_ids or ())) * ps
+            if m_use < self.prefix.min_match:
+                self.metrics.record_prefix_miss()
+                if self.timeline is not None:
+                    self.timeline.instant(
+                        "prefix_miss", "serving", args={"prompt": p}
+                    )
+                return None
         reuse = self._prefix_reuses
         self._prefix_reuses += 1
         if self._faults is not None:
             self._faults.on_prefix_reuse(reuse, entry)
-        if not self._validate_prefix(entry):
+        if self._page_size is not None:
+            # paged validation is host accounting: the entry's pages must
+            # still be allocated, pinned, and un-quarantined (the content
+            # never left the pool — poisoned pages route through the
+            # page-quarantine path, which evicts pinning entries)
+            valid = self.cache.pages_live(
+                entry.page_ids[:m_use // self._page_size]
+            )
+        else:
+            valid = self._validate_prefix(entry)
+        if not valid:
             self.prefix.evict_entry(entry)
             self.metrics.record_prefix_validation_failure()
             self.metrics.record_prefix_eviction()
@@ -1457,6 +1802,42 @@ class ServingEngine:
                     "prefix_evict", "serving", args={"evicted": evicted}
                 )
 
+    def _remember_prefix_paged(self, ctx, p: int, slot: int,
+                               matched: int = 0) -> None:
+        """Paged insert-on-miss: PIN the admitted slot's whole context
+        pages instead of extracting a compact copy — zero device work, zero
+        KV bytes moved, the very pages the prefill just wrote become the
+        shared storage (decode writes always land beyond the aligned
+        context, so a still-decoding donor can never touch them). Runs
+        AFTER ``cache.admit`` (the pins ride the allocator, which donation
+        cannot consume). Same skip rules as the copy path: contexts too
+        short to reuse, contexts already covered, hits whose aligned tail
+        adds less than ``min_match``."""
+        if self.prefix is None:
+            return
+        ps = self._page_size
+        m_ins = (p // ps) * ps
+        if m_ins < self.prefix.min_match:
+            return
+        if matched and m_ins - matched < self.prefix.min_match:
+            return
+        key = tuple(int(t) for t in ctx[:m_ins])
+        if self.prefix.covers(key):
+            return
+        ids = self.cache.slot_context_pages(slot, m_ins // ps)
+        self.cache.pin_pages(ids)
+        entry, evicted = self.prefix.insert(key, None, None, bucket=m_ins)
+        if entry is None:  # raced covered / disabled: drop the pins
+            self.cache.unpin_pages(ids)
+        else:
+            entry.page_ids = tuple(int(i) for i in ids)
+        if evicted:
+            self.metrics.record_prefix_eviction(evicted)
+            if self.timeline is not None:
+                self.timeline.instant(
+                    "prefix_evict", "serving", args={"evicted": evicted}
+                )
+
     # --- decode -------------------------------------------------------------
 
     def _maybe_profile(self) -> None:
@@ -1495,6 +1876,13 @@ class ServingEngine:
         and the next admission/free event no per-slot host state moves. A
         failed dispatch routes through the recovery state machine instead of
         crashing the loop."""
+        if self._page_size is not None and not self._ensure_decode_pages():
+            # page-pressure wall: the pool cannot back every active slot's
+            # next write window even after reclaiming prefix entries —
+            # preempt-and-rewind, the cursor wall's exact remedy (frees
+            # every slot mapping; re-admission repacks from column 0)
+            self._preempt_all()
+            return
         if self.draft_model is not None:
             self._decode_spec()
         else:
@@ -1583,6 +1971,8 @@ class ServingEngine:
             toks, counts, self.gamma, self._vocab,
             np.flatnonzero(self._active),
         )
+        # paged: page-poison victims leave self._active before the unpack
+        self._apply_page_poison(readback)
         emitted = int(
             sum(
                 int(counts[:, s].sum())
@@ -1759,6 +2149,10 @@ class ServingEngine:
             toks, counts, self.decode_chunk_size, self._vocab,
             np.flatnonzero(self._active),
         )
+        # paged engines: a poisoned PAGE quarantines only the requests
+        # mapping it — victims leave self._active here, so the emit loop
+        # below never touches their (discarded) readback columns
+        self._apply_page_poison(readback)
         emitted = int(
             sum(
                 int(counts[s])
@@ -1835,7 +2229,16 @@ class ServingEngine:
                              args={"tokens": len(r.tokens)})
         self.scheduler.requeue_front(requeued)
         self.cache.release_all_slots()
-        self.cache.recover(cache_in)
+        survived = self.cache.recover(cache_in)
+        if self._page_size is not None and not survived and (
+            self.prefix is not None
+        ):
+            # the POOL was consumed: every pinned page's content is gone
+            # with it, so paged entries (which hold no copies) are void —
+            # clear the store; on_evict releases each entry's page refs
+            dropped = self.prefix.clear()
+            if dropped:
+                self.metrics.record_prefix_eviction(dropped)
         if self.draft_cache is not None:
             # the draft twin recovers identically: salvage-or-drop its
             # storage and rewind — every slot was vacated, so a lazy
@@ -1912,6 +2315,13 @@ class ServingEngine:
                 self._on_token.pop(req.rid, None)
         if self.cache.usable_slots == 0:
             self._halt("all slots quarantined")
+        elif (
+            self._page_size is not None and self.cache.alloc.capacity == 0
+        ):
+            # paged: the slot index survives a quarantine (only its
+            # exclusive PAGES retire), so total capacity loss shows up in
+            # the pool, not the slot count
+            self._halt("all KV pages quarantined")
 
     # --- lifecycle helpers --------------------------------------------------
 

@@ -28,6 +28,13 @@ Injection points (all indices are 0-based and deterministic):
   prefix storage. The engine's reuse-time checksum/shape validation must
   evict the entry and fall back to a full prefill — poisoned KV must never
   reach a slot.
+* ``poison_page(at=k, slot=s)`` — PAGED engines (``kv_page_size=``): at the
+  k-th successful readback, the first pool page mapped by slot ``s``'s
+  block table is declared poisoned. The engine must quarantine THE PAGE
+  (retire it from the pool) and requeue exactly the requests whose tables
+  map it — CoW sharers included, neighbors untouched, the slot index
+  itself back in rotation. Defers like ``poison_readback`` when the slot
+  is not active (or maps nothing) at that readback.
 * ``skew_clock(by=s)`` / ``skew_clock(by=s, after=t)`` — the engine clock
   reads ``s`` seconds ahead (optionally only once real time passes
   ``after``), driving deadline/queue-timeout shedding paths without
@@ -78,6 +85,7 @@ class FaultInjector:
         self._prefix_windows: List[Tuple[int, Optional[int]]] = []
         self._draft_dispatch_windows: List[Tuple[int, Optional[int]]] = []
         self._draft_poison_windows: List[Tuple[int, Optional[int]]] = []
+        self._page_poisons: Dict[int, List[int]] = {}  # readback -> [slot]
         self._skew: float = 0.0
         self._skew_after: Optional[float] = None
         self.counters: Dict[str, int] = {
@@ -87,6 +95,7 @@ class FaultInjector:
             "poisoned_prefixes": 0,
             "draft_dispatch_failures": 0,
             "poisoned_drafts": 0,
+            "poisoned_pages": 0,
         }
 
     # --- schedule construction ----------------------------------------------
@@ -135,6 +144,17 @@ class FaultInjector:
         draft quality)."""
         end = None if times is None else at + times
         self._draft_poison_windows.append((at, end))
+        return self
+
+    def poison_page(self, at: int = 0, times: int = 1,
+                    slot: int = 0) -> "FaultInjector":
+        """At the ``at``-th..(at+times-1)-th successful readbacks of a
+        PAGED engine, poison the first pool page slot ``slot``'s block
+        table maps — modeling one corrupted HBM page. The engine's
+        page-granular quarantine must retire the page and requeue exactly
+        the requests mapping it (bit-identically), nothing else."""
+        for i in range(times):
+            self._page_poisons.setdefault(at + i, []).append(slot)
         return self
 
     def skew_clock(self, by: float, after: Optional[float] = None) -> "FaultInjector":
@@ -235,6 +255,30 @@ class FaultInjector:
             self._poisons.setdefault(readback + 1, []).extend(deferred)
         return toks, counts
 
+    def on_page_readback(self, readback: int, slot_pages, active=None):
+        """Called by PAGED engines with the 0-based successful-readback
+        index and ``slot_pages`` — a callable mapping a slot index to the
+        pool page ids its block table maps. Returns the page ids the
+        schedule poisons at this readback. A scheduled slot that is not
+        active (or maps nothing) DEFERS to the next readback — the counter
+        increments only when a real page is actually poisoned, so chaos
+        tests asserting on it prove the quarantine path ran."""
+        pages: List[int] = []
+        deferred: List[int] = []
+        for slot in self._page_poisons.pop(readback, ()):
+            mapped = (
+                slot_pages(slot)
+                if active is None or bool(active[slot]) else []
+            )
+            if not mapped:
+                deferred.append(slot)
+                continue
+            pages.append(int(mapped[0]))
+            self.counters["poisoned_pages"] += 1
+        if deferred:
+            self._page_poisons.setdefault(readback + 1, []).extend(deferred)
+        return pages
+
     def on_prefill(self, call: int) -> None:
         """Called with the 0-based prefill call index before the prefill
         dispatch."""
@@ -254,6 +298,10 @@ class FaultInjector:
         checksum validation catches silent data corruption, not a shape
         mismatch."""
         if not self._hit(self._prefix_windows, reuse):
+            return
+        if getattr(entry, "tree", None) is None:
+            # paged CoW entry: no host-managed KV copy to corrupt — page
+            # corruption is poison_page's territory
             return
         import jax
         import jax.numpy as jnp

@@ -57,6 +57,10 @@ _COUNTERS = (
     ("prefix_tokens_reused", "serving_prefix_tokens_reused", True),
     ("prefix_evictions", "serving_prefix_evictions", True),
     ("prefix_validation_failures", "serving_prefix_validation_failures", True),
+    # paged KV (kv_page_size=): pages retired for poison, and pool pages
+    # mapped copy-on-write into a hitting slot (zero bytes moved)
+    ("page_quarantines", "serving_page_quarantines", True),
+    ("prefix_pages_shared", "serving_prefix_pages_shared", True),
     ("occupied_slot_steps", "serving_occupied_slot_steps", True),
     ("prefill_full_wall_s", "serving_prefill_full_wall_s", False),
     ("prefill_suffix_wall_s", "serving_prefill_suffix_wall_s", False),
@@ -236,6 +240,16 @@ class ServingMetrics:
         self._inc("prefix_hits")
         self._inc("prefix_tokens_reused", matched)
 
+    def record_prefix_pages_shared(self, n: int) -> None:
+        """A paged prefix hit mapped ``n`` pool pages copy-on-write into
+        the admitted slot's block table (zero KV bytes copied)."""
+        self._inc("prefix_pages_shared", n)
+
+    def record_page_quarantine(self, page: int, victims: int) -> None:
+        """A poisoned pool page was retired; ``victims`` requests mapping
+        it were requeued (page-granular fault domain)."""
+        self._inc("page_quarantines")
+
     def record_prefix_miss(self) -> None:
         self._inc("prefix_misses")
 
@@ -366,6 +380,8 @@ class ServingMetrics:
             "prefix_tokens_reused": self.prefix_tokens_reused,
             "prefix_evictions": self.prefix_evictions,
             "prefix_validation_failures": self.prefix_validation_failures,
+            "prefix_pages_shared": self.prefix_pages_shared,
+            "page_quarantines": self.page_quarantines,
             "prefill_count": self.prefill_count,
             "prefill_wall_s": self.prefill_wall_s,
             "prefill_mean_s": self._h_prefill.mean,

@@ -1,0 +1,698 @@
+"""Paged KV cache for the serving engine: block-table slots over a
+ref-counted page pool with zero-copy copy-on-write prefix sharing.
+
+The row-per-slot manager (``serving/cache_manager.py``) charges every slot a
+full ``max_seq_len`` row of HBM whatever its request actually uses, and the
+prefix cache physically copies KV on every insert and reuse. This module
+replaces that storage model at BLOCK/PAGE granularity while keeping every
+byte of the decode math untouched:
+
+* :class:`PageAllocator` owns a fixed pool of ``num_pages`` KV pages
+  (``page_size`` cache columns each), free-listed and ref-counted. Page 0 is
+  the reserved NULL page — never allocated, the scatter target of every
+  unmapped block-table entry, never attendable.
+* :class:`PagedCacheManager` is the drop-in slot manager: each slot holds a
+  block table row (host-authoritative numpy mirror, uploaded as the
+  ``pages`` leaf of the paged cache pytree ``{"pages": bt, "pool": tree}``
+  the decode chunk donates). The jitted chunk gathers the logical
+  ``(num_slots, max_seq_len)`` view through the table, runs the EXACT
+  row-per-slot math (attention masking/RoPE still run off per-row
+  ``kv_valid`` counts), and scatters back only its write window
+  (``modules/attention.gather_cache_pages`` / ``scatter_cache_window`` over
+  ``kernels/flash_decode``'s paged transport) — so token streams are
+  bit-identical across layouts and XLA still compiles ONE decode program.
+* Copy-on-write prefix sharing: every paged admission page-aligns its
+  context start (the cursor target is bumped ``< page_size`` columns; gap
+  columns stay invalid as ever), so insert-on-miss PINS the slot's
+  whole context pages instead of extracting a compact copy, and a later
+  hit maps those pages straight into the new slot's block table — ref-counts
+  up, ZERO KV bytes copied (``PageAllocator.copy_bytes`` stays 0 by
+  construction; the allocator accounting is the test surface). Decode
+  writes always land beyond the aligned shared range, and the chunk's
+  window scatter never rewrites pages outside the window, so shared pages
+  are bit-stable while any number of holders decode off them.
+* Page-granular fault domains: a poisoned page is quarantined out of the
+  POOL (``PageAllocator.quarantine``) — only the requests whose tables map
+  it are requeued, the slot indices stay in rotation, and capacity degrades
+  by one page instead of one permanent slot row.
+
+Every manager instance registers in a weak set; ``check_all_live()`` runs
+the leak/ref-count invariant (:meth:`PagedCacheManager.check`) over all
+live managers — the serving test suite calls it after every test teardown.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_tpu.modules.attention import (
+    cache_batch_axis,
+    cache_leaf_name,
+    reset_cache,
+    reset_cache_slot,
+    seed_cache_prefix,
+)
+
+_LIVE_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def check_all_live() -> int:
+    """Run the page-leak/ref-count invariant over every live
+    :class:`PagedCacheManager` (the serving suite's teardown fixture).
+    Returns how many managers were checked; raises AssertionError on the
+    first violated invariant."""
+    n = 0
+    for mgr in list(_LIVE_MANAGERS):
+        mgr.check()
+        n += 1
+    return n
+
+
+class PageExhausted(RuntimeError):
+    """The pool has fewer free pages than an allocation needs (after any
+    reclaim callback ran dry). Admission accounting exists to make this
+    unreachable on the conservative path; the eager path treats it as the
+    page-pressure wall (preempt-and-rewind)."""
+
+
+class PageAllocator:
+    """Host-side owner of the physical page pool: free list + ref counts.
+
+    A page is exactly one of: RESERVED (page 0, the null scatter target),
+    FREE (on the free list, refcount absent), REFERENCED (mapped by >= 1
+    block table and/or pinned by >= 1 prefix entry — the refcount is the
+    sum), or QUARANTINED (poisoned, permanently out of circulation; a
+    referenced page that gets quarantined leaves circulation when its last
+    ref drops). ``copy_bytes`` counts KV bytes physically duplicated on
+    prefix reuse — the zero-copy CoW contract is that it STAYS 0."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is reserved), got {num_pages}"
+            )
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(1, num_pages))
+        self._refs: Dict[int, int] = {}
+        self._quarantined: set = set()
+        self.copy_bytes = 0  # CoW contract: never incremented by sharing
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def referenced_pages(self) -> int:
+        return len(self._refs)
+
+    @property
+    def pages_quarantined(self) -> int:
+        return len(self._quarantined)
+
+    @property
+    def capacity(self) -> int:
+        """Usable pages: everything but the reserved null page and the
+        quarantined set (referenced or free alike)."""
+        return self.num_pages - 1 - len(self._quarantined)
+
+    def refcount(self, pid: int) -> int:
+        return self._refs.get(pid, 0)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` pages off the free list, each born with refcount 1
+        (the caller's mapping). Raises :class:`PageExhausted` when short."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            raise PageExhausted(
+                f"need {n} pages, {len(self._free)} free "
+                f"(capacity {self.capacity})"
+            )
+        ids = [self._free.pop(0) for _ in range(n)]
+        for pid in ids:
+            self._refs[pid] = 1
+        return ids
+
+    def ref(self, pid: int) -> None:
+        """One more holder of an already-live page (CoW share / prefix pin)."""
+        if pid not in self._refs:
+            raise ValueError(f"page {pid} is not live (cannot ref)")
+        self._refs[pid] += 1
+
+    def deref(self, pid: int) -> None:
+        """Drop one holder; the last drop returns the page to the free list
+        (or retires it for good if it was quarantined while referenced)."""
+        c = self._refs.get(pid)
+        if c is None:
+            raise ValueError(f"page {pid} is not live (cannot deref)")
+        if c > 1:
+            self._refs[pid] = c - 1
+            return
+        del self._refs[pid]
+        if pid not in self._quarantined:
+            self._free.append(pid)
+            self._free.sort()
+
+    def quarantine(self, pid: int) -> None:
+        """Pull a page out of circulation permanently (poisoned content).
+        A free page leaves the free list now; a referenced page keeps
+        serving its current holders' BOOKKEEPING (they are being requeued
+        by the caller) and retires when the last ref drops."""
+        if pid <= 0 or pid >= self.num_pages:
+            raise ValueError(f"page {pid} outside pool [1, {self.num_pages})")
+        self._quarantined.add(pid)
+        if pid in self._free:
+            self._free.remove(pid)
+
+    def release_all(self) -> None:
+        """Drop every reference (pool-loss recovery: all mappings and pins
+        are void). Quarantined pages stay out of circulation."""
+        for pid in list(self._refs):
+            del self._refs[pid]
+            if pid not in self._quarantined:
+                self._free.append(pid)
+        self._free.sort()
+
+
+class PagedCacheManager:
+    """Host-side owner of a paged cache collection + slot/block-table
+    bookkeeping — the page-granular sibling of ``SlotCacheManager`` (same
+    take/restore/recover/update_after_decode donation protocol, same shared
+    write cursor semantics; the engine drives either through one code
+    path). Device work is a handful of jitted programs: paged admission
+    roll-in (scatter the prefill row's occupied pages through host-chosen
+    ids), per-slot free / full reset (the row manager's exact programs —
+    they touch only ``kv_valid``/``index`` leaves, which stay logical), and
+    the non-donating seed-from-pages gather behind zero-copy prefix hits."""
+
+    def __init__(self, num_slots: int, max_seq_len: int, page_size: int,
+                 num_pages: Optional[int] = None):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_seq_len % page_size != 0:
+            raise ValueError(
+                f"max_seq_len ({max_seq_len}) must be a multiple of "
+                f"page_size ({page_size})"
+            )
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len
+        self.page_size = page_size
+        self.pages_per_row = max_seq_len // page_size
+        if num_pages is None:
+            # default: the row manager's exact HBM (every slot a full row)
+            # plus the reserved null page — paging is then a pure layout
+            # change; smaller pools buy the packing win
+            num_pages = num_slots * self.pages_per_row + 1
+        self.alloc = PageAllocator(num_pages)
+        self.cache = None  # {"pages": bt, "pool": tree}; lazy like the row mgr
+        self.cursor = 0
+        self._free = list(range(num_slots))
+        self._quarantined: set = set()  # slot indices (API compat; rare)
+        self._tables = np.zeros((num_slots, self.pages_per_row), np.int32)
+        self._slot_start: List[Optional[int]] = [None] * num_slots
+        self._pins: Dict[int, int] = {}  # page -> prefix-entry pin count
+        # engine-installed pressure valve: evict one unpinned prefix entry,
+        # return whether anything was reclaimed
+        self.reclaim: Optional[Callable[[], bool]] = None
+        self.prefix_pages_shared_total = 0
+        ps, n_log = page_size, self.pages_per_row
+
+        def _paged_admit(paged, row, slot, shift, cursor, ids, lo_page):
+            from neuronx_distributed_tpu.kernels.flash_decode import (
+                paged_write_pages_leaf,
+            )
+
+            n_adm = ids.shape[0]
+
+            def fn(path, pool_leaf, row_leaf):
+                name = cache_leaf_name(path)
+                if name in ("k", "v"):
+                    r_ax = row_leaf.ndim - 4  # row batch axis
+                    col = r_ax + 1
+                    rolled = jnp.roll(row_leaf, shift, axis=col)
+                    lead = row_leaf.shape[:r_ax]
+                    tail = row_leaf.shape[col + 1:]
+                    pg = rolled.reshape(lead + (1, n_log, ps) + tail)
+                    win = jax.lax.dynamic_slice_in_dim(
+                        pg, lo_page, n_adm, axis=r_ax + 1
+                    )
+                    pages = win.reshape(lead + (n_adm, ps) + tail)
+                    return paged_write_pages_leaf(pool_leaf, pages, ids)
+                ax = cache_batch_axis(name, pool_leaf.ndim)
+                if name == "kv_valid":
+                    rolled = jnp.roll(row_leaf, shift, axis=ax + 1)
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        pool_leaf, rolled, slot, axis=ax
+                    )
+                return jnp.full_like(pool_leaf, cursor)
+
+            pool = jax.tree_util.tree_map_with_path(fn, paged["pool"], row)
+            return {"pages": paged["pages"], "pool": pool}
+
+        def _seed_from_pages(pool, ids, m, start):
+            """Batch-1 row whose columns [start, start+m) hold the first
+            ``m`` tokens of the shared pages ``ids`` — the zero-copy twin
+            of ``seed_cache_prefix`` on a stored entry COPY. The pool is
+            READ (never donated, never aliased into the result): the
+            gather materializes compute-only views, no pool page moves."""
+            from neuronx_distributed_tpu.kernels.flash_decode import (
+                paged_read_pages_leaf,
+            )
+
+            bucket = ids.shape[0] * ps
+
+            def fn(path, leaf):
+                name = cache_leaf_name(path)
+                if name in ("k", "v"):
+                    block = paged_read_pages_leaf(leaf, ids)
+                    return jnp.expand_dims(block, leaf.ndim - 4)
+                ax = cache_batch_axis(name, leaf.ndim)
+                if name == "kv_valid":
+                    valid = jnp.arange(bucket, dtype=jnp.int32)[None] < m
+                    return jnp.broadcast_to(
+                        valid, leaf.shape[:ax] + (1, bucket)
+                    )
+                return jnp.full_like(leaf, m)
+
+            block = jax.tree_util.tree_map_with_path(fn, pool)
+            return seed_cache_prefix(block, m, start, max_seq_len)
+
+        self._admit_fn = jax.jit(_paged_admit, donate_argnums=(0,))
+        self._seed_fn = jax.jit(_seed_from_pages)
+        self._free_fn = jax.jit(reset_cache_slot, donate_argnums=(0,))
+        self._reset_fn = jax.jit(reset_cache, donate_argnums=(0,))
+        _LIVE_MANAGERS.add(self)
+
+    # --- slot accounting (SlotCacheManager surface) -------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_slots(self) -> int:
+        return self.num_slots - len(self._free) - len(self._quarantined)
+
+    @property
+    def usable_slots(self) -> int:
+        return self.num_slots - len(self._quarantined)
+
+    @property
+    def quarantined_slots(self) -> list:
+        return sorted(self._quarantined)
+
+    @property
+    def pages_quarantined(self) -> int:
+        return self.alloc.pages_quarantined
+
+    @property
+    def degraded(self) -> bool:
+        """Capacity permanently shrunk (poisoned pages retired)."""
+        return self.alloc.pages_quarantined > 0
+
+    @property
+    def pages_mapped(self) -> int:
+        return int((self._tables != 0).sum())
+
+    @property
+    def seed_compilations(self) -> int:
+        """Distinct zero-copy seed programs compiled (one per shared page
+        count) — bounded by ``pages_per_row``, never by hit traffic."""
+        return int(self._seed_fn._cache_size())
+
+    def acquire(self) -> int:
+        return self._free.pop(0)
+
+    def quarantine(self, slot: int) -> None:
+        """Slot-poison entry point (the engine's poisoned-readback path):
+        page-granular — the slot's EXCLUSIVELY-owned pages are retired from
+        the pool (their content is suspect), shared/pinned pages predate
+        this slot and survive, and the slot index itself stays in rotation
+        (the caller's ``free`` returns it). Capacity degrades by the pages
+        lost, not a permanent row."""
+        row = self._tables[slot]
+        for pid in {int(p) for p in row[row != 0]}:
+            if self.alloc.refcount(pid) == 1 and self._pins.get(pid, 0) == 0:
+                self.alloc.quarantine(pid)
+
+    def quarantine_page(self, pid: int) -> List[int]:
+        """Retire one poisoned page from the pool; returns the slots whose
+        block tables currently map it (the caller requeues exactly those —
+        the page-granular fault domain)."""
+        self.alloc.quarantine(pid)
+        return [
+            s for s in range(self.num_slots)
+            if (self._tables[s] == pid).any()
+        ]
+
+    # --- page math ----------------------------------------------------------
+
+    def aligned_target(self, base: int, p: int) -> int:
+        """Smallest cursor >= ``base`` placing a p-token context's first
+        token on a page boundary (``(target - p) % page_size == 0``) — the
+        alignment every paged admission enforces so whole context pages are
+        shareable. Costs < page_size gap columns, invisible to the math."""
+        return base + (-(base - p)) % self.page_size
+
+    def page_span(self, lo_col: int, hi_col: int) -> int:
+        """Pages overlapped by columns [lo_col, hi_col)."""
+        hi_col = min(hi_col, self.max_seq_len)
+        if hi_col <= lo_col:
+            return 0
+        return -(-hi_col // self.page_size) - lo_col // self.page_size
+
+    def active_spans(self) -> List[int]:
+        """Start column of every slot currently holding a context (the
+        admission projection's per-slot page-span inputs)."""
+        return [s for s in self._slot_start if s is not None]
+
+    def available_pages(self) -> int:
+        """Free pages plus what evicting every unpinned-by-flight prefix
+        entry could reclaim (pages pinned by entries and mapped by no
+        slot) — the eager-admission page budget."""
+        reclaimable = sum(
+            1 for pid, pins in self._pins.items()
+            if pins > 0
+            and self.alloc.refcount(pid) == pins
+            and pid not in self.alloc._quarantined
+        )
+        return self.alloc.free_pages + reclaimable
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        while self.alloc.free_pages < n and self.reclaim is not None:
+            if not self.reclaim():
+                break
+        return self.alloc.alloc(n)
+
+    # --- prefix pins (CoW) --------------------------------------------------
+
+    def pin_pages(self, ids: Sequence[int]) -> None:
+        """A prefix entry takes a reference on each page (insert-on-miss:
+        the slot's own context pages become shared storage, zero copies)."""
+        for pid in ids:
+            self.alloc.ref(int(pid))
+            self._pins[int(pid)] = self._pins.get(int(pid), 0) + 1
+
+    def unpin_pages(self, ids: Sequence[int]) -> None:
+        for pid in ids:
+            pid = int(pid)
+            pins = self._pins.get(pid, 0)
+            if pins <= 1:
+                self._pins.pop(pid, None)
+            else:
+                self._pins[pid] = pins - 1
+            self.alloc.deref(pid)
+
+    def pages_live(self, ids: Sequence[int]) -> bool:
+        """Reuse-time validation for a paged prefix entry: every page still
+        allocated, pinned, and un-quarantined (host accounting only — the
+        content never left the pool, so there is nothing to checksum)."""
+        return all(
+            self.alloc.refcount(int(pid)) > 0
+            and self._pins.get(int(pid), 0) > 0
+            and int(pid) not in self.alloc._quarantined
+            for pid in ids
+        )
+
+    def slot_context_pages(self, slot: int, n: int) -> List[int]:
+        """The first ``n`` context pages of a slot (insert-on-miss pins
+        exactly these)."""
+        start = self._slot_start[slot]
+        if start is None:
+            raise ValueError(f"slot {slot} holds no context")
+        lo = start // self.page_size
+        ids = [int(p) for p in self._tables[slot, lo:lo + n]]
+        if any(p == 0 for p in ids):
+            raise ValueError(
+                f"slot {slot} pages {ids} include unmapped entries"
+            )
+        return ids
+
+    def slot_pages(self, slot: int) -> List[int]:
+        row = self._tables[slot]
+        return [int(p) for p in row[row != 0]]
+
+    # --- device-state transitions -------------------------------------------
+
+    def _upload_tables(self) -> None:
+        if self.cache is not None:
+            self.cache = dict(
+                self.cache, pages=jnp.asarray(self._tables)
+            )
+
+    def allocate_from(self, row_cache) -> None:
+        """Build the page pool + block table from a batch-1 prefill row's
+        structure — zeros everywhere; happens exactly once (lazily)."""
+        num_pages, ps = self.alloc.num_pages, self.page_size
+
+        def fn(path, leaf):
+            name = cache_leaf_name(path)
+            ax = cache_batch_axis(name, leaf.ndim)
+            if name in ("k", "v"):
+                lead = leaf.shape[:ax]
+                return jnp.zeros(
+                    lead + (num_pages, ps) + leaf.shape[ax + 2:], leaf.dtype
+                )
+            if name == "kv_valid":
+                lead = leaf.shape[:ax]
+                return jnp.zeros(
+                    lead + (self.num_slots, self.max_seq_len), jnp.bool_
+                )
+            return jnp.zeros_like(leaf)
+
+        pool = jax.tree_util.tree_map_with_path(fn, row_cache)
+        self.cache = {"pages": jnp.asarray(self._tables), "pool": pool}
+
+    def admit(self, row_cache, slot: int, padded_len: int,
+              cursor: Optional[int] = None, p: Optional[int] = None,
+              shared_ids: Sequence[int] = (), m_shared: int = 0) -> None:
+        """Roll a prefill row into ``slot`` at page granularity: map
+        ``shared_ids`` (a CoW prefix hit's pages, ref-counted up — zero KV
+        bytes move) over the first ``m_shared`` context columns, allocate
+        own pages for the rest, scatter ONLY those own pages out of the
+        rolled row, and set the shared cursor to ``cursor``. ``p`` is the
+        real context length (default ``padded_len``); the context start
+        ``cursor - p`` must be page-aligned (``aligned_target``)."""
+        p = padded_len if p is None else p
+        ps, n_log = self.page_size, self.pages_per_row
+        if self.cache is None:
+            if self.cursor > 0:
+                raise RuntimeError(
+                    "cache collection missing mid-flight (cursor "
+                    f"{self.cursor}): a take() was never paired with "
+                    "update_after_decode/restore"
+                )
+            self.allocate_from(row_cache)
+        target = (
+            self.aligned_target(max(self.cursor, padded_len), p)
+            if cursor is None else cursor
+        )
+        if target < padded_len:
+            raise ValueError(
+                f"cursor {target} < padded prefill length {padded_len}: the "
+                "prompt's last token cannot land left of its own start"
+            )
+        start = target - p
+        if start % ps != 0:
+            raise ValueError(
+                f"context start {start} not page-aligned (page_size {ps}) — "
+                "use aligned_target for the cursor"
+            )
+        if m_shared % ps != 0 or m_shared > p:
+            raise ValueError(
+                f"m_shared ({m_shared}) must be a page multiple <= p ({p})"
+            )
+        n_sh = m_shared // ps
+        if len(shared_ids) < n_sh:
+            raise ValueError(
+                f"{n_sh} shared pages needed, got {len(shared_ids)}"
+            )
+        if (self._tables[slot] != 0).any():
+            raise ValueError(f"slot {slot} still maps pages (not freed?)")
+        own_lo = (start + m_shared) // ps
+        n_own = -(-(p - m_shared) // ps)
+        own = self._alloc_pages(n_own)
+        s0 = start // ps
+        for j in range(n_sh):
+            pid = int(shared_ids[j])
+            self.alloc.ref(pid)
+            self._tables[slot, s0 + j] = pid
+        self.prefix_pages_shared_total += n_sh
+        for j, pid in enumerate(own):
+            self._tables[slot, own_lo + j] = pid
+        self._slot_start[slot] = start
+        # device roll-in: one compiled program per (row bucket, n_adm)
+        n_adm = min(padded_len // ps + 1, n_log)
+        lo_c = min(own_lo, n_log - n_adm)
+        ids_arr = np.zeros((n_adm,), np.int32)
+        for j, pid in enumerate(own):
+            ids_arr[own_lo - lo_c + j] = pid
+        self.cache = self._admit_fn(
+            self.cache, row_cache,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(target - padded_len, jnp.int32),
+            jnp.asarray(target, jnp.int32),
+            jnp.asarray(ids_arr),
+            jnp.asarray(lo_c, jnp.int32),
+        )
+        self.cursor = target
+        self._upload_tables()
+
+    def seed_row(self, page_ids: Sequence[int], m: int, start: int):
+        """Batch-1 row whose columns [start, start+m) read the shared pages
+        — the zero-copy prefix hit's suffix-prefill substrate. Pool pages
+        are gathered for COMPUTE only (nothing allocated, nothing written;
+        ``PageAllocator.copy_bytes`` untouched)."""
+        if self.cache is None:
+            raise RuntimeError("no cache allocated yet (nothing to seed from)")
+        return self._seed_fn(
+            self.cache["pool"],
+            jnp.asarray(np.asarray(page_ids, np.int32)),
+            jnp.asarray(m, jnp.int32),
+            jnp.asarray(start, jnp.int32),
+        )
+
+    def ensure_decode_window(self, active_slots, width: int) -> bool:
+        """Map real pages under every active slot's next write window
+        (columns ``[cursor, cursor + width)``) before a chunk dispatch.
+        Returns False when the pool cannot cover it even after reclaiming
+        prefix entries — the page-pressure wall (the engine preempts and
+        rewinds, exactly like the cursor wall)."""
+        if self.cache is None or len(active_slots) == 0:
+            return True
+        ps, n_log = self.page_size, self.pages_per_row
+        lo = self.cursor // ps
+        hi = min(n_log, -(-(self.cursor + width) // ps))
+        need = [
+            (int(s), j)
+            for s in active_slots
+            for j in range(lo, hi)
+            if self._tables[int(s), j] == 0
+        ]
+        if not need:
+            return True
+        try:
+            ids = self._alloc_pages(len(need))
+        except PageExhausted:
+            return False
+        for (s, j), pid in zip(need, ids):
+            self._tables[s, j] = pid
+        self._upload_tables()
+        return True
+
+    def free(self, slot: int) -> None:
+        """Clear the slot's validity row, deref every page it maps (shared
+        pages survive through their other holders/pins; exclusive pages
+        return to the free list immediately), and return the slot to the
+        rotation."""
+        if self.cache is not None:
+            self.cache = self._free_fn(self.cache, jnp.asarray(slot, jnp.int32))
+        row = self._tables[slot]
+        for pid in row[row != 0]:
+            self.alloc.deref(int(pid))
+        self._tables[slot] = 0
+        self._slot_start[slot] = None
+        self._upload_tables()
+        if slot not in self._quarantined and slot not in self._free:
+            self._free.append(slot)
+            self._free.sort()
+
+    def take(self):
+        cache, self.cache = self.cache, None
+        return cache
+
+    def restore(self, cache) -> None:
+        self.cache = cache
+
+    def _release_all_mappings(self) -> None:
+        for slot in range(self.num_slots):
+            row = self._tables[slot]
+            for pid in row[row != 0]:
+                self.alloc.deref(int(pid))
+            self._tables[slot] = 0
+            self._slot_start[slot] = None
+
+    def recover(self, cache) -> bool:
+        """Post-failed-dispatch salvage (the SlotCacheManager contract):
+        every slot has been vacated, so all block-table mappings are
+        dropped either way; surviving storage is invalidated in place,
+        consumed storage falls to lazy reallocation. Prefix pins are the
+        ENGINE's to resolve: on pool loss it clears the store, whose
+        eviction hook releases every pin (the pinned content is gone)."""
+        consumed = cache is None or any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree_util.tree_leaves(cache)
+        )
+        self.cursor = 0
+        self._release_all_mappings()
+        if consumed:
+            self.cache = None
+            return False
+        self.cache = self._reset_fn(cache)
+        self._upload_tables()
+        return True
+
+    def release_all_slots(self) -> None:
+        self._free = [
+            s for s in range(self.num_slots) if s not in self._quarantined
+        ]
+
+    def update_after_decode(self, new_cache, steps: int = 1) -> None:
+        self.cache = new_cache
+        self.cursor += steps
+
+    def reset(self) -> None:
+        """Rewind the cursor, invalidate every slot's context, and release
+        every block-table mapping (drain / preemption — pages flow back to
+        the free list unless a prefix pin or another holder keeps them;
+        pinned page CONTENT is untouched, so entries stay servable)."""
+        self.cursor = 0
+        self._release_all_mappings()
+        if self.cache is not None:
+            self.cache = self._reset_fn(self.cache)
+            self._upload_tables()
+
+    # --- invariants ---------------------------------------------------------
+
+    def check(self) -> None:
+        """The page-leak/ref-count invariant: every page is exactly one of
+        free / table-mapped / prefix-pinned / quarantined / reserved, ref
+        counts reconcile with the mappers + pins, no slot double-maps a
+        page, and the free list is duplicate-free. AssertionError with the
+        offending page on any violation."""
+        a = self.alloc
+        free = set(a._free)
+        assert len(free) == len(a._free), "free list has duplicates"
+        assert 0 not in free and 0 not in a._refs and 0 not in self._pins, (
+            "reserved null page 0 entered circulation"
+        )
+        mapped: Dict[int, int] = {}
+        for s in range(self.num_slots):
+            row = [int(p) for p in self._tables[s] if p != 0]
+            assert len(row) == len(set(row)), (
+                f"slot {s} double-maps a page: {row}"
+            )
+            for pid in row:
+                mapped[pid] = mapped.get(pid, 0) + 1
+        for pid in range(1, a.num_pages):
+            expect = mapped.get(pid, 0) + self._pins.get(pid, 0)
+            have = a.refcount(pid)
+            assert have == expect, (
+                f"page {pid}: refcount {have} != mapped({mapped.get(pid, 0)})"
+                f" + pinned({self._pins.get(pid, 0)})"
+            )
+            states = [
+                pid in free,
+                expect > 0,
+                pid in a._quarantined and expect == 0,
+            ]
+            assert sum(states) == 1, (
+                f"page {pid} is not exactly one of free/referenced/"
+                f"quarantined: free={pid in free} refs={have} "
+                f"quarantined={pid in a._quarantined}"
+            )

@@ -1,0 +1,16 @@
+"""Serving-suite teardown invariant: after EVERY test in this directory,
+run the page-leak/ref-count checker over every live paged cache manager —
+a test that leaks a page ref, double-maps a page, or frees a still-pinned
+page fails HERE even if its own assertions passed (the ISSUE 10 allocator
+contract: every page is free, table-mapped, prefix-pinned, or quarantined;
+never orphaned, never double-booked)."""
+
+import pytest
+
+from neuronx_distributed_tpu.serving.paging import check_all_live
+
+
+@pytest.fixture(autouse=True)
+def _page_invariants():
+    yield
+    check_all_live()

@@ -1,0 +1,408 @@
+"""Paged KV cache (ISSUE 10): block-table attention + zero-copy CoW prefix
+sharing.
+
+The load-bearing contracts, each pinned here:
+
+* allocator algebra — alloc/ref/deref/quarantine and the ``check()``
+  invariant actually catching orphans, double-maps, and bad refcounts;
+* streams BIT-IDENTICAL to the row-per-slot engine for plain greedy,
+  sampled, mixed-length staggered traffic, prefix hits, and speculative
+  decode — the paged chunk is the same program over a gathered view;
+* ``decode_compilations == 1`` across block-table layouts (tables are
+  data, not shape);
+* prefix hits copy ZERO KV bytes, asserted via allocator accounting
+  (``copy_bytes`` never moves; ``prefix_pages_shared`` does);
+* free-page admission: a pool a fraction of the row-equivalent HBM still
+  serves mixed-length traffic the row manager could not hold concurrently,
+  and the permanently-unplaceable rejection stays exact.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig, generate
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.serving import (
+    PageAllocator,
+    PagedCacheManager,
+    PageExhausted,
+    PrefixCache,
+    RequestState,
+    ServingEngine,
+)
+
+PS = 8  # page size used throughout
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+# --- PageAllocator ------------------------------------------------------------
+
+
+def test_allocator_alloc_ref_deref_roundtrip():
+    a = PageAllocator(8)  # pages 1..7 usable
+    assert a.free_pages == 7 and a.capacity == 7
+    ids = a.alloc(3)
+    assert len(ids) == 3 and 0 not in ids
+    assert a.free_pages == 4 and all(a.refcount(p) == 1 for p in ids)
+    a.ref(ids[0])
+    a.deref(ids[0])
+    assert a.refcount(ids[0]) == 1  # still held by the original mapping
+    for p in ids:
+        a.deref(p)
+    assert a.free_pages == 7 and a.referenced_pages == 0
+
+
+def test_allocator_exhaustion_and_quarantine():
+    a = PageAllocator(4)
+    ids = a.alloc(3)
+    with pytest.raises(PageExhausted):
+        a.alloc(1)
+    a.quarantine(ids[0])  # referenced: retires on last deref
+    a.deref(ids[0])
+    assert a.capacity == 2 and a.free_pages == 0
+    a.deref(ids[1])
+    a.deref(ids[2])
+    assert a.free_pages == 2  # the quarantined page never came back
+    with pytest.raises(ValueError):
+        a.ref(ids[0])  # dead page cannot be re-referenced
+
+
+def test_allocator_reserved_null_page():
+    a = PageAllocator(4)
+    assert 0 not in a.alloc(3)
+    with pytest.raises(ValueError):
+        a.quarantine(0)
+
+
+def test_manager_check_catches_leaks_and_double_maps():
+    mgr = PagedCacheManager(num_slots=2, max_seq_len=32, page_size=PS)
+    mgr.check()  # empty: fine
+    ids = mgr.alloc.alloc(2)
+    with pytest.raises(AssertionError, match="refcount"):
+        mgr.check()  # allocated but mapped/pinned nowhere = leak
+    mgr._tables[0, 0], mgr._tables[0, 1] = ids
+    mgr.check()
+    mgr._tables[1, 0] = ids[0]  # second mapper without a ref
+    with pytest.raises(AssertionError, match="refcount"):
+        mgr.check()
+    mgr.alloc.ref(ids[0])
+    mgr.check()
+    mgr._tables[1, 1] = ids[0]  # one slot, same page twice
+    with pytest.raises(AssertionError, match="double-maps"):
+        mgr.check()
+    # clean up so the suite-wide teardown fixture stays green
+    mgr._tables[:] = 0
+    mgr.alloc.deref(ids[0])
+    for p in ids:
+        mgr.alloc.deref(p)
+    mgr.check()
+
+
+def test_manager_geometry_validation():
+    with pytest.raises(ValueError, match="multiple"):
+        PagedCacheManager(num_slots=2, max_seq_len=30, page_size=PS)
+    m = PagedCacheManager(num_slots=2, max_seq_len=32, page_size=PS)
+    assert m.pages_per_row == 4
+    # default pool = row-equivalent HBM + the reserved null page
+    assert m.alloc.num_pages == 2 * 4 + 1
+    assert m.aligned_target(10, 6) == 14  # (14-6) % 8 == 0
+    assert m.aligned_target(8, 8) == 8
+    assert m.page_span(0, 17) == 3 and m.page_span(8, 16) == 1
+
+
+# --- stream bit-identity across layouts ---------------------------------------
+
+
+def _run_engine(model, params, prompts, gcfg, keys, **kw):
+    eng = ServingEngine(model, params, **kw)
+    reqs = [
+        eng.submit(p, gcfg, key=k) for p, k in zip(prompts, keys)
+    ]
+    eng.run()
+    return eng, [r.tokens for r in reqs]
+
+
+def test_streams_bit_identical_mixed_lengths(setup):
+    """Plain greedy + sampled mixed-length staggered traffic: the paged
+    engine's streams equal the row engine's AND solo generate()'s."""
+    cfg, model, params = setup
+    rng = np.random.RandomState(7)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=n).astype(np.int32)
+        for n in (5, 23, 9, 14, 3, 31)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=9, temperature=0.8, top_k=17)
+    keys = [jax.random.PRNGKey(40 + i) for i in range(len(prompts))]
+    _, row_toks = _run_engine(
+        model, params, prompts, gcfg, keys,
+        num_slots=3, decode_chunk_size=4, prefix_cache=None,
+    )
+    pg, pg_toks = _run_engine(
+        model, params, prompts, gcfg, keys,
+        num_slots=3, decode_chunk_size=4, prefix_cache=None, kv_page_size=PS,
+    )
+    assert pg_toks == row_toks
+    solo = np.asarray(
+        generate(
+            model, params, jax.numpy.asarray(prompts[0])[None], keys[0], gcfg
+        )
+    )[0].tolist()
+    assert pg_toks[0] == solo
+    assert pg.decode_compilations == 1
+    pg.cache.check()
+
+
+def test_decode_compilations_stay_one_across_table_layouts(setup):
+    """Three waves with drain/rewind between them churn the block tables
+    through disjoint physical pages — the table is DATA, so XLA still
+    compiled exactly one decode program."""
+    cfg, model, params = setup
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=None, kv_page_size=PS,
+    )
+    gcfg = GenerationConfig(max_new_tokens=5, temperature=0.0)
+    for wave in range(3):
+        for i in range(3):
+            eng.submit(
+                np.arange(1 + i, 7 + wave + 2 * i, dtype=np.int32), gcfg,
+                key=jax.random.PRNGKey(wave * 10 + i),
+            )
+        eng.run()
+    assert eng.decode_compilations == 1
+    assert eng.metrics.snapshot()["completed"] == 9
+    eng.cache.check()
+
+
+def test_speculative_paged_streams_match_row(setup):
+    cfg, model, params = setup
+    draft = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    d_params = draft.init(jax.random.PRNGKey(9), ids)
+    prompts = [
+        np.arange(1, 8, dtype=np.int32), np.arange(4, 17, dtype=np.int32)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    keys = [jax.random.PRNGKey(60 + i) for i in range(2)]
+    kw = dict(
+        num_slots=2, decode_chunk_size=3, draft_model=draft,
+        draft_params=d_params, gamma=3, prefix_cache=None,
+    )
+    _, row_toks = _run_engine(model, params, prompts, gcfg, keys, **kw)
+    pg, pg_toks = _run_engine(
+        model, params, prompts, gcfg, keys, kv_page_size=PS, **kw
+    )
+    assert pg_toks == row_toks
+    assert pg.decode_compilations == 1
+    pg.cache.check()
+    pg.draft_cache.check()
+
+
+def test_preemption_resume_bit_identical(setup):
+    """Eager admission with a short row: the paged engine hits the wall
+    (alignment gaps spend columns faster), preempts, and resumes — streams
+    still equal the row engine's."""
+    cfg, model, params = setup
+    cfg2 = dataclasses.replace(cfg, max_seq_len=32)
+    model2 = LlamaForCausalLM(cfg2, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params2 = model2.init(jax.random.PRNGKey(1), ids)
+    prompts = [
+        np.arange(1, 9, dtype=np.int32), np.arange(2, 12, dtype=np.int32)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.6, top_k=11)
+    keys = [jax.random.PRNGKey(70 + i) for i in range(2)]
+    kw = dict(
+        num_slots=2, decode_chunk_size=4, admission="eager",
+        prefix_cache=None,
+    )
+    _, row_toks = _run_engine(model2, params2, prompts, gcfg, keys, **kw)
+    pg, pg_toks = _run_engine(
+        model2, params2, prompts, gcfg, keys, kv_page_size=PS, **kw
+    )
+    assert pg_toks == row_toks
+    assert pg.metrics.snapshot()["preemptions"] > 0  # the wall actually hit
+    pg.cache.check()
+
+
+# --- zero-copy CoW prefix sharing ---------------------------------------------
+
+
+def test_prefix_hit_is_zero_copy_and_bit_identical(setup):
+    """Shared-system-prompt traffic: hits map pool pages into the new
+    slot's table (ref-counted), allocator ``copy_bytes`` stays 0, streams
+    equal the prefix-off and row engines."""
+    cfg, model, params = setup
+    sys_p = np.arange(1, 18, dtype=np.int32)  # 17 tokens -> 2 whole pages
+    rng = np.random.RandomState(3)
+    prompts = [
+        np.concatenate([
+            sys_p, rng.randint(1, cfg.vocab_size, size=4 + i).astype(np.int32)
+        ])
+        for i in range(4)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    keys = [jax.random.PRNGKey(80 + i) for i in range(4)]
+    _, off_toks = _run_engine(
+        model, params, prompts, gcfg, keys,
+        num_slots=2, decode_chunk_size=4, prefix_cache=None, kv_page_size=PS,
+    )
+    _, row_toks = _run_engine(
+        model, params, prompts, gcfg, keys,
+        num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(min_match=8),
+    )
+    pg, pg_toks = _run_engine(
+        model, params, prompts, gcfg, keys,
+        num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(min_match=8), kv_page_size=PS,
+    )
+    assert pg_toks == off_toks == row_toks
+    snap = pg.metrics.snapshot()
+    assert snap["prefix_hits"] >= 3
+    assert snap["prefix_pages_shared"] >= snap["prefix_hits"] * 2
+    # THE zero-copy assertion: allocator accounting, not timing
+    assert pg.cache.alloc.copy_bytes == 0
+    # entries hold pins, shared pages hold multiple refs while decoding
+    assert pg.cache.prefix_pages_shared_total >= 6
+    pg.cache.check()
+
+
+def test_prefix_insert_pins_pages_and_eviction_releases(setup):
+    cfg, model, params = setup
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(max_entries=8, min_match=8), kv_page_size=PS,
+    )
+    gcfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    r = eng.submit(np.arange(1, 20, dtype=np.int32), gcfg,
+                   key=jax.random.PRNGKey(0))
+    eng.run()
+    assert r.state is RequestState.DONE
+    entries = eng.prefix.entries
+    assert len(entries) == 1 and entries[0].page_ids
+    pinned = entries[0].page_ids
+    # the slot retired, but the entry keeps its pages alive
+    assert all(eng.cache.alloc.refcount(p) == 1 for p in pinned)
+    eng.cache.check()
+    # eviction releases them (on_evict hook)
+    eng.prefix.evict_entry(entries[0])
+    assert all(eng.cache.alloc.refcount(p) == 0 for p in pinned)
+    eng.cache.check()
+
+
+def test_weight_swap_clears_paged_entries_and_pins(setup):
+    cfg, model, params = setup
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(min_match=8), kv_page_size=PS,
+    )
+    gcfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    eng.submit(np.arange(1, 20, dtype=np.int32), gcfg,
+               key=jax.random.PRNGKey(0))
+    eng.run()
+    assert len(eng.prefix) == 1
+    eng.params = params  # swap clears the store; pins must release
+    assert len(eng.prefix) == 0
+    assert eng.cache.alloc.referenced_pages == 0
+    eng.cache.check()
+
+
+# --- free-page admission accounting -------------------------------------------
+
+
+def test_small_pool_serves_more_slots_than_row_equivalent(setup):
+    """Fixed KV budget of ONE row-equivalent (16 pages = 128 columns): the
+    paged engine runs 4 short requests CONCURRENTLY where the row manager
+    could hold exactly 1 slot at that budget."""
+    cfg, model, params = setup
+    eng = ServingEngine(
+        model, params, num_slots=4, decode_chunk_size=4, prefix_cache=None,
+        kv_page_size=PS, kv_num_pages=cfg.max_seq_len // PS + 1,
+    )
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    reqs = [
+        eng.submit(np.arange(1, 5 + i, dtype=np.int32), gcfg,
+                   key=jax.random.PRNGKey(i))
+        for i in range(4)
+    ]
+    eng.run()
+    assert all(r.state is RequestState.DONE and len(r.tokens) == 8
+               for r in reqs)
+    assert eng.metrics.snapshot()["mean_occupancy"] == 4.0
+    eng.cache.check()
+
+
+def test_unplaceable_page_footprint_rejected_at_submit(setup):
+    """The up-front permanently-unplaceable rejection stays exact: a
+    request whose solo worst-case page footprint exceeds the pool fails at
+    the door; one page under the line is accepted."""
+    cfg, model, params = setup
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None,
+        kv_page_size=PS, kv_num_pages=5,  # 4 usable pages = 32 columns
+    )
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    with pytest.raises(ValueError, match="KV pages"):
+        eng.submit(np.arange(1, 27, dtype=np.int32), gcfg)  # 26 + 8 > 32
+    r = eng.submit(np.arange(1, 24, dtype=np.int32), gcfg,
+                   key=jax.random.PRNGKey(0))  # 23 + 8 = 31 <= 32: placeable
+    eng.run()
+    assert r.state is RequestState.DONE and len(r.tokens) == 8
+    eng.cache.check()
+
+
+@pytest.mark.parametrize("admission", ["conservative", "eager"])
+def test_minimal_pool_short_tail_completes(setup, admission):
+    """Review regression: the per-chunk page window is clamped to the
+    active slots' REMAINING work, so a request the door check admits into
+    a minimal pool (2 pages) completes instead of livelocking at the
+    page-pressure wall when decode_chunk_size alone would demand more
+    window pages than it was ever charged for."""
+    cfg, model, params = setup
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=8, prefix_cache=None,
+        kv_page_size=4, kv_num_pages=3, admission=admission,
+    )
+    r = eng.submit(
+        np.arange(1, 5, dtype=np.int32),
+        GenerationConfig(max_new_tokens=2, temperature=0.0),
+        key=jax.random.PRNGKey(0),
+    )
+    eng.run(max_steps=50)
+    assert r.state is RequestState.DONE and len(r.tokens) == 2
+    assert eng.metrics.snapshot()["preemptions"] == 0
+    eng.cache.check()
+
+
+def test_conservative_admission_queues_on_page_pressure(setup):
+    """Two placeable-but-not-together requests: the second queues until
+    the first retires (no preemption on the conservative path), then runs."""
+    cfg, model, params = setup
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None,
+        kv_page_size=PS, kv_num_pages=7,  # 6 usable pages = 48 columns
+    )
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    r1 = eng.submit(np.arange(1, 24, dtype=np.int32), gcfg,
+                    key=jax.random.PRNGKey(0))
+    r2 = eng.submit(np.arange(1, 20, dtype=np.int32), gcfg,
+                    key=jax.random.PRNGKey(1))
+    eng.step()
+    assert r1.state is RequestState.DECODE
+    assert r2.state is RequestState.QUEUED  # pages would not cover both
+    eng.run()
+    assert r1.state is RequestState.DONE and r2.state is RequestState.DONE
+    assert eng.metrics.snapshot()["preemptions"] == 0
+    eng.cache.check()

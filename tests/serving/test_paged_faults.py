@@ -1,0 +1,255 @@
+"""Paged-KV chaos coverage (ISSUE 10 satellites): page-granular poison
+quarantine, page-ref release on recovery/halt, CoW-pressure eviction
+safety, and the host-sync budget re-pinned with paging on.
+
+Every test drives the engine through deterministic ``FaultInjector``
+schedules; the suite-level teardown fixture additionally runs the
+page-leak invariant after each one."""
+
+import jax
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.serving import (
+    FaultInjector,
+    PrefixCache,
+    RequestState,
+    ServingEngine,
+)
+
+PS = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+def _clean_streams(model, params, prompts, gcfg, keys, **kw):
+    eng = ServingEngine(model, params, prefix_cache=None, kv_page_size=PS,
+                        **kw)
+    reqs = [eng.submit(p, gcfg, key=k) for p, k in zip(prompts, keys)]
+    eng.run()
+    return [r.tokens for r in reqs]
+
+
+def test_poisoned_page_quarantines_only_mapping_requests(setup):
+    """One poisoned page: its victim is requeued and resumes BIT-IDENTICALLY
+    in fresh pages, the neighbor's stream is untouched, the page is retired
+    (capacity -1) but the slot index stays in rotation."""
+    cfg, model, params = setup
+    prompts = [
+        np.arange(1, 7, dtype=np.int32), np.arange(3, 12, dtype=np.int32)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=10, temperature=0.7, top_k=9)
+    keys = [jax.random.PRNGKey(i) for i in range(2)]
+    ref = _clean_streams(model, params, prompts, gcfg, keys,
+                         num_slots=2, decode_chunk_size=4)
+    inj = FaultInjector().poison_page(at=0, slot=0)
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None,
+        kv_page_size=PS, fault_injector=inj,
+    )
+    reqs = [eng.submit(p, gcfg, key=k) for p, k in zip(prompts, keys)]
+    eng.run()
+    assert inj.counters["poisoned_pages"] == 1  # the schedule really fired
+    assert [r.tokens for r in reqs] == ref
+    assert all(r.state is RequestState.DONE for r in reqs)
+    snap = eng.metrics.snapshot()
+    assert snap["page_quarantines"] == 1
+    assert snap["quarantines"] == 0  # no SLOT was lost
+    assert eng.cache.usable_slots == 2
+    assert eng.cache.alloc.pages_quarantined == 1
+    assert eng.health().value == "degraded"
+
+
+def test_poisoned_shared_page_requeues_all_cow_holders(setup):
+    """Poisoning a page SHARED copy-on-write by two decoding requests
+    requeues both (they map it), evicts the prefix entry pinning it, and
+    leaves an un-sharing neighbor alone."""
+    cfg, model, params = setup
+    sys_p = np.arange(1, 18, dtype=np.int32)  # 2 whole shared pages
+    prompts = [
+        np.concatenate([sys_p, np.arange(50, 54, dtype=np.int32)]),
+        np.concatenate([sys_p, np.arange(60, 66, dtype=np.int32)]),
+        np.arange(70, 78, dtype=np.int32),  # no shared prefix
+    ]
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.0)
+    keys = [jax.random.PRNGKey(20 + i) for i in range(3)]
+
+    def run(injector):
+        eng = ServingEngine(
+            model, params, num_slots=3, decode_chunk_size=4,
+            prefix_cache=PrefixCache(min_match=8), kv_page_size=PS,
+            fault_injector=injector,
+        )
+        reqs = [eng.submit(p, gcfg, key=k) for p, k in zip(prompts, keys)]
+        eng.run()
+        return eng, reqs
+
+    _, clean = run(None)
+    ref = [r.tokens for r in clean]
+    # readback 1: by then request 0 inserted the prefix and request 1 hit
+    # it — slot 1's FIRST mapped page is the shared one
+    inj = FaultInjector().poison_page(at=1, slot=1)
+    eng, reqs = run(inj)
+    assert inj.counters["poisoned_pages"] == 1
+    assert [r.tokens for r in reqs] == ref
+    snap = eng.metrics.snapshot()
+    assert snap["page_quarantines"] == 1
+    assert snap["prefix_hits"] >= 1
+    # the entry pinning the poisoned page is gone (its content is suspect)
+    assert all(
+        not (e.page_ids and any(
+            p in eng.cache.alloc._quarantined for p in e.page_ids
+        ))
+        for e in (eng.prefix.entries if eng.prefix else [])
+    )
+
+
+def test_recovery_and_halt_release_all_page_refs(setup):
+    """A consumed-buffer dispatch failure releases every slot mapping; an
+    exhausted retry budget HALTs with the work requeued and zero pages
+    mapped (entry pins cleared with the lost pool)."""
+    cfg, model, params = setup
+    gcfg = GenerationConfig(max_new_tokens=10, temperature=0.0)
+    # transient failure -> recovery, stream bit-identical
+    ref = _clean_streams(
+        model, params, [np.arange(1, 9, dtype=np.int32)], gcfg,
+        [jax.random.PRNGKey(0)], num_slots=2, decode_chunk_size=4,
+    )
+    inj = FaultInjector().fail_dispatch(at=1, times=1)
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(min_match=4), kv_page_size=PS,
+        fault_injector=inj, sleep_fn=lambda s: None,
+    )
+    r = eng.submit(np.arange(1, 9, dtype=np.int32), gcfg,
+                   key=jax.random.PRNGKey(0))
+    eng.run()
+    assert r.tokens == ref[0]
+    assert eng.metrics.snapshot()["recoveries"] == 1
+    # permanent failure -> HALT; requeued work keeps its tokens, no page
+    # stays mapped, no pin survives a lost pool
+    inj2 = FaultInjector().fail_dispatch(at=0, times=None)
+    eng2 = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(min_match=4), kv_page_size=PS,
+        fault_injector=inj2, sleep_fn=lambda s: None,
+    )
+    r2 = eng2.submit(np.arange(1, 9, dtype=np.int32), gcfg,
+                     key=jax.random.PRNGKey(0))
+    eng2.run()
+    assert eng2.health().value == "halted"
+    assert not r2.finished and r2.state is RequestState.QUEUED
+    assert eng2.cache.pages_mapped == 0
+
+
+def test_cow_eviction_never_frees_still_mapped_page(setup):
+    """Evicting a prefix entry while a CoW hitter is still decoding off
+    its pages drops only the ENTRY's refs — the hitter's block-table
+    mapping keeps the pages alive and its stream completes bit-identically
+    (a premature free would also trip the suite's teardown invariant)."""
+    cfg, model, params = setup
+    sys_p = np.arange(1, 18, dtype=np.int32)
+    donor = np.concatenate([sys_p, np.arange(40, 44, dtype=np.int32)])
+    hitter = np.concatenate([sys_p, np.arange(50, 56, dtype=np.int32)])
+    gcfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    long_cfg = GenerationConfig(max_new_tokens=16, temperature=0.0)
+    eng_ref = ServingEngine(model, params, num_slots=2, decode_chunk_size=4,
+                            prefix_cache=None, kv_page_size=PS)
+    eng_ref.submit(donor, gcfg, key=jax.random.PRNGKey(1))
+    r_ref = eng_ref.submit(hitter, long_cfg, key=jax.random.PRNGKey(2))
+    eng_ref.run()
+
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(min_match=8), kv_page_size=PS,
+    )
+    eng.submit(donor, gcfg, key=jax.random.PRNGKey(1))
+    r = eng.submit(hitter, long_cfg, key=jax.random.PRNGKey(2))
+    eng.step()  # both admitted; the hitter shares the entry's pages
+    assert eng.metrics.snapshot()["prefix_hits"] == 1
+    entry = eng.prefix.entries[0]
+    shared = entry.page_ids
+    assert shared and not r.finished
+    assert all(eng.cache.alloc.refcount(p) >= 2 for p in shared)
+    eng.prefix.evict_entry(entry)  # CoW pressure: entry goes, holder stays
+    assert all(eng.cache.alloc.refcount(p) >= 1 for p in shared), (
+        "eviction freed a page a decoding slot still maps"
+    )
+    eng.run()
+    assert r.state is RequestState.DONE and r.tokens == r_ref.tokens
+    assert eng.cache.alloc.copy_bytes == 0
+
+
+def test_page_pressure_reclaims_prefix_entries(setup):
+    """Organic pressure: a pool sized so a later full prefill cannot fit
+    while retired entries pin pages — the admission reclaims (evicts) them
+    instead of failing, and the request runs to completion."""
+    cfg, model, params = setup
+    sys_p = np.arange(1, 18, dtype=np.int32)
+    first = np.concatenate([sys_p, np.arange(40, 44, dtype=np.int32)])
+    big = np.arange(60, 100, dtype=np.int32)  # 40 tokens, 5 own pages
+    gcfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    eng = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(min_match=8), kv_page_size=PS,
+        kv_num_pages=7,  # 6 usable pages
+    )
+    r1 = eng.submit(first, gcfg, key=jax.random.PRNGKey(0))
+    eng.run()
+    assert r1.state is RequestState.DONE
+    assert len(eng.prefix) == 1  # entry pinned: 2 of 6 pages held
+    r2 = eng.submit(big, gcfg, key=jax.random.PRNGKey(1))
+    eng.run()
+    assert r2.state is RequestState.DONE and len(r2.tokens) == 4
+    assert eng.metrics.snapshot()["prefix_evictions"] >= 1
+    # the sys-prompt entry was reclaimed (the big context inserted its own)
+    assert eng.prefix.match_len(first) == 0
+    assert eng.cache.alloc.copy_bytes == 0
+
+
+def test_host_sync_budget_pinned_with_paging_on(setup):
+    """The GL02 budgets hold with paging: submit=1 (key capture),
+    admission step=2 (first-token pair + chunk readback), steady chunk=1.
+    Block-table refresh is host->device and costs no sync."""
+    cfg, model, params = setup
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=PrefixCache(min_match=4), kv_page_size=PS,
+    )
+    real = jax.device_get
+    calls = [0]
+
+    def counting(x):
+        calls[0] += 1
+        return real(x)
+
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    jax.device_get = counting
+    try:
+        calls[0] = 0
+        req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(7))
+        assert calls[0] == 1, f"paged submit must stay 1 sync, saw {calls[0]}"
+        calls[0] = 0
+        engine.step()
+        assert calls[0] == 2, (
+            f"paged admission step must stay 2 syncs, saw {calls[0]}"
+        )
+        calls[0] = 0
+        engine.step()
+        assert calls[0] == 1, (
+            f"paged steady chunk must stay 1 sync, saw {calls[0]}"
+        )
+    finally:
+        jax.device_get = real
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 12
