@@ -59,6 +59,7 @@ TRAIN_FAULTS_TIMEOUT_S = 420
 OBSERVE_TIMEOUT_S = 300
 SPEC_TIMEOUT_S = 540
 PAGED_TIMEOUT_S = 540
+TRAFFIC_TIMEOUT_S = 540
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -1381,6 +1382,136 @@ def _measure_observability(devs):
     }
 
 
+def _measure_traffic(devs):
+    """SLO observability under realistic load (``--child-traffic``): the
+    SAME two-tenant workload (interactive chat under a tight SLO, batch
+    long-doc under a loose one) replayed through the engine under Poisson
+    AND bursty/diurnal arrivals on a virtual clock. Reports per-tenant
+    p50/p99 TTFT, TPOT, goodput, and SLO attainment — and proves the
+    whole pipeline is DETERMINISTIC by running every scenario twice from
+    the same seed and comparing the reports byte-for-byte (the property
+    that makes the harness a judge for scheduler/cache changes: a perf
+    diff is a real diff, not replay noise)."""
+    import dataclasses
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.observability import SLOSpec
+    from neuronx_distributed_tpu.serving import (
+        ServingEngine,
+        TenantProfile,
+        VirtualClock,
+        generate_tape,
+        replay,
+        tape_bytes,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+
+    # virtual-time budget: step_dt=0.05 makes 3 slots × chunk 4 ≈ 12 req/s
+    # of service capacity, so the bursty peak (4 rps × 4) actually queues —
+    # attainment must be measured where the SLO can fail, or it measures
+    # nothing
+    STEP_DT = 0.05
+    slo = {
+        "chat": SLOSpec(ttft_p99_s=0.15, tpot_p99_s=0.02),
+        "docs": SLOSpec(ttft_p99_s=1.00, tpot_p99_s=0.05),
+    }
+
+    def tenants(arrival):
+        return [
+            TenantProfile(
+                "chat", rate_rps=4.0, arrival=arrival, workload="chat",
+                priority="interactive", burst_factor=4.0,
+                burst_period_s=4.0, burst_duty=0.25, deadline_s=2.0,
+            ),
+            TenantProfile(
+                "docs", rate_rps=1.0, arrival=arrival, workload="longdoc",
+                priority="batch",
+            ),
+        ]
+
+    def run_once(tape):
+        clock = VirtualClock()
+        engine = ServingEngine(
+            model, params, num_slots=3, decode_chunk_size=4,
+            admission="eager", prefix_cache=None, slo=slo,
+            timeline=None, flight_recorder=None,
+            time_fn=clock, sleep_fn=lambda s: None,
+        )
+        report = replay(engine, tape, clock, step_dt=STEP_DT)
+        report["decode_compilations"] = engine.decode_compilations
+        return report
+
+    out = {"step_dt_s": STEP_DT, "slo_specs": {
+        t: dataclasses.asdict(s) for t, s in sorted(slo.items())
+    }}
+    deterministic = True
+    for arrival in ("poisson", "bursty"):
+        tape = generate_tape(
+            tenants(arrival), duration_s=6.0, seed=7,
+            vocab_size=cfg.vocab_size,
+        )
+        tape_again = generate_tape(
+            tenants(arrival), duration_s=6.0, seed=7,
+            vocab_size=cfg.vocab_size,
+        )
+        raw = tape_bytes(tape)
+        tape_identical = raw == tape_bytes(tape_again)
+        first = run_once(tape)
+        second = run_once(tape)
+        same = json.dumps(first, sort_keys=True) == json.dumps(
+            second, sort_keys=True
+        )
+        deterministic = deterministic and same and tape_identical
+        out[arrival] = {
+            **first,
+            "tape_arrivals": len(tape),
+            "tape_sha256": hashlib.sha256(raw).hexdigest()[:16],
+            "tape_identical_across_gens": tape_identical,
+            "report_identical_across_runs": same,
+        }
+    out["deterministic"] = deterministic
+    return out
+
+
+def child_traffic() -> None:
+    """Traffic-replay child (``--child-traffic``): per-tenant SLO report
+    under Poisson + bursty arrivals, determinism-checked. Prints one JSON
+    line; merged into the BENCH artifact as ``extras.serving_traffic``."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_traffic",
+                "unit": "per-tenant SLO attainment/goodput (virtual clock)",
+                "platform": devs[0].platform,
+                **_measure_traffic(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_traffic",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
 def child_sweep() -> None:
     """Remat-policy × batch MFU sweep on the real chip (VERDICT r4 next #1b):
     the r2 record (MFU 0.492) ran full per-layer remat; this measures the
@@ -2018,6 +2149,7 @@ def main() -> None:
     observe_result = None
     spec_result = None
     paged_result = None
+    traffic_result = None
 
     import signal
 
@@ -2067,6 +2199,11 @@ def main() -> None:
             paged_result
             if paged_result is not None
             else {"error": "paged child did not finish"}
+        )
+        extras["serving_traffic"] = (
+            traffic_result
+            if traffic_result is not None
+            else {"error": "traffic child did not finish"}
         )
         extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
@@ -2234,6 +2371,16 @@ def main() -> None:
     else:
         paged_result = {"error": f"paged child: {err}"}
 
+    # 12. Traffic-replay child: per-tenant SLO attainment/goodput under
+    #     Poisson + bursty arrivals on a virtual clock (wall-independent,
+    #     but serialized anyway — replay wall time still bounds it).
+    traffic, err = _run_child("--child-traffic", TRAFFIC_TIMEOUT_S)
+    if traffic is not None:
+        traffic.pop("metric", None)
+        traffic_result = traffic
+    else:
+        traffic_result = {"error": f"traffic child: {err}"}
+
     _finalize()
 
 
@@ -2248,6 +2395,8 @@ if __name__ == "__main__":
         child_serving()
     elif "--child-paged" in sys.argv:
         child_paged()
+    elif "--child-traffic" in sys.argv:
+        child_traffic()
     elif "--child-spec" in sys.argv:
         child_spec()
     elif "--child-train-faults" in sys.argv:

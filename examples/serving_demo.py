@@ -39,6 +39,15 @@ loop survives). ``--deadline``/``--queue-timeout`` attach per-request
 deadlines so sheds show up in the summary (pair with ``--inject-fault
 skew`` to jump the engine clock past them without waiting).
 
+``--traffic {steady,bursty}`` switches the demo into SLO-observability
+mode (ISSUE 11): a seeded multi-tenant arrival tape (Poisson or
+bursty/diurnal) replays through the engine on a VIRTUAL clock —
+``--tenants N`` alternating interactive-chat / batch-long-doc tenants,
+``--slo-ttft-ms``/``--slo-tpot-ms`` the interactive per-request bounds
+(batch gets 4x) — and prints the per-tenant p50/p99 TTFT, TPOT, goodput,
+and SLO attainment report. The same ``--seed`` replays byte-identically;
+compare steady vs bursty to watch bursts break an SLO the mean load meets.
+
 ``--draft-layers N`` turns on SPECULATIVE serving: the draft model is the
 target's first N layers (early-exit weight sharing — the smaller N, the
 cheaper the draft; the later layers are eps-scaled so the draft actually
@@ -61,6 +70,8 @@ CPU-runnable out of the box:
   python examples/serving_demo.py --kv-page-size 16  # paged KV + CoW reuse
   python examples/serving_demo.py --kv-page-size 16 --kv-pages 24 --slots 8
   python examples/serving_demo.py --kv-page-size 16 --inject-fault page
+  python examples/serving_demo.py --traffic steady --tenants 2
+  python examples/serving_demo.py --traffic bursty --slo-ttft-ms 100
   python examples/serving_demo.py --draft-layers 1 --gamma 4  # speculative
   python examples/serving_demo.py --draft-layers 1 --inject-fault draft
   python examples/serving_demo.py --inject-fault dispatch
@@ -152,8 +163,115 @@ def parse_args(argv=None):
                    help="print the metrics registry in Prometheus text "
                         "exposition format after the run (what a scrape "
                         "endpoint would serve)")
+    p.add_argument("--traffic", default="none",
+                   choices=["none", "steady", "bursty"],
+                   help="SLO observability mode (ISSUE 11): replay a "
+                        "seeded multi-tenant arrival tape through the "
+                        "engine on a VIRTUAL clock (steady = Poisson, "
+                        "bursty = diurnal square-wave bursts) and print "
+                        "the per-tenant TTFT/TPOT/goodput/attainment "
+                        "report — byte-identical for the same --seed")
+    p.add_argument("--tenants", type=int, default=2,
+                   help="tenant count for --traffic (alternating chat/"
+                        "long-doc workloads, interactive/batch priority)")
+    p.add_argument("--traffic-duration", type=float, default=6.0,
+                   help="virtual seconds of arrivals to generate")
+    p.add_argument("--slo-ttft-ms", type=float, default=150.0,
+                   help="per-request TTFT bound for interactive tenants "
+                        "(batch tenants get 4x); violations show in the "
+                        "attainment report")
+    p.add_argument("--slo-tpot-ms", type=float, default=20.0,
+                   help="per-request mean-TPOT bound for interactive "
+                        "tenants (batch tenants get 4x)")
     p.add_argument("--force-cpu-devices", type=int, default=None)
     return p.parse_args(argv)
+
+
+def _run_traffic(args, cfg, model, params):
+    """``--traffic``: seeded multi-tenant replay + per-tenant SLO report.
+
+    Even-indexed tenants are interactive chat under the tight
+    ``--slo-ttft-ms``/``--slo-tpot-ms`` bounds; odd ones are batch
+    long-doc under 4x-looser bounds — re-run with ``--traffic bursty``
+    (same seed) to watch the same tape's bursts blow the interactive
+    attainment that the steady replay meets."""
+    from neuronx_distributed_tpu.observability import SLOSpec
+    from neuronx_distributed_tpu.serving import (
+        ServingEngine,
+        TenantProfile,
+        VirtualClock,
+        generate_tape,
+        replay,
+    )
+
+    arrival = "poisson" if args.traffic == "steady" else "bursty"
+    tenants, slo = [], {}
+    for i in range(max(1, args.tenants)):
+        interactive = i % 2 == 0
+        name = f"tenant{i}-{'chat' if interactive else 'docs'}"
+        tenants.append(
+            TenantProfile(
+                name,
+                rate_rps=3.0 if interactive else 0.8,
+                arrival=arrival,
+                workload="chat" if interactive else "longdoc",
+                priority="interactive" if interactive else "batch",
+                burst_factor=4.0, burst_period_s=4.0, burst_duty=0.25,
+            )
+        )
+        scale = 1.0 if interactive else 4.0
+        slo[name] = SLOSpec(
+            ttft_p99_s=args.slo_ttft_ms * scale / 1e3,
+            tpot_p99_s=args.slo_tpot_ms * scale / 1e3,
+        )
+    tape = generate_tape(
+        tenants, duration_s=args.traffic_duration, seed=args.seed,
+        vocab_size=cfg.vocab_size,
+    )
+    clock = VirtualClock()
+    engine = ServingEngine(
+        model, params,
+        num_slots=args.slots,
+        admission=args.admission,
+        decode_chunk_size=args.decode_chunk,
+        prefix_cache=None if args.no_prefix_cache else "auto",
+        kv_page_size=args.kv_page_size or None,
+        kv_num_pages=args.kv_pages,
+        slo=slo,
+        time_fn=clock,
+        sleep_fn=lambda s: None,
+    )
+    report = replay(engine, tape, clock, step_dt=0.05)
+
+    print(f"=== traffic replay: {args.traffic} ({arrival}), "
+          f"{len(tape)} arrivals / {len(tenants)} tenants, seed "
+          f"{args.seed}, {report['replay']['steps']} engine steps over "
+          f"{report['replay']['virtual_end_s']:.2f} virtual s ===")
+    for name, row in report["tenants"].items():
+        spec = slo[name]
+        print(
+            f"{name:>16s}  submitted={row['submitted']:>3d} "
+            f"done={row['completed']:>3d} shed={row['sheds']:>2d} "
+            f"rej={row['rejects']:>2d} | "
+            f"ttft p50/p99 {row['ttft_p50_s'] * 1e3:6.1f}/"
+            f"{row['ttft_p99_s'] * 1e3:6.1f}ms "
+            f"(SLO {spec.ttft_p99_s * 1e3:.0f}ms) | "
+            f"tpot p99 {row['tpot_p99_s'] * 1e3:5.2f}ms "
+            f"(SLO {spec.tpot_p99_s * 1e3:.0f}ms) | "
+            f"attain {row.get('attainment', 1.0):5.1%} "
+            f"goodput {row.get('goodput_tok_s', 0.0):7.1f} tok/s"
+        )
+    s = report["slo"]
+    print(f"\n=== SLO totals: attained {s['attained']} / violated "
+          f"{s['violated']} (attainment {s['attainment']:.1%}), goodput "
+          f"{s['goodput_tok_s']:.1f} tok/s over {s['span_s']:.2f} "
+          f"virtual s ===")
+    if s["violation_reasons"]:
+        print(f"violation reasons: {s['violation_reasons']}")
+    if args.prometheus:
+        print("\n=== prometheus exposition ===")
+        print(engine.metrics.registry.prometheus_text())
+    return report
 
 
 def main(argv=None):
@@ -180,6 +298,9 @@ def main(argv=None):
     rng = np.random.RandomState(args.seed)
     init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
     params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+
+    if args.traffic != "none":
+        return _run_traffic(args, cfg, model, params)
 
     draft_model, draft_params = None, None
     if args.draft_layers > 0:

@@ -20,6 +20,12 @@ training.
   around a block), :func:`install_compile_listener` (compile-event
   counter/duration histogram), :func:`record_device_memory` (per-device
   memory gauges).
+* :mod:`slo` — :class:`SLOSpec` (per-request TTFT/TPOT bounds per tenant
+  or priority class) + :class:`SLOTracker` (attained/violated counts,
+  attainment rate, and **goodput** — tokens from SLO-attaining requests
+  per second — per tenant, exported through the same registry as labeled
+  families). The feedback signal and judge for the SLO-aware scheduler
+  work (ISSUE 11).
 
 Hard constraint carried by the whole package (and enforced by graftlint
 GL02, whose hot-path list covers the emit paths here): instrumentation
@@ -32,8 +38,10 @@ from neuronx_distributed_tpu.observability.registry import (
     Counter,
     Gauge,
     Histogram,
+    MetricFamily,
     MetricsRegistry,
 )
+from neuronx_distributed_tpu.observability.slo import SLOSpec, SLOTracker
 from neuronx_distributed_tpu.observability.tracing import RequestTracer
 from neuronx_distributed_tpu.observability.flight_recorder import FlightRecorder
 from neuronx_distributed_tpu.observability.profiler import (
@@ -49,9 +57,12 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "MetricFamily",
     "MetricsCallback",
     "MetricsRegistry",
     "RequestTracer",
+    "SLOSpec",
+    "SLOTracker",
     "SpecStats",
     "install_compile_listener",
     "profile_window",

@@ -26,6 +26,16 @@ record functions run inside the engine/trainer inner loops):
   reported value overestimates the true quantile by at most ``growth``
   (relative error ``growth - 1``, default 5%) — and, unlike the previous
   recent-window p95, never drifts with stream length or phase.
+
+Labeled metric families (ISSUE 11): ``registry.counter("ttft_s",
+labels=("tenant",))`` returns a :class:`MetricFamily` — a get-or-create
+container of per-labelset children (``family.labels("acme")`` is a plain
+Counter/Gauge/Histogram, so the record path is identical to the unlabeled
+case: the child is resolved once where the caller already holds its host
+scalars, then ``inc``/``observe`` as usual). Families export label-aware
+``snapshot()`` entries and labeled Prometheus series (label values escaped
+per the text exposition format: ``\\`` → ``\\\\``, ``"`` → ``\\"``,
+newline → ``\\n`` — a hostile tenant string cannot break the scrape).
 """
 
 from __future__ import annotations
@@ -33,14 +43,17 @@ from __future__ import annotations
 import json
 import math
 import threading
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "MetricFamily",
     "MetricsRegistry",
+    "MetricsView",
     "DEFAULT_GROWTH",
+    "escape_label_value",
 ]
 
 # relative bucket width of histograms: percentile error <= 5%
@@ -61,8 +74,27 @@ def _sanitize(name: str) -> str:
     return s
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash, double
+    quote, and newline are the three characters the format reserves —
+    everything else (including arbitrary unicode) passes through raw."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _braced(labels: str) -> str:
+    """``{tenant="acme"}`` or ``""`` for the unlabeled series."""
+    return f"{{{labels}}}" if labels else ""
+
+
 class Counter:
     """Monotone accumulator (int or float increments)."""
+
+    kind = "counter"
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
@@ -79,12 +111,16 @@ class Counter:
     def snapshot(self):
         return self._value
 
+    def prometheus_samples(self, labels: str = "") -> List[str]:
+        n = _sanitize(self.name)
+        return [f"{n}{_braced(labels)} {_fmt(self._value)}"]
+
     def prometheus_lines(self) -> List[str]:
         n = _sanitize(self.name)
         return [
             f"# HELP {n} {self.help}",
             f"# TYPE {n} counter",
-            f"{n} {_fmt(self._value)}",
+            *self.prometheus_samples(),
         ]
 
 
@@ -95,6 +131,8 @@ class Gauge:
     the operator reading the snapshot, not the inner loop. ``set_fn``
     registers a zero-cost callable evaluated at export instead (e.g. the
     engine's compile counters)."""
+
+    kind = "gauge"
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
@@ -117,12 +155,16 @@ class Gauge:
     def snapshot(self) -> float:
         return self.value
 
+    def prometheus_samples(self, labels: str = "") -> List[str]:
+        n = _sanitize(self.name)
+        return [f"{n}{_braced(labels)} {_fmt(self.value)}"]
+
     def prometheus_lines(self) -> List[str]:
         n = _sanitize(self.name)
         return [
             f"# HELP {n} {self.help}",
             f"# TYPE {n} gauge",
-            f"{n} {_fmt(self.value)}",
+            *self.prometheus_samples(),
         ]
 
 
@@ -134,6 +176,8 @@ class Histogram:
     zero bucket reports as value ``0.0`` in quantiles. ``count``/``sum``/
     ``min``/``max`` are tracked exactly, so means and totals carry no
     bucketing error — only the quantiles are bucket-quantized."""
+
+    kind = "histogram"
 
     def __init__(self, name: str, help: str = "", growth: float = DEFAULT_GROWTH):
         if growth <= 1.0:
@@ -210,26 +254,33 @@ class Histogram:
             "p99": self.percentile(0.99),
         }
 
-    def prometheus_lines(self) -> List[str]:
-        """Cumulative ``le`` buckets over the touched range + the
-        standard ``_sum``/``_count`` series."""
+    def prometheus_samples(self, labels: str = "") -> List[str]:
+        """Cumulative ``le`` buckets over the touched range + the standard
+        ``_sum``/``_count`` series; ``labels`` (a pre-rendered
+        ``name="escaped-value"`` list) composes with ``le``."""
         n = _sanitize(self.name)
-        lines = [
-            f"# HELP {n} {self.help}",
-            f"# TYPE {n} histogram",
-        ]
+        pre = f"{labels}," if labels else ""
+        lines = []
         cum = self._zero
         if self._zero:
-            lines.append(f'{n}_bucket{{le="0"}} {self._zero}')
+            lines.append(f'{n}_bucket{{{pre}le="0"}} {self._zero}')
         for i in sorted(self._buckets):
             cum += self._buckets[i]
             lines.append(
-                f'{n}_bucket{{le="{_fmt(self.growth ** (i + 1))}"}} {cum}'
+                f'{n}_bucket{{{pre}le="{_fmt(self.growth ** (i + 1))}"}} {cum}'
             )
-        lines.append(f'{n}_bucket{{le="+Inf"}} {self.count}')
-        lines.append(f"{n}_sum {_fmt(self.sum)}")
-        lines.append(f"{n}_count {self.count}")
+        lines.append(f'{n}_bucket{{{pre}le="+Inf"}} {self.count}')
+        lines.append(f"{n}_sum{_braced(labels)} {_fmt(self.sum)}")
+        lines.append(f"{n}_count{_braced(labels)} {self.count}")
         return lines
+
+    def prometheus_lines(self) -> List[str]:
+        n = _sanitize(self.name)
+        return [
+            f"# HELP {n} {self.help}",
+            f"# TYPE {n} histogram",
+            *self.prometheus_samples(),
+        ]
 
 
 def _fmt(v) -> str:
@@ -238,6 +289,191 @@ def _fmt(v) -> str:
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return repr(f)
+
+
+def _labelset_key(values: Tuple[str, ...]) -> str:
+    """Deterministic JSON-safe snapshot key for one labelset: the bare
+    value for the common single-label case, a JSON list otherwise (a
+    separator-joined key would be ambiguous for values containing the
+    separator)."""
+    if len(values) == 1:
+        return values[0]
+    return json.dumps(list(values))
+
+
+class MetricFamily:
+    """Get-or-create labeled children of one metric name.
+
+    ``family.labels("acme")`` (positionally, in ``label_names`` order) or
+    ``family.labels(tenant="acme")`` returns the child metric for that
+    labelset — a plain :class:`Counter`/:class:`Gauge`/:class:`Histogram`,
+    so record paths are byte-for-byte the unlabeled ones (resolve the
+    child once, then ``inc``/``observe`` host scalars). Children are
+    never garbage-collected: a labelset that ever reported stays on the
+    export surface, the standard Prometheus client semantics.
+
+    Label *names* are sanitized to the exposition charset at family
+    creation; label *values* stay raw (any string is a valid value) and
+    are escaped only at exposition time."""
+
+    def __init__(self, name: str, cls, label_names: Iterable[str],
+                 help: str = "", **child_kwargs):
+        names = tuple(_sanitize(str(n)) for n in label_names)
+        if not names:
+            raise ValueError("a MetricFamily needs at least one label name")
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate label names after sanitizing: {names}")
+        self.name = name
+        self.help = help
+        self.cls = cls
+        self.label_names = names
+        self._child_kwargs = child_kwargs
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def kind(self) -> str:
+        return self.cls.kind
+
+    def _values(self, args, by_name) -> Tuple[str, ...]:
+        if by_name:
+            if args:
+                raise ValueError(
+                    "pass label values positionally or by name, not both"
+                )
+            extra = set(by_name) - set(self.label_names)
+            if extra or len(by_name) != len(self.label_names):
+                raise ValueError(
+                    f"labels {sorted(by_name)} do not match the family's "
+                    f"label names {list(self.label_names)}"
+                )
+            args = tuple(by_name[n] for n in self.label_names)
+        values = tuple(str(v) for v in args)
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} label "
+                f"value(s) for {list(self.label_names)}, got {len(values)}"
+            )
+        return values
+
+    def labels(self, *args, **by_name):
+        """Get-or-create the child for one labelset."""
+        values = self._values(args, by_name)
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.get(values)
+                if child is None:
+                    child = self.cls(
+                        self.name, help=self.help, **self._child_kwargs
+                    )
+                    self._children[values] = child
+        return child
+
+    def has_child(self, *args, **by_name) -> bool:
+        return self._values(args, by_name) in self._children
+
+    def child_labelsets(self) -> List[Tuple[str, ...]]:
+        """Every labelset that has a child, sorted (deterministic)."""
+        return sorted(self._children)
+
+    def children(self) -> List[Tuple[Tuple[str, ...], object]]:
+        return [(v, self._children[v]) for v in sorted(self._children)]
+
+    def snapshot(self) -> dict:
+        """Label-aware export: ``{"labels": [...], "children": {labelset:
+        child snapshot}}`` — children sorted, keys per
+        :func:`_labelset_key`, so the same stream always serializes to the
+        same JSON."""
+        return {
+            "labels": list(self.label_names),
+            "children": {
+                _labelset_key(values): child.snapshot()
+                for values, child in self.children()
+            },
+        }
+
+    def _label_str(self, values: Tuple[str, ...]) -> str:
+        return ",".join(
+            f'{n}="{escape_label_value(v)}"'
+            for n, v in zip(self.label_names, values)
+        )
+
+    def prometheus_lines(self) -> List[str]:
+        """One HELP/TYPE header, then every child's samples with its
+        escaped labelset."""
+        n = _sanitize(self.name)
+        lines = [f"# HELP {n} {self.help}", f"# TYPE {n} {self.kind}"]
+        for values, child in self.children():
+            lines.extend(child.prometheus_samples(self._label_str(values)))
+        return lines
+
+
+class MetricsView:
+    """A (possibly) label-scoped lens over a registry.
+
+    Metrics resolved through a view with a non-empty labelset are
+    children of that labelset under families carrying the view's label
+    names; an empty view resolves plain unlabeled metrics. This is the
+    ONE owner of the ``engine_label`` wrapping that ``ServingMetrics``,
+    ``SpecStats``, and ``SLOTracker`` share — two labeled engines on one
+    registry stay separate because each resolves everything through its
+    own view.
+
+    ``family``/``child``/``has_child`` extend the scope with per-record
+    label dimensions (e.g. ``tenant``): the family's label names are the
+    view's followed by the extra ones, and ``child(fam, "acme")``
+    prepends the view's values. ``has_child`` is the READ-side guard —
+    checking existence never materializes a child (a snapshot must not
+    mint empty series)."""
+
+    def __init__(self, registry: "MetricsRegistry",
+                 label_names: Iterable[str] = (),
+                 label_values: Iterable[str] = ()):
+        names = tuple(label_names)
+        values = tuple(str(v) for v in label_values)
+        if len(names) != len(values):
+            raise ValueError(
+                f"label_names {list(names)} and label_values "
+                f"{list(values)} must pair up"
+            )
+        self.registry = registry
+        self.label_names = names
+        self.label_values = values
+
+    def _resolve(self, kind: str, name: str, help: str,
+                 extra_labels: Tuple[str, ...] = (), **kwargs):
+        labels = self.label_names + tuple(extra_labels)
+        return getattr(self.registry, kind)(
+            name, help=help, labels=labels or None, **kwargs
+        )
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        m = self._resolve("counter", name, help)
+        return m.labels(*self.label_values) if self.label_names else m
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        m = self._resolve("gauge", name, help)
+        return m.labels(*self.label_values) if self.label_names else m
+
+    def histogram(self, name: str, help: str = "",
+                  growth: float = DEFAULT_GROWTH) -> Histogram:
+        m = self._resolve("histogram", name, help, growth=growth)
+        return m.labels(*self.label_values) if self.label_names else m
+
+    def family(self, kind: str, name: str, help: str = "",
+               labels: Iterable[str] = ("tenant",), **kwargs) -> MetricFamily:
+        """A family whose label names are this view's + ``labels``."""
+        return self._resolve(kind, name, help,
+                             extra_labels=tuple(labels), **kwargs)
+
+    def child(self, family: MetricFamily, *values):
+        """Get-or-create the child at (view values, ``values``)."""
+        return family.labels(*self.label_values, *values)
+
+    def has_child(self, family: MetricFamily, *values) -> bool:
+        """Existence check that never creates the child."""
+        return family.has_child(*self.label_values, *values)
 
 
 class MetricsRegistry:
@@ -252,12 +488,39 @@ class MetricsRegistry:
         self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()
 
-    def _get_or_create(self, name: str, cls, **kwargs):
+    def _get_or_create(self, name: str, cls, labels=None, **kwargs):
+        wanted = tuple(_sanitize(str(l)) for l in labels) if labels else ()
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = cls(name, **kwargs)
+                if wanted:
+                    m = MetricFamily(name, cls, wanted, **kwargs)
+                else:
+                    m = cls(name, **kwargs)
                 self._metrics[name] = m
+                return m
+            if wanted:
+                if (
+                    not isinstance(m, MetricFamily)
+                    or m.cls is not cls
+                    or m.label_names != wanted
+                ):
+                    have = (
+                        f"{m.cls.__name__} family with labels "
+                        f"{list(m.label_names)}"
+                        if isinstance(m, MetricFamily)
+                        else f"unlabeled {type(m).__name__}"
+                    )
+                    raise TypeError(
+                        f"metric {name!r} already registered as {have}, "
+                        f"not a {cls.__name__} family with labels "
+                        f"{list(wanted)}"
+                    )
+            elif isinstance(m, MetricFamily):
+                raise TypeError(
+                    f"metric {name!r} already registered as a labeled "
+                    f"family ({list(m.label_names)}); pass labels= to get it"
+                )
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric {name!r} already registered as "
@@ -265,16 +528,25 @@ class MetricsRegistry:
                 )
             return m
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(name, Counter, help=help)
+    def counter(
+        self, name: str, help: str = "", labels=None
+    ) -> Union[Counter, MetricFamily]:
+        """Unlabeled counter, or (with ``labels=("tenant",)``) the counter
+        FAMILY whose ``.labels(...)`` children are counters."""
+        return self._get_or_create(name, Counter, labels=labels, help=help)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(name, Gauge, help=help)
+    def gauge(
+        self, name: str, help: str = "", labels=None
+    ) -> Union[Gauge, MetricFamily]:
+        return self._get_or_create(name, Gauge, labels=labels, help=help)
 
     def histogram(
-        self, name: str, help: str = "", growth: float = DEFAULT_GROWTH
-    ) -> Histogram:
-        return self._get_or_create(name, Histogram, help=help, growth=growth)
+        self, name: str, help: str = "", growth: float = DEFAULT_GROWTH,
+        labels=None,
+    ) -> Union[Histogram, MetricFamily]:
+        return self._get_or_create(
+            name, Histogram, labels=labels, help=help, growth=growth
+        )
 
     def get(self, name: str):
         return self._metrics.get(name)

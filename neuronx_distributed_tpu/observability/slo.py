@@ -1,0 +1,320 @@
+"""SLO accounting: per-tenant attainment tracking and goodput.
+
+Production serving comparisons are reported in SLO terms — latency-bounded
+throughput under realistic multi-tenant load, not steady-state microbench
+tok/s (PAPERS.md: the Gemma-on-TPU serving comparison). This module is the
+accounting half of the ROADMAP's "SLO-aware multi-tenant scheduling" item:
+it turns the engine's existing host-side request timestamps (TTFT/TPOT are
+already measured at chunk boundaries off the one-``device_get``-per-chunk
+readback) into the numbers a scheduler or an operator is actually judged
+on:
+
+* **Attainment** — a finished request ATTAINS its tenant's
+  :class:`SLOSpec` when its TTFT and its mean TPOT are both within the
+  spec's per-request bounds; every terminal fault (shed, timeout, reject,
+  engine failure) is a VIOLATION. The per-tenant attained/violated counts
+  (and the attainment *rate* — compare against your availability target,
+  e.g. ≥0.99 for a p99 spec) are the scheduler-PR feedback signal.
+* **Goodput** — tokens delivered by SLO-attaining requests per second of
+  observed span. Tokens streamed by a request that then blew its deadline
+  were wasted work; goodput is the throughput number that cannot be
+  gamed by shedding latency-sensitive traffic.
+
+Counting contract (chaos-tested): a request is classified exactly ONCE, at
+its terminal state — a requeued-then-finished request (preemption,
+dispatch recovery, quarantine) is one observation, not two; a request shed
+from the queue before ever being admitted is one violation.
+
+Hot-path contract (this module is on graftlint GL02's hot-path list —
+``record_*`` run inside the engine's chunk-boundary bookkeeping): every
+argument is a host scalar the caller already owns. Nothing here may touch
+a device value, so full SLO tracking adds ZERO device→host syncs — the
+pinned budgets (submit=1, admission=2, steady chunk=1) hold with it on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from neuronx_distributed_tpu.observability.registry import (
+    MetricsRegistry,
+    MetricsView,
+)
+
+__all__ = ["SLOSpec", "SLOTracker"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """Per-request latency bounds for one tenant / priority class.
+
+    ``ttft_p99_s`` bounds submit→first-token, ``tpot_p99_s`` bounds the
+    request's mean time per output token after the first; ``None`` leaves
+    that dimension unbounded. The ``p99`` in the name states the
+    *availability target* the bound is meant to be held at: the tracker
+    classifies each request against the raw bound and reports the
+    attainment rate — "p99 attained" means that rate is ≥ 0.99."""
+
+    ttft_p99_s: Optional[float] = None
+    tpot_p99_s: Optional[float] = None
+
+    def __post_init__(self):
+        for field in ("ttft_p99_s", "tpot_p99_s"):
+            v = getattr(self, field)
+            if v is not None and v <= 0:
+                raise ValueError(f"{field} must be > 0, got {v}")
+
+    def attains(self, ttft_s: Optional[float],
+                tpot_s: Optional[float]) -> bool:
+        """Whether one request's measured latencies meet this spec. A
+        ``None`` TTFT (no first token ever) fails a TTFT bound; a ``None``
+        TPOT (single-token request — the quantity is undefined) passes a
+        TPOT bound vacuously."""
+        if self.ttft_p99_s is not None:
+            if ttft_s is None or ttft_s > self.ttft_p99_s:
+                return False
+        if self.tpot_p99_s is not None and tpot_s is not None:
+            if tpot_s > self.tpot_p99_s:
+                return False
+        return True
+
+
+class _TenantSLO:
+    """One tenant's running attainment state (host ints/floats only)."""
+
+    __slots__ = ("attained", "violated", "attained_tokens", "total_tokens",
+                 "violation_reasons")
+
+    def __init__(self):
+        self.attained = 0
+        self.violated = 0
+        self.attained_tokens = 0
+        self.total_tokens = 0
+        self.violation_reasons: Dict[str, int] = {}
+
+
+class SLOTracker:
+    """Attainment/goodput accounting over per-tenant :class:`SLOSpec`\\ s.
+
+    ``specs`` maps tenant name → spec; ``default`` (or a bare
+    :class:`SLOSpec` passed as ``specs``) covers tenants without their
+    own entry. Tenants with NO applicable spec are not classified (their
+    traffic is observed but never counted attained or violated).
+
+    With a ``registry``, per-tenant counters (``<prefix>_attained_requests``,
+    ``_violated_requests``, ``_attained_tokens``) and an attainment-rate
+    gauge export as labeled families next to the serving metrics — one
+    Prometheus surface for latency histograms AND the contract they are
+    judged against. A label-scoped
+    :class:`~neuronx_distributed_tpu.observability.registry.MetricsView`
+    (``view=``) prepends its labels (e.g. ``engine``) so two engines
+    sharing a registry stay distinguishable."""
+
+    def __init__(
+        self,
+        specs=None,
+        default: Optional[SLOSpec] = None,
+        registry: Optional[MetricsRegistry] = None,
+        prefix: str = "slo",
+        view: Optional[MetricsView] = None,
+    ):
+        if isinstance(specs, SLOSpec):
+            if default is not None:
+                raise ValueError(
+                    "pass either a bare SLOSpec (the default for every "
+                    "tenant) or a dict + default=, not both"
+                )
+            specs, default = {}, specs
+        self.specs: Dict[str, SLOSpec] = dict(specs or {})
+        for tenant, spec in self.specs.items():
+            if not isinstance(spec, SLOSpec):
+                raise TypeError(
+                    f"specs[{tenant!r}] must be an SLOSpec, got "
+                    f"{type(spec).__name__}"
+                )
+        self.default = default
+        self._tenants: Dict[str, _TenantSLO] = {}
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._view: Optional[MetricsView] = None
+        self._c_attained = self._c_violated = self._c_tokens = None
+        self._g_rate = None
+        if view is not None and registry is None:
+            registry = view.registry
+        if registry is not None:
+            self._view = view if view is not None else MetricsView(registry)
+            self._c_attained = self._view.family(
+                "counter", f"{prefix}_attained_requests",
+                help="requests that finished within their tenant's SLOSpec",
+            )
+            self._c_violated = self._view.family(
+                "counter", f"{prefix}_violated_requests",
+                help="requests that missed their SLOSpec (incl. sheds, "
+                     "timeouts, rejects, failures)",
+            )
+            self._c_tokens = self._view.family(
+                "counter", f"{prefix}_attained_tokens",
+                help="tokens delivered by SLO-attaining requests "
+                     "(the goodput numerator)",
+            )
+            self._g_rate = self._view.family(
+                "gauge", f"{prefix}_attainment",
+                help="attained / (attained + violated) per tenant",
+            )
+
+    # --- classification -----------------------------------------------------
+
+    def spec_for(self, tenant: str) -> Optional[SLOSpec]:
+        return self.specs.get(tenant, self.default)
+
+    def _state(self, tenant: str) -> _TenantSLO:
+        s = self._tenants.get(tenant)
+        if s is None:
+            s = self._tenants[tenant] = _TenantSLO()
+        return s
+
+    def touch(self, now: Optional[float]) -> None:
+        """Extend the observed span (the goodput denominator). The engine
+        calls this at submit time so goodput covers the whole run, not
+        just finish-to-finish. ``None`` (an event with no engine-clock
+        timestamp, e.g. a door reject) leaves the span alone."""
+        if now is None:
+            return
+        if self._t_first is None or now < self._t_first:
+            self._t_first = now
+        if self._t_last is None or now > self._t_last:
+            self._t_last = now
+
+    def _export(self, tenant: str, state: _TenantSLO,
+                tokens_attained: int, violations: int,
+                attainments: int) -> None:
+        if self._view is None:
+            return
+        if attainments:
+            self._view.child(self._c_attained, tenant).inc(attainments)
+        if violations:
+            self._view.child(self._c_violated, tenant).inc(violations)
+        if tokens_attained:
+            self._view.child(self._c_tokens, tenant).inc(tokens_attained)
+        total = state.attained + state.violated
+        self._view.child(self._g_rate, tenant).set(
+            state.attained / total if total else 1.0
+        )
+
+    def record_finish(
+        self,
+        tenant: str,
+        ttft_s: Optional[float],
+        tpot_s: Optional[float],
+        tokens: int,
+        now: float,
+    ) -> bool:
+        """Classify one FINISHED request (called exactly once, at DONE).
+        Returns whether it attained (untracked tenants return True but
+        count nowhere)."""
+        self.touch(now)
+        spec = self.spec_for(tenant)
+        if spec is None:
+            return True
+        state = self._state(tenant)
+        state.total_tokens += int(tokens)
+        if spec.attains(ttft_s, tpot_s):
+            state.attained += 1
+            state.attained_tokens += int(tokens)
+            self._export(tenant, state, int(tokens), 0, 1)
+            return True
+        state.violated += 1
+        state.violation_reasons["latency"] = (
+            state.violation_reasons.get("latency", 0) + 1
+        )
+        self._export(tenant, state, 0, 1, 0)
+        return False
+
+    def record_violation(self, tenant: str, now: Optional[float],
+                         reason: str = "shed", tokens: int = 0) -> None:
+        """Classify one request that terminated WITHOUT finishing — shed,
+        timeout, reject, or engine failure. ``tokens`` it already streamed
+        count as total (wasted) work, never as goodput."""
+        self.touch(now)
+        if self.spec_for(tenant) is None:
+            return
+        state = self._state(tenant)
+        state.violated += 1
+        state.total_tokens += int(tokens)
+        state.violation_reasons[reason] = (
+            state.violation_reasons.get(reason, 0) + 1
+        )
+        self._export(tenant, state, 0, 1, 0)
+
+    # --- export -------------------------------------------------------------
+
+    @property
+    def span_s(self) -> float:
+        """Observed span in seconds (first submit → last terminal event)."""
+        if self._t_first is None or self._t_last is None:
+            return 0.0
+        return self._t_last - self._t_first
+
+    def goodput_tok_s(self, tenant: Optional[str] = None) -> float:
+        """Tokens from SLO-attaining requests per second of observed span
+        (one tenant, or everyone)."""
+        span = self.span_s
+        if span <= 0:
+            return 0.0
+        if tenant is not None:
+            state = self._tenants.get(tenant)
+            return state.attained_tokens / span if state else 0.0
+        return sum(s.attained_tokens for s in self._tenants.values()) / span
+
+    def per_tenant(self) -> Dict[str, dict]:
+        """Flat per-tenant scalars, tenant-sorted (deterministic keys —
+        the traffic-replay determinism pin serializes this)."""
+        out = {}
+        for tenant in sorted(self._tenants):
+            s = self._tenants[tenant]
+            total = s.attained + s.violated
+            out[tenant] = {
+                "attained": s.attained,
+                "violated": s.violated,
+                "attainment": s.attained / total if total else 1.0,
+                "attained_tokens": s.attained_tokens,
+                "total_tokens": s.total_tokens,
+                "goodput_tok_s": self.goodput_tok_s(tenant),
+            }
+        return out
+
+    def totals(self) -> dict:
+        attained = sum(s.attained for s in self._tenants.values())
+        violated = sum(s.violated for s in self._tenants.values())
+        total = attained + violated
+        return {
+            "attained": attained,
+            "violated": violated,
+            "attainment": attained / total if total else 1.0,
+            "attained_tokens": sum(
+                s.attained_tokens for s in self._tenants.values()
+            ),
+            "total_tokens": sum(
+                s.total_tokens for s in self._tenants.values()
+            ),
+            "goodput_tok_s": self.goodput_tok_s(),
+            "span_s": self.span_s,
+        }
+
+    def violation_reasons(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant violation breakdown by reason (latency / shed /
+        timeout / reject / failed / ...)."""
+        return {
+            t: dict(sorted(self._tenants[t].violation_reasons.items()))
+            for t in sorted(self._tenants)
+            if self._tenants[t].violation_reasons
+        }
+
+    def snapshot(self) -> dict:
+        """JSON-safe export: totals + per-tenant breakdown + reasons."""
+        return {
+            **self.totals(),
+            "per_tenant": self.per_tenant(),
+            "violation_reasons": self.violation_reasons(),
+        }

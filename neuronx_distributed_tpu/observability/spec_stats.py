@@ -22,7 +22,10 @@ way).
 
 from __future__ import annotations
 
-from neuronx_distributed_tpu.observability.registry import MetricsRegistry
+from neuronx_distributed_tpu.observability.registry import (
+    MetricsRegistry,
+    MetricsView,
+)
 
 
 class SpecStats:
@@ -30,30 +33,39 @@ class SpecStats:
 
     ``registry`` metrics are get-or-create, so an engine's metrics object
     and a solo ``speculative_generate(..., registry=)`` call pointed at the
-    same registry aggregate into one surface."""
+    same registry aggregate into one surface. A label-scoped
+    :class:`~neuronx_distributed_tpu.observability.registry.MetricsView`
+    (``view=``, ISSUE 11's shared-registry mode) resolves every metric as
+    that view's family child instead — two engine-labeled views on one
+    registry never merge their acceptance stats; the attribute surface
+    and snapshot keys are identical either way."""
 
-    def __init__(self, registry: MetricsRegistry, prefix: str = "spec"):
+    def __init__(self, registry: MetricsRegistry, prefix: str = "spec",
+                 view: MetricsView = None):
         self.registry = registry
-        self.accept_len = registry.histogram(
+        if view is None:
+            view = MetricsView(registry)
+        histogram, counter = view.histogram, view.counter
+        self.accept_len = histogram(
             f"{prefix}_accept_len",
             help="per-slot accepted draft length per speculative round "
                  "(0..gamma)",
         )
-        self.drafted = registry.counter(
+        self.drafted = counter(
             f"{prefix}_draft_tokens", help="draft tokens proposed"
         )
-        self.accepted = registry.counter(
+        self.accepted = counter(
             f"{prefix}_accepted_tokens",
             help="draft tokens the target accepted",
         )
-        self.wasted = registry.counter(
+        self.wasted = counter(
             f"{prefix}_draft_tokens_wasted",
             help="draft tokens rejected (drafted - accepted)",
         )
-        self.rounds = registry.counter(
+        self.rounds = counter(
             f"{prefix}_rounds", help="per-slot speculative rounds executed"
         )
-        self.fallbacks = registry.counter(
+        self.fallbacks = counter(
             f"{prefix}_fallbacks",
             help="chunks decoded non-speculatively after a failed "
                  "speculative dispatch",

@@ -79,6 +79,12 @@ HOT_SUFFIXES = (
     "observability/tracing.py",
     "observability/flight_recorder.py",
     "utils/timeline.py",
+    # SLO observability (ISSUE 11): the attainment tracker's record_*
+    # functions run inside the engine's chunk-boundary bookkeeping, and
+    # the traffic replay loop wraps engine.step() — an implicit sync in
+    # either would stall the hot loop / pollute every replay measurement
+    "observability/slo.py",
+    "serving/traffic.py",
 )
 HOT_MARKER = "graftlint: hot-path"
 

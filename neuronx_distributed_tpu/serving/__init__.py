@@ -41,6 +41,12 @@ Layers:
 * :mod:`faults` — deterministic, schedule-driven fault injection
   (``FaultInjector``) for the engine's chaos hooks: dispatch failures,
   poisoned readbacks, prefill faults, clock skew.
+* :mod:`traffic` — the deterministic open-loop load harness (ISSUE 11):
+  seeded multi-tenant workload generation (Poisson + bursty/diurnal
+  arrivals, chat vs long-doc length mixes) materialized as a
+  byte-identical arrival tape, replayed through the engine on a
+  :class:`VirtualClock` so the per-tenant TTFT/TPOT/goodput/attainment
+  report is reproducible to the byte (``bench.py --child-traffic``).
 
 Observability (ISSUE 8, ``neuronx_distributed_tpu/observability``): the
 metrics above live in a shared ``MetricsRegistry`` (Prometheus/JSON
@@ -90,8 +96,18 @@ from neuronx_distributed_tpu.serving.scheduler import (
     RequestState,
     Scheduler,
 )
+from neuronx_distributed_tpu.serving.traffic import (
+    Arrival,
+    TenantProfile,
+    VirtualClock,
+    build_report,
+    generate_tape,
+    replay,
+    tape_bytes,
+)
 
 __all__ = [
+    "Arrival",
     "EngineHealth",
     "FaultInjector",
     "InjectedDispatchError",
@@ -110,4 +126,10 @@ __all__ = [
     "ServingEngine",
     "ServingMetrics",
     "SlotCacheManager",
+    "TenantProfile",
+    "VirtualClock",
+    "build_report",
+    "generate_tape",
+    "replay",
+    "tape_bytes",
 ]

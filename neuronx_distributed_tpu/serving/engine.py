@@ -152,9 +152,19 @@ with everything enabled):
   timeline auto-saves (atomically) on halt.
 * ``self.flight`` (a ``FlightRecorder``, ``flight_dir=`` for the dump
   location) records health transitions and fault events and writes a
-  redacted JSON post-mortem the moment the engine HALTs.
+  redacted JSON post-mortem the moment the engine HALTs — including, under
+  multi-tenant load, per-tenant queue depths and the SLO attainment state
+  (who was being starved when it died).
 * ``profile_dir=`` captures a ``jax.profiler`` device trace of decode
   chunks [2, 5).
+* SLO observability (ISSUE 11): ``submit(..., tenant=, priority=)``
+  attributes every request (per-tenant TTFT/TPOT/queue-wait histogram
+  families, shed/timeout/reject counters, tenant-tagged flows and flight
+  events); ``slo=`` (``SLOSpec`` or ``{tenant: SLOSpec}``) classifies each
+  request once at its terminal state and reports attainment + goodput per
+  tenant; ``engine_label=`` lets N engines share one registry as labeled
+  families. ``serving/traffic.py`` replays seeded multi-tenant load
+  through the engine on a virtual clock for reproducible SLO reports.
 
 Cache capacity: all slots share one write cursor (see
 ``serving/cache_manager.py``), which advances every decode step while ANY
@@ -406,6 +416,8 @@ class ServingEngine:
         fault_injector=None,
         timeline=None,
         registry=None,
+        engine_label: Optional[str] = None,
+        slo=None,
         flight_recorder="auto",
         flight_dir: Optional[str] = None,
         profile_dir: Optional[str] = None,
@@ -541,7 +553,13 @@ class ServingEngine:
             self._draft_params = None
             self.draft_cache = None
         self._draft_prefill_fns: Dict[int, Callable] = {}
-        self.metrics = ServingMetrics(num_slots, registry=registry)
+        # tenant/SLO attribution (ISSUE 11): slo= is an SLOSpec (one
+        # contract for every tenant) or a {tenant: SLOSpec} dict;
+        # engine_label= makes every metric a child of an engine-labeled
+        # family so N engines can share one registry/scrape endpoint
+        self.metrics = ServingMetrics(
+            num_slots, registry=registry, engine_label=engine_label, slo=slo
+        )
         # observability layer (ISSUE 8): request-scoped flow tracing on the
         # shared timeline, and an always-on flight recorder whose ring is
         # dumped as a redacted post-mortem the moment the engine HALTs.
@@ -640,16 +658,19 @@ class ServingEngine:
                 return getattr(engine, attr) if engine is not None else -1
             return fn
 
-        reg = self.metrics.registry
-        reg.gauge(
+        # own_gauge honors engine_label: labeled engines export these as
+        # engine-labeled family children, so shared registries never
+        # last-writer-wins another engine's export gauges
+        gauge = self.metrics.own_gauge
+        gauge(
             "serving_decode_compilations",
             help="distinct decode programs XLA compiled (invariant: 1)",
         ).set_fn(_export("decode_compilations"))
-        reg.gauge(
+        gauge(
             "serving_prefill_compilations",
             help="distinct full+suffix prefill programs compiled",
         ).set_fn(_export("prefill_compilations"))
-        reg.gauge(
+        gauge(
             "serving_queue_depth", help="queued (unfinished) requests"
         ).set_fn(_export("queue_depth"))
         if kv_page_size is not None:
@@ -659,14 +680,14 @@ class ServingEngine:
                     return fn(engine.cache) if engine is not None else -1
                 return read
 
-            reg.gauge(
+            gauge(
                 "serving_kv_pages_total",
                 help="usable KV pool pages (reserved + quarantined excluded)",
             ).set_fn(_page_export(lambda c: c.alloc.capacity))
-            reg.gauge(
+            gauge(
                 "serving_kv_pages_free", help="KV pool pages on the free list"
             ).set_fn(_page_export(lambda c: c.alloc.free_pages))
-            reg.gauge(
+            gauge(
                 "serving_kv_pages_mapped",
                 help="KV pool pages mapped by some slot's block table",
             ).set_fn(_page_export(lambda c: c.pages_mapped))
@@ -881,6 +902,8 @@ class ServingEngine:
         on_token: Optional[Callable[[Request, int], None]] = None,
         deadline_s: Optional[float] = None,
         queue_timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> Request:
         """Enqueue one request; returns its live ``Request`` (``tokens``
         fills in as the engine steps). ``key`` defaults to a per-request
@@ -895,14 +918,25 @@ class ServingEngine:
         request requeued by preemption or dispatch recovery answers only to
         ``deadline_s``.
 
+        ``tenant``/``priority`` (ISSUE 11) attribute the request for
+        observability: per-tenant latency histograms, shed/timeout/reject
+        attribution, SLO attainment (``slo=`` specs), trace-flow and
+        flight-recorder tagging. Host strings only — attribution adds no
+        device work and no host syncs. Scheduling is unaffected in this
+        PR; the SLO-aware scheduler consumes these fields.
+
         Raises :class:`RejectedError` when the engine is draining/halted or
         the bounded queue (``max_queue``) is full, and ``ValueError`` for
         requests that could NEVER be placed (so an impossible request fails
         at the door instead of livelocking ``run()`` at the queue head)."""
+        tenant = str(tenant) if tenant is not None else "default"
+        priority = str(priority) if priority is not None else "standard"
         health = self.health()
         if health in (EngineHealth.DRAINING, EngineHealth.HALTED):
             depth = self.scheduler.queued
-            self.metrics.record_reject(depth, health.value)
+            self.metrics.record_reject(
+                depth, health.value, tenant=tenant, now=self._now()
+            )
             raise RejectedError(
                 f"engine is {health.value}; not accepting new requests",
                 queue_depth=depth,
@@ -970,10 +1004,13 @@ class ServingEngine:
         # an unserviceable backlog
         depth = self.scheduler.queued
         if self.max_queue is not None and depth >= self.max_queue:
-            self.metrics.record_reject(depth, "queue full")
+            self.metrics.record_reject(
+                depth, "queue full", tenant=tenant, now=self._now()
+            )
             if self.timeline is not None:
                 self.timeline.instant(
-                    "reject", "serving", args={"queue_depth": depth}
+                    "reject", "serving",
+                    args={"queue_depth": depth, "tenant": tenant},
                 )
             raise RejectedError(
                 f"queue full ({depth} >= max_queue {self.max_queue})",
@@ -984,7 +1021,8 @@ class ServingEngine:
         if key is None:
             key = jax.random.PRNGKey(rid)
         req = Request(
-            rid=rid, prompt=prompt, config=config, key=_key_data(key)
+            rid=rid, prompt=prompt, config=config, key=_key_data(key),
+            tenant=tenant, priority=priority,
         )
         req.submit_time = self._now()
         if deadline_s is not None:
@@ -999,7 +1037,15 @@ class ServingEngine:
             self.timeline.instant(f"submit r{rid}", "serving")
         # open the request's trace flow: every later lifecycle event links
         # back to this id, so one Perfetto flow is the request's whole life
-        self.tracer.begin(rid, args={"prompt_len": int(prompt.size)})
+        # (tenant/priority on the opening event tag the whole flow)
+        self.tracer.begin(
+            rid,
+            args={
+                "prompt_len": int(prompt.size),
+                "tenant": tenant,
+                "priority": priority,
+            },
+        )
         return req
 
     def cancel(self, rid: int) -> bool:
@@ -1092,13 +1138,20 @@ class ServingEngine:
         # operator; the timeline flushes too so the trace survives a crash
         if self.flight is not None:
             self.flight.record("halt", reason=reason)
-            self.flight.dump(
-                reason,
-                extra={
-                    "requeued": len(requeued),
-                    "metrics": self.metrics.snapshot(),
-                },
-            )
+            # who was being starved when the engine died: per-tenant queue
+            # depths AFTER the requeue (so in-flight victims count), plus
+            # the SLO attainment state — kept FLAT enough that the flight
+            # recorder's depth-capped redaction preserves every scalar
+            # (tests/observability/test_flight_recorder.py pins the schema)
+            extra = {
+                "requeued": len(requeued),
+                "metrics": self.metrics.snapshot(),
+                "tenant_queue_depths": self.scheduler.queued_by_tenant(),
+            }
+            if self.metrics.slo is not None:
+                extra["slo"] = self.metrics.slo.per_tenant()
+                extra["slo_totals"] = self.metrics.slo.totals()
+            self.flight.dump(reason, extra=extra)
         if self.timeline is not None:
             self.timeline.save()
 
@@ -1242,10 +1295,13 @@ class ServingEngine:
                     f"shed r{req.rid}", "serving",
                     args={"where": "queue", "reason": req.error},
                 )
-            self.tracer.end(req.rid, "shed", args={"where": "queue"})
+            self.tracer.end(
+                req.rid, "shed",
+                args={"where": "queue", "tenant": req.tenant},
+            )
             if self.flight is not None:
                 self.flight.record("shed", rid=req.rid, where="queue",
-                                   reason=req.error)
+                                   reason=req.error, tenant=req.tenant)
         for req in list(self._slot_req):
             if req is None or req.deadline is None or now < req.deadline:
                 continue
@@ -1260,11 +1316,13 @@ class ServingEngine:
                 )
             self.tracer.end(
                 req.rid, "shed",
-                args={"where": "inflight", "tokens": len(req.tokens)},
+                args={"where": "inflight", "tokens": len(req.tokens),
+                      "tenant": req.tenant},
             )
             if self.flight is not None:
                 self.flight.record("shed", rid=req.rid, where="inflight",
-                                   tokens=len(req.tokens))
+                                   tokens=len(req.tokens),
+                                   tenant=req.tenant)
             self._release_slot(req)
 
     # --- admission ----------------------------------------------------------
@@ -1534,7 +1592,7 @@ class ServingEngine:
             self.tracer.end(req.rid, "failed", args={"kind": "prefill"})
             if self.flight is not None:
                 self.flight.record("prefill_failure", rid=req.rid,
-                                   error=str(e))
+                                   error=str(e), tenant=req.tenant)
             self._on_token.pop(req.rid, None)
             self._consecutive_prefill_failures += 1
             if (
@@ -2289,7 +2347,8 @@ class ServingEngine:
             )
         if self.flight is not None:
             self.flight.record("quarantine", slot=slot,
-                               rid=req.rid if req else None, reason=reason)
+                               rid=req.rid if req else None, reason=reason,
+                               tenant=req.tenant if req else None)
         self._slot_req[slot] = None
         self._active[slot] = False
         self._state = self._slot_clear(self._state, np.int32(slot))
@@ -2350,7 +2409,8 @@ class ServingEngine:
             if self.timeline is not None:
                 self.timeline.instant(f"done r{req.rid}", "serving")
             self.tracer.end(req.rid, "retire",
-                            args={"tokens": len(req.tokens)})
+                            args={"tokens": len(req.tokens),
+                                  "tenant": req.tenant})
 
     def _release_slot(self, req: Request) -> None:
         slot = req.slot

@@ -20,13 +20,42 @@ whose value drifted with stream phase on long runs — and new TTFT/TPOT
 histograms (``ttft_p50_s``..``tpot_p99_s`` in the snapshot) feed the SLO
 scheduling work the ROADMAP names. Recording stays sync-free: every sample
 is a host scalar the engine already owned.
+
+Tenant attribution + SLO accounting (ISSUE 11):
+
+* Every request carries ``tenant``/``priority`` (threaded through
+  ``ServingEngine.submit``); TTFT, TPOT, and queue-wait additionally land
+  in per-tenant labeled histogram families
+  (``serving_tenant_ttft_s{tenant="..."}``), and sheds / timeouts /
+  rejects / failures / completions / delivered tokens get per-tenant
+  attribution counters — the ``snapshot()``'s ``tenants`` breakdown.
+* ``slo=`` (an :class:`~neuronx_distributed_tpu.observability.slo.SLOSpec`
+  or a ``{tenant: SLOSpec}`` dict) attaches an
+  :class:`~neuronx_distributed_tpu.observability.slo.SLOTracker`: each
+  request is classified once at its terminal state (attained / violated),
+  goodput = tokens from attaining requests per second, all per tenant —
+  the ``snapshot()``'s ``slo`` block and the labeled ``serving_slo_*``
+  Prometheus families.
+* ``engine_label=`` retires the PR 7 one-engine-per-registry restriction:
+  with a label, EVERY serving metric registers as a child of an
+  ``engine``-labeled family, so two labeled engines share one registry
+  (one scrape endpoint for a multi-engine host) without merging a single
+  counter. Unlabeled engines keep the loud rejection.
+
+All of it rides the same host scalars — zero added device→host syncs
+(re-pinned in tests/serving/test_host_sync.py with tenants + SLO on).
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
-from neuronx_distributed_tpu.observability.registry import MetricsRegistry
+from neuronx_distributed_tpu.observability.registry import (
+    MetricFamily,
+    MetricsRegistry,
+    MetricsView,
+)
+from neuronx_distributed_tpu.observability.slo import SLOSpec, SLOTracker
 from neuronx_distributed_tpu.observability.spec_stats import SpecStats
 
 
@@ -70,63 +99,150 @@ _COUNTERS = (
 
 _HEALTH_CODES = {"ok": 0, "degraded": 1, "draining": 2, "halted": 3}
 
+# per-tenant attribution counters (ISSUE 11): attr suffix -> registry
+# family name. Rejects/sheds/timeouts answer "WHO is being turned away",
+# completed/decode_tokens feed the per-tenant goodput/throughput story
+_TENANT_COUNTERS = (
+    ("submitted", "serving_tenant_submitted"),
+    ("completed", "serving_tenant_completed"),
+    ("decode_tokens", "serving_tenant_decode_tokens"),
+    ("sheds", "serving_tenant_sheds"),
+    ("timed_out", "serving_tenant_timed_out"),
+    ("rejects", "serving_tenant_rejects"),
+    ("failed", "serving_tenant_failed"),
+)
+
+
+def _tenant_of(req) -> str:
+    return getattr(req, "tenant", "default")
+
 
 class ServingMetrics:
     """Aggregates the engine's request lifecycle events into a registry."""
 
     def __init__(self, num_slots: int = 0,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 engine_label: Optional[str] = None,
+                 slo=None):
         self.num_slots = num_slots
-        if registry is not None and registry.get(_COUNTERS[0][1]) is not None:
-            # registries have no instance labels, so a second engine on the
-            # same registry would SILENTLY merge its counters into the
-            # first's (and last-writer-wins the export gauges). Refuse
-            # loudly: one registry per engine; sharing across SUBSYSTEMS
-            # (serving_ + train_ prefixes) is the supported pattern, and
-            # multi-replica aggregation belongs to the scrape layer
-            raise ValueError(
-                "registry already holds serving metrics (another "
-                "ServingEngine registered into it) — pass a distinct "
-                "MetricsRegistry per engine"
-            )
+        self.engine_label = engine_label
+        if registry is not None:
+            existing = registry.get(_COUNTERS[0][1])
+            if existing is not None:
+                # an unlabeled second engine on the same registry would
+                # SILENTLY merge its counters into the first's (and
+                # last-writer-wins the export gauges). Labeled metric
+                # families retire that restriction: when BOTH engines pass
+                # a distinct engine_label, every serving metric is a child
+                # of an `engine`-labeled family and the series stay
+                # separate. Anything else still fails loudly. Sharing
+                # across SUBSYSTEMS (serving_ + train_ prefixes) needs no
+                # labels either way
+                shareable = (
+                    engine_label is not None
+                    and isinstance(existing, MetricFamily)
+                    and existing.label_names == ("engine",)
+                    and not existing.has_child(engine_label)
+                )
+                if not shareable:
+                    raise ValueError(
+                        "registry already holds serving metrics (another "
+                        "ServingEngine registered into it) — pass a "
+                        "distinct engine_label= on every engine to share "
+                        "one registry via labeled families, or a distinct "
+                        "MetricsRegistry per engine"
+                    )
         self.registry = registry if registry is not None else MetricsRegistry()
+
+        # with an engine label, every serving metric resolves through an
+        # engine-scoped MetricsView (a family child keyed by the label);
+        # without one, the original unlabeled series. The record paths
+        # below are identical either way — children ARE plain
+        # Counter/Gauge/Histogram instances. The view is the ONE owner of
+        # the labeling scheme (SpecStats and SLOTracker ride it too)
+        self.view = (
+            MetricsView(self.registry, ("engine",), (engine_label,))
+            if engine_label is not None else MetricsView(self.registry)
+        )
+        own_counter = self.view.counter
+        own_histogram = self.view.histogram
+        self.own_gauge = self.view.gauge  # the engine's export gauges
         self._c = {}
         for attr, name, is_int in _COUNTERS:
-            self._c[attr] = (self.registry.counter(name), is_int)
+            self._c[attr] = (own_counter(name), is_int)
         # latency histograms: log-bucketed, fixed memory, quantiles exact
         # to the bucket (observability/registry.py) — prefill feeds the
         # legacy prefill_p95_s key; TTFT/TPOT feed the SLO roadmap item
-        self._h_prefill = self.registry.histogram(
+        self._h_prefill = own_histogram(
             "serving_prefill_latency_s",
             help="wall time of one successful prefill dispatch (s)",
         )
-        self._h_ttft = self.registry.histogram(
+        self._h_ttft = own_histogram(
             "serving_ttft_s", help="submit -> first token (s)"
         )
-        self._h_tpot = self.registry.histogram(
+        self._h_tpot = own_histogram(
             "serving_tpot_s",
             help="per-request mean time per output token after the first (s)",
         )
-        self._h_queue_wait = self.registry.histogram(
+        self._h_queue_wait = own_histogram(
             "serving_queue_wait_s", help="submit -> first admission (s)"
         )
-        self._g_cursor = self.registry.gauge(
+        self._g_cursor = self.view.gauge(
             "serving_cursor_high_water", help="highest shared cache cursor seen"
         )
-        self._g_health = self.registry.gauge(
+        self._g_health = self.view.gauge(
             "serving_health", help="0=ok 1=degraded 2=draining 3=halted"
         )
         self._g_health.set_fn(lambda: _HEALTH_CODES.get(self.health, -1))
+        # per-tenant labeled families (tenant label; engine+tenant when
+        # this engine itself is labeled — the view prepends its scope).
+        # Registered up front so the exposition surface exists before
+        # traffic arrives; children materialize per tenant on first use
+        self._tc: Dict[str, MetricFamily] = {
+            attr: self.view.family("counter", name)
+            for attr, name in _TENANT_COUNTERS
+        }
+        self._th_ttft = self.view.family(
+            "histogram", "serving_tenant_ttft_s",
+            help="submit -> first token per tenant (s)",
+        )
+        self._th_tpot = self.view.family(
+            "histogram", "serving_tenant_tpot_s",
+            help="per-request mean time per output token per tenant (s)",
+        )
+        self._th_queue_wait = self.view.family(
+            "histogram", "serving_tenant_queue_wait_s",
+            help="submit -> first admission per tenant (s)",
+        )
+        self._tenants_seen = set()
+        # SLO accounting (observability/slo.py): classify every request
+        # once at its terminal state against its tenant's SLOSpec; export
+        # attainment + goodput per tenant through the same registry
+        if slo is not None and not isinstance(slo, SLOTracker):
+            slo = SLOTracker(
+                slo, registry=self.registry, prefix="serving_slo",
+                view=self.view,
+            )
+        self.slo: Optional[SLOTracker] = slo
         # speculative-decoding acceptance stats: the SHARED recorder (solo
         # speculative_generate reports through the same class, so both
         # paths expose identical names/keys); always registered so the
         # snapshot surface is stable whether or not a draft model is bound
-        self.spec = SpecStats(self.registry, prefix="spec")
-        self.registry.gauge("serving_num_slots").set(num_slots)
+        self.spec = SpecStats(self.registry, prefix="spec", view=self.view)
+        self.view.gauge("serving_num_slots").set(num_slots)
         self.health = "ok"  # engine-owned mirror of ServingEngine.health()
         self.cursor_high_water = 0
         # per-request
         self._requests: Dict[int, dict] = {}
+
+    def _tenant_inc(self, attr: str, tenant: str, n=1) -> None:
+        self._tenants_seen.add(tenant)
+        self.view.child(self._tc[attr], tenant).inc(n)
+
+    def _tenant_observe(self, family: MetricFamily, tenant: str,
+                        value: float) -> None:
+        self._tenants_seen.add(tenant)
+        self.view.child(family, tenant).observe(value)
 
     def __getattr__(self, name):
         # counter attributes (``metrics.steps`` etc.) read through to the
@@ -146,11 +262,19 @@ class ServingMetrics:
     # --- request lifecycle --------------------------------------------------
 
     def record_submit(self, req, now: float) -> None:
+        tenant = _tenant_of(req)
         self._requests[req.rid] = {
             "rid": req.rid,
             "prompt_len": int(len(req.prompt)),
             "submit_time": now,
+            "tenant": tenant,
+            "priority": getattr(req, "priority", "standard"),
         }
+        self._tenant_inc("submitted", tenant)
+        if self.slo is not None:
+            # goodput's denominator starts at the FIRST submit, not the
+            # first finish — idle-tail truncation would inflate it
+            self.slo.touch(now)
 
     def record_admit(self, req, now: float) -> None:
         r = self._requests[req.rid]
@@ -160,6 +284,9 @@ class ServingMetrics:
         if "queue_wait" not in r:
             r["queue_wait"] = now - r["submit_time"]
             self._h_queue_wait.observe(r["queue_wait"])
+            self._tenant_observe(
+                self._th_queue_wait, _tenant_of(req), r["queue_wait"]
+            )
         self._inc("prefills")
 
     def record_first_token(self, req, now: float) -> None:
@@ -167,9 +294,11 @@ class ServingMetrics:
         r["first_token_time"] = now
         r["ttft"] = now - r["submit_time"]
         self._h_ttft.observe(r["ttft"])
+        self._tenant_observe(self._th_ttft, _tenant_of(req), r["ttft"])
 
     def record_finish(self, req, now: float) -> None:
         r = self._requests[req.rid]
+        tenant = _tenant_of(req)
         r["finish_time"] = now
         r["latency"] = now - r["submit_time"]
         r["tokens"] = len(req.tokens)
@@ -178,12 +307,31 @@ class ServingMetrics:
         r["decode_tokens_per_sec"] = (
             (len(req.tokens) - 1) / decode_span if decode_span > 0 else 0.0
         )
-        if len(req.tokens) > 1:
-            self._h_tpot.observe(decode_span / (len(req.tokens) - 1))
+        # TPOT is undefined for single-token requests (None: an SLO TPOT
+        # bound passes vacuously); a 0-span multi-chunk finish under a
+        # virtual clock observes 0 (the histogram's zero bucket)
+        tpot = (
+            decode_span / (len(req.tokens) - 1)
+            if len(req.tokens) > 1 else None
+        )
+        if tpot is not None:
+            self._h_tpot.observe(tpot)
+            self._tenant_observe(self._th_tpot, tenant, tpot)
         r["preemptions"] = req.preemptions
         self._inc("completed")
+        self._tenant_inc("completed", tenant)
+        self._tenant_inc("decode_tokens", tenant, len(req.tokens))
+        if self.slo is not None:
+            # the ONE terminal classification of a finished request —
+            # preemption/recovery requeues re-admit but never re-finish,
+            # so a requeued-then-finished request counts exactly once
+            r["slo_attained"] = self.slo.record_finish(
+                tenant, r.get("ttft"), tpot, len(req.tokens), now
+            )
 
     def record_cancel(self, req, now: float) -> None:
+        # a user cancellation is neither attained nor violated — the
+        # engine met whatever contract the caller abandoned
         r = self._requests.get(req.rid)
         if r is not None:
             r["finish_time"] = now
@@ -198,6 +346,7 @@ class ServingMetrics:
     def record_shed(self, req, now: float, where: str) -> None:
         """A request timed out — ``where`` is ``"queue"`` (shed before
         prefill) or ``"inflight"`` (deadline hit at a chunk boundary)."""
+        tenant = _tenant_of(req)
         r = self._requests.get(req.rid)
         if r is not None:
             r["finish_time"] = now
@@ -206,9 +355,28 @@ class ServingMetrics:
             r["tokens"] = len(req.tokens)
         self._inc("sheds")
         self._inc("timed_out")
+        self._tenant_inc("sheds", tenant)
+        self._tenant_inc("timed_out", tenant)
+        if self.slo is not None:
+            # tokens a shed request already streamed are wasted work:
+            # total, never goodput
+            self.slo.record_violation(
+                tenant, now,
+                reason=f"shed_{where}", tokens=len(req.tokens),
+            )
 
-    def record_reject(self, queue_depth: int, reason: str) -> None:
+    def record_reject(self, queue_depth: int, reason: str,
+                      tenant: str = "default",
+                      now: Optional[float] = None) -> None:
+        """A submission refused at the door (queue full, draining,
+        halted) — no Request exists, so the tenant (and the engine-clock
+        timestamp) ride in directly. Rejected traffic is an SLO
+        violation: shedding a tenant's load must never read as improving
+        its attainment."""
         self._inc("rejects")
+        self._tenant_inc("rejects", tenant)
+        if self.slo is not None:
+            self.slo.record_violation(tenant, now, reason="reject")
 
     def record_quarantine(self, slot: int, rid) -> None:
         self._inc("quarantines")
@@ -223,14 +391,20 @@ class ServingMetrics:
         """A request the engine failed for cause (``req.error`` has the
         reason): ``kind`` is ``"prefill"`` (OOM-like admission fault) or
         ``"quarantine"`` (poisoned slot under the fail policy)."""
+        tenant = _tenant_of(req)
         r = self._requests.get(req.rid)
         if r is not None:
             r["finish_time"] = now
             r["failed"] = True
             r["failed_kind"] = kind
         self._inc("failed")
+        self._tenant_inc("failed", tenant)
         if kind == "prefill":
             self._inc("prefill_failures")
+        if self.slo is not None:
+            self.slo.record_violation(
+                tenant, now, reason=f"failed_{kind}", tokens=len(req.tokens)
+            )
 
     # --- prefix cache -------------------------------------------------------
 
@@ -339,6 +513,44 @@ class ServingMetrics:
         r = self._requests.get(rid)
         return dict(r) if r is not None else None
 
+    def _tenant_child_value(self, attr: str, tenant: str) -> int:
+        fam = self._tc[attr]
+        if not self.view.has_child(fam, tenant):
+            return 0
+        return int(self.view.child(fam, tenant).value)
+
+    def tenant_snapshot(self) -> Dict[str, dict]:
+        """Per-tenant breakdown (tenant-sorted, deterministic keys):
+        attribution counters + the tenant's latency percentiles off its
+        labeled histogram children. READ-only: a tenant that never
+        recorded a latency (e.g. only ever rejected at the door) reports
+        0.0 percentiles without materializing empty histogram children —
+        a snapshot must not change what the next scrape exports."""
+        out: Dict[str, dict] = {}
+        for tenant in sorted(self._tenants_seen):
+            row = {
+                attr: self._tenant_child_value(attr, tenant)
+                for attr, _ in _TENANT_COUNTERS
+            }
+            for key, fam in (
+                ("ttft", self._th_ttft),
+                ("tpot", self._th_tpot),
+            ):
+                h = (
+                    self.view.child(fam, tenant)
+                    if self.view.has_child(fam, tenant) else None
+                )
+                for q in (50, 95, 99):
+                    row[f"{key}_p{q}_s"] = (
+                        h.percentile(q / 100.0) if h is not None else 0.0
+                    )
+            row["queue_wait_p95_s"] = (
+                self.view.child(self._th_queue_wait, tenant).percentile(0.95)
+                if self.view.has_child(self._th_queue_wait, tenant) else 0.0
+            )
+            out[tenant] = row
+        return out
+
     def snapshot(self) -> dict:
         """Plain-dict export (log lines, tests, dashboards). Every key of
         the pre-registry snapshot is preserved in name and type; the
@@ -409,6 +621,15 @@ class ServingMetrics:
             "tpot_p95_s": self._h_tpot.percentile(0.95),
             "tpot_p99_s": self._h_tpot.percentile(0.99),
             "queue_wait_p95_s": self._h_queue_wait.percentile(0.95),
+            # per-tenant attribution (ISSUE 11): who submitted, who got
+            # served, who was shed — plus each tenant's own latency
+            # percentiles (labeled histogram families)
+            "tenants": self.tenant_snapshot(),
+            # SLO accounting (present only with slo= specs): attainment +
+            # goodput, totals and per tenant
+            **(
+                {"slo": self.slo.snapshot()} if self.slo is not None else {}
+            ),
             # speculative serving (ISSUE 9): identical keys to the solo
             # speculative path's registry reporting — all zero without a
             # draft model

@@ -54,6 +54,12 @@ class Request:
     prompt: np.ndarray  # (S,) int32
     config: GenerationConfig
     key: Any  # jax PRNG key data, advances as tokens are sampled
+    # attribution (ISSUE 11): which tenant submitted this and at what
+    # priority class — pure host strings threaded into per-tenant metrics,
+    # SLO accounting, trace flows, and flight-recorder events. Scheduling
+    # itself stays FIFO in this PR; the SLO-aware scheduler consumes these
+    tenant: str = "default"
+    priority: str = "standard"
     state: RequestState = RequestState.QUEUED
     tokens: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
@@ -231,6 +237,15 @@ class Scheduler:
     @property
     def queued(self) -> int:
         return len(self.queued_requests)
+
+    def queued_by_tenant(self) -> Dict[str, int]:
+        """Live queue depth per tenant, tenant-sorted — who is waiting
+        (and, at halt time, who was being starved: the flight recorder
+        dumps this into the post-mortem's ``extra``)."""
+        depths: Dict[str, int] = {}
+        for r in self.queued_requests:
+            depths[r.tenant] = depths.get(r.tenant, 0) + 1
+        return dict(sorted(depths.items()))
 
     def get(self, rid: int) -> Optional[Request]:
         return self._requests.get(rid)

@@ -185,3 +185,69 @@ def test_trainer_anomaly_budget_halt_dumps_postmortem(tmp_path):
     halt_ev = [e for e in pm["events"] if e["kind"] == "halt"][-1]
     assert halt_ev["emergency_tag"] == ei.value.emergency_tag
     assert pm["extra"]["anomaly_skips"] == 3
+
+
+def test_halt_postmortem_records_slo_and_tenant_queue_depths(tmp_path):
+    """ISSUE 11 satellite: a crash under multi-tenant load records WHO was
+    being starved — the post-mortem's ``extra`` carries per-tenant queue
+    depths (post-requeue, so in-flight victims count) and the per-tenant
+    SLO attainment state, with every scalar surviving the depth-capped
+    redaction (the schema this test pins)."""
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+    from neuronx_distributed_tpu.observability import SLOSpec
+    from neuronx_distributed_tpu.serving import (
+        EngineHealth,
+        FaultInjector,
+        ServingEngine,
+    )
+
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2,
+        fault_injector=FaultInjector().fail_dispatch(at=2, times=None),
+        flight_dir=str(tmp_path), sleep_fn=lambda s: None,
+        slo={"chat": SLOSpec(ttft_p99_s=1e6)},
+    )
+    gcfg = GenerationConfig(max_new_tokens=16, temperature=0.0)
+    done = engine.submit(
+        np.asarray([1, 2, 3], np.int32),
+        GenerationConfig(max_new_tokens=2, temperature=0.0),
+        key=jax.random.PRNGKey(1), tenant="chat",
+    )
+    engine.step()  # chat finishes within its first chunk → one ATTAINED
+    assert done.finished
+    starved = [
+        engine.submit(np.asarray([4 + i, 5 + i], np.int32), gcfg,
+                      key=jax.random.PRNGKey(10 + i), tenant=t)
+        for i, t in enumerate(["chat", "bulk", "bulk"])
+    ]
+    engine.run()  # dispatch failures exhaust the budget → HALT mid-load
+    assert engine.health() is EngineHealth.HALTED
+
+    dumps = sorted(tmp_path.glob("postmortem_serving_*.json"))
+    assert len(dumps) == 1
+    pm = json.load(open(dumps[0]))
+    extra = pm["extra"]
+    # schema: who was waiting when the engine died (requeued included)
+    assert extra["tenant_queue_depths"] == {"bulk": 2, "chat": 1}
+    # schema: the SLO state, flat enough that redaction keeps the scalars
+    assert extra["slo"]["chat"]["attained"] == 1
+    assert isinstance(extra["slo"]["chat"]["goodput_tok_s"], float)
+    assert extra["slo_totals"]["attained"] == 1
+    assert extra["slo_totals"]["violated"] == 0
+    assert isinstance(extra["slo_totals"]["span_s"], float)
+    # the shed/starved requests survive in the queue, unclassified (they
+    # are not terminal — an operator handoff may still finish them)
+    assert all(not r.finished for r in starved)
+    # tenant attribution on the ring events themselves
+    ev_tenants = {
+        e.get("tenant") for e in pm["events"] if e["kind"] == "shed"
+    }
+    assert ev_tenants <= {"chat", "bulk"}  # no foreign values leaked

@@ -1,0 +1,268 @@
+"""Labeled metric families (ISSUE 11 tentpole a).
+
+``registry.counter(name, labels=("tenant",))`` returns a get-or-create
+family of per-labelset children; snapshots are label-aware; the Prometheus
+exposition escapes label values per the text format (backslash, quote,
+newline) so a hostile tenant string cannot break a scrape; and labeled
+serving metrics retire PR 7's one-engine-per-registry restriction."""
+
+import json
+import re
+
+import pytest
+
+from neuronx_distributed_tpu.observability import (
+    MetricFamily,
+    MetricsRegistry,
+)
+from neuronx_distributed_tpu.observability.registry import escape_label_value
+
+
+# --- family mechanics --------------------------------------------------------
+
+
+def test_family_children_are_get_or_create():
+    reg = MetricsRegistry()
+    fam = reg.counter("req_total", labels=("tenant",))
+    assert isinstance(fam, MetricFamily)
+    a = fam.labels("acme")
+    a.inc(3)
+    assert fam.labels("acme") is a  # same child object
+    assert fam.labels("acme").value == 3
+    fam.labels("bulk").inc()
+    assert fam.labels("bulk").value == 1  # independent streams
+    assert reg.counter("req_total", labels=("tenant",)) is fam
+
+
+def test_family_labels_by_name_and_arity_checks():
+    reg = MetricsRegistry()
+    fam = reg.histogram("lat_s", labels=("engine", "tenant"))
+    h = fam.labels(engine="e0", tenant="acme")
+    assert fam.labels("e0", "acme") is h
+    with pytest.raises(ValueError):
+        fam.labels("e0")  # missing a value
+    with pytest.raises(ValueError):
+        fam.labels("e0", "acme", "extra")
+    with pytest.raises(ValueError):
+        fam.labels(engine="e0", nope="x")
+    with pytest.raises(ValueError):
+        fam.labels("e0", tenant="acme")  # mixed positional + named
+
+
+def test_family_vs_plain_type_conflicts():
+    reg = MetricsRegistry()
+    reg.counter("plain")
+    with pytest.raises(TypeError):
+        reg.counter("plain", labels=("tenant",))
+    reg.counter("fam", labels=("tenant",))
+    with pytest.raises(TypeError):
+        reg.counter("fam")  # family fetched without labels
+    with pytest.raises(TypeError):
+        reg.gauge("fam", labels=("tenant",))  # wrong child type
+    with pytest.raises(TypeError):
+        reg.counter("fam", labels=("engine",))  # wrong label names
+
+
+def test_family_needs_label_names():
+    with pytest.raises(ValueError):
+        MetricFamily("x", type(None), ())
+
+
+def test_label_aware_snapshot_is_json_and_deterministic():
+    reg = MetricsRegistry()
+    fam = reg.counter("req_total", labels=("tenant",))
+    fam.labels("zeta").inc(1)
+    fam.labels("acme").inc(2)
+    h = reg.histogram("lat_s", labels=("engine", "tenant"))
+    h.labels("e0", "acme").observe(0.5)
+    snap = reg.snapshot()
+    json.dumps(snap)  # JSON-serializable
+    assert snap["req_total"]["labels"] == ["tenant"]
+    # children sorted by labelset, single-label keys are the bare value
+    assert list(snap["req_total"]["children"]) == ["acme", "zeta"]
+    assert snap["req_total"]["children"]["acme"] == 2
+    # multi-label keys are JSON lists (comma-in-value cannot collide)
+    assert list(snap["lat_s"]["children"]) == ['["e0", "acme"]']
+    assert snap["lat_s"]["children"]['["e0", "acme"]']["count"] == 1
+
+
+# --- exposition escaping (satellite: property-style over hostile values) -----
+
+HOSTILE_VALUES = [
+    'quote" inject',
+    'close"} evil_metric{x="y',
+    "back\\slash",
+    "new\nline",
+    '\\"both\\" and \n more \\',
+    "unicode-ütf∞",
+    "",  # empty value is legal
+    "a" * 300,
+]
+
+# one exposition line: name{label="value",...} number — value chars are
+# anything except raw ", \, or newline (escapes \\ \" \n allowed)
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*")*\} '
+    r'-?[0-9.e+\-]+$'
+)
+
+
+def _unescape(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def test_escape_roundtrip_property():
+    for v in HOSTILE_VALUES:
+        assert _unescape(escape_label_value(v)) == v
+        # escaped form never contains a raw quote/newline, and every
+        # backslash starts a valid escape
+        esc = escape_label_value(v)
+        assert "\n" not in esc
+        assert re.fullmatch(r'(?:[^"\\\n]|\\\\|\\"|\\n)*', esc), esc
+
+
+def test_hostile_tenant_values_cannot_break_exposition():
+    reg = MetricsRegistry()
+    fam = reg.counter("req_total", labels=("tenant",))
+    hist = reg.histogram("lat_s", labels=("tenant",))
+    for i, v in enumerate(HOSTILE_VALUES):
+        fam.labels(v).inc(i + 1)
+        hist.labels(v).observe(0.25)
+    text = reg.prometheus_text()
+    seen_values = []
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert _SAMPLE_RE.match(line), f"malformed exposition line: {line!r}"
+        for m in re.finditer(r'tenant="((?:[^"\\\n]|\\\\|\\"|\\n)*)"', line):
+            seen_values.append(_unescape(m.group(1)))
+    # every hostile value round-trips out of the exposition intact
+    for v in HOSTILE_VALUES:
+        assert v in seen_values, f"value {v!r} lost in exposition"
+
+
+def test_label_names_sanitized_consistently():
+    reg = MetricsRegistry()
+    fam = reg.counter("c", labels=("bad-name!",))
+    assert fam.label_names == ("bad_name_",)
+    fam.labels("v").inc()
+    text = reg.prometheus_text()
+    assert 'bad_name_="v"' in text
+    # the sanitized name is the registered identity — both spellings
+    # resolve to the same family, a DIFFERENT name does not
+    assert reg.counter("c", labels=("bad_name_",)) is fam
+    with pytest.raises(TypeError):
+        reg.counter("c", labels=("other",))
+
+
+def test_labeled_histogram_exposition_composes_le():
+    reg = MetricsRegistry()
+    fam = reg.histogram("lat_s", labels=("tenant",))
+    fam.labels("acme").observe(0.5)
+    fam.labels("acme").observe(0.0)  # zero bucket
+    text = reg.prometheus_text()
+    assert 'lat_s_bucket{tenant="acme",le="0"} 1' in text
+    assert 'lat_s_bucket{tenant="acme",le="+Inf"} 2' in text
+    assert 'lat_s_count{tenant="acme"} 2' in text
+    assert 'lat_s_sum{tenant="acme"} 0.5' in text
+    # cumulative monotone within the labelset
+    cums = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("lat_s_bucket")
+    ]
+    assert cums == sorted(cums)
+
+
+# --- retiring the one-engine-per-registry restriction ------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_setup():
+    import jax
+
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+def test_two_labeled_engines_share_one_registry(engine_setup):
+    """ISSUE 11: engine_label= retires PR 7's restriction — two labeled
+    engines on one registry keep fully separate series (nothing merges),
+    one scrape endpoint serves both."""
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.serving import ServingEngine
+
+    cfg, model, params = engine_setup
+    reg = MetricsRegistry()
+    e0 = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=None, engine_label="replica0", registry=reg,
+    )
+    e1 = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4,
+        prefix_cache=None, engine_label="replica1", registry=reg,
+    )
+    req = e0.submit(
+        np.arange(1, 7, dtype=np.int32),
+        GenerationConfig(max_new_tokens=6, temperature=0.0),
+        key=jax.random.PRNGKey(3), tenant="acme",
+    )
+    e0.run()
+    assert req.tokens and e0.metrics.completed == 1
+    assert e1.metrics.completed == 0  # nothing merged
+    text = reg.prometheus_text()
+    assert 'serving_completed{engine="replica0"} 1' in text
+    assert 'serving_completed{engine="replica1"} 0' in text
+    # per-tenant series carry both labels
+    assert (
+        'serving_tenant_completed{engine="replica0",tenant="acme"} 1'
+        in text
+    )
+    # snapshots stay engine-scoped
+    assert e0.metrics.snapshot()["tenants"]["acme"]["completed"] == 1
+    assert e1.metrics.snapshot()["tenants"] == {}
+
+
+def test_label_collisions_still_rejected(engine_setup):
+    """Same label twice, unlabeled-after-labeled, and labeled-after-
+    unlabeled all keep the loud PR 7 rejection."""
+    from neuronx_distributed_tpu.serving import ServingEngine
+
+    cfg, model, params = engine_setup
+    reg = MetricsRegistry()
+    ServingEngine(model, params, num_slots=1, prefix_cache=None,
+                  engine_label="r0", registry=reg)
+    with pytest.raises(ValueError, match="engine_label"):
+        ServingEngine(model, params, num_slots=1, prefix_cache=None,
+                      engine_label="r0", registry=reg)
+    with pytest.raises(ValueError, match="distinct"):
+        ServingEngine(model, params, num_slots=1, prefix_cache=None,
+                      registry=reg)
+    reg2 = MetricsRegistry()
+    ServingEngine(model, params, num_slots=1, prefix_cache=None,
+                  registry=reg2)
+    with pytest.raises(ValueError, match="distinct MetricsRegistry"):
+        ServingEngine(model, params, num_slots=1, prefix_cache=None,
+                      engine_label="r1", registry=reg2)

@@ -1,0 +1,229 @@
+"""SLO accounting (ISSUE 11 tentpole c): SLOSpec semantics, attainment /
+goodput arithmetic, per-tenant breakdown, registry export, and the engine
+integration under a fake clock (deterministic latencies)."""
+
+import json
+
+import pytest
+
+from neuronx_distributed_tpu.observability import (
+    MetricsRegistry,
+    SLOSpec,
+    SLOTracker,
+)
+
+
+# --- SLOSpec ------------------------------------------------------------------
+
+
+def test_spec_attains_semantics():
+    spec = SLOSpec(ttft_p99_s=0.5, tpot_p99_s=0.05)
+    assert spec.attains(0.5, 0.05)          # bounds inclusive
+    assert not spec.attains(0.51, 0.01)     # ttft blown
+    assert not spec.attains(0.1, 0.06)      # tpot blown
+    assert not spec.attains(None, 0.01)     # no first token ever
+    assert spec.attains(0.1, None)          # single-token: tpot vacuous
+    assert SLOSpec(ttft_p99_s=0.5).attains(0.4, 99.0)  # unbounded tpot
+    assert SLOSpec().attains(None, None)    # fully unbounded
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        SLOSpec(ttft_p99_s=0.0)
+    with pytest.raises(ValueError):
+        SLOSpec(tpot_p99_s=-1.0)
+
+
+# --- SLOTracker ---------------------------------------------------------------
+
+
+def test_tracker_attainment_and_goodput():
+    t = SLOTracker({"chat": SLOSpec(ttft_p99_s=0.2, tpot_p99_s=0.02)})
+    t.touch(0.0)  # first submit
+    assert t.record_finish("chat", 0.1, 0.01, tokens=10, now=5.0)
+    assert not t.record_finish("chat", 0.9, 0.01, tokens=30, now=10.0)
+    snap = t.snapshot()
+    assert snap["attained"] == 1 and snap["violated"] == 1
+    assert snap["attainment"] == 0.5
+    assert snap["attained_tokens"] == 10 and snap["total_tokens"] == 40
+    assert snap["span_s"] == 10.0
+    # goodput = attaining tokens / span: violated tokens never count
+    assert snap["goodput_tok_s"] == pytest.approx(1.0)
+    assert snap["per_tenant"]["chat"]["attainment"] == 0.5
+    assert snap["violation_reasons"] == {"chat": {"latency": 1}}
+    json.dumps(snap)
+
+
+def test_tracker_violations_from_faults():
+    t = SLOTracker(SLOSpec(ttft_p99_s=1.0))  # bare spec = default for all
+    t.record_violation("a", 1.0, reason="shed_queue")
+    t.record_violation("a", 2.0, reason="shed_inflight", tokens=4)
+    t.record_violation("b", 3.0, reason="reject")
+    snap = t.snapshot()
+    assert snap["violated"] == 3 and snap["attained"] == 0
+    # partial tokens from a shed request are work, never goodput
+    assert snap["total_tokens"] == 4 and snap["attained_tokens"] == 0
+    assert snap["goodput_tok_s"] == 0.0
+    assert snap["violation_reasons"]["a"] == {
+        "shed_inflight": 1, "shed_queue": 1,
+    }
+
+
+def test_untracked_tenant_not_classified():
+    t = SLOTracker({"chat": SLOSpec(ttft_p99_s=0.2)})
+    assert t.record_finish("other", 99.0, None, tokens=5, now=1.0)
+    t.record_violation("other", 2.0)
+    snap = t.snapshot()
+    assert snap["attained"] == 0 and snap["violated"] == 0
+    assert "other" not in snap["per_tenant"]
+
+
+def test_default_spec_and_per_tenant_override():
+    t = SLOTracker(
+        {"tight": SLOSpec(ttft_p99_s=0.1)},
+        default=SLOSpec(ttft_p99_s=10.0),
+    )
+    assert not t.record_finish("tight", 0.5, None, tokens=1, now=1.0)
+    assert t.record_finish("loose", 0.5, None, tokens=1, now=2.0)
+    assert t.snapshot()["per_tenant"]["tight"]["violated"] == 1
+    assert t.snapshot()["per_tenant"]["loose"]["attained"] == 1
+
+
+def test_bare_spec_plus_default_rejected():
+    with pytest.raises(ValueError):
+        SLOTracker(SLOSpec(ttft_p99_s=1.0), default=SLOSpec())
+    with pytest.raises(TypeError):
+        SLOTracker({"a": 0.5})
+
+
+def test_none_now_leaves_span_alone():
+    t = SLOTracker(SLOSpec(ttft_p99_s=1.0))
+    t.record_violation("a", None, reason="reject")
+    assert t.span_s == 0.0
+    t.touch(5.0)
+    t.touch(8.0)
+    assert t.span_s == 3.0
+
+
+def test_registry_export_labeled():
+    reg = MetricsRegistry()
+    t = SLOTracker(
+        {"chat": SLOSpec(ttft_p99_s=0.2)}, registry=reg, prefix="slo"
+    )
+    t.record_finish("chat", 0.1, None, tokens=7, now=1.0)
+    t.record_violation("chat", 2.0, reason="shed_queue")
+    text = reg.prometheus_text()
+    assert 'slo_attained_requests{tenant="chat"} 1' in text
+    assert 'slo_violated_requests{tenant="chat"} 1' in text
+    assert 'slo_attained_tokens{tenant="chat"} 7' in text
+    assert 'slo_attainment{tenant="chat"} 0.5' in text
+
+
+def test_registry_export_engine_labeled():
+    from neuronx_distributed_tpu.observability.registry import MetricsView
+
+    reg = MetricsRegistry()
+    t = SLOTracker(
+        SLOSpec(ttft_p99_s=0.2), prefix="slo",
+        view=MetricsView(reg, ("engine",), ("e0",)),
+    )
+    t.record_finish("chat", 0.1, None, tokens=3, now=1.0)
+    assert (
+        'slo_attained_requests{engine="e0",tenant="chat"} 1'
+        in reg.prometheus_text()
+    )
+
+
+# --- engine integration -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+def test_engine_classifies_requests_against_slo(setup):
+    """Fake clock ⇒ deterministic latencies: a request admitted instantly
+    attains, one submitted while every slot is busy accrues queue-wait
+    TTFT and violates its (tight) spec; both show in snapshot + export."""
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.serving import ServingEngine
+
+    cfg, model, params = setup
+    clock = {"t": 0.0}
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=2, prefix_cache=None,
+        time_fn=lambda: clock["t"],
+        slo={"chat": SLOSpec(ttft_p99_s=0.5)},
+    )
+    gcfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    fast = engine.submit(np.asarray([1, 2, 3], np.int32), gcfg,
+                         key=jax.random.PRNGKey(0), tenant="chat")
+    engine.step()  # fast admitted at t=0 → ttft 0
+    slow = engine.submit(np.asarray([4, 5, 6], np.int32), gcfg,
+                         key=jax.random.PRNGKey(1), tenant="chat")
+    while slow.slot is None and engine.has_work:
+        clock["t"] += 0.4  # queue wait accrues past the 0.5s bound
+        engine.step()
+    engine.run()
+    assert fast.tokens and slow.tokens
+    snap = engine.metrics.snapshot()
+    assert snap["slo"]["attained"] == 1
+    assert snap["slo"]["violated"] == 1
+    assert snap["slo"]["per_tenant"]["chat"]["attainment"] == 0.5
+    assert snap["slo"]["violation_reasons"] == {"chat": {"latency": 1}}
+    # goodput counts only the attaining request's tokens
+    assert snap["slo"]["attained_tokens"] == len(fast.tokens)
+    # request snapshots carry the verdict
+    assert engine.metrics.request_snapshot(fast.rid)["slo_attained"] is True
+    assert engine.metrics.request_snapshot(slow.rid)["slo_attained"] is False
+    text = engine.metrics.registry.prometheus_text()
+    assert 'serving_slo_attained_requests{tenant="chat"} 1' in text
+    assert 'serving_slo_violated_requests{tenant="chat"} 1' in text
+
+
+def test_engine_shed_and_reject_are_violations(setup):
+    """Terminal faults classify as violations with attributed reasons:
+    a queue-timeout shed and a door reject both land on the right tenant."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.serving import RejectedError, ServingEngine
+
+    cfg, model, params = setup
+    clock = {"t": 0.0}
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=2, prefix_cache=None,
+        max_queue=1, time_fn=lambda: clock["t"],
+        slo=SLOSpec(ttft_p99_s=10.0),
+    )
+    gcfg = GenerationConfig(max_new_tokens=20, temperature=0.0)
+    engine.submit(np.asarray([1, 2], np.int32), gcfg, tenant="a")
+    engine.step()  # slot taken
+    victim = engine.submit(np.asarray([3, 4], np.int32), gcfg,
+                           tenant="b", queue_timeout_s=1.0)
+    with pytest.raises(RejectedError):
+        engine.submit(np.asarray([5, 6], np.int32), gcfg, tenant="c")
+    clock["t"] = 2.0  # past b's queue timeout
+    engine.run()
+    snap = engine.metrics.snapshot()
+    assert victim.tokens == []
+    assert snap["slo"]["violation_reasons"]["b"] == {"shed_queue": 1}
+    assert snap["slo"]["violation_reasons"]["c"] == {"reject": 1}
+    assert snap["tenants"]["b"]["sheds"] == 1
+    assert snap["tenants"]["c"]["rejects"] == 1
+    assert snap["slo"]["per_tenant"]["a"]["attained"] == 1

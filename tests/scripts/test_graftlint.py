@@ -257,6 +257,38 @@ def test_gl02_observability_emit_paths_are_hot(tmp_path):
     assert report.violations == []
 
 
+def test_gl02_slo_and_traffic_modules_are_hot(tmp_path):
+    """ISSUE 11 satellite: the SLO tracker's record paths run inside the
+    engine's chunk-boundary bookkeeping and the traffic replay loop wraps
+    engine.step() — both are hot BY PATH, so an implicit sync smuggled
+    into either trips GL02 with no marker needed."""
+    fixture = """\
+        import jax.numpy as jnp
+
+        def record(tracker, x):
+            tracker.record_finish("t", float(jnp.sum(x)), None, 1, 0.0)
+        """
+    for name in ("observability/slo.py", "serving/traffic.py"):
+        assert "GL02" in rules_of(lint(tmp_path, fixture, name=name)), name
+    # an explicit undocumented device_get in the replay loop trips too
+    v = lint(tmp_path, """\
+        import jax
+
+        def replay_step(engine, state):
+            engine.step()
+            return jax.device_get(state)
+        """, name="serving/traffic.py")
+    assert any("device_get" in x.message for x in v if x.rule == "GL02")
+    # ...and the shipped modules scan clean
+    targets = [
+        os.path.join(PKG, "observability", "slo.py"),
+        os.path.join(PKG, "serving", "traffic.py"),
+    ]
+    assert all(os.path.exists(t) for t in targets)
+    report = runner.scan(targets, root=REPO_ROOT)
+    assert report.violations == []
+
+
 # --- GL03 recompile-hazard ----------------------------------------------------
 
 

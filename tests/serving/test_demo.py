@@ -23,3 +23,15 @@ def test_serving_demo_runs():
     assert snap["decode_compilations"] == 1
     assert 0 < snap["mean_occupancy"] <= 2
     assert snap["preemptions"] == 0  # conservative admission default
+
+def test_serving_demo_traffic_mode_runs():
+    """--traffic (ISSUE 11): the SLO-replay demo path runs end to end and
+    returns the per-tenant attainment report."""
+    report = _load_demo().main(
+        ["--traffic", "steady", "--tenants", "2", "--slots", "2",
+         "--traffic-duration", "3.0"]
+    )
+    assert set(report["tenants"]) == {"tenant0-chat", "tenant1-docs"}
+    s = report["slo"]
+    assert s["attained"] + s["violated"] == report["replay"]["submitted"]
+    assert report["replay"]["truncated"] is False

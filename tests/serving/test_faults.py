@@ -956,3 +956,153 @@ def test_soak_mixed_faults_under_load(setup):
     for i, (req, ref) in enumerate(zip(reqs, refs)):
         assert req.state is RequestState.DONE
         assert req.tokens == ref, f"request {i} diverged in the soak"
+
+
+# --- SLO attribution under chaos (ISSUE 11) ----------------------------------
+
+
+def _slo_specs():
+    from neuronx_distributed_tpu.observability import SLOSpec
+
+    # generous bounds: chaos must not turn recovered requests into
+    # latency violations on this box — these tests pin COUNTING, the
+    # latency-classification tests live in tests/observability/test_slo.py
+    return {
+        "a": SLOSpec(ttft_p99_s=1e6, tpot_p99_s=1e6),
+        "b": SLOSpec(ttft_p99_s=1e6, tpot_p99_s=1e6),
+    }
+
+
+def test_slo_requeued_then_finished_counted_once(setup):
+    """A request requeued by dispatch recovery and finished later is ONE
+    SLO observation (attained), never two — and its stream is still
+    bit-identical to solo generate() (tokens_lost = 0)."""
+    cfg, model, params = setup
+    prompts, gcfgs, keys = _workload(cfg)
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    inj = FaultInjector().fail_dispatch(at=1, times=1)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3,
+        fault_injector=inj, sleep_fn=lambda s: None, slo=_slo_specs(),
+    )
+    tenants = ["a", "b", "a", "b"]
+    reqs = [
+        engine.submit(p, c, key=k, tenant=t)
+        for p, c, k, t in zip(prompts, gcfgs, keys, tenants)
+    ]
+    engine.run()
+    assert inj.counters["dispatch_failures"] == 1
+    lost = 0
+    for req, ref in zip(reqs, refs):
+        assert req.state is RequestState.DONE
+        lost += sum(1 for x, y in zip(req.tokens, ref) if x != y)
+        lost += abs(len(req.tokens) - len(ref))
+    assert lost == 0  # tokens_lost = 0 across the recovery
+    snap = engine.metrics.snapshot()
+    assert snap["recoveries"] == 1
+    slo = snap["slo"]
+    # exactly one terminal classification per request — a requeue must
+    # not double-count, a recovery must not mint a violation
+    assert slo["attained"] == len(reqs) and slo["violated"] == 0
+    assert slo["per_tenant"]["a"]["attained"] == 2
+    assert slo["per_tenant"]["b"]["attained"] == 2
+    assert slo["attained_tokens"] == sum(len(r.tokens) for r in reqs)
+
+
+def test_slo_quarantine_requeue_counted_once(setup):
+    """A poisoned-readback victim requeued into a fresh slot finishes
+    bit-identically and counts as ONE attained request; the quarantine
+    itself is not an SLO event."""
+    cfg, model, params = setup
+    prompt = np.asarray([2, 4, 6, 8], np.int32)
+    gcfg = GenerationConfig(max_new_tokens=10, temperature=0.0)
+    key = jax.random.PRNGKey(77)
+    ref = _solo(model, params, prompt, key, gcfg)
+    inj = FaultInjector().poison_readback(at=1, slot=0, token=-1)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2,
+        fault_injector=inj, sleep_fn=lambda s: None, slo=_slo_specs(),
+    )
+    req = engine.submit(prompt, gcfg, key=key, tenant="a")
+    engine.run()
+    assert inj.counters["poisoned_readbacks"] == 1
+    assert engine.metrics.quarantines == 1
+    assert req.state is RequestState.DONE and req.tokens == ref
+    slo = engine.metrics.snapshot()["slo"]
+    assert slo["attained"] == 1 and slo["violated"] == 0
+
+
+def test_slo_sheds_attribute_to_right_tenant_under_skew(setup):
+    """Clock-skew-driven deadline shedding lands the violation on the
+    tenant whose deadline blew — the neighbor tenant's request still
+    attains with its stream intact."""
+    cfg, model, params = setup
+    gcfg_free = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    safe_prompt = np.asarray([11, 13, 17], np.int32)
+    ref_safe = _solo(
+        model, params, safe_prompt, jax.random.PRNGKey(9), gcfg_free
+    )
+    inj = FaultInjector()
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=2,
+        fault_injector=inj, sleep_fn=lambda s: None, slo=_slo_specs(),
+    )
+    doomed = engine.submit(
+        np.asarray([2, 4, 6], np.int32),
+        GenerationConfig(max_new_tokens=40, temperature=0.0),
+        deadline_s=50.0, tenant="b",
+    )
+    safe = engine.submit(
+        safe_prompt, gcfg_free, key=jax.random.PRNGKey(9), tenant="a"
+    )
+    engine.step()
+    engine.step()
+    streamed = len(doomed.tokens)
+    assert streamed > 0
+    inj.skew_clock(by=100.0)  # jump past b's deadline mid-generation
+    engine.run()
+    assert doomed.state is RequestState.TIMED_OUT
+    assert safe.state is RequestState.DONE and safe.tokens == ref_safe
+    snap = engine.metrics.snapshot()
+    slo = snap["slo"]
+    assert slo["per_tenant"]["a"]["attained"] == 1
+    assert slo["per_tenant"]["b"]["violated"] == 1
+    assert slo["violation_reasons"]["b"] == {"shed_inflight": 1}
+    assert "a" not in slo["violation_reasons"]
+    # the shed request's partial stream is work, never goodput
+    assert slo["per_tenant"]["b"]["total_tokens"] == len(doomed.tokens)
+    assert slo["per_tenant"]["b"]["attained_tokens"] == 0
+    assert snap["tenants"]["b"]["sheds"] == 1
+    assert snap["tenants"]["a"]["sheds"] == 0
+
+
+def test_slo_queue_shed_attributes_before_any_compute(setup):
+    """A queue-timeout shed (never admitted) is one violation with the
+    queue reason, on the right tenant, with zero tokens."""
+    cfg, model, params = setup
+    clock = {"t": 0.0}
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=2,
+        time_fn=lambda: clock["t"], slo=_slo_specs(),
+    )
+    blocker = engine.submit(
+        np.asarray([1, 2, 3], np.int32),
+        GenerationConfig(max_new_tokens=30, temperature=0.0), tenant="a",
+    )
+    engine.step()
+    victim = engine.submit(
+        np.asarray([4, 5, 6], np.int32),
+        GenerationConfig(max_new_tokens=5, temperature=0.0),
+        queue_timeout_s=2.0, tenant="b",
+    )
+    clock["t"] = 3.0
+    engine.run()
+    assert victim.state is RequestState.TIMED_OUT
+    assert blocker.state is RequestState.DONE
+    slo = engine.metrics.snapshot()["slo"]
+    assert slo["violation_reasons"]["b"] == {"shed_queue": 1}
+    assert slo["per_tenant"]["b"]["total_tokens"] == 0
+    assert slo["per_tenant"]["a"]["attained"] == 1

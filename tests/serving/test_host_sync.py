@@ -188,3 +188,48 @@ def test_engine_hot_loop_under_transfer_guard(setup, transfer_guard_disallow):
     for req in reqs:
         assert req.state is RequestState.DONE
         assert len(req.tokens) == 6
+
+
+def test_sync_budget_unchanged_with_tenants_and_slo(setup, tmp_path):
+    """ISSUE 11 pin: tenant/priority attribution + per-tenant labeled
+    histogram families + full SLO tracking change what is ACCOUNTED, not
+    what the host pays — every record rides host strings and timestamps
+    the loop already owns. Budgets identical to the bare engine:
+    submit=1, admission step=2, steady chunk=1."""
+    from neuronx_distributed_tpu.observability import (
+        MetricsRegistry,
+        SLOSpec,
+    )
+    from neuronx_distributed_tpu.utils.timeline import Timeline
+
+    cfg, model, params = setup
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None,
+        timeline=Timeline(str(tmp_path / "trace.json")),
+        registry=MetricsRegistry(), flight_dir=str(tmp_path),
+        engine_label="replica0",
+        slo={"acme": SLOSpec(ttft_p99_s=10.0, tpot_p99_s=1.0)},
+    )
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    with _SyncCounter() as c:
+        req = engine.submit(
+            prompt, gcfg, key=jax.random.PRNGKey(7),
+            tenant="acme", priority="interactive",
+        )
+    assert c.calls == 1, f"tenant+SLO submit must stay 1 sync, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 2, (
+        f"tenant+SLO admission must stay 2 syncs, saw {c.calls}"
+    )
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 1, (
+        f"tenant+SLO steady chunk must stay 1 sync, saw {c.calls}"
+    )
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 12
+    snap = engine.metrics.snapshot()
+    assert snap["slo"]["attained"] == 1
+    assert snap["tenants"]["acme"]["completed"] == 1

@@ -243,3 +243,20 @@ def test_spec_keys_zero_without_speculation():
     assert snap["draft_tokens_wasted"] == 0
     assert snap["spec_accept_rate"] == 0.0
     assert snap["spec_fallbacks"] == 0
+
+
+def test_tenant_snapshot_is_read_only():
+    """Review regression: a tenant seen only via record_reject has no
+    latency observations — snapshot() must report 0.0 percentiles WITHOUT
+    materializing empty histogram children (a read must not change what
+    the next scrape exports)."""
+    m = ServingMetrics(num_slots=2)
+    m.record_reject(3, "queue full", tenant="door-only")
+    before = m.registry.prometheus_text()
+    assert 'serving_tenant_ttft_s_count{tenant="door-only"}' not in before
+    snap = m.snapshot()
+    assert snap["tenants"]["door-only"]["rejects"] == 1
+    assert snap["tenants"]["door-only"]["ttft_p99_s"] == 0.0
+    assert snap["tenants"]["door-only"]["queue_wait_p95_s"] == 0.0
+    after = m.registry.prometheus_text()
+    assert after == before  # the snapshot minted no series
