@@ -159,6 +159,11 @@ def parse_args(argv=None):
                         "chunks [2, 5) into DIR (open with TensorBoard/"
                         "XProf) — the device-level truth to pair with "
                         "--trace's host-side view")
+    p.add_argument("--programs", action="store_true",
+                   help="print the compiled-program ledger (dispatches, "
+                        "compiler-reported FLOPs/bytes, roofline) and the "
+                        "HBM ledger (residents, limits, capacity plan) "
+                        "after the run")
     p.add_argument("--prometheus", action="store_true",
                    help="print the metrics registry in Prometheus text "
                         "exposition format after the run (what a scrape "
@@ -450,6 +455,11 @@ def main(argv=None):
         )
 
     snap = engine.metrics.snapshot()
+    # the device-efficiency blocks are nested tables — printed in their
+    # own sections under --programs instead of the flat k:v dump below
+    # (the program table prints from engine.programs.table() directly)
+    snap.pop("programs", None)
+    hbm_snap = snap.pop("hbm", {})
     snap["decode_compilations"] = engine.decode_compilations
     snap["rejected_submits"] = rejected
     if args.kv_page_size:
@@ -467,6 +477,33 @@ def main(argv=None):
     for k, v in snap.items():
         print(f"  {k:>28s}: {v:.4f}" if isinstance(v, float) else
               f"  {k:>28s}: {v}")
+    if args.programs:
+        print("\n=== program ledger (compiler-reported cost) ===")
+        print(engine.programs.table())
+        print("\n=== hbm ledger ===")
+        for name, entry in hbm_snap.get("residents", {}).items():
+            unit = (
+                f"  ({entry['count']} x {entry['unit_bytes']}B "
+                f"{entry['unit']}s)" if "unit_bytes" in entry else ""
+            )
+            print(f"  {name:>16s}: {entry['bytes']:>12,d} B{unit}")
+        print(f"  {'total':>16s}: "
+              f"{hbm_snap.get('resident_bytes_total', 0):>12,d} B")
+        print(f"  {'bytes_limit':>16s}: {hbm_snap.get('bytes_limit')}")
+        print(f"  {'utilization':>16s}: {hbm_snap.get('utilization')}")
+        plan = engine.hbm.plan()
+        if plan["budget_bytes"] == "unavailable":
+            # no device limit on this backend: show the 2x-residents plan
+            # so the capacity math is still demonstrated
+            plan = engine.hbm.plan(
+                budget_bytes=2 * hbm_snap.get("resident_bytes_total", 0)
+            )
+            print("  plan (no device limit; 2x-residents budget):")
+        else:
+            print("  plan (device bytes_limit budget):")
+        for name, fit in plan["fits"].items():
+            print(f"    {name}: +{fit['additional']} {fit['unit']}s fit "
+                  f"the remaining {plan['free_bytes']:,d} B")
     if args.prometheus:
         print("\n=== prometheus exposition ===")
         print(engine.metrics.registry.prometheus_text())

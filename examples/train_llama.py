@@ -104,6 +104,10 @@ def parse_args(argv=None):
     io.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler device trace of steps "
                          "[2, 5) into DIR (open with TensorBoard/XProf)")
+    io.add_argument("--programs", action="store_true",
+                    help="print the compiled-program ledger (dispatches, "
+                         "compiler-reported FLOPs, per-step roofline) and "
+                         "the HBM ledger after training")
 
     f = p.add_argument_group("fault injection (chaos demo)")
     f.add_argument("--inject-fault", default=None,
@@ -414,6 +418,23 @@ def main(argv=None):
             f"p95 {st.percentile(0.95) * 1e3:.1f}ms over {st.count} steps "
             "(log-bucketed registry histogram)"
         )
+    if args.programs:
+        print("\n=== program ledger (compiler-reported cost) ===")
+        print(trainer.programs.table())
+        print("\n=== hbm ledger ===")
+        for key, value in trainer.hbm.halt_summary().items():
+            print(f"  {key:>28s}: {value:,d}" if isinstance(value, int)
+                  else f"  {key:>28s}: {value}")
+        entry = trainer.programs.snapshot()["by_program"].get("train_step", {})
+        flops = entry.get("flops_per_dispatch")
+        wall = entry.get("wall", {}).get("p50_s")
+        if isinstance(flops, float) and wall:
+            print(
+                f"\ncompiler-reported step: {flops:.3e} FLOPs, "
+                f"achieved {flops / wall:.3e} FLOP/s at p50 step wall "
+                f"{wall * 1e3:.1f}ms "
+                f"(mfu {entry.get('mfu_p50')})"
+            )
     return metrics
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -116,19 +117,35 @@ class ModelBuilder:
         )
         return self
 
-    def trace(self, donate_argnums: Tuple[int, ...] = ()) -> NxDModel:
+    def trace(self, donate_argnums: Tuple[int, ...] = (),
+              programs=None) -> NxDModel:
         """AOT-compile every (key, bucket) (reference trace:189; the thread
         pool + priority-NEFF layout grafting are unnecessary — XLA compiles
-        each executable with its own layout assignment)."""
+        each executable with its own layout assignment).
+
+        ``programs`` (a :class:`~neuronx_distributed_tpu.observability.
+        programs.ProgramLedger`) records each executable under
+        ``"{key}[{bucket}]"`` — compile wall, cost analysis AND memory
+        analysis captured eagerly at zero extra compile cost (the
+        ``Compiled`` is already in hand on this path), with the routed
+        calls dispatch-counted through ledger proxies."""
         model = NxDModel()
         for key, entry in self._entries.items():
             jitted = jax.jit(entry.fn, donate_argnums=donate_argnums)
             for args in entry.bucket_args:
                 size = args[entry.route_argnum].shape[entry.bucket_dim]
-                compiled = jitted.lower(*args).compile()
+                t0 = time.perf_counter()
+                lowered = jitted.lower(*args)
+                compiled = lowered.compile()
+                wall = time.perf_counter() - t0
                 logger.info("compiled %s bucket=%d", key, size)
+                call = compiled
+                if programs is not None:
+                    name = f"{key}[{size}]"
+                    programs.note_aot(name, lowered, compiled, wall)
+                    call = programs.wrap(name, compiled)
                 model.add_compiled(
-                    key, size, compiled, entry.bucket_dim, entry.route_argnum,
+                    key, size, call, entry.bucket_dim, entry.route_argnum,
                     unpad=entry.unpad,
                 )
         return model

@@ -20,6 +20,19 @@ training.
   around a block), :func:`install_compile_listener` (compile-event
   counter/duration histogram), :func:`record_device_memory` (per-device
   memory gauges).
+* :mod:`programs` — :class:`ProgramLedger` (ISSUE 12): every jit site in
+  the serving engine, cache/paging managers, inference builders, and
+  trainer registers through it — per compiled program: dispatch counts,
+  compile count/wall, compiler-reported FLOPs / bytes accessed
+  (``cost_analysis``), donation map, opt-in ``memory_analysis`` HBM
+  numbers, and roofline telemetry (achieved FLOPs / MFU / HBM-bandwidth
+  utilization derived at export from caller-fed measured walls against
+  :func:`device_peaks`). Backend gaps degrade to explicit
+  ``"unavailable"`` fields.
+* :mod:`hbm` — :class:`HBMLedger`: named static residents (params, KV
+  pool, draft cache, slot state, prefix store) reconciled against
+  ``Device.memory_stats()`` limits, with ``plan()`` answering capacity
+  questions (max pages/slots/adapters that fit a budget).
 * :mod:`slo` — :class:`SLOSpec` (per-request TTFT/TPOT bounds per tenant
   or priority class) + :class:`SLOTracker` (attained/violated counts,
   attainment rate, and **goodput** — tokens from SLO-attaining requests
@@ -51,20 +64,31 @@ from neuronx_distributed_tpu.observability.profiler import (
 )
 from neuronx_distributed_tpu.observability.callback import MetricsCallback
 from neuronx_distributed_tpu.observability.spec_stats import SpecStats
+from neuronx_distributed_tpu.observability.programs import (
+    UNAVAILABLE,
+    ProgramLedger,
+    device_peaks,
+)
+from neuronx_distributed_tpu.observability.hbm import HBMLedger, tree_nbytes
 
 __all__ = [
     "Counter",
     "FlightRecorder",
     "Gauge",
+    "HBMLedger",
     "Histogram",
     "MetricFamily",
     "MetricsCallback",
     "MetricsRegistry",
+    "ProgramLedger",
     "RequestTracer",
     "SLOSpec",
     "SLOTracker",
     "SpecStats",
+    "UNAVAILABLE",
+    "device_peaks",
     "install_compile_listener",
     "profile_window",
     "record_device_memory",
+    "tree_nbytes",
 ]

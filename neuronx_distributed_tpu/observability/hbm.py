@@ -1,0 +1,285 @@
+"""HBM ledger: static-resident accounting reconciled against device limits.
+
+The serving engine and trainer know exactly which big allocations they own
+— params, the KV pool / page pool, the draft cache, slot state, the prefix
+store — but nothing added them up, compared them to what the device SAYS is
+in use (``Device.memory_stats()``), or answered capacity questions ("how
+many more pages/slots fit this budget?"). :class:`HBMLedger` is that
+reconciliation: named residents registered as callables over live trees
+(bytes come from ``leaf.nbytes`` — host metadata, readable even on a
+donated/consumed buffer, so accounting NEVER syncs the device), device
+limits read per snapshot, and a :meth:`plan` that turns the headroom into
+unit counts for every resident that declared a unit size.
+
+Degradation contract: backends whose ``memory_stats()`` is missing or
+omits ``bytes_limit`` (this container's CPU) report the literal string
+``"unavailable"`` for every device-derived field — resident accounting and
+explicit-budget ``plan(budget_bytes=...)`` keep working regardless.
+
+Hot-path contract (graftlint GL02 lists this module): nothing here touches
+a device value — residents are metadata sums, stats are host dicts — so
+wiring the ledger into the engine/trainer adds zero device→host syncs.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from neuronx_distributed_tpu.observability.programs import (
+    UNAVAILABLE,
+    weak_reader,
+)
+
+__all__ = ["HBMLedger", "tree_nbytes", "UNAVAILABLE"]
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of a pytree's array leaves — ``nbytes`` is host
+    metadata on numpy and jax arrays alike (aval-derived: a deleted
+    donated buffer still reports its size)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = getattr(leaf, "nbytes", None)
+        if n is not None:
+            total += int(n)
+    return total
+
+
+def _as_fn(source) -> Callable[[], int]:
+    if callable(source):
+        return source
+    if isinstance(source, int):
+        return lambda: source
+    return lambda: tree_nbytes(source)
+
+
+class _Resident:
+    __slots__ = ("name", "bytes_fn", "unit_bytes_fn", "count_fn", "unit")
+
+    def __init__(self, name, bytes_fn, unit_bytes_fn, count_fn, unit):
+        self.name = name
+        self.bytes_fn = bytes_fn
+        self.unit_bytes_fn = unit_bytes_fn
+        self.count_fn = count_fn
+        self.unit = unit
+
+
+class HBMLedger:
+    """Named static-resident accounting for one device.
+
+    ``add_resident(name, source)`` registers a byte source: a callable
+    returning bytes (the usual form — closures over weakrefs so a kept
+    ledger never pins an engine), a pytree (summed once per read), or an
+    int. ``unit_bytes=``/``count=`` (values or callables) declare the
+    resident's granularity — what :meth:`plan` sizes budgets in (KV pages,
+    slots, adapters). Registered gauges (``hbm_resident_bytes{resident=}``,
+    totals, limit, utilization) resolve lazily at export; -1 means
+    unavailable there (Prometheus values must be numbers)."""
+
+    def __init__(self, device="auto", registry=None, view=None,
+                 prefix: str = "hbm"):
+        from neuronx_distributed_tpu.observability.registry import (
+            MetricsRegistry,
+            MetricsView,
+        )
+
+        if device == "auto":
+            try:
+                device = jax.local_devices()[0]
+            except Exception:
+                device = None
+        self.device = device
+        if view is None:
+            view = MetricsView(
+                registry if registry is not None else MetricsRegistry()
+            )
+        self._view = view
+        self._prefix = prefix
+        self._residents: "OrderedDict[str, _Resident]" = OrderedDict()
+        self._fam_resident = view.family(
+            "gauge", f"{prefix}_resident_bytes", labels=("resident",),
+            help="bytes of each accounted static resident",
+        )
+        view.gauge(
+            f"{prefix}_resident_bytes_total",
+            help="sum of accounted residents (bytes)",
+        ).set_fn(weak_reader(
+            self, lambda led: led.resident_bytes_total(), -1
+        ))
+        view.gauge(
+            f"{prefix}_bytes_limit",
+            help="Device.memory_stats() bytes_limit (-1 = unavailable)",
+        ).set_fn(weak_reader(
+            self,
+            lambda led: (led.memory_stats() or {}).get("bytes_limit"),
+            -1,
+        ))
+        view.gauge(
+            f"{prefix}_utilization",
+            help="accounted resident bytes / bytes_limit (-1 = unavailable)",
+        ).set_fn(weak_reader(self, lambda led: led._utilization(), -1))
+
+    # --- residents -----------------------------------------------------------
+
+    def add_resident(self, name: str, source, unit_bytes=None,
+                     count=None, unit: Optional[str] = None) -> None:
+        """Register (or replace) the byte source for resident ``name``."""
+        res = _Resident(
+            name,
+            _as_fn(source),
+            None if unit_bytes is None else _as_fn(unit_bytes),
+            None if count is None else _as_fn(count),
+            unit,
+        )
+        fresh = name not in self._residents
+        self._residents[name] = res
+        if fresh:
+            self._view.child(self._fam_resident, name).set_fn(weak_reader(
+                self, lambda led, name=name: led.resident_bytes(name), -1
+            ))
+
+    def remove_resident(self, name: str) -> None:
+        self._residents.pop(name, None)
+
+    def resident_bytes(self, name: str) -> int:
+        res = self._residents.get(name)
+        if res is None:
+            return 0
+        try:
+            return int(res.bytes_fn())
+        except Exception:
+            return 0
+
+    def resident_bytes_total(self) -> int:
+        return sum(self.resident_bytes(n) for n in self._residents)
+
+    # --- device reconciliation ----------------------------------------------
+
+    def memory_stats(self) -> Optional[dict]:
+        """The device's ``memory_stats()`` dict, or None when the backend
+        has none (quietly — the CPU proxy's normal state)."""
+        if self.device is None:
+            return None
+        try:
+            stats = self.device.memory_stats()
+        except Exception:
+            return None
+        return stats or None
+
+    def _utilization(self):
+        stats = self.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if not limit:
+            return None
+        return self.resident_bytes_total() / float(limit)
+
+    def snapshot(self) -> dict:
+        """Residents + device reconciliation. Resident bytes are
+        deterministic for identical runs; device-derived fields degrade to
+        UNAVAILABLE where the backend reports nothing."""
+        residents = {}
+        for name, res in self._residents.items():
+            entry: Dict[str, Any] = {"bytes": self.resident_bytes(name)}
+            if res.unit_bytes_fn is not None:
+                try:
+                    entry["unit_bytes"] = int(res.unit_bytes_fn())
+                except Exception:
+                    entry["unit_bytes"] = 0
+                if res.unit:
+                    entry["unit"] = res.unit
+            if res.count_fn is not None:
+                try:
+                    entry["count"] = int(res.count_fn())
+                except Exception:
+                    entry["count"] = 0
+            residents[name] = entry
+        total = sum(e["bytes"] for e in residents.values())
+        stats = self.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use")
+        out: Dict[str, Any] = {
+            "device": {
+                "kind": str(getattr(self.device, "device_kind", "") or ""),
+                "platform": str(getattr(self.device, "platform", "") or ""),
+            },
+            "residents": residents,
+            "resident_bytes_total": total,
+            "bytes_limit": int(limit) if limit else UNAVAILABLE,
+            "bytes_in_use": (
+                int(in_use) if in_use is not None else UNAVAILABLE
+            ),
+            "peak_bytes_in_use": (
+                int(stats["peak_bytes_in_use"])
+                if "peak_bytes_in_use" in stats else UNAVAILABLE
+            ),
+            "utilization": (
+                total / float(limit) if limit else UNAVAILABLE
+            ),
+            "unaccounted_bytes": (
+                int(in_use) - total if in_use is not None else UNAVAILABLE
+            ),
+        }
+        return out
+
+    def plan(self, budget_bytes: Optional[int] = None) -> dict:
+        """Capacity answers: with ``budget_bytes`` (total bytes the
+        residents may occupy; default ``bytes_limit``), how many MORE
+        units of each unit-declaring resident fit the remaining headroom?
+        Budget-less on a limit-less backend → explicit UNAVAILABLE."""
+        total = self.resident_bytes_total()
+        if budget_bytes is None:
+            stats = self.memory_stats() or {}
+            budget_bytes = stats.get("bytes_limit") or None
+        if not budget_bytes:
+            return {
+                "budget_bytes": UNAVAILABLE,
+                "free_bytes": UNAVAILABLE,
+                "fits": {},
+            }
+        free = max(0, int(budget_bytes) - total)
+        fits = {}
+        for name, res in self._residents.items():
+            if res.unit_bytes_fn is None:
+                continue
+            try:
+                unit = int(res.unit_bytes_fn())
+            except Exception:
+                unit = 0
+            entry: Dict[str, Any] = {
+                "unit_bytes": unit,
+                "unit": res.unit or name,
+            }
+            if unit > 0:
+                entry["additional"] = free // unit
+                if res.count_fn is not None:
+                    try:
+                        entry["max_total"] = (
+                            int(res.count_fn()) + free // unit
+                        )
+                    except Exception:
+                        pass
+            else:
+                entry["additional"] = UNAVAILABLE
+            fits[name] = entry
+        return {
+            "budget_bytes": int(budget_bytes),
+            "free_bytes": free,
+            "fits": fits,
+        }
+
+    def halt_summary(self) -> dict:
+        """Flat scalar projection for halt post-mortems (survives the
+        flight recorder's depth-3 redaction intact)."""
+        snap = self.snapshot()
+        out = {
+            f"resident_{name}_bytes": entry["bytes"]
+            for name, entry in snap["residents"].items()
+        }
+        out["resident_bytes_total"] = snap["resident_bytes_total"]
+        out["bytes_limit"] = snap["bytes_limit"]
+        out["bytes_in_use"] = snap["bytes_in_use"]
+        out["utilization"] = snap["utilization"]
+        return out

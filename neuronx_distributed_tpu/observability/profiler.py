@@ -100,7 +100,11 @@ def install_compile_listener(registry) -> bool:
 
 def record_device_memory(registry) -> int:
     """Snapshot per-device memory stats into gauges
-    (``device{i}_bytes_in_use`` / ``device{i}_peak_bytes_in_use``).
+    (``device{i}_bytes_in_use`` / ``device{i}_peak_bytes_in_use`` /
+    ``device{i}_bytes_limit``), plus a ``device{i}_memory_utilization``
+    fraction (``bytes_in_use / bytes_limit``) on backends whose stats
+    carry the limit — backends that omit it (or report 0) skip the
+    fraction quietly rather than exporting a division by a guess.
     Returns how many devices reported stats (0 on backends without them —
     the CPU proxy — so callers can tell 'no memory pressure' from 'no
     data')."""
@@ -121,4 +125,11 @@ def record_device_memory(registry) -> int:
                     f"device{i}_{key}",
                     help=f"jax Device.memory_stats()[{key!r}]",
                 ).set(int(stats[key]))
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use")
+        if limit and in_use is not None:
+            registry.gauge(
+                f"device{i}_memory_utilization",
+                help="bytes_in_use / bytes_limit",
+            ).set(float(in_use) / float(limit))
     return reported

@@ -85,6 +85,13 @@ HOT_SUFFIXES = (
     # either would stall the hot loop / pollute every replay measurement
     "observability/slo.py",
     "serving/traffic.py",
+    # device-efficiency observability (ISSUE 12): the program ledger's
+    # dispatch proxy runs INSIDE every hot jit call (decode chunk, train
+    # step, slot events) and the HBM ledger's resident reads run at
+    # snapshot/export next to device trees — an implicit coercion in
+    # either would sync the very dispatches they meter
+    "observability/programs.py",
+    "observability/hbm.py",
 )
 HOT_MARKER = "graftlint: hot-path"
 

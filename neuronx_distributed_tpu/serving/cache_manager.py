@@ -50,6 +50,7 @@ from neuronx_distributed_tpu.modules.attention import (
     reset_cache,
     reset_cache_slot,
 )
+from neuronx_distributed_tpu.observability.programs import per_instance
 
 
 def _admit_row(big, row, slot, padded_len, cursor):
@@ -89,9 +90,37 @@ class SlotCacheManager:
         self.cursor = 0  # host mirror of the shared `index` cursor
         self._free = list(range(num_slots))
         self._quarantined: set = set()  # slots pulled from rotation for good
-        self._admit_fn = jax.jit(_admit_row, donate_argnums=(0,))
-        self._free_fn = jax.jit(reset_cache_slot, donate_argnums=(0,))
-        self._reset_fn = jax.jit(reset_cache, donate_argnums=(0,))
+        # per_instance: a module-level helper jitted directly would share
+        # its pjit cache (and _cache_size) across managers in this jax —
+        # fresh function objects keep compile accounting per-manager
+        self._admit_fn = jax.jit(per_instance(_admit_row), donate_argnums=(0,))
+        self._free_fn = jax.jit(per_instance(reset_cache_slot), donate_argnums=(0,))
+        self._reset_fn = jax.jit(per_instance(reset_cache), donate_argnums=(0,))
+
+    def register_programs(self, programs, prefix: str = "") -> None:
+        """Wrap the manager's jitted programs in a
+        :class:`~neuronx_distributed_tpu.observability.programs.
+        ProgramLedger` (ISSUE 12). Called by the engine after construction;
+        the proxies forward ``_cache_size()`` so nothing else changes."""
+        self._admit_fn = programs.wrap(f"{prefix}cache_admit", self._admit_fn)
+        self._free_fn = programs.wrap(f"{prefix}cache_free", self._free_fn)
+        self._reset_fn = programs.wrap(f"{prefix}cache_reset", self._reset_fn)
+
+    # --- HBM accounting ----------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        """Bytes of the live cache collection (leaf metadata — no sync;
+        0 before first allocation or while a donating consumer holds it)."""
+        from neuronx_distributed_tpu.observability.hbm import tree_nbytes
+
+        return tree_nbytes(self.cache) if self.cache is not None else 0
+
+    @property
+    def slot_nbytes(self) -> int:
+        """Approximate bytes one slot row occupies (the HBM ledger's
+        ``plan()`` unit for row-mode capacity questions)."""
+        return self.nbytes // self.num_slots if self.num_slots else 0
 
     # --- slot accounting ---------------------------------------------------
 
@@ -336,6 +365,18 @@ class PrefixCache:
     @property
     def tokens_stored(self) -> int:
         return sum(e.m for e in self._lru.values())
+
+    @property
+    def nbytes(self) -> int:
+        """Bytes of stored entry KV copies (leaf metadata — no sync).
+        Paged entries hold page ids, not copies, so they report 0 here;
+        their bytes live in the page pool's accounting."""
+        from neuronx_distributed_tpu.observability.hbm import tree_nbytes
+
+        return sum(
+            tree_nbytes(e.tree) for e in self._lru.values()
+            if e.tree is not None
+        )
 
     def _walk(self, tokens) -> Tuple[_TrieNode, int]:
         """Deepest trie node reachable along ``tokens`` and its depth.

@@ -48,6 +48,7 @@ All of it rides the same host scalars — zero added device→host syncs
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Optional
 
 from neuronx_distributed_tpu.observability.registry import (
@@ -232,8 +233,19 @@ class ServingMetrics:
         self.view.gauge("serving_num_slots").set(num_slots)
         self.health = "ok"  # engine-owned mirror of ServingEngine.health()
         self.cursor_high_water = 0
+        # device-efficiency ledgers (ISSUE 12): attached weakly by the
+        # engine so snapshot() can carry "programs"/"hbm" without a kept
+        # metrics object pinning a retired engine's ledgers
+        self._programs_ref = None
+        self._hbm_ref = None
         # per-request
         self._requests: Dict[int, dict] = {}
+
+    def attach_device_efficiency(self, programs, hbm) -> None:
+        """Wire the engine's :class:`ProgramLedger`/:class:`HBMLedger`
+        into ``snapshot()["programs"]``/``["hbm"]`` (weak references)."""
+        self._programs_ref = weakref.ref(programs) if programs is not None else None
+        self._hbm_ref = weakref.ref(hbm) if hbm is not None else None
 
     def _tenant_inc(self, attr: str, tenant: str, n=1) -> None:
         self._tenants_seen.add(tenant)
@@ -551,11 +563,14 @@ class ServingMetrics:
             out[tenant] = row
         return out
 
-    def snapshot(self) -> dict:
+    def snapshot(self, analyze_programs: bool = True) -> dict:
         """Plain-dict export (log lines, tests, dashboards). Every key of
         the pre-registry snapshot is preserved in name and type; the
         percentile keys now read bucket-exact histogram quantiles, and the
-        ``ttft_*``/``tpot_*`` families are new."""
+        ``ttft_*``/``tpot_*`` families are new. ``analyze_programs=False``
+        skips any not-yet-run program cost analysis (halt paths)."""
+        programs = self._programs_ref() if self._programs_ref else None
+        hbm = self._hbm_ref() if self._hbm_ref else None
         done = [r for r in self._requests.values() if "latency" in r]
         ttfts = [r["ttft"] for r in self._requests.values() if "ttft" in r]
         waits = [
@@ -625,6 +640,14 @@ class ServingMetrics:
             # served, who was shed — plus each tenant's own latency
             # percentiles (labeled histogram families)
             "tenants": self.tenant_snapshot(),
+            # device efficiency (ISSUE 12): the compiled-program ledger
+            # (compiler-reported cost, dispatch counts, roofline) and the
+            # HBM resident accounting — {} when no engine attached them
+            "programs": (
+                programs.snapshot(analyze=analyze_programs)
+                if programs is not None else {}
+            ),
+            "hbm": hbm.snapshot() if hbm is not None else {},
             # SLO accounting (present only with slo= specs): attainment +
             # goodput, totals and per tenant
             **(

@@ -57,6 +57,7 @@ from neuronx_distributed_tpu.modules.attention import (
     reset_cache_slot,
     seed_cache_prefix,
 )
+from neuronx_distributed_tpu.observability.programs import per_instance
 
 _LIVE_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
 
@@ -282,11 +283,52 @@ class PagedCacheManager:
             block = jax.tree_util.tree_map_with_path(fn, pool)
             return seed_cache_prefix(block, m, start, max_seq_len)
 
+        # _paged_admit/_seed_from_pages are per-manager closures already;
+        # the module-level reset helpers need per_instance for the same
+        # pjit-cache-per-function-object reason as SlotCacheManager
         self._admit_fn = jax.jit(_paged_admit, donate_argnums=(0,))
         self._seed_fn = jax.jit(_seed_from_pages)
-        self._free_fn = jax.jit(reset_cache_slot, donate_argnums=(0,))
-        self._reset_fn = jax.jit(reset_cache, donate_argnums=(0,))
+        self._free_fn = jax.jit(per_instance(reset_cache_slot), donate_argnums=(0,))
+        self._reset_fn = jax.jit(per_instance(reset_cache), donate_argnums=(0,))
         _LIVE_MANAGERS.add(self)
+
+    def register_programs(self, programs, prefix: str = "") -> None:
+        """Wrap the manager's jitted programs in a
+        :class:`~neuronx_distributed_tpu.observability.programs.
+        ProgramLedger` (ISSUE 12); proxies forward ``_cache_size()`` so
+        ``seed_compilations`` keeps reading through."""
+        self._admit_fn = programs.wrap(f"{prefix}paged_admit", self._admit_fn)
+        self._seed_fn = programs.wrap(f"{prefix}paged_seed", self._seed_fn)
+        self._free_fn = programs.wrap(f"{prefix}paged_free", self._free_fn)
+        self._reset_fn = programs.wrap(f"{prefix}paged_reset", self._reset_fn)
+
+    # --- HBM accounting ----------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        """Bytes of the live pool + block tables (leaf metadata — no sync;
+        0 before first allocation or while a donating consumer holds it)."""
+        from neuronx_distributed_tpu.observability.hbm import tree_nbytes
+
+        return tree_nbytes(self.cache) if self.cache is not None else 0
+
+    @property
+    def page_nbytes(self) -> int:
+        """Bytes one pool page occupies across the k/v leaves — the HBM
+        ledger's ``plan()`` unit for paged capacity questions."""
+        if self.cache is None:
+            return 0
+        total = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            self.cache["pool"]
+        )[0]:
+            if cache_leaf_name(path) in ("k", "v"):
+                # pool k/v leaves are (..., P, page_size, Hkv, D) — the
+                # page axis sits 4 from the end (leading axes are nn.scan
+                # layer stacking)
+                pages_ax = max(int(leaf.shape[leaf.ndim - 4]), 1)
+                total += int(leaf.nbytes) // pages_ax
+        return total
 
     # --- slot accounting (SlotCacheManager surface) -------------------------
 

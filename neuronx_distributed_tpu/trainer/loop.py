@@ -328,6 +328,12 @@ class Trainer:
     # when the run halts. None = fit() builds one whose dumps land next to
     # the checkpoints (memory-only when no checkpoint dir is known).
     flight_recorder: Optional[Any] = None
+    # Compiled-program ledger (observability/programs.py, ISSUE 12): the
+    # train/eval steps register through it — dispatch counts, compile
+    # wall, compiler-reported FLOPs/bytes, per-step roofline off the
+    # inter-step wall the loop already measures. None = fit() builds one
+    # (sharing a MetricsCallback's registry when one is attached).
+    program_ledger: Optional[Any] = None
     # Install SIGTERM/SIGINT graceful-preemption handlers during fit()
     # (main thread only; a second signal falls through to the original
     # handler).
@@ -508,17 +514,25 @@ class Trainer:
         if fl is not None:
             fl.record("halt", reason=reason, step=self.step,
                       emergency_tag=tag)
-            fl.dump(
-                reason,
-                extra={
-                    "step": self.step,
-                    "emergency_tag": tag,
-                    "anomaly_skips": self.anomaly_skips,
-                    "dispatch_retries": self.dispatch_retries,
-                    "callback_errors": self.callback_errors,
-                    "tokens_seen": self.tokens_seen,
-                },
-            )
+            extra = {
+                "step": self.step,
+                "emergency_tag": tag,
+                "anomaly_skips": self.anomaly_skips,
+                "dispatch_retries": self.dispatch_retries,
+                "callback_errors": self.callback_errors,
+                "tokens_seen": self.tokens_seen,
+            }
+            # device-efficiency context (ISSUE 12): where HBM went and
+            # which programs were hot when training died — flat scalar
+            # tables (survive the recorder's depth-3 redaction); cost
+            # analysis is NOT started on this error path
+            hbm = getattr(self, "hbm", None)
+            if hbm is not None:
+                extra["hbm"] = hbm.halt_summary()
+            programs = getattr(self, "programs", None)
+            if programs is not None:
+                extra["programs"] = programs.halt_summary()
+            fl.dump(reason, extra=extra)
         logger.error("training HALTED: %s", reason)
         raise TrainerHalted(reason, emergency_tag=tag)
 
@@ -816,6 +830,28 @@ class Trainer:
             self._flight = FlightRecorder(
                 dump_dir=self._checkpoint_dir(), subsystem="trainer"
             )
+        # compiled-program ledger (ISSUE 12): created once per Trainer so
+        # re-fits ACCUMULATE (a rebuilt train step wraps the same record);
+        # rides a MetricsCallback's registry when one is attached so the
+        # per-step achieved-FLOPs/MFU gauges share the scrape surface
+        if self.program_ledger is None:
+            from neuronx_distributed_tpu.observability.callback import (
+                MetricsCallback,
+            )
+            from neuronx_distributed_tpu.observability.programs import (
+                ProgramLedger,
+            )
+
+            reg = None
+            for cb in self.callbacks:
+                if isinstance(cb, MetricsCallback):
+                    reg = cb.registry
+                    break
+            self.program_ledger = ProgramLedger(
+                registry=reg, prefix="train", subsystem="trainer",
+                timeline=tl,
+            )
+        self.programs = self.program_ledger
         inj = self.fault_injector
         first = sample_batch if sample_batch is not None else next(data_iter)
         optimizer = make_optimizer(self.optimizer_config)
@@ -867,9 +903,38 @@ class Trainer:
                     return shard_microbatched_batch(microbatch(batch, accum))
             else:
                 prepare = shard_batch
+        # ledger proxy: dispatch counts + compile detection, zero syncs
+        # (the proxy forwards _cache_size(), so the compile-budget guard
+        # below keeps reading through)
+        train_step = self.programs.wrap("train_step", train_step)
         # exposed for the compile-budget guard (one program must serve clean
         # AND anomalous batches — tests/trainer/test_faults.py)
         self._train_step = train_step
+        # HBM ledger (ISSUE 12): the trainer's static residents as weakref
+        # closures over the live TrainState — params, optimizer state, the
+        # anomaly-guard carry — reconciled against device limits
+        from neuronx_distributed_tpu.observability.hbm import (
+            HBMLedger,
+            tree_nbytes,
+        )
+        from neuronx_distributed_tpu.observability.programs import weak_reader
+
+        self.hbm = HBMLedger(view=self.programs.view)
+
+        def _res(fn):
+            # state=None (pre-fit reads) falls to 0 via the resident
+            # reader's exception guard — tree_nbytes(None.state) raises
+            return weak_reader(self, fn)
+
+        self.hbm.add_resident("params", _res(
+            lambda t: tree_nbytes(t.state.params)
+        ))
+        self.hbm.add_resident("opt_state", _res(
+            lambda t: tree_nbytes(t.state.opt_state)
+        ))
+        self.hbm.add_resident("anomaly_guard", _res(
+            lambda t: tree_nbytes(t.state.guard)
+        ))
         pending = first if sample_batch is None else None
         # the probe pull advanced the cursor past a batch nothing has
         # trained on yet — checkpoints written before it is consumed must
@@ -958,6 +1023,7 @@ class Trainer:
         metrics = {}
         profiling = False
         self._fit_t0 = time.perf_counter()
+        self._step_wall_t0 = time.perf_counter()
         orig_handlers = self._install_signal_handlers()
         halted: Optional[TrainerHalted] = None
         error: Optional[BaseException] = None
@@ -1000,6 +1066,19 @@ class Trainer:
                 self.step += 1
                 self.steps_run += 1
                 self.tokens_seen += batch_tokens
+                # per-step roofline feed: the inter-step wall (host clock
+                # the loop already owns — dispatch is async, so steady-state
+                # iteration time IS the step wall). The first iteration and
+                # any compile-bearing step are skipped so MFU never
+                # averages in trace+compile time
+                now_wall = time.perf_counter()
+                if self.steps_run > 1 and not getattr(
+                    train_step, "last_call_compiled", True
+                ):
+                    self.programs.observe_wall(
+                        "train_step", now_wall - self._step_wall_t0
+                    )
+                self._step_wall_t0 = now_wall
                 # budget-check the PREVIOUS step's guard flags now that this
                 # step is dispatched — the readback overlaps device compute
                 self._account_guard()
@@ -1066,6 +1145,10 @@ class Trainer:
                 self._eval_prepare = shard_batch
             # cached: a fresh jit wrapper per call would retrace every time
             self._eval_step = jax.jit(loss_fn)
+            if getattr(self, "programs", None) is not None:
+                self._eval_step = self.programs.wrap(
+                    "eval_step", self._eval_step
+                )
         data_iter = iter(data_iter)
         total, n = 0.0, 0
         while n < max_steps:

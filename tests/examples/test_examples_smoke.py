@@ -38,6 +38,18 @@ def test_train_example_tp_sp_zero1(train_mod):
     assert float(metrics["loss"]) > 0
 
 
+def test_train_example_programs_mode(train_mod, capsys):
+    """--programs (ISSUE 12): the ledger/HBM sections print after fit."""
+    metrics = train_mod.main([
+        "--model", "tiny", "--steps", "2", "--seq-len", "32", "--programs",
+    ])
+    assert float(metrics["loss"]) > 0
+    out = capsys.readouterr().out
+    assert "program ledger (compiler-reported cost)" in out
+    assert "train_step" in out
+    assert "resident_opt_state_bytes" in out
+
+
 def test_train_example_pp_1f1b(train_mod):
     """BASELINE config-4 shape (TP+PP, 1F1B schedule) on the CPU mesh."""
     metrics = train_mod.main([

@@ -133,6 +133,27 @@ def test_serving_dispatch_halt_dumps_postmortem(tmp_path):
     assert "halt" in kinds and "health" in kinds
     assert pm["extra"]["metrics"]["dispatch_retries"] == 3
     assert pm["extra"]["requeued"] == 0  # work requeued before the dump
+    # ISSUE 12: the post-mortem carries the HBM ledger and the top-N
+    # program table as FLAT scalar dicts — the depth-3 redaction must
+    # preserve every value (a collapsed {"keys": n} here means the shape
+    # regressed). Cost analysis is NOT run on the halt path, so program
+    # cost fields may read "unavailable" — but counts are always real.
+    hbm = pm["extra"]["hbm"]
+    assert isinstance(hbm["resident_params_bytes"], int)
+    assert hbm["resident_params_bytes"] > 0
+    assert hbm["resident_bytes_total"] > 0
+    assert hbm["bytes_limit"] == "unavailable"  # CPU container, pinned
+    # the embedded metrics snapshot drops its nested efficiency blocks —
+    # the redaction would collapse them to key-count stubs; the flat
+    # tables above are the one carrier (review fix, pinned)
+    assert "programs" not in pm["extra"]["metrics"]
+    assert "hbm" not in pm["extra"]["metrics"]
+    progs = pm["extra"]["programs"]
+    assert "prefill[8]" in progs
+    for entry in progs.values():
+        assert set(entry) >= {"dispatches", "compiles", "variants",
+                              "compile_wall_s", "flops_per_dispatch"}
+        assert isinstance(entry["dispatches"], int)  # scalar, not redacted
     # the victim's work survived in the queue (the PR 3 halt contract)
     assert not req.finished
     # timeline auto-saved at the halt — the trace survives with no explicit
@@ -185,6 +206,16 @@ def test_trainer_anomaly_budget_halt_dumps_postmortem(tmp_path):
     halt_ev = [e for e in pm["events"] if e["kind"] == "halt"][-1]
     assert halt_ev["emergency_tag"] == ei.value.emergency_tag
     assert pm["extra"]["anomaly_skips"] == 3
+    # ISSUE 12: trainer halts carry the same flat HBM + program tables
+    # (schema pin — values must survive the depth-3 redaction)
+    hbm = pm["extra"]["hbm"]
+    assert hbm["resident_params_bytes"] > 0
+    assert hbm["resident_opt_state_bytes"] > 0
+    assert hbm["bytes_limit"] == "unavailable"
+    progs = pm["extra"]["programs"]
+    assert "train_step" in progs
+    assert isinstance(progs["train_step"]["dispatches"], int)
+    assert progs["train_step"]["compiles"] >= 1
 
 
 def test_halt_postmortem_records_slo_and_tenant_queue_depths(tmp_path):

@@ -289,6 +289,38 @@ def test_gl02_slo_and_traffic_modules_are_hot(tmp_path):
     assert report.violations == []
 
 
+def test_gl02_programs_and_hbm_modules_are_hot(tmp_path):
+    """ISSUE 12 satellite: the program ledger's dispatch proxy runs INSIDE
+    every hot jit call and the HBM ledger's resident reads sit next to
+    device trees — both are hot BY PATH, so an implicit sync smuggled into
+    either trips GL02 with no marker needed."""
+    fixture = """\
+        import jax.numpy as jnp
+
+        def record_dispatch(rec, out):
+            rec.flops_seen += float(jnp.sum(out))
+        """
+    for name in ("observability/programs.py", "observability/hbm.py"):
+        assert "GL02" in rules_of(lint(tmp_path, fixture, name=name)), name
+    # an undocumented explicit device_get in the ledger trips too (the
+    # whole point: accounting must never sync the dispatches it meters)
+    v = lint(tmp_path, """\
+        import jax
+
+        def resident_bytes(tree):
+            return sum(a.nbytes for a in jax.device_get(tree))
+        """, name="observability/hbm.py")
+    assert any("device_get" in x.message for x in v if x.rule == "GL02")
+    # ...and the shipped modules scan clean
+    targets = [
+        os.path.join(PKG, "observability", "programs.py"),
+        os.path.join(PKG, "observability", "hbm.py"),
+    ]
+    assert all(os.path.exists(t) for t in targets)
+    report = runner.scan(targets, root=REPO_ROOT)
+    assert report.violations == []
+
+
 # --- GL03 recompile-hazard ----------------------------------------------------
 
 

@@ -24,6 +24,20 @@ def test_serving_demo_runs():
     assert 0 < snap["mean_occupancy"] <= 2
     assert snap["preemptions"] == 0  # conservative admission default
 
+def test_serving_demo_programs_mode_runs(capsys):
+    """--programs (ISSUE 12): the device-efficiency sections print the
+    program ledger table and the HBM ledger with its capacity plan."""
+    _load_demo().main(
+        ["--requests", "3", "--slots", "2", "--max-new-tokens", "4",
+         "--programs"]
+    )
+    out = capsys.readouterr().out
+    assert "program ledger (compiler-reported cost)" in out
+    assert "decode_chunk" in out and "prefill[" in out
+    assert "hbm ledger" in out and "kv_cache" in out
+    assert "plan (no device limit" in out  # CPU container: explicit fallback
+
+
 def test_serving_demo_traffic_mode_runs():
     """--traffic (ISSUE 11): the SLO-replay demo path runs end to end and
     returns the per-tenant attainment report."""

@@ -164,6 +164,43 @@ def test_sync_budget_unchanged_with_speculation(setup):
     assert req.state is RequestState.DONE and len(req.tokens) == 24
 
 
+def test_sync_budget_with_program_and_hbm_ledgers(setup):
+    """ISSUE 12 pin: the compiled-program ledger and HBM accounting are ON
+    BY DEFAULT on every engine — this test makes that explicit and re-pins
+    the budgets with both fully active, then reads the efficiency snapshot
+    AFTER the run (analysis is lazy export-time work, never hot-path).
+    The dispatch proxy's per-call cost is a counter bump + a
+    ``_cache_size()`` metadata read; the budgets cannot move: submit=1,
+    admission step=2, steady chunk=1."""
+    from neuronx_distributed_tpu.observability import UNAVAILABLE
+
+    cfg, model, params = setup
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None
+    )
+    assert engine.programs is not None and engine.hbm is not None
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    with _SyncCounter() as c:
+        req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(7))
+    assert c.calls == 1, f"ledgered submit must stay 1 sync, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 2, f"ledgered admission must stay 2 syncs, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 1, f"ledgered steady chunk must stay 1 sync, saw {c.calls}"
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 12
+    snap = engine.metrics.snapshot()
+    dc = snap["programs"]["by_program"]["decode_chunk"]
+    assert dc["dispatches"] >= 3 and isinstance(
+        dc["flops_per_dispatch"], float
+    )
+    assert snap["hbm"]["residents"]["params"]["bytes"] > 0
+    assert snap["hbm"]["bytes_limit"] == UNAVAILABLE  # CPU, pinned
+
+
 @pytest.mark.sanitize
 def test_engine_hot_loop_under_transfer_guard(setup, transfer_guard_disallow):
     """Dynamic GL02 witness: a full serve cycle — submit, prefill (with the
