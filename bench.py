@@ -59,6 +59,7 @@ TRAIN_FAULTS_TIMEOUT_S = 420
 OBSERVE_TIMEOUT_S = 300
 SPEC_TIMEOUT_S = 540
 PAGED_TIMEOUT_S = 540
+QUANT_TIMEOUT_S = 540
 TRAFFIC_TIMEOUT_S = 540
 EFFICIENCY_TIMEOUT_S = 540
 
@@ -515,8 +516,12 @@ def _measure_serving_chunk(devs):
     gcfg = GenerationConfig(max_new_tokens=64, temperature=0.8, top_k=20)
     out = {}
     for chunk in (1, 8):
+        # paged KV is the serving children's default layout now (ISSUE 13
+        # fold-in) — the row engine keeps its own head-to-head in
+        # --child-paged
         engine = ServingEngine(
-            model, params, num_slots=4, decode_chunk_size=chunk
+            model, params, num_slots=4, decode_chunk_size=chunk,
+            kv_page_size=16,
         )
         # warmup wave: compiles the prefill buckets + the one decode program
         for i, p in enumerate(prompts[:4]):
@@ -599,7 +604,7 @@ def _measure_serving_faults(devs):
     def run(injector):
         engine = ServingEngine(
             model, params, num_slots=4, decode_chunk_size=4,
-            fault_injector=injector,
+            fault_injector=injector, kv_page_size=16,
         )
         # warmup wave compiles prefill buckets + the decode program so the
         # fault run's overhead measures RECOVERY, not compilation
@@ -805,7 +810,7 @@ def _measure_serving_prefix(devs):
     def run(prefix_cache):
         engine = ServingEngine(
             model, params, num_slots=4, decode_chunk_size=4,
-            prefix_cache=prefix_cache,
+            prefix_cache=prefix_cache, kv_page_size=16,
         )
         orig_prefill_fn = engine._prefill_fn
         engine._prefill_fn = lambda padded: _Blocking(orig_prefill_fn(padded))
@@ -1050,6 +1055,188 @@ def _measure_serving_paged(devs):
     }
 
 
+def _measure_serving_quant(devs):
+    """Quantized serving (``--child-quant``, ISSUE 13): the SAME workload
+    through three engines — fp32, int8 weights (dequantize-on-load), and
+    int8 weights + int8 KV pages — all on the paged layout. Reports decode
+    tok/s per variant, the HBMLedger's resident deltas (params + page
+    pool), the ``plan()``-reported page capacity at a FIXED byte budget
+    (the half-size-pages → 2x-pages claim as ledger arithmetic), and the
+    MEASURED logit divergence of the quantized decode vs the fp32 stream
+    (max/mean KL + top-1 agreement over teacher-forced decode steps) —
+    the acceptance contract's both axes in one artifact."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.inference.generate import serving_clones
+    from neuronx_distributed_tpu.inference.utils import unwrap_logits
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.quantization import (
+        QuantConfig,
+        quantize_param_tree,
+    )
+    from neuronx_distributed_tpu.serving import ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    PAGE = 16
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+    prompts = [
+        rng.randint(1, cfg.vocab_size,
+                    size=int(rng.randint(6, 18))).astype(np.int32)
+        for _ in range(8)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=48, temperature=0.0)  # greedy
+
+    def run(quantize):
+        engine = ServingEngine(
+            model, params, num_slots=4, decode_chunk_size=8,
+            kv_page_size=PAGE, prefix_cache=None, quantize=quantize,
+        )
+        # warmup wave compiles the prefill buckets + the decode program
+        for i, p in enumerate(prompts[:4]):
+            engine.submit(
+                p, GenerationConfig(max_new_tokens=8, temperature=0.0),
+                key=jax.random.PRNGKey(i),
+            )
+        engine.run()
+        m = engine.metrics
+        base_tok = m.decode_tokens
+        base_wall = m.decode_dispatch_s + m.decode_readback_s
+        t0 = _t.perf_counter()
+        reqs = [
+            engine.submit(p, gcfg, key=jax.random.PRNGKey(100 + i))
+            for i, p in enumerate(prompts)
+        ]
+        engine.run()
+        wall = _t.perf_counter() - t0
+        dtok = m.decode_tokens - base_tok
+        dwall = (m.decode_dispatch_s + m.decode_readback_s) - base_wall
+        hbm = engine.hbm.snapshot()["residents"]
+        engine.cache.check()
+        stats = {
+            "decode_tok_s": round(dtok / dwall, 2) if dwall > 0 else 0.0,
+            "e2e_tok_s": round(dtok / wall, 2) if wall > 0 else 0.0,
+            "decode_tokens": int(dtok),
+            "decode_compilations": engine.decode_compilations,
+            "params_bytes": int(hbm["params"]["bytes"]),
+            "kv_pool_bytes": int(hbm["kv_pages"]["bytes"]),
+            "page_bytes": int(engine.cache.page_nbytes),
+        }
+        return stats, [r.tokens for r in reqs], engine
+
+    out, engines = {}, {}
+    out["fp32"], fp_toks, engines["fp32"] = run(None)
+    out["int8_weights"], w_toks, engines["int8_weights"] = run(
+        QuantConfig(weights="int8")
+    )
+    out["int8_weights_int8_kv"], wk_toks, engines["int8_weights_int8_kv"] = (
+        run(QuantConfig(weights="int8", kv="int8"))
+    )
+    # fixed-budget page capacity, REPORTED BY plan() itself (the HBM
+    # ledger's capacity answer): the same byte budget for every variant
+    # (2x the fp32 engine's residents, the demo's no-device-limit
+    # yardstick) — half/quarter-size quantized pages fit proportionally
+    # more of the remaining headroom
+    budget = 2 * engines["fp32"].hbm.resident_bytes_total()
+    for name, engine in engines.items():
+        fit = engine.hbm.plan(budget_bytes=budget)["fits"]["kv_pages"]
+        out[name]["plan_pages_at_budget"] = int(fit["additional"])
+    engines.clear()
+
+    # measured logit divergence: teacher-force the fp32 greedy continuation
+    # through BOTH decode stacks and compare per-step next-token logits
+    import dataclasses
+
+    qcfg = QuantConfig(weights="int8", kv=None).weight_qconfig()
+    qmodel = LlamaForCausalLM(
+        dataclasses.replace(cfg, quantization=qcfg), attention_impl="xla"
+    )
+    qparams = quantize_param_tree(params, qcfg)
+    prompt0 = jnp.asarray(prompts[0])
+    cont = jnp.asarray(np.asarray(fp_toks[0], np.int32))
+
+    def teacher_forced_logits(m_, p_):
+        prefill, decode = serving_clones(m_)
+
+        @jax.jit
+        def steps(p, prompt_ids, cont_ids):
+            out_, v = prefill.apply(p, prompt_ids[None], mutable=["cache"])
+            first = unwrap_logits(out_)[0, -1]
+
+            def step(cache, tok):
+                o, vv = decode.apply(
+                    {**p, "cache": cache}, tok[None, None],
+                    mutable=["cache"],
+                )
+                return vv["cache"], unwrap_logits(o)[0, -1]
+
+            _, rest = jax.lax.scan(step, v["cache"], cont_ids)
+            return jnp.concatenate([first[None], rest], 0)
+
+        return np.asarray(steps(dict(p_), prompt0, cont[:-1]))
+
+    ref_logits = teacher_forced_logits(model, params)
+    q_logits = teacher_forced_logits(qmodel, qparams)
+    pr = jax.nn.softmax(jnp.asarray(ref_logits), -1)
+    lq = jax.nn.log_softmax(jnp.asarray(q_logits), -1)
+    lr = jax.nn.log_softmax(jnp.asarray(ref_logits), -1)
+    kl = np.asarray(jnp.sum(pr * (lr - lq), -1))
+    top1 = np.asarray(ref_logits).argmax(-1) == np.asarray(q_logits).argmax(-1)
+    tokens_identical_w = fp_toks == w_toks
+    tokens_identical_wk = fp_toks == wk_toks
+
+    def prefix_agree(a_list, b_list):
+        fracs = []
+        for a, b in zip(a_list, b_list):
+            n = min(len(a), len(b))
+            i = 0
+            while i < n and a[i] == b[i]:
+                i += 1
+            fracs.append(i / max(n, 1))
+        return round(float(np.mean(fracs)), 4)
+    return {
+        **out,
+        "decode_tok_s_ratio_int8": round(
+            out["int8_weights"]["decode_tok_s"]
+            / max(out["fp32"]["decode_tok_s"], 1e-9), 3
+        ),
+        "decode_tok_s_ratio_int8_kv": round(
+            out["int8_weights_int8_kv"]["decode_tok_s"]
+            / max(out["fp32"]["decode_tok_s"], 1e-9), 3
+        ),
+        "plan_pages_ratio_int8_kv": round(
+            out["int8_weights_int8_kv"]["plan_pages_at_budget"]
+            / max(out["fp32"]["plan_pages_at_budget"], 1), 3
+        ),
+        "params_bytes_ratio": round(
+            out["fp32"]["params_bytes"]
+            / max(out["int8_weights"]["params_bytes"], 1), 3
+        ),
+        "logit_divergence": {
+            "steps": int(kl.shape[0]),
+            "max_kl": round(float(kl.max()), 6),
+            "mean_kl": round(float(kl.mean()), 6),
+            "top1_agreement": round(float(top1.mean()), 4),
+        },
+        "greedy_tokens_identical_int8": bool(tokens_identical_w),
+        "greedy_tokens_identical_int8_kv": bool(tokens_identical_wk),
+        "greedy_prefix_agreement_int8": prefix_agree(fp_toks, w_toks),
+        "greedy_prefix_agreement_int8_kv": prefix_agree(fp_toks, wk_toks),
+    }
+
+
 def _flash_block_sweep(batch, seq):
     import jax
     import jax.numpy as jnp
@@ -1191,7 +1378,7 @@ def _measure_serving_spec(devs):
             )
         engine = ServingEngine(
             model, t_params, num_slots=4, decode_chunk_size=4,
-            prefix_cache=None, **kw,
+            prefix_cache=None, kv_page_size=16, **kw,
         )
         # warmup wave compiles prefill buckets + the decode program
         for i, p in enumerate(prompts[:4]):
@@ -1318,11 +1505,13 @@ def _measure_observability(devs):
     bare = ServingEngine(
         model, params, num_slots=4, decode_chunk_size=8,
         timeline=None, flight_recorder=None, prefix_cache=None,
+        kv_page_size=16,
     )
     inst = ServingEngine(
         model, params, num_slots=4, decode_chunk_size=8,
         timeline=Timeline(os.path.join(tmp, "trace.json")),
         registry=MetricsRegistry(), flight_dir=tmp, prefix_cache=None,
+        kv_page_size=16,
     )
     gcfg = GenerationConfig(max_new_tokens=64, temperature=0.8, top_k=20)
 
@@ -1473,7 +1662,7 @@ def _measure_traffic(devs):
         engine = ServingEngine(
             model, params, num_slots=3, decode_chunk_size=4,
             admission="eager", prefix_cache=None, slo=slo,
-            timeline=None, flight_recorder=None,
+            timeline=None, flight_recorder=None, kv_page_size=16,
             time_fn=clock, sleep_fn=lambda s: None,
         )
         report = replay(engine, tape, clock, step_dt=STEP_DT)
@@ -1751,6 +1940,32 @@ def child_paged() -> None:
         )
 
 
+def child_quant() -> None:
+    """Quantized-serving child (``--child-quant``, ISSUE 13): fp32 vs
+    int8-weights vs int8-weights+int8-KV decode throughput, HBM resident
+    deltas, plan() page capacity at a fixed budget, and the measured
+    logit divergence. Prints one JSON line; merged into the BENCH artifact
+    as ``extras.serving_quant``."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_quant",
+                "unit": "decode tok/s + pages @ fixed budget",
+                "platform": devs[0].platform,
+                **_measure_serving_quant(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_quant",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
 def child_spec() -> None:
     """Speculative-serving child (``--child-spec``): spec-off vs spec-on
     engine decode tokens/s across a synthetic-acceptance sweep (early-exit
@@ -1836,7 +2051,7 @@ def _measure_efficiency(devs) -> dict:
         )
         engine = ServingEngine(
             model, params, num_slots=4, decode_chunk_size=8,
-            program_ledger=ledger,
+            program_ledger=ledger, kv_page_size=16,
         )
         for i in range(6):
             engine.submit(
@@ -2293,6 +2508,7 @@ def main() -> None:
     observe_result = None
     spec_result = None
     paged_result = None
+    quant_result = None
     traffic_result = None
     efficiency_result = None
 
@@ -2344,6 +2560,11 @@ def main() -> None:
             paged_result
             if paged_result is not None
             else {"error": "paged child did not finish"}
+        )
+        extras["serving_quant"] = (
+            quant_result
+            if quant_result is not None
+            else {"error": "quant child did not finish"}
         )
         extras["serving_traffic"] = (
             traffic_result
@@ -2521,6 +2742,16 @@ def main() -> None:
     else:
         paged_result = {"error": f"paged child: {err}"}
 
+    # 11b. Quantized-serving child: fp32 vs int8-weights vs int8-w+int8-KV
+    #      decode throughput + plan() page capacity at a fixed budget +
+    #      measured logit divergence (wall-clock comparison — serialized).
+    quant, err = _run_child("--child-quant", QUANT_TIMEOUT_S)
+    if quant is not None:
+        quant.pop("metric", None)
+        quant_result = quant
+    else:
+        quant_result = {"error": f"quant child: {err}"}
+
     # 12. Traffic-replay child: per-tenant SLO attainment/goodput under
     #     Poisson + bursty arrivals on a virtual clock (wall-independent,
     #     but serialized anyway — replay wall time still bounds it).
@@ -2556,6 +2787,8 @@ if __name__ == "__main__":
         child_serving()
     elif "--child-paged" in sys.argv:
         child_paged()
+    elif "--child-quant" in sys.argv:
+        child_quant()
     elif "--child-traffic" in sys.argv:
         child_traffic()
     elif "--child-spec" in sys.argv:

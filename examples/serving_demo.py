@@ -67,9 +67,12 @@ CPU-runnable out of the box:
   python examples/serving_demo.py --decode-chunk 1   # per-token stepping
   python examples/serving_demo.py --shared-prefix 24 # system-prompt reuse
   python examples/serving_demo.py --shared-prefix 24 --no-prefix-cache
-  python examples/serving_demo.py --kv-page-size 16  # paged KV + CoW reuse
-  python examples/serving_demo.py --kv-page-size 16 --kv-pages 24 --slots 8
-  python examples/serving_demo.py --kv-page-size 16 --inject-fault page
+  python examples/serving_demo.py --row-cache        # legacy row-per-slot KV
+  python examples/serving_demo.py --kv-pages 24 --slots 8  # paged (default)
+  python examples/serving_demo.py --inject-fault page
+  python examples/serving_demo.py --quantize int8    # weight-only int8
+  python examples/serving_demo.py --quantize fp8
+  python examples/serving_demo.py --quantize int8 --kv-quant  # + int8 KV pages
   python examples/serving_demo.py --traffic steady --tenants 2
   python examples/serving_demo.py --traffic bursty --slo-ttft-ms 100
   python examples/serving_demo.py --draft-layers 1 --gamma 4  # speculative
@@ -119,13 +122,31 @@ def parse_args(argv=None):
     p.add_argument("--gamma", type=int, default=4,
                    help="draft tokens proposed per speculative round (each "
                         "round emits 1..gamma tokens per slot)")
-    p.add_argument("--kv-page-size", type=int, default=0,
-                   help="PAGED KV cache: pool page size in cache columns "
-                        "(0 = row-per-slot layout). Admission packs by "
-                        "actual page footprint, prefix hits share pages "
-                        "copy-on-write (zero KV bytes copied), poison "
+    p.add_argument("--kv-page-size", type=int, default=16,
+                   help="PAGED KV cache pool page size in cache columns — "
+                        "the DEFAULT layout (ISSUE 13 fold-in): admission "
+                        "packs by actual page footprint, prefix hits share "
+                        "pages copy-on-write (zero KV bytes copied), poison "
                         "quarantine is page-granular; streams are "
-                        "bit-identical either way")
+                        "bit-identical to the row layout either way. 0 or "
+                        "--row-cache restores row-per-slot")
+    p.add_argument("--row-cache", action="store_true",
+                   help="row-per-slot KV layout (the pre-paging default; "
+                        "one max_seq_len row of HBM per slot)")
+    p.add_argument("--quantize", default=None, choices=["int8", "fp8"],
+                   help="weight-only quantized serving: the engine "
+                        "converts the float params once at construction "
+                        "(per-channel scales) and every decode/prefill "
+                        "matmul dequantizes-on-load — HBM holds 1-byte "
+                        "weights, decode_compilations stays 1. Streams "
+                        "follow the logit-divergence contract instead of "
+                        "bit-identity (greedy smoke stays token-identical "
+                        "on this tiny model)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="quantize the PAGED KV pool to int8 pages + "
+                        "per-page scales (needs the paged layout; "
+                        "~2-4x pages at a fixed HBM budget). Implies "
+                        "--quantize int8 unless --quantize is given")
     p.add_argument("--kv-pages", type=int, default=None,
                    help="pool size in pages (default: the row-equivalent "
                         "HBM). Size it DOWN to see free-page admission "
@@ -192,6 +213,29 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _engine_layout(args):
+    """(kv_page_size, QuantConfig-or-None) from the demo flags: paged by
+    default (ISSUE 13 fold-in), ``--row-cache``/``--kv-page-size 0`` for
+    the legacy row layout, ``--quantize``/``--kv-quant`` for the quantized
+    serving path."""
+    page = (
+        None if (args.row_cache or not args.kv_page_size)
+        else args.kv_page_size
+    )
+    if args.kv_quant and page is None:
+        raise SystemExit("--kv-quant needs the paged layout (drop "
+                         "--row-cache / use --kv-page-size > 0)")
+    quant = None
+    if args.quantize or args.kv_quant:
+        from neuronx_distributed_tpu.serving import QuantConfig
+
+        quant = QuantConfig(
+            weights=args.quantize or "int8",
+            kv="int8" if args.kv_quant else None,
+        )
+    return page, quant
+
+
 def _run_traffic(args, cfg, model, params):
     """``--traffic``: seeded multi-tenant replay + per-tenant SLO report.
 
@@ -234,14 +278,16 @@ def _run_traffic(args, cfg, model, params):
         vocab_size=cfg.vocab_size,
     )
     clock = VirtualClock()
+    page, quant = _engine_layout(args)
     engine = ServingEngine(
         model, params,
         num_slots=args.slots,
         admission=args.admission,
         decode_chunk_size=args.decode_chunk,
         prefix_cache=None if args.no_prefix_cache else "auto",
-        kv_page_size=args.kv_page_size or None,
+        kv_page_size=page,
         kv_num_pages=args.kv_pages,
+        quantize=quant,
         slo=slo,
         time_fn=clock,
         sleep_fn=lambda s: None,
@@ -343,8 +389,10 @@ def main(argv=None):
                 )
             injector.fail_draft_dispatch(at=2, times=1)
         if args.inject_fault == "page":
-            if not args.kv_page_size:
-                raise SystemExit("--inject-fault page needs --kv-page-size")
+            if args.row_cache or not args.kv_page_size:
+                raise SystemExit(
+                    "--inject-fault page needs the paged layout"
+                )
             injector.poison_page(at=2, slot=0)  # page-granular quarantine
         if args.inject_fault == "dispatch":
             injector.fail_dispatch(at=2, times=1)  # one mid-run failure
@@ -367,6 +415,7 @@ def main(argv=None):
     )
     trace_path = args.trace or args.timeline
     timeline = Timeline(trace_path) if trace_path else None
+    page, quant = _engine_layout(args)
     engine = ServingEngine(
         model, params,
         num_slots=args.slots,
@@ -377,8 +426,9 @@ def main(argv=None):
         draft_params=draft_params,
         gamma=args.gamma,
         prefix_cache=None if args.no_prefix_cache else "auto",
-        kv_page_size=args.kv_page_size or None,
+        kv_page_size=page,
         kv_num_pages=args.kv_pages,
+        quantize=quant,
         fault_injector=injector,
         timeline=timeline,
         profile_dir=args.profile,
@@ -433,10 +483,16 @@ def main(argv=None):
         else f"on (shared {args.shared_prefix} tokens)" if shared is not None
         else "on"
     )
+    layout_desc = f"paged[{page}]" if page else "row"
+    if quant is not None:
+        layout_desc += (
+            f", quantized weights={quant.weights}"
+            + (", kv=int8" if quant.kv else "")
+        )
     print(f"\n=== {len(reqs)} requests through {args.slots} slots "
           f"({args.admission} admission, decode chunk "
-          f"{args.decode_chunk}, prefix cache {prefix_desc}, "
-          f"fault={args.inject_fault}) ===")
+          f"{args.decode_chunk}, kv {layout_desc}, prefix cache "
+          f"{prefix_desc}, fault={args.inject_fault}) ===")
     for req in reqs:
         r = engine.metrics.request_snapshot(req.rid)
         ttft = r.get("ttft")
@@ -462,7 +518,7 @@ def main(argv=None):
     hbm_snap = snap.pop("hbm", {})
     snap["decode_compilations"] = engine.decode_compilations
     snap["rejected_submits"] = rejected
-    if args.kv_page_size:
+    if page:
         snap["kv_pages_usable"] = engine.cache.alloc.capacity
         snap["kv_pages_free"] = engine.cache.alloc.free_pages
         snap["kv_pages_quarantined"] = engine.cache.alloc.pages_quarantined

@@ -128,7 +128,12 @@ def chunked_decode_step(decode_model, chunk_size: int, max_seq_len: int,
     gathers the logical view through the block table on entry, runs the
     EXACT row-per-slot math above on it, and scatters back only the pages
     its write window could have touched on exit — one program either way,
-    token streams bit-identical across layouts."""
+    token streams bit-identical across layouts. A QUANTIZED pool (int8
+    pages + ``k_scale``/``v_scale`` siblings, ISSUE 13) is self-describing:
+    the gather dequantizes the logical view and the scatter re-quantizes
+    the window pages inside the same program — the row math in between is
+    untouched, and the stream contract becomes the engine's pinned
+    logit-divergence budget instead of bit-identity."""
     from neuronx_distributed_tpu.inference.utils import unwrap_logits
     from neuronx_distributed_tpu.modules.attention import (
         cache_cursor,

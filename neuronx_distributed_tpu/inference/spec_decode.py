@@ -80,7 +80,10 @@ def speculative_decode_chunk(
     on entry, the exact row-per-slot round math runs on them, and each
     cache's write window (``chunk_rounds * gamma`` columns from its entry
     cursor) is scattered back on exit — shared copy-on-write prefix pages
-    outside the window are never rewritten."""
+    outside the window are never rewritten. A QUANTIZED target pool (int8
+    pages + scale siblings, ISSUE 13) de/re-quantizes inside the same
+    transports; the draft cache stays float (the engine never quantizes
+    it — drafts only steer acceptance)."""
     from neuronx_distributed_tpu.inference.generate import decode_write_mask
     from neuronx_distributed_tpu.inference.utils import unwrap_logits
     from neuronx_distributed_tpu.modules.attention import (

@@ -44,6 +44,14 @@ from neuronx_distributed_tpu.kernels.flash_attention import (
 
 NEG_INF = -1e30
 
+# jax<0.5 spelling compat: CompilerParams was TPUCompilerParams. The alias
+# lets the PAGED kernel's interpret-mode tests (the non-TPU CI proof of the
+# fused block-index-map path) run on old containers where the other kernel
+# tests are env-triaged; modern jax resolves the first name.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 # --- paged KV: block-table gather/scatter -------------------------------------
 #
@@ -55,10 +63,12 @@ NEG_INF = -1e30
 # already speaks, and the window scatter writes back ONLY the pages a decode
 # chunk could have touched — shared copy-on-write prefix pages outside the
 # window are never rewritten. On TPU the gather feeds ``flash_decode_attention``
-# unchanged (the kernel is oblivious to where its cache slice came from); a
-# future step can fold the page lookup into the kernel's block index map.
-# Both ops are pure jnp (no pallas) so they trace inside the engine's donated
-# decode chunk on any backend.
+# unchanged (the kernel is oblivious to where its cache slice came from);
+# ``paged_flash_decode_attention`` below folds the page lookup into the
+# kernel's block index map instead — the entry point the TP serving item
+# routes through once attention carries the paged transport. Both transport
+# ops here are pure jnp (no pallas) so they trace inside the engine's
+# donated decode chunk on any backend.
 
 
 def paged_gather_leaf(pool: jax.Array, block_table: jax.Array,
@@ -80,6 +90,44 @@ def paged_gather_leaf(pool: jax.Array, block_table: jax.Array,
     return out.reshape(shape)
 
 
+def paged_window_vals(logical: jax.Array, block_table: jax.Array,
+                      page0: jax.Array, n_win: int, page_size: int,
+                      lead_ndim: int):
+    """Extract the ``n_win`` logical pages starting at ``page0`` of every
+    slot as scatter-ready page blocks: returns ``(vals, idx)`` — ``vals``
+    (lead..., B*n_win, page_size, tail...) and ``idx`` (B*n_win,) physical
+    page ids from the block table. The shared half of the plain and the
+    quantizing window scatters."""
+    b, n_log = block_table.shape
+    lead = logical.shape[:lead_ndim]
+    page0 = jnp.clip(page0, 0, max(n_log - n_win, 0))
+    bt_win = jax.lax.dynamic_slice(block_table, (0, page0), (b, n_win))
+    idx = bt_win.reshape(-1)  # (B*n_win,)
+    lg = logical.reshape(
+        lead + (b, n_log, page_size) + logical.shape[lead_ndim + 2:]
+    )
+    win = jax.lax.dynamic_slice_in_dim(lg, page0, n_win, axis=lead_ndim + 1)
+    vals = win.reshape(
+        lead + (b * n_win, page_size) + win.shape[lead_ndim + 3:]
+    )
+    return vals, idx
+
+
+def paged_scatter_vals(pool: jax.Array, vals: jax.Array,
+                       idx: jax.Array) -> jax.Array:
+    """Scatter page blocks ``vals`` (lead..., n, page_size, tail...) into
+    the pool at physical ids ``idx`` (n,). Slots whose pages are unmapped
+    (block table 0) scatter into the reserved null page; duplicate targets
+    carry identical values everywhere except that null page, whose content
+    is never attendable."""
+    pax = pool.ndim - 4
+    lead_n = pax
+    pool_flat = pool.reshape((-1,) + pool.shape[pax:])
+    vals_flat = vals.reshape((-1,) + vals.shape[lead_n:])
+    out = jax.vmap(lambda p, v: p.at[idx].set(v))(pool_flat, vals_flat)
+    return out.reshape(pool.shape)
+
+
 def paged_scatter_window_leaf(pool: jax.Array, logical: jax.Array,
                               block_table: jax.Array, page0: jax.Array,
                               n_win: int, page_size: int) -> jax.Array:
@@ -87,26 +135,12 @@ def paged_scatter_window_leaf(pool: jax.Array, logical: jax.Array,
     slot back into the pool (the decode chunk's write window, statically
     sized; ``page0`` is traced). Values outside the window are discarded —
     they were read-only in the chunk, so the pool already holds them; this
-    is what keeps shared (ref > 1) prefix pages bit-stable under CoW.
-
-    Slots whose window pages are unmapped (block table 0) scatter into the
-    reserved null page; duplicate targets carry identical values everywhere
-    except that null page, whose content is never attendable."""
+    is what keeps shared (ref > 1) prefix pages bit-stable under CoW."""
     pax = pool.ndim - 4
-    b, n_log = block_table.shape
-    lead = pool.shape[:pax]
-    page0 = jnp.clip(page0, 0, max(n_log - n_win, 0))
-    bt_win = jax.lax.dynamic_slice(block_table, (0, page0), (b, n_win))
-    idx = bt_win.reshape(-1)  # (B*n_win,)
-    lg = logical.reshape(
-        lead + (b, n_log, page_size) + logical.shape[pax + 2:]
+    vals, idx = paged_window_vals(
+        logical, block_table, page0, n_win, page_size, pax
     )
-    win = jax.lax.dynamic_slice_in_dim(lg, page0, n_win, axis=pax + 1)
-    vals = win.reshape(lead + (b * n_win, page_size) + win.shape[pax + 3:])
-    pool_flat = pool.reshape((-1,) + pool.shape[pax:])
-    vals_flat = vals.reshape((-1,) + vals.shape[len(lead):])
-    out = jax.vmap(lambda p, v: p.at[idx].set(v))(pool_flat, vals_flat)
-    return out.reshape(pool.shape)
+    return paged_scatter_vals(pool, vals, idx)
 
 
 def paged_write_pages_leaf(pool: jax.Array, pages: jax.Array,
@@ -132,6 +166,68 @@ def paged_read_pages_leaf(pool: jax.Array, page_ids: jax.Array) -> jax.Array:
     n, ps = page_ids.shape[0], pool.shape[pax + 1]
     shape = out.shape[:pax] + (n * ps,) + out.shape[pax + 2:]
     return out.reshape(shape)
+
+
+# --- quantized KV pages (ISSUE 13) --------------------------------------------
+#
+# With ServingEngine(quantize=QuantConfig(kv="int8")) the pool k/v leaves
+# store int8 pages with per-page, per-kv-head symmetric scales as SIBLING
+# leaves (k_scale/v_scale, shape (..., P, 1, Hkv, 1), dtype = the compute
+# dtype so the transport is self-describing — dequantization targets the
+# scale leaf's dtype). The four ops below are the quantized twins of the
+# transport above: gather/read dequantize into the logical/compute view,
+# the window quantizer turns a chunk's float write window back into
+# (int8 pages, scales) for the scatter. Everything is pure jnp — it traces
+# inside the donated decode chunk on any backend, and XLA fuses the
+# dequant multiply into the attention consumer.
+
+KV_QMAX = 127.0  # int8 symmetric clamp bound (quantization/config.py)
+
+
+def quantize_page_block(pages: jax.Array):
+    """Quantize float page blocks (..., n, page_size, Hkv, D) to int8 with
+    per-(page, kv-head) symmetric scales (..., n, 1, Hkv, 1). The scale is
+    computed in fp32 then CAST to the block dtype BEFORE quantizing, so a
+    dequantize→requantize round-trip with an unchanged absmax is exact
+    (chunk N+1 re-scattering a page chunk N wrote)."""
+    pf = pages.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(pf), axis=(-3, -1), keepdims=True)
+    scale = (jnp.maximum(amax, 1e-12) / KV_QMAX).astype(pages.dtype)
+    sf = scale.astype(jnp.float32)
+    q = jnp.clip(jnp.round(pf / sf), -KV_QMAX, KV_QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def paged_gather_leaf_dequant(pool_q: jax.Array, pool_scale: jax.Array,
+                              block_table: jax.Array,
+                              page_size: int) -> jax.Array:
+    """Materialize the DEQUANTIZED logical view of a quantized pool leaf:
+    int8 pages and their per-page scales gather through the same block
+    table, and the logical (..., B, L, Hkv, D) view comes back in the scale
+    leaf's (compute) dtype — the exact view the unquantized gather would
+    hold, so the whole decode/attention stack runs on it unchanged."""
+    col = pool_q.ndim - 4 + 1  # logical column axis (after the B axis)
+    q = paged_gather_leaf(pool_q, block_table, page_size)
+    s = paged_gather_leaf(pool_scale, block_table, 1)  # (..., B, n_log, Hkv, 1)
+    s = jnp.repeat(s, page_size, axis=col)
+    return (q.astype(jnp.float32) * s.astype(jnp.float32)).astype(
+        pool_scale.dtype
+    )
+
+
+def paged_read_pages_leaf_dequant(pool_q: jax.Array, pool_scale: jax.Array,
+                                  page_ids: jax.Array,
+                                  page_size: int) -> jax.Array:
+    """Quantized twin of :func:`paged_read_pages_leaf`: read ``n`` physical
+    pages as one contiguous DEQUANTIZED block (..., n*page_size, Hkv, D) in
+    the scale leaf's dtype (the zero-copy CoW prefix-hit view)."""
+    pax = pool_q.ndim - 4
+    q = paged_read_pages_leaf(pool_q, page_ids)       # (..., n*ps, Hkv, D)
+    s = paged_read_pages_leaf(pool_scale, page_ids)   # (..., n, Hkv, 1)
+    s = jnp.repeat(s, page_size, axis=pax)
+    return (q.astype(jnp.float32) * s.astype(jnp.float32)).astype(
+        pool_scale.dtype
+    )
 
 
 def _decode_kernel(pos_ref, bound_ref, valid_ref, q_ref, k_ref, v_ref,
@@ -229,7 +325,7 @@ def _flash_decode_call(q, k, v, pos, kv_valid, l_off, interpret, block_l):
             pltpu.VMEM((r, 1), jnp.float32),
             pltpu.VMEM((r, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -366,3 +462,179 @@ def flash_decode_attention(
     out = fn(qt, kt, vt, rows_pos,
              kv_valid if kv_valid is not None else jnp.ones((b, L), jnp.int32))
     return unfold(out)
+
+
+# --- fused paged decode: block table IN the kernel's index map ----------------
+#
+# The transport above materializes the logical view (jnp.take through the
+# block table) BEFORE the kernel sees it — an extra HBM round-trip of the
+# whole mapped cache per chunk. This kernel folds the page lookup into the
+# block index map instead: the block table rides Pallas scalar prefetch
+# (SMEM), and the K/V BlockSpec index maps read it to stream each slot's
+# PHYSICAL pool pages directly — page j of slot b arrives from pool page
+# ``block_table[b, j]``, no logical copy ever exists. Same online-softmax
+# math as `_decode_kernel`, one page per sequential grid step. The gather
+# path stays the non-TPU fallback (and the numerics golden: streams are
+# pinned identical in tests/kernels/test_flash_decode.py, interpret mode).
+
+
+def _paged_decode_kernel(bt_ref, pos_ref, bound_ref, valid_ref, q_ref,
+                         k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                         acc_scr, *, page_size, num_pages_log, use_valid):
+    j = pl.program_id(2)  # logical page (sequential; physical via bt_ref)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # skip logical pages entirely beyond every row's position
+    run = j * page_size < bound_ref[0]
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)            # (R, D)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # (ps, D)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)      # (ps, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * (1.0 / (q.shape[-1] ** 0.5))               # (R, ps)
+        rows = pos_ref[0, :][:, None]                  # (R, 1) slot positions
+        cols = (
+            jax.lax.broadcasted_iota(jnp.int32, (q.shape[0], page_size), 1)
+            + j * page_size
+        )
+        s = jnp.where(rows >= cols, s, NEG_INF)
+        if use_valid:
+            ok = valid_ref[0, :][None, :] != 0          # (1, ps)
+            s = jnp.where(ok, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        ref = jnp.where(m_new > NEG_INF / 2, m_new, 0.0)
+        p = jnp.exp(s - ref)
+        alpha = jnp.exp(m_prev - ref)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = m_new
+
+    @pl.when(j == num_pages_log - 1)
+    def _finish():
+        l = l_scr[:]
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            l > 0, m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF
+        )
+
+
+def paged_flash_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_table: jax.Array,
+    q_pos: jax.Array,
+    kv_valid: Optional[jax.Array] = None,
+    page_size: int = 16,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Paged cached-decode attention with the page lookup FUSED into the
+    kernel's block index map: q (B, S, H, D) rows at slot positions
+    ``q_pos`` (S,) attend each slot's logically-mapped cache directly from
+    the physical pool — ``k_pool``/``v_pool`` (P, page_size, Hkv, D)
+    single-layer pool leaves, ``block_table`` (B, n_log) int32 (0 = the
+    reserved null page, whose columns MUST be masked by ``kv_valid`` —
+    the serving contract). Output matches
+    ``flash_decode_attention(q, gather(pool), ..., block_l=page_size)``
+    BIT-FOR-BIT (same online-softmax block partition; other ``block_l``
+    choices differ only in fp accumulation order, ~1e-7) — without ever
+    materializing the gathered logical view in HBM.
+
+    Off-TPU (and not ``interpret``) this routes through the gather
+    fallback — the exact transport the serving chunk uses today — so the
+    function is safe to call on any backend."""
+    b, s, h, d = q.shape
+    hkv = k_pool.shape[2]
+    group = h // hkv
+    n_log = block_table.shape[1]
+    L = n_log * page_size
+    if interpret is None:
+        interpret = False
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not (on_tpu or interpret):
+        # non-TPU fallback: materialize the logical view (the serving
+        # chunk's gather transport) and run the reference decode math
+        from neuronx_distributed_tpu.modules.attention import (
+            decode_attention,
+        )
+
+        k_log = paged_gather_leaf(k_pool, block_table, page_size)
+        v_log = paged_gather_leaf(v_pool, block_table, page_size)
+        return decode_attention(q, k_log, v_log, q_pos, kv_valid=kv_valid)
+
+    qt = jnp.swapaxes(q, 1, 2).reshape(b, hkv, group, s, d).reshape(
+        b, hkv, group * s, d
+    )
+    q_pos = q_pos[None] if q_pos.ndim == 0 else q_pos
+    rows_pos = jnp.tile(q_pos.astype(jnp.int32), (group,))  # (R,)
+    r = group * s
+    use_valid = kv_valid is not None
+    if kv_valid is None:
+        kv_valid = jnp.zeros((1, 1), jnp.int32)
+        vspec = _SMEM_SPEC
+    else:
+        kv_valid = kv_valid.astype(jnp.int32)
+        vspec = pl.BlockSpec((1, page_size), lambda b_, h_, j, bt: (b_, j))
+    bound = jnp.max(rows_pos) + 1
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # the block table, read by the k/v index maps
+        grid=(b, hkv, n_log),
+        in_specs=[
+            pl.BlockSpec((1, r), lambda b_, h_, j, bt: (0, 0)),   # pos
+            _SMEM_SPEC,                                            # bound
+            vspec,                                                 # kv_valid
+            pl.BlockSpec((1, 1, r, d), lambda b_, h_, j, bt: (b_, h_, 0, 0)),
+            # THE fusion: logical page j of slot b_ streams straight from
+            # physical pool page bt[b_, j] — no gathered copy in HBM
+            pl.BlockSpec(
+                (1, page_size, 1, d), lambda b_, h_, j, bt: (bt[b_, j], 0, h_, 0)
+            ),
+            pl.BlockSpec(
+                (1, page_size, 1, d), lambda b_, h_, j, bt: (bt[b_, j], 0, h_, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, r, d), lambda b_, h_, j, bt: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, r, 1), lambda b_, h_, j, bt: (b_, h_, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((r, 1), jnp.float32),
+            pltpu.VMEM((r, 1), jnp.float32),
+            pltpu.VMEM((r, d), jnp.float32),
+        ],
+    )
+    out, _ = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, page_size=page_size,
+            num_pages_log=n_log, use_valid=use_valid,
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, r, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hkv, r, 1), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        block_table.astype(jnp.int32),
+        rows_pos.reshape(1, r),
+        jnp.asarray(bound, jnp.int32).reshape((1,)),
+        kv_valid,
+        qt, k_pool, v_pool,
+    )
+    return jnp.swapaxes(
+        out.reshape(b, hkv, group, s, d).reshape(b, h, s, d), 1, 2
+    ).astype(q.dtype)

@@ -452,6 +452,51 @@ def invalidate_cache_window(cache, start, keep):
 
 
 _PAGED_LEAVES = ("k", "v")
+_SCALE_SUFFIX = "_scale"  # quantized-pool sibling leaves: k_scale / v_scale
+
+
+def cache_node_at(tree, path):
+    """Walk a cache tree to the node at ``path`` (tree_util DictKey path or
+    plain key sequence) — the sibling-lookup primitive of the quantized
+    paged transport (a ``k`` leaf's per-page scales live next door as
+    ``k_scale``, which ``tree_map`` alone can never see)."""
+    node = tree
+    for k in path:
+        node = node[k.key if hasattr(k, "key") else k]
+    return node
+
+
+def pool_scale_base(name: str):
+    """``"k"``/``"v"`` if ``name`` is a quantized pool's scale sibling
+    (``k_scale``/``v_scale``), else None — THE one copy of the sibling
+    naming rule every pool walker classifies by."""
+    if name.endswith(_SCALE_SUFFIX):
+        base = name[: -len(_SCALE_SUFFIX)]
+        if base in _PAGED_LEAVES:
+            return base
+    return None
+
+
+def pool_scale_sibling(pool, path, base: str):
+    """The ``<base>_scale`` leaf next to the pool leaf at ``path``, or None
+    on an unquantized pool — the one sibling lookup the quantized
+    transports (gather/scatter/admit/seed/accounting) share."""
+    parent = cache_node_at(pool, path[:-1])
+    name = base + _SCALE_SUFFIX
+    return parent[name] if name in parent else None
+
+
+def _rebuild_tree(items):
+    """Nested dict from ``(keys, leaf)`` pairs (the gather/seed side of the
+    quantized pool, whose OUTPUT tree drops the scale siblings — the model
+    must see exactly the k/v/index/kv_valid collection it always has)."""
+    out: dict = {}
+    for keys, leaf in items:
+        node = out
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = leaf
+    return out
 
 
 def gather_cache_pages(paged, page_size: int):
@@ -464,17 +509,36 @@ def gather_cache_pages(paged, page_size: int):
     so the whole decode/attention stack runs on it unchanged; unmapped
     logical pages surface null-page garbage in columns ``kv_valid`` already
     masks. Gather routes through the flash-decode module's paged transport
-    (kernels/flash_decode.py), the same file the TPU decode kernel lives in."""
-    from neuronx_distributed_tpu.kernels.flash_decode import paged_gather_leaf
+    (kernels/flash_decode.py), the same file the TPU decode kernel lives in.
+
+    QUANTIZED pools (ISSUE 13) are self-describing: a ``k_scale``/
+    ``v_scale`` sibling next to a k/v leaf marks int8 pages with per-page,
+    per-kv-head scales, and the gather DEQUANTIZES into the scale leaf's
+    (compute) dtype — the logical view the model sees is float either way,
+    and the scale siblings never appear in it."""
+    from neuronx_distributed_tpu.kernels.flash_decode import (
+        paged_gather_leaf,
+        paged_gather_leaf_dequant,
+    )
+    from neuronx_distributed_tpu.utils.tree import path_keys
 
     bt = paged["pages"]
-
-    def fn(path, leaf):
-        if cache_leaf_name(path) not in _PAGED_LEAVES:
-            return leaf
-        return paged_gather_leaf(leaf, bt, page_size)
-
-    return jax.tree_util.tree_map_with_path(fn, paged["pool"])
+    pool = paged["pool"]
+    items = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(pool)[0]:
+        keys = tuple(path_keys(path))
+        name = keys[-1]
+        if pool_scale_base(name) is not None:
+            continue  # transport metadata — dropped from the logical view
+        if name in _PAGED_LEAVES:
+            scale = pool_scale_sibling(pool, path, name)
+            leaf = (
+                paged_gather_leaf_dequant(leaf, scale, bt, page_size)
+                if scale is not None
+                else paged_gather_leaf(leaf, bt, page_size)
+            )
+        items.append((keys, leaf))
+    return _rebuild_tree(items)
 
 
 def scatter_cache_window(paged, logical, page_size: int, start_col,
@@ -486,27 +550,47 @@ def scatter_cache_window(paged, logical, page_size: int, start_col,
     page is left untouched, which is exactly what keeps shared
     copy-on-write prefix pages bit-stable while their ref-holders decode.
     ``index``/``kv_valid`` (logical, per-slot) are adopted wholesale from
-    ``logical``. Returns a fresh paged pytree (same treedef)."""
+    ``logical``. Returns a fresh paged pytree (same treedef).
+
+    On a QUANTIZED pool the window pages are re-quantized on the way out
+    (per-page absmax → int8 pages + scale siblings; the scale recompute for
+    the sibling leaf is CSE'd with the base leaf's inside the one jitted
+    chunk). Pages outside the window keep their stored (int8, scale) pair
+    untouched — the CoW bit-stability contract is unchanged."""
     from neuronx_distributed_tpu.kernels.flash_decode import (
+        paged_scatter_vals,
         paged_scatter_window_leaf,
+        paged_window_vals,
+        quantize_page_block,
     )
 
     bt = paged["pages"]
+    pool = paged["pool"]
     n_log = bt.shape[1]
     # pages a width-column window can overlap, wherever it starts
     n_win = min((width - 1) // page_size + 2, n_log)
     page0 = jnp.asarray(start_col, jnp.int32) // page_size
 
-    def fn(path, pool_leaf, logical_leaf):
-        if cache_leaf_name(path) not in _PAGED_LEAVES:
-            return logical_leaf  # index / kv_valid: logical IS the storage
-        return paged_scatter_window_leaf(
-            pool_leaf, logical_leaf, bt, page0, n_win, page_size
+    def fn(path, pool_leaf):
+        name = cache_leaf_name(path)
+        base = pool_scale_base(name) or name
+        if base not in _PAGED_LEAVES:
+            # index / kv_valid: logical IS the storage
+            return cache_node_at(logical, path[:-1])[name]
+        lg = cache_node_at(logical, path[:-1])[base]
+        if pool_scale_sibling(pool, path, base) is None:
+            return paged_scatter_window_leaf(
+                pool_leaf, lg, bt, page0, n_win, page_size
+            )
+        vals, idx = paged_window_vals(
+            lg, bt, page0, n_win, page_size, lg.ndim - 4
         )
+        q, s = quantize_page_block(vals)
+        return paged_scatter_vals(pool_leaf, q if base == name else s, idx)
 
     return {
         "pages": bt,
-        "pool": jax.tree_util.tree_map_with_path(fn, paged["pool"], logical),
+        "pool": jax.tree_util.tree_map_with_path(fn, pool),
     }
 
 

@@ -125,24 +125,16 @@ def _declare_kernel_q(module, shape, partition, kernel_init, dtype,
                       scale_partition, name="kernel", channel_dim=1,
                       batch_dim=None):
     """Like :func:`_declare_kernel`, but returns a 3-tuple
-    ``(weight, qscale, act_scale)``: when the module's config requests the
-    native int8 MXU path (``use_int8_matmul``) the RAW int8 kernel + fp32
-    weight scale (+ the scalar ``act_scale`` param iff
-    ``use_static_act_scale``, for ``int8_matmul``'s static activation
-    quantization); otherwise ``(dequantized_weight, None, None)``.
+    ``(kernel, qscale, act_scale)`` with the RAW quantized kernel whenever
+    the module carries a ``quantization_config`` — the caller routes the
+    matmul itself: ``qscale is None`` means float (plain ``dot_general``);
+    otherwise ``quantization.layers.quantized_matmul`` (dequantize-on-load,
+    the weight-only serving path) or — when the config requests the native
+    int8 MXU path (``use_int8_matmul``) — ``quantization.utils.int8_matmul``
+    with the ``act_scale`` param iff ``use_static_act_scale``.
     ``quantize_param_tree`` with the same config emits exactly this tree."""
     qcfg = module.quantization_config
-    use_int8 = (
-        qcfg is not None
-        and getattr(qcfg, "use_int8_matmul", False)
-        and batch_dim is None
-        and len(shape) == 2
-    )
-    if use_int8:
-        from neuronx_distributed_tpu.quantization.config import QuantizedDtype
-
-        use_int8 = qcfg.quantized_dtype == QuantizedDtype.INT8
-    if not use_int8:
+    if qcfg is None:
         return (
             _declare_kernel(module, shape, partition, kernel_init, dtype,
                             scale_partition, name=name,
@@ -160,6 +152,8 @@ def _declare_kernel_q(module, shape, partition, kernel_init, dtype,
         wants_static_act_scale,
     )
 
+    # wants_static_act_scale subsumes the int8-MXU predicate (it requires
+    # use_int8_matmul + int8 kernels itself)
     if wants_static_act_scale(qcfg):
         # scalar static activation scale, filled by a calibration pass
         # (observer.calibrate_activation_scale); init 1.0 keeps an
@@ -171,6 +165,22 @@ def _declare_kernel_q(module, shape, partition, kernel_init, dtype,
             jnp.float32,
         )
     return kernel, scale, act_scale
+
+
+def _quantized_forward(qcfg, x, kernel, qscale, act_scale, dtype):
+    """The one matmul-mode dispatch of a quantized linear: the native int8
+    MXU path when the config asks for it, otherwise the serving-shaped
+    dequantize-on-load ``quantized_matmul`` (HBM holds 1-byte weights, the
+    MXU sees a dense GEMM — the memory-bound decode case)."""
+    from neuronx_distributed_tpu.quantization.layers import quantized_matmul
+    from neuronx_distributed_tpu.quantization.utils import (
+        int8_matmul,
+        wants_int8_mxu,
+    )
+
+    if wants_int8_mxu(qcfg):
+        return int8_matmul(x, kernel, qscale, dtype, act_scale=act_scale)
+    return quantized_matmul(x, kernel, qscale, dtype)
 
 
 class ColumnParallelLinear(nn.Module):
@@ -220,9 +230,10 @@ class ColumnParallelLinear(nn.Module):
             # layers_utils.py:16).
             x = constrain(x, P(*([UNC] * (x.ndim - 2)), self.axis, None))
         if qscale is not None:
-            from neuronx_distributed_tpu.quantization.utils import int8_matmul
-
-            y = int8_matmul(x, kernel, qscale, self.dtype, act_scale=act_scale)
+            y = _quantized_forward(
+                self.quantization_config, x, kernel, qscale, act_scale,
+                self.dtype,
+            )
         else:
             y = jax.lax.dot_general(
                 x, kernel, (((x.ndim - 1,), (0,)), ((), ())), precision=None
@@ -282,9 +293,10 @@ class RowParallelLinear(nn.Module):
         if self.input_is_parallel:
             x = constrain(x, P(*([UNC] * (x.ndim - 1)), self.axis))
         if qscale is not None:
-            from neuronx_distributed_tpu.quantization.utils import int8_matmul
-
-            y = int8_matmul(x, kernel, qscale, self.dtype, act_scale=act_scale)
+            y = _quantized_forward(
+                self.quantization_config, x, kernel, qscale, act_scale,
+                self.dtype,
+            )
         else:
             y = jax.lax.dot_general(
                 x, kernel, (((x.ndim - 1,), (0,)), ((), ())), precision=None

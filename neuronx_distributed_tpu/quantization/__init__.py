@@ -7,6 +7,7 @@ scales, dequant-then-matmul forward (reference quantization_layers.py:66
 """
 
 from neuronx_distributed_tpu.quantization.config import (
+    QuantConfig,
     QuantizationConfig,
     QuantizationType,
     QuantizedDtype,
@@ -16,6 +17,7 @@ from neuronx_distributed_tpu.quantization.layers import (
     QuantizedExpertFusedColumnParallel,
     QuantizedExpertFusedRowParallel,
     QuantizedRowParallel,
+    quantized_matmul,
 )
 from neuronx_distributed_tpu.quantization.observer import (
     PerChannelAbsMaxObserver,
@@ -25,10 +27,12 @@ from neuronx_distributed_tpu.quantization.observer import (
 from neuronx_distributed_tpu.quantization.utils import (
     dequantize,
     direct_cast_quantize,
+    is_quantized_tree,
     quantize_param_tree,
 )
 
 __all__ = [
+    "QuantConfig",
     "QuantizationConfig",
     "QuantizationType",
     "QuantizedDtype",
@@ -41,5 +45,7 @@ __all__ = [
     "direct_cast_quantize",
     "calibrate_activation_scale",
     "dequantize",
+    "is_quantized_tree",
     "quantize_param_tree",
+    "quantized_matmul",
 ]

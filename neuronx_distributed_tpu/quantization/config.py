@@ -1,10 +1,24 @@
 """Quantization config (reference: ``quantization/quantization_config.py``
-``QuantizationType``/``QuantizedDtype`` enums + qconfig dicts :39-101)."""
+``QuantizationType``/``QuantizedDtype`` enums + qconfig dicts :39-101).
+
+Two levels of config live here:
+
+* :class:`QuantizationConfig` — the per-kernel qconfig the sharded layers
+  and ``quantize_param_tree`` speak (dtype, scale scheme, channel layout).
+* :class:`QuantConfig` — the SERVING-level knob
+  (``ServingEngine(quantize=QuantConfig(weights="int8", kv="int8"))``):
+  which resources of the decode hot path are quantized — the bound params
+  (weight-only int8/fp8, dequantize-on-load inside the jitted matmul) and
+  the paged KV pool (int8 pages + per-page/per-head scales). It lowers to
+  a :class:`QuantizationConfig` for the weight side via
+  :meth:`QuantConfig.weight_qconfig`.
+"""
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+from typing import Optional
 
 import jax.numpy as jnp
 
@@ -58,3 +72,71 @@ class QuantizationConfig:
     # (observer.calibrate_activation_scale on each linear's input); the
     # dynamic path needs no calibration and is the default.
     use_static_act_scale: bool = False
+
+
+# the serving-level spellings ServingEngine(quantize=) accepts, mapped to
+# the kernel dtype each lowers to
+_WEIGHT_DTYPES = {
+    "int8": QuantizedDtype.INT8,
+    "fp8": QuantizedDtype.FP8E4M3,
+}
+_KV_DTYPES = ("int8",)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantConfig:
+    """What the serving engine quantizes (``ServingEngine(quantize=...)``).
+
+    ``weights``: ``"int8"`` / ``"fp8"`` / ``None`` — weight-only
+    quantization of the bound params, converted ONCE at engine construction
+    (per-channel symmetric scales, the ``quantize_param_tree`` contract);
+    the jitted decode/prefill programs dequantize-on-load inside the matmul
+    (``quantization.layers.quantized_matmul``), so HBM holds 1-byte weights
+    while the MXU still sees a dense GEMM — the memory-bound decode win.
+
+    ``kv``: ``"int8"`` / ``None`` — quantize the PAGED KV pool (requires
+    ``kv_page_size=``): pool pages store int8 K/V plus per-page/per-kv-head
+    scales as sibling leaves; the decode chunk dequantizes on the gathered
+    logical view and re-quantizes only its write-window pages on the way
+    out. Half-size pages → ~2x pages at a fixed HBM budget, compounding
+    with paging's ~2x slots.
+
+    The correctness contract under quantization shifts from bit-identity to
+    a LOGIT-DIVERGENCE budget (pinned in
+    ``tests/serving/test_quantized_engine.py``): greedy short-prompt smoke
+    stays token-identical on the bench model, and the quantized stream's
+    per-step logits stay within a max-KL / top-1-agreement budget of the
+    fp32 stream. Keep fp32 (``quantize=None``) when bit-exact streams are
+    the requirement."""
+
+    weights: Optional[str] = "int8"
+    kv: Optional[str] = None
+
+    def __post_init__(self):
+        if self.weights is not None and self.weights not in _WEIGHT_DTYPES:
+            raise ValueError(
+                f"unknown weight quantization {self.weights!r} "
+                f"(expected one of {sorted(_WEIGHT_DTYPES)} or None)"
+            )
+        if self.kv is not None and self.kv not in _KV_DTYPES:
+            raise ValueError(
+                f"unknown KV quantization {self.kv!r} "
+                f"(expected one of {sorted(_KV_DTYPES)} or None)"
+            )
+        if self.weights is None and self.kv is None:
+            raise ValueError(
+                "QuantConfig quantizes nothing (weights=None, kv=None) — "
+                "pass quantize=None instead"
+            )
+
+    def weight_qconfig(self) -> Optional[QuantizationConfig]:
+        """The per-kernel :class:`QuantizationConfig` the weight side lowers
+        to: per-channel symmetric scales (the serving default — robust to
+        per-channel outliers, sharding-compatible on every parallel
+        layer), dequant-then-matmul forward."""
+        if self.weights is None:
+            return None
+        return QuantizationConfig(
+            quantization_type=QuantizationType.PER_CHANNEL_SYMMETRIC,
+            quantized_dtype=_WEIGHT_DTYPES[self.weights],
+        )

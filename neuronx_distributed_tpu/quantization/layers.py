@@ -30,6 +30,27 @@ from neuronx_distributed_tpu.quantization.config import (
 Dtype = Any
 
 
+def quantized_matmul(x: jax.Array, kernel_q: jax.Array, scale: jax.Array,
+                     out_dtype: Any) -> jax.Array:
+    """The serving-shaped weight-only matmul: dequantize-on-load, then a
+    dense GEMM in the activation dtype — THE hot matmul of the quantized
+    decode path (every llama/mixtral linear under
+    ``ServingEngine(quantize=QuantConfig(weights=...))`` routes here via
+    ``parallel.layers``' ``quantization_config`` declarations).
+
+    ``kernel_q`` (in, out) int8/fp8, ``scale`` () per-tensor or (1, out)
+    per-channel fp32. XLA fuses the ``cast · scale`` dequant into the matmul
+    epilogue on TPU, so HBM traffic sees 1-byte weights (the memory-bound
+    decode case this exists for) while the MXU runs a dense ``out_dtype``
+    GEMM. Pure function of its operands — traces inside the engine's
+    donated decode chunk with zero host syncs; one program per shape, so
+    ``decode_compilations`` stays 1 with quantization ON."""
+    w = (kernel_q.astype(jnp.float32) * scale).astype(out_dtype)
+    return jax.lax.dot_general(
+        x.astype(out_dtype), w, (((x.ndim - 1,), (0,)), ((), ()))
+    )
+
+
 def _scale_shape(cfg: QuantizationConfig, kernel_shape, channel_dim):
     if cfg.quantization_type == QuantizationType.PER_TENSOR_SYMMETRIC:
         return ()
@@ -60,24 +81,20 @@ class QuantizedColumnParallel(nn.Module):
     @nn.compact
     def __call__(self, x):
         from neuronx_distributed_tpu.parallel.layers import (
-            _declare_kernel,
-            default_kernel_init,
+            _declare_quantized,
         )
 
-        # ONE declaration/dequant implementation shared with
+        # ONE declaration implementation shared with
         # ColumnParallelLinear(quantization_config=...) — per-channel scales
-        # live on the output dim and shard with it
-        w = _declare_kernel(
-            self,
+        # live on the output dim and shard with it; the forward routes
+        # through the serving-shaped quantized_matmul (dequantize-on-load)
+        kernel, scale = _declare_quantized(
+            self, self.quantization_config,
             (self.input_size, self.output_size),
-            (None, self.axis),
-            default_kernel_init,
-            self.dtype,
-            scale_partition=(None, self.axis),
+            (None, self.axis), (None, self.axis), "kernel",
+            channel_dim=1, batch_dim=None,
         )
-        y = jax.lax.dot_general(
-            x.astype(self.dtype), w, (((x.ndim - 1,), (0,)), ((), ()))
-        )
+        y = quantized_matmul(x, kernel, scale, self.dtype)
         if self.use_bias:
             bias = self.param(
                 "bias",
@@ -212,23 +229,20 @@ class QuantizedRowParallel(nn.Module):
     @nn.compact
     def __call__(self, x):
         from neuronx_distributed_tpu.parallel.layers import (
-            _declare_kernel,
-            default_kernel_init,
+            _declare_quantized,
         )
 
         # per-channel scales on the output dim are NOT sharded for row layers
-        w = _declare_kernel(
-            self,
+        kernel, scale = _declare_quantized(
+            self, self.quantization_config,
             (self.input_size, self.output_size),
-            (self.axis, None),
-            default_kernel_init,
-            self.dtype,
-            scale_partition=(None, None),
+            (self.axis, None), (None, None), "kernel",
+            channel_dim=1, batch_dim=None,
         )
         x = x.astype(self.dtype)
         if self.input_is_parallel:
             x = constrain(x, P(*([UNC] * (x.ndim - 1)), self.axis))
-        y = jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())))
+        y = quantized_matmul(x, kernel, scale, self.dtype)
         y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
         if self.use_bias:
             bias = self.param(

@@ -17,6 +17,40 @@ from neuronx_distributed_tpu.quantization.config import (
 )
 
 
+def wants_int8_mxu(cfg) -> bool:
+    """ONE copy of the matmul-mode predicate for quantized 2-D linears:
+    the native int8×int8 MXU path needs ``use_int8_matmul`` AND int8
+    kernels (fp8 keeps the dequant path). 3-D expert stacks never route
+    here (they declare through ``_declare_kernel``, not the _q variant)."""
+    return (
+        getattr(cfg, "use_int8_matmul", False)
+        and cfg.quantized_dtype == QuantizedDtype.INT8
+    )
+
+
+def is_quantized_tree(params) -> bool:
+    """Whether a params pytree already carries quantized kernels — a
+    ``scale``/``*_scale`` sibling next to any selected kernel leaf (the
+    exact structure ``quantize_param_tree`` emits). The serving engine's
+    ``params`` setter uses this so a weight swap accepts EITHER a float
+    tree (quantized on assignment) or a pre-quantized one (bound as-is)."""
+    from flax.core import meta
+
+    from neuronx_distributed_tpu.utils.tree import path_keys
+
+    params = meta.unbox(params)
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    names = {tuple(path_keys(path)) for path, _ in flat}
+    for keys in names:
+        if keys[-1] == "kernel" and keys[:-1] + ("scale",) in names:
+            return True
+        if keys[-1].endswith("_scale") and (
+            keys[:-1] + (keys[-1][: -len("_scale")],) in names
+        ):
+            return True
+    return False
+
+
 def wants_static_act_scale(cfg) -> bool:
     """ONE copy of the static-activation-scale eligibility predicate, shared
     by the model-side declaration (parallel/layers._declare_kernel_q) and

@@ -92,6 +92,13 @@ HOT_SUFFIXES = (
     # either would sync the very dispatches they meter
     "observability/programs.py",
     "observability/hbm.py",
+    # quantized serving (ISSUE 13): quantized_matmul traces inside EVERY
+    # jitted matmul of a quantize= engine's decode/prefill programs, and
+    # the quantized ring all-reduce runs inside shard_map'd TP steps — an
+    # implicit coercion in either would sync (or retrace) the innermost
+    # hot loops; both modules must stay pure traced jnp
+    "quantization/layers.py",
+    "parallel/quantized_collectives.py",
 )
 HOT_MARKER = "graftlint: hot-path"
 

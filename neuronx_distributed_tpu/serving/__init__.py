@@ -68,6 +68,7 @@ RejectedError`; ``drain()`` finishes in-flight work while admitting nothing
 new; ``health()`` reports ``OK/DEGRADED/DRAINING/HALTED``.
 """
 
+from neuronx_distributed_tpu.quantization.config import QuantConfig
 from neuronx_distributed_tpu.serving.cache_manager import (
     PrefixCache,
     PrefixEntry,
@@ -119,6 +120,7 @@ __all__ = [
     "PagedCacheManager",
     "PrefixCache",
     "PrefixEntry",
+    "QuantConfig",
     "RejectedError",
     "Request",
     "RequestState",

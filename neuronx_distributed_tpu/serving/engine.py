@@ -196,6 +196,7 @@ against running past ``max_seq_len``:
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 import time
 import weakref
@@ -427,6 +428,7 @@ class ServingEngine:
         gamma: int = 4,
         kv_page_size: Optional[int] = None,
         kv_num_pages: Optional[int] = None,
+        quantize=None,
         prefix_cache="auto",
         dispatch_retry: Optional[RetryPolicy] = None,
         degraded_cooldown_chunks: int = 8,
@@ -453,6 +455,51 @@ class ServingEngine:
             raise ValueError(
                 f"unknown quarantine policy {quarantine_policy!r}"
             )
+        # quantized serving (ISSUE 13): quantize= is a QuantConfig.
+        # Weights: the TARGET model is rebound to its quantization_config'd
+        # clone HERE — every jitted program below (prefill buckets, the
+        # fused decode/spec chunks, the suffix prefill) traces the
+        # dequantize-on-load quantized_matmul forward, one program per
+        # bucket exactly like fp32 — and the params setter converts any
+        # float tree ONCE per assignment (construction AND later weight
+        # swaps). KV: the paged pool stores int8 pages + scale siblings
+        # (PagedCacheManager(kv_quant=)); the chunk's gather/scatter
+        # transports de/re-quantize in-program. The draft model (if any)
+        # stays float: drafts only steer speculation, and the emitted
+        # stream never depends on draft numerics. Correctness contract
+        # shifts from bit-identity to the pinned logit-divergence budget
+        # (tests/serving/test_quantized_engine.py).
+        self.quantize = quantize
+        self._weight_qcfg = None
+        if quantize is not None:
+            if quantize.kv is not None and kv_page_size is None:
+                raise ValueError(
+                    "quantize.kv needs kv_page_size= (quantized KV is "
+                    "page-granular — the row layout stays fp32)"
+                )
+            wq = quantize.weight_qconfig()
+            if wq is not None:
+                cfg = getattr(model, "config", None)
+                if not dataclasses.is_dataclass(cfg) or not any(
+                    f.name == "quantization"
+                    for f in dataclasses.fields(cfg)
+                ):
+                    raise ValueError(
+                        "quantize.weights needs a model whose config "
+                        "carries a 'quantization' field (the llama/mixtral "
+                        "families); got "
+                        f"{type(cfg).__name__ if cfg is not None else None}"
+                    )
+                if getattr(cfg, "quantization", None) is not None:
+                    raise ValueError(
+                        "model already carries a quantization config — "
+                        "pass the float model (the engine quantizes) or "
+                        "drop quantize="
+                    )
+                model = model.clone(
+                    config=dataclasses.replace(cfg, quantization=wq)
+                )
+                self._weight_qcfg = wq
         max_seq_len = getattr(getattr(model, "config", None), "max_seq_len", None)
         if max_seq_len is None:
             raise ValueError(
@@ -541,7 +588,8 @@ class ServingEngine:
         self._page_size = kv_page_size
         if kv_page_size is not None:
             self.cache = PagedCacheManager(
-                num_slots, max_seq_len, kv_page_size, kv_num_pages
+                num_slots, max_seq_len, kv_page_size, kv_num_pages,
+                kv_quant=quantize.kv if quantize is not None else None,
             )
             self.cache.reclaim = self._reclaim_prefix_entry
         else:
@@ -956,6 +1004,20 @@ class ServingEngine:
 
     @params.setter
     def params(self, value):
+        qcfg = getattr(self, "_weight_qcfg", None)
+        if qcfg is not None:
+            from neuronx_distributed_tpu.quantization.utils import (
+                is_quantized_tree,
+                quantize_param_tree,
+            )
+
+            # a float tree converts ONCE per assignment (construction and
+            # hot weight swaps alike); a pre-quantized tree — an offline
+            # quantize_param_tree output, a loaded quantized checkpoint —
+            # binds as-is. Either way the bound tree matches the quantized
+            # model clone's declaration structure exactly
+            if not is_quantized_tree(value):
+                value = quantize_param_tree(value, qcfg)
         self._params_src = value
         self._params = dict(value)
         # a weight swap invalidates every stored prefix: its KV was computed

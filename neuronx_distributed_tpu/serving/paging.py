@@ -51,8 +51,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_tpu.modules.attention import (
+    _SCALE_SUFFIX,
     cache_batch_axis,
     cache_leaf_name,
+    cache_node_at,
+    pool_scale_base,
+    pool_scale_sibling,
     reset_cache,
     reset_cache_slot,
     seed_cache_prefix,
@@ -192,7 +196,8 @@ class PagedCacheManager:
     the non-donating seed-from-pages gather behind zero-copy prefix hits."""
 
     def __init__(self, num_slots: int, max_seq_len: int, page_size: int,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None,
+                 kv_quant: Optional[str] = None):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if max_seq_len % page_size != 0:
@@ -200,6 +205,17 @@ class PagedCacheManager:
                 f"max_seq_len ({max_seq_len}) must be a multiple of "
                 f"page_size ({page_size})"
             )
+        if kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"unknown kv_quant {kv_quant!r} (expected 'int8' or None)"
+            )
+        # quantized pool (ISSUE 13): k/v pages stored int8 with per-page,
+        # per-kv-head scale SIBLING leaves (k_scale/v_scale, dtype = the
+        # compute dtype). The jitted transports (gather/scatter/admit/seed)
+        # detect the siblings and de/re-quantize in-program; all HOST
+        # accounting here (block tables, refs, pins, quarantine) is
+        # layout-blind, so CoW sharing and the leak invariant are unchanged
+        self.kv_quant = kv_quant
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len
         self.page_size = page_size
@@ -226,33 +242,48 @@ class PagedCacheManager:
         def _paged_admit(paged, row, slot, shift, cursor, ids, lo_page):
             from neuronx_distributed_tpu.kernels.flash_decode import (
                 paged_write_pages_leaf,
+                quantize_page_block,
             )
 
             n_adm = ids.shape[0]
+            pool_in = paged["pool"]
 
-            def fn(path, pool_leaf, row_leaf):
+            def row_pages(path, base):
+                """The admitted row's n_adm page blocks for pool leaf
+                ``base`` (k or v) — shared by the page write and (on a
+                quantized pool) the sibling scale write, which XLA CSEs
+                inside the one jitted admit program."""
+                row_leaf = cache_node_at(row, path[:-1])[base]
+                r_ax = row_leaf.ndim - 4  # row batch axis
+                col = r_ax + 1
+                rolled = jnp.roll(row_leaf, shift, axis=col)
+                lead = row_leaf.shape[:r_ax]
+                tail = row_leaf.shape[col + 1:]
+                pg = rolled.reshape(lead + (1, n_log, ps) + tail)
+                win = jax.lax.dynamic_slice_in_dim(
+                    pg, lo_page, n_adm, axis=r_ax + 1
+                )
+                return win.reshape(lead + (n_adm, ps) + tail)
+
+            def fn(path, pool_leaf):
                 name = cache_leaf_name(path)
-                if name in ("k", "v"):
-                    r_ax = row_leaf.ndim - 4  # row batch axis
-                    col = r_ax + 1
-                    rolled = jnp.roll(row_leaf, shift, axis=col)
-                    lead = row_leaf.shape[:r_ax]
-                    tail = row_leaf.shape[col + 1:]
-                    pg = rolled.reshape(lead + (1, n_log, ps) + tail)
-                    win = jax.lax.dynamic_slice_in_dim(
-                        pg, lo_page, n_adm, axis=r_ax + 1
-                    )
-                    pages = win.reshape(lead + (n_adm, ps) + tail)
+                base = pool_scale_base(name) or name
+                if base in ("k", "v"):
+                    pages = row_pages(path, base)
+                    if pool_scale_sibling(pool_in, path, base) is not None:
+                        q, s = quantize_page_block(pages)
+                        pages = q if base == name else s
                     return paged_write_pages_leaf(pool_leaf, pages, ids)
                 ax = cache_batch_axis(name, pool_leaf.ndim)
                 if name == "kv_valid":
+                    row_leaf = cache_node_at(row, path[:-1])[name]
                     rolled = jnp.roll(row_leaf, shift, axis=ax + 1)
                     return jax.lax.dynamic_update_slice_in_dim(
                         pool_leaf, rolled, slot, axis=ax
                     )
                 return jnp.full_like(pool_leaf, cursor)
 
-            pool = jax.tree_util.tree_map_with_path(fn, paged["pool"], row)
+            pool = jax.tree_util.tree_map_with_path(fn, pool_in)
             return {"pages": paged["pages"], "pool": pool}
 
         def _seed_from_pages(pool, ids, m, start):
@@ -260,28 +291,47 @@ class PagedCacheManager:
             ``m`` tokens of the shared pages ``ids`` — the zero-copy twin
             of ``seed_cache_prefix`` on a stored entry COPY. The pool is
             READ (never donated, never aliased into the result): the
-            gather materializes compute-only views, no pool page moves."""
+            gather materializes compute-only views, no pool page moves.
+            Quantized pools dequantize into the compute view here (the
+            suffix prefill consumes a float row either way); the scale
+            siblings never reach the row."""
             from neuronx_distributed_tpu.kernels.flash_decode import (
                 paged_read_pages_leaf,
+                paged_read_pages_leaf_dequant,
             )
+            from neuronx_distributed_tpu.utils.tree import path_keys
 
             bucket = ids.shape[0] * ps
-
-            def fn(path, leaf):
-                name = cache_leaf_name(path)
+            items = []
+            for path, leaf in jax.tree_util.tree_flatten_with_path(pool)[0]:
+                keys = tuple(path_keys(path))
+                name = keys[-1]
+                if pool_scale_base(name) is not None:
+                    continue  # transport metadata — not part of a row
                 if name in ("k", "v"):
-                    block = paged_read_pages_leaf(leaf, ids)
-                    return jnp.expand_dims(block, leaf.ndim - 4)
-                ax = cache_batch_axis(name, leaf.ndim)
-                if name == "kv_valid":
+                    scale = pool_scale_sibling(pool, path, name)
+                    block = (
+                        paged_read_pages_leaf_dequant(leaf, scale, ids, ps)
+                        if scale is not None
+                        else paged_read_pages_leaf(leaf, ids)
+                    )
+                    leaf = jnp.expand_dims(block, leaf.ndim - 4)
+                elif name == "kv_valid":
+                    ax = cache_batch_axis(name, leaf.ndim)
                     valid = jnp.arange(bucket, dtype=jnp.int32)[None] < m
-                    return jnp.broadcast_to(
+                    leaf = jnp.broadcast_to(
                         valid, leaf.shape[:ax] + (1, bucket)
                     )
-                return jnp.full_like(leaf, m)
+                else:
+                    leaf = jnp.full_like(leaf, m)
+                items.append((keys, leaf))
+            from neuronx_distributed_tpu.modules.attention import (
+                _rebuild_tree,
+            )
 
-            block = jax.tree_util.tree_map_with_path(fn, pool)
-            return seed_cache_prefix(block, m, start, max_seq_len)
+            return seed_cache_prefix(
+                _rebuild_tree(items), m, start, max_seq_len
+            )
 
         # _paged_admit/_seed_from_pages are per-manager closures already;
         # the module-level reset helpers need per_instance for the same
@@ -322,10 +372,13 @@ class PagedCacheManager:
         for path, leaf in jax.tree_util.tree_flatten_with_path(
             self.cache["pool"]
         )[0]:
-            if cache_leaf_name(path) in ("k", "v"):
-                # pool k/v leaves are (..., P, page_size, Hkv, D) — the
-                # page axis sits 4 from the end (leading axes are nn.scan
-                # layer stacking)
+            name = cache_leaf_name(path)
+            # pool k/v leaves are (..., P, page_size, Hkv, D), their
+            # quantized scale siblings (..., P, 1, Hkv, 1) — the page axis
+            # sits 4 from the end either way (leading axes are nn.scan
+            # layer stacking); scales are real per-page HBM, so plan()
+            # capacity math must charge them
+            if name in ("k", "v") or pool_scale_base(name) is not None:
                 pages_ax = max(int(leaf.shape[leaf.ndim - 4]), 1)
                 total += int(leaf.nbytes) // pages_ax
         return total
@@ -489,26 +542,54 @@ class PagedCacheManager:
 
     def allocate_from(self, row_cache) -> None:
         """Build the page pool + block table from a batch-1 prefill row's
-        structure — zeros everywhere; happens exactly once (lazily)."""
-        num_pages, ps = self.alloc.num_pages, self.page_size
+        structure — zeros everywhere; happens exactly once (lazily). With
+        ``kv_quant`` the k/v pool leaves are int8 and each gains a
+        per-page, per-kv-head scale SIBLING (``k_scale``/``v_scale``,
+        dtype = the row's compute dtype — the transport dequantizes into
+        it), so HBM holds ~1-byte KV: ~2x (bf16) / ~4x (fp32) pages at a
+        fixed budget on top of paging's packing."""
+        from neuronx_distributed_tpu.modules.attention import _rebuild_tree
+        from neuronx_distributed_tpu.utils.tree import path_keys
 
-        def fn(path, leaf):
-            name = cache_leaf_name(path)
+        num_pages, ps = self.alloc.num_pages, self.page_size
+        items = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(row_cache)[0]:
+            keys = tuple(path_keys(path))
+            name = keys[-1]
             ax = cache_batch_axis(name, leaf.ndim)
             if name in ("k", "v"):
                 lead = leaf.shape[:ax]
-                return jnp.zeros(
-                    lead + (num_pages, ps) + leaf.shape[ax + 2:], leaf.dtype
-                )
-            if name == "kv_valid":
+                tail = leaf.shape[ax + 2:]  # (Hkv, D)
+                if self.kv_quant is not None:
+                    items.append(
+                        (keys, jnp.zeros(lead + (num_pages, ps) + tail,
+                                         jnp.int8))
+                    )
+                    items.append((
+                        keys[:-1] + (name + _SCALE_SUFFIX,),
+                        jnp.zeros(
+                            lead + (num_pages, 1) + tail[:-1] + (1,),
+                            leaf.dtype,
+                        ),
+                    ))
+                else:
+                    items.append(
+                        (keys,
+                         jnp.zeros(lead + (num_pages, ps) + tail, leaf.dtype))
+                    )
+            elif name == "kv_valid":
                 lead = leaf.shape[:ax]
-                return jnp.zeros(
-                    lead + (self.num_slots, self.max_seq_len), jnp.bool_
+                items.append(
+                    (keys, jnp.zeros(
+                        lead + (self.num_slots, self.max_seq_len), jnp.bool_
+                    ))
                 )
-            return jnp.zeros_like(leaf)
-
-        pool = jax.tree_util.tree_map_with_path(fn, row_cache)
-        self.cache = {"pages": jnp.asarray(self._tables), "pool": pool}
+            else:
+                items.append((keys, jnp.zeros_like(leaf)))
+        self.cache = {
+            "pages": jnp.asarray(self._tables),
+            "pool": _rebuild_tree(items),
+        }
 
     def admit(self, row_cache, slot: int, padded_len: int,
               cursor: Optional[int] = None, p: Optional[int] = None,

@@ -160,14 +160,10 @@ _COMPAT_ENV_FAILING = {
     "tests/kernels/test_flash_attention.py::test_segments_gqa_forward",
     "tests/kernels/test_flash_attention.py::test_segments_padding_forward",
     "tests/kernels/test_flash_attention.py::test_uneven_blocks",
-    "tests/kernels/test_flash_decode.py::test_early_slot_bound_skip",
-    "tests/kernels/test_flash_decode.py::test_irregular_geometry_routes_through_manual_shard_map",
-    "tests/kernels/test_flash_decode.py::test_kv_valid_mask",
-    "tests/kernels/test_flash_decode.py::test_matches_einsum_decode[1-4-4]",
-    "tests/kernels/test_flash_decode.py::test_matches_einsum_decode[1-8-2]",
-    "tests/kernels/test_flash_decode.py::test_matches_einsum_decode[4-8-2]",
-    "tests/kernels/test_flash_decode.py::test_tp_shards_kv_heads",
-    "tests/kernels/test_flash_decode.py::test_tp_splits_cache_length",
+    # tests/kernels/test_flash_decode.py entries REMOVED (ISSUE 13): the
+    # flash-decode module grew a jax<0.5 _CompilerParams spelling alias
+    # for the fused paged kernel, which flipped the whole file green on
+    # old containers — verified passing here, so it is tier-1 again
     "tests/kernels/test_ring_attention.py::test_llama_cp2_matches_cp1",
     "tests/kernels/test_ring_attention.py::test_llama_cp_train_step",
     "tests/kernels/test_ring_attention.py::test_ring_flash_gqa_and_grads",

@@ -121,3 +121,113 @@ def test_tp_shards_kv_heads():
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
     finally:
         mesh_lib.destroy_model_parallel()
+
+
+# --- fused paged decode: block table IN the kernel's index map (ISSUE 13) -----
+
+def _paged_setup(key, s=1, h=4, hkv=2, ps=16, n_log=8, pool_pages=24,
+                 ctx=70):
+    """A pool + block tables whose gathered logical view has ``ctx`` valid
+    columns per slot (distinct physical pages per slot, rest unmapped →
+    null page 0, masked invalid)."""
+    ks = jax.random.split(key, 3)
+    k_pool = jax.random.normal(ks[0], (pool_pages, ps, hkv, D), jnp.float32)
+    v_pool = jax.random.normal(ks[1], (pool_pages, ps, hkv, D), jnp.float32)
+    mapped = -(-ctx // ps)
+    bt = np.zeros((B, n_log), np.int32)
+    nxt = 1
+    for b in range(B):
+        for j in range(mapped):
+            bt[b, j] = nxt
+            nxt = nxt % (pool_pages - 1) + 1
+    q = jax.random.normal(ks[2], (B, s, h, D), jnp.float32)
+    valid = np.zeros((B, n_log * ps), bool)
+    valid[:, :ctx] = True
+    pos = ctx - s + jnp.arange(s, dtype=jnp.int32)
+    return q, k_pool, v_pool, jnp.asarray(bt), jnp.asarray(valid), pos
+
+
+@pytest.mark.parametrize("s,h,hkv", [(1, 4, 4), (4, 8, 2), (1, 8, 2)])
+def test_paged_kernel_bit_identical_to_gather_path(s, h, hkv):
+    """The fused block-index-map kernel reproduces gather-then-kernel
+    BIT-FOR-BIT at the matching block partition (block_l=page_size) — the
+    satellite's pinned contract; the gather path stays the non-TPU
+    fallback."""
+    from neuronx_distributed_tpu.kernels.flash_decode import (
+        paged_flash_decode_attention,
+        paged_gather_leaf,
+    )
+
+    ps = 16
+    q, kp, vp, bt, valid, pos = _paged_setup(
+        jax.random.PRNGKey(0), s=s, h=h, hkv=hkv, ps=ps
+    )
+    fused = paged_flash_decode_attention(
+        q, kp, vp, bt, pos, valid, page_size=ps, interpret=True
+    )
+    k_log = paged_gather_leaf(kp, bt, ps)
+    v_log = paged_gather_leaf(vp, bt, ps)
+    ref = flash_decode_attention(
+        q, k_log, v_log, pos, valid, block_l=ps, interpret=True
+    )
+    assert np.array_equal(np.asarray(fused), np.asarray(ref))
+
+
+def test_paged_kernel_matches_einsum_golden():
+    from neuronx_distributed_tpu.kernels.flash_decode import (
+        paged_flash_decode_attention,
+        paged_gather_leaf,
+    )
+
+    ps = 16
+    q, kp, vp, bt, valid, pos = _paged_setup(jax.random.PRNGKey(1))
+    fused = paged_flash_decode_attention(
+        q, kp, vp, bt, pos, valid, page_size=ps, interpret=True
+    )
+    ref = decode_attention(
+        q, paged_gather_leaf(kp, bt, ps), paged_gather_leaf(vp, bt, ps),
+        pos, kv_valid=valid,
+    )
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_kernel_null_pages_never_attend():
+    """Unmapped logical pages point at the reserved null page; with the
+    serving kv_valid mask they must not influence the output — poisoning
+    the null page's content must change nothing."""
+    from neuronx_distributed_tpu.kernels.flash_decode import (
+        paged_flash_decode_attention,
+    )
+
+    ps = 16
+    q, kp, vp, bt, valid, pos = _paged_setup(jax.random.PRNGKey(2))
+    out = paged_flash_decode_attention(
+        q, kp, vp, bt, pos, valid, page_size=ps, interpret=True
+    )
+    kp2 = kp.at[0].set(1e9)
+    vp2 = vp.at[0].set(-1e9)
+    out2 = paged_flash_decode_attention(
+        q, kp2, vp2, bt, pos, valid, page_size=ps, interpret=True
+    )
+    assert np.array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_paged_kernel_non_tpu_fallback_is_gather_path():
+    """interpret=None off-TPU routes through the gather fallback (the
+    serving chunk's exact transport) — same numbers as the explicit
+    gather + einsum golden."""
+    from neuronx_distributed_tpu.kernels.flash_decode import (
+        paged_flash_decode_attention,
+        paged_gather_leaf,
+    )
+
+    ps = 16
+    q, kp, vp, bt, valid, pos = _paged_setup(jax.random.PRNGKey(3))
+    out = paged_flash_decode_attention(
+        q, kp, vp, bt, pos, valid, page_size=ps
+    )
+    ref = decode_attention(
+        q, paged_gather_leaf(kp, bt, ps), paged_gather_leaf(vp, bt, ps),
+        pos, kv_valid=valid,
+    )
+    assert np.array_equal(np.asarray(out), np.asarray(ref))

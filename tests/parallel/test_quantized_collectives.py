@@ -1,0 +1,123 @@
+"""Quantized all-reduce (ISSUE 13, EQuARX-style): error budget vs the exact
+psum on the CPU tp8 mesh, rank agreement, the gated entry point, and the
+wire-byte accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+from neuronx_distributed_tpu.parallel.quantized_collectives import (
+    QuantizedAllReduceConfig,
+    all_reduce,
+    comm_bytes,
+    quantized_all_reduce,
+)
+
+REL_ERR_BUDGET = 0.05  # documented per-hop requantization error bound
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    return jax.jit(mesh_lib.compat_shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        axis_names={"tp"}, check_vma=False,
+    ))
+
+
+def _run(mesh, x, **kw):
+    fn = _smap(
+        lambda v: quantized_all_reduce(v[0], "tp", **kw),
+        mesh, (P("tp"),), P("tp"),
+    )
+    return np.asarray(fn(x)).reshape(x.shape[0], -1)
+
+
+@pytest.mark.parametrize("granularity", ["block", "absmax"])
+def test_matches_exact_psum_within_budget(tp8_mesh, granularity):
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 3000), jnp.float32)
+    out = _run(tp8_mesh, x, scale_granularity=granularity)
+    exact = np.asarray(x.sum(0))
+    rel = np.abs(out - exact).max() / np.abs(exact).max()
+    assert rel < REL_ERR_BUDGET, rel
+    # every rank holds the IDENTICAL result (the ring is deterministic)
+    for r in range(1, 8):
+        assert np.array_equal(out[0], out[r])
+
+
+def test_blockwise_isolates_outlier_blocks(tp8_mesh):
+    """One huge block must not destroy the quiet blocks' grid — the
+    EQuARX blockwise-scale rationale; the abs-max fallback smears it."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 4096), jnp.float32)
+    x = x.at[:, :256].mul(1000.0)
+    exact = np.asarray(x.sum(0))
+    quiet = slice(256, None)
+    err_block = np.abs(
+        _run(tp8_mesh, x, block_size=256)[0][quiet] - exact[quiet]
+    ).max()
+    err_absmax = np.abs(
+        _run(tp8_mesh, x, scale_granularity="absmax", block_size=256)[0][quiet]
+        - exact[quiet]
+    ).max()
+    assert err_block < err_absmax / 20, (err_block, err_absmax)
+
+
+def test_non_divisible_sizes_and_shapes(tp8_mesh):
+    """Sizes that divide into neither ranks nor blocks round-trip through
+    the padding exactly (shape and dtype preserved)."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 7, 53), jnp.float32)
+    fn = _smap(
+        lambda v: quantized_all_reduce(v, "tp", block_size=64),
+        tp8_mesh, (P("tp", None, None),), P("tp", None, None),
+    )
+    out = np.asarray(fn(x))
+    assert out.shape == (8, 7, 53)  # per-rank (1, 7, 53) slices, all equal
+    exact = np.asarray(x.sum(0))
+    rel = np.abs(out[0] - exact).max() / np.abs(exact).max()
+    assert rel < REL_ERR_BUDGET, rel
+    assert np.array_equal(out[0], out[7])
+
+
+def test_gated_entry_point_disabled_is_exact(tp8_mesh):
+    """all_reduce(config=disabled/None) IS the exact psum, bit for bit —
+    the config flag's safety contract."""
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 500), jnp.float32)
+    exact = np.asarray(_smap(
+        lambda v: jax.lax.psum(v[0], "tp"), tp8_mesh, (P("tp"),), P("tp")
+    )(x)).reshape(8, -1)
+    for cfg in (None, QuantizedAllReduceConfig(enabled=False)):
+        out = np.asarray(_smap(
+            lambda v: all_reduce(v[0], "tp", cfg),
+            tp8_mesh, (P("tp"),), P("tp"),
+        )(x)).reshape(8, -1)
+        assert np.array_equal(out, exact)
+    # enabled routes to the quantized ring (approximate, within budget)
+    out = np.asarray(_smap(
+        lambda v: all_reduce(v[0], "tp", QuantizedAllReduceConfig(enabled=True)),
+        tp8_mesh, (P("tp"),), P("tp"),
+    )(x)).reshape(8, -1)
+    assert not np.array_equal(out, exact)
+    rel = np.abs(out[0] - exact[0]).max() / np.abs(exact[0]).max()
+    assert rel < REL_ERR_BUDGET
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="block_size"):
+        QuantizedAllReduceConfig(block_size=0)
+    with pytest.raises(ValueError, match="scale_granularity"):
+        QuantizedAllReduceConfig(scale_granularity="row")
+
+
+def test_comm_bytes_accounting():
+    """The wire-byte arithmetic: ~4x fewer bytes than fp32 at block=256
+    (1 byte/elem + 4/256 scale overhead), trivial at N=1."""
+    acct = comm_bytes(1 << 20, 8, block_size=256)
+    assert acct["ratio"] > 3.5
+    # hand math: moved = 2*(N-1)*chunk elements per rank
+    chunk = (1 << 20) // 8
+    assert acct["fp_bytes"] == 2 * 7 * chunk * 4
+    assert acct["quantized_bytes"] == 2 * 7 * chunk + 2 * 7 * (chunk // 256) * 4
+    assert comm_bytes(100, 1) == {
+        "fp_bytes": 0, "quantized_bytes": 0, "ratio": 1.0
+    }

@@ -697,6 +697,35 @@ def test_spec_decode_module_is_hot_by_path(tmp_path):
     assert rep.violations == []
 
 
+def test_quantized_serving_modules_are_hot_by_path(tmp_path):
+    """ISSUE 13 satellite: the quantized-matmul layer module and the
+    quantized collective wrapper are on the GL02 hot-path list BY PATH —
+    an implicit sync smuggled into either (they trace inside every
+    quantize= engine's jitted matmuls / shard_map'd TP steps) trips with
+    no marker needed — and both shipped modules scan clean."""
+    code = """\
+        import jax.numpy as jnp
+
+        def quantized_matmul(x, k, s):
+            amax = jnp.max(jnp.abs(s))
+            return float(amax)  # host read of a device scale
+        """
+    for name in (
+        "quantization/layers.py",
+        "parallel/quantized_collectives.py",
+    ):
+        assert "GL02" in rules_of(lint(tmp_path, code, name=name)), name
+    for rel in (
+        os.path.join("quantization", "layers.py"),
+        os.path.join("parallel", "quantized_collectives.py"),
+    ):
+        out = tmp_path / rel
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(open(os.path.join(PKG, rel)).read())
+        rep = runner.scan([str(out)], root=str(tmp_path))
+        assert rep.violations == [], (rel, rep.violations)
+
+
 def test_draft_cache_cursor_host_read_in_chunk_loop_fails(tmp_path):
     """Acceptance re-injection (ISSUE 9): a host read of the draft cache
     inside the speculative chunk loop — the exact shape of the PR 2 bug,

@@ -16,6 +16,8 @@ def _load_demo():
 
 
 def test_serving_demo_runs():
+    """Paged KV is the demo's DEFAULT layout now (ISSUE 13 fold-in): the
+    plain invocation runs the block-table engine, pool accounting clean."""
     snap = _load_demo().main(
         ["--requests", "5", "--slots", "2", "--max-new-tokens", "6"]
     )
@@ -23,10 +25,39 @@ def test_serving_demo_runs():
     assert snap["decode_compilations"] == 1
     assert 0 < snap["mean_occupancy"] <= 2
     assert snap["preemptions"] == 0  # conservative admission default
+    assert snap["kv_pages_usable"] > 0  # paged by default
+    assert snap["prefix_copy_bytes"] == 0  # zero-copy CoW contract
+
+
+def test_serving_demo_row_cache_runs():
+    """--row-cache keeps the legacy row-per-slot engine available (and the
+    streams contract identical)."""
+    snap = _load_demo().main(
+        ["--requests", "4", "--slots", "2", "--max-new-tokens", "6",
+         "--row-cache"]
+    )
+    assert snap["completed"] == 4
+    assert snap["decode_compilations"] == 1
+    assert "kv_pages_usable" not in snap
+
+
+def test_serving_demo_quantized_runs():
+    """--quantize int8 --kv-quant (ISSUE 13): the quantized serving path —
+    int8 weights dequantized-on-load + int8 KV pages — serves the same
+    workload with ONE decode program."""
+    snap = _load_demo().main(
+        ["--requests", "4", "--slots", "2", "--max-new-tokens", "6",
+         "--quantize", "int8", "--kv-quant"]
+    )
+    assert snap["completed"] == 4
+    assert snap["decode_compilations"] == 1
+    assert snap["kv_pages_usable"] > 0
+
 
 def test_serving_demo_programs_mode_runs(capsys):
     """--programs (ISSUE 12): the device-efficiency sections print the
-    program ledger table and the HBM ledger with its capacity plan."""
+    program ledger table and the HBM ledger with its capacity plan (the
+    paged-by-default engine registers its pool as kv_pages)."""
     _load_demo().main(
         ["--requests", "3", "--slots", "2", "--max-new-tokens", "4",
          "--programs"]
@@ -34,7 +65,7 @@ def test_serving_demo_programs_mode_runs(capsys):
     out = capsys.readouterr().out
     assert "program ledger (compiler-reported cost)" in out
     assert "decode_chunk" in out and "prefill[" in out
-    assert "hbm ledger" in out and "kv_cache" in out
+    assert "hbm ledger" in out and "kv_pages" in out
     assert "plan (no device limit" in out  # CPU container: explicit fallback
 
 

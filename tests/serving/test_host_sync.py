@@ -201,6 +201,41 @@ def test_sync_budget_with_program_and_hbm_ledgers(setup):
     assert snap["hbm"]["bytes_limit"] == UNAVAILABLE  # CPU, pinned
 
 
+def test_sync_budget_unchanged_with_quantization(setup):
+    """ISSUE 13 pin: full quantized serving — int8 weights dequantized
+    on-load inside every jitted matmul AND int8 KV pages de/re-quantized
+    inside the chunk's gather/scatter transports — changes what the
+    DEVICE computes, not what the host pays. The params conversion is one
+    device program at construction (no sync: is_quantized_tree reads
+    metadata); budgets identical to the bare engine: submit=1, admission
+    step=2, steady chunk=1."""
+    from neuronx_distributed_tpu.serving import QuantConfig
+
+    cfg, model, params = setup
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None,
+        quantize=QuantConfig(weights="int8", kv="int8"), kv_page_size=16,
+    )
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    with _SyncCounter() as c:
+        req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(7))
+    assert c.calls == 1, f"quantized submit must stay 1 sync, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 2, (
+        f"quantized admission must stay 2 syncs, saw {c.calls}"
+    )
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 1, (
+        f"quantized steady chunk must stay 1 sync, saw {c.calls}"
+    )
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 12
+    assert engine.decode_compilations == 1
+
+
 @pytest.mark.sanitize
 def test_engine_hot_loop_under_transfer_guard(setup, transfer_guard_disallow):
     """Dynamic GL02 witness: a full serve cycle — submit, prefill (with the
