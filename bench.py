@@ -62,6 +62,7 @@ PAGED_TIMEOUT_S = 540
 QUANT_TIMEOUT_S = 540
 TRAFFIC_TIMEOUT_S = 540
 EFFICIENCY_TIMEOUT_S = 540
+MULTICHIP_TIMEOUT_S = 540
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -1725,6 +1726,276 @@ def child_traffic() -> None:
         )
 
 
+def _measure_serving_multichip(devs) -> dict:
+    """Multi-chip serving (``--child-multichip``, ISSUE 14), three legs on
+    the CPU mesh proxy (the bench TPU relay has been dead since r3 — these
+    are structure/identity numbers, not chip speed):
+
+    * **tp scaling** — the same mixed greedy/sampled workload through the
+      mesh-free engine and tp ∈ {1, 2, 4} TP-sharded engines: streams must
+      be BIT-identical everywhere (and across two runs of each),
+      ``decode_compilations == 1``, plus the tp=2 EQuARX-comms leg and the
+      analytical per-decode-step all-reduce wire bytes with/without
+      quantized collectives (the EQuARX arithmetic at serving shapes).
+    * **coupled vs disaggregated** — the ISSUE 11 BURSTY tape replayed on
+      the WALL clock through a coupled paged engine and through the
+      prefill/decode-disaggregated server over an identical engine: TPOT
+      p99 under bursts is the decode-isolation headline (a coupled engine
+      admits whole prefill rounds between chunks; the disagg server bounds
+      prefill to one per loop iteration and hands off by page table,
+      ``copy_bytes == 0``).
+    * **determinism** — tape byte-identity across generations and stream
+      identity across runs (wall-clock latencies are measurements, never
+      part of the pin)."""
+    import hashlib
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+    from neuronx_distributed_tpu.parallel.quantized_collectives import (
+        QuantizedAllReduceConfig,
+        comm_bytes,
+    )
+    from neuronx_distributed_tpu.serving import (
+        DisaggregatedServer,
+        ServingEngine,
+        TenantProfile,
+        generate_tape,
+        tape_bytes,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=352,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=256,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+    SLOTS = 3
+
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=int(n)).astype(np.int32)
+        for n in rng.randint(6, 24, size=6)
+    ]
+    gcfgs = [
+        GenerationConfig(max_new_tokens=16, temperature=0.0)
+        if i % 2 == 0
+        else GenerationConfig(max_new_tokens=16, temperature=0.8, top_k=13)
+        for i in range(6)
+    ]
+    keys = [jax.random.PRNGKey(300 + i) for i in range(6)]
+
+    def run_tp(tp, tp_comms=None):
+        mesh_lib.destroy_model_parallel()
+        engine = ServingEngine(
+            model, params, num_slots=SLOTS, decode_chunk_size=4,
+            prefix_cache=None, kv_page_size=16,
+            tp=tp, tp_comms=tp_comms,
+        )
+        reqs = [
+            engine.submit(p, c, key=k)
+            for p, c, k in zip(prompts, gcfgs, keys)
+        ]
+        t0 = time.monotonic()
+        engine.run()
+        wall = time.monotonic() - t0
+        snap = engine.metrics.snapshot()
+        streams = [r.tokens for r in reqs]
+        return streams, {
+            "decode_compilations": engine.decode_compilations,
+            "decode_tok_s": round(snap["decode_tokens"] / max(wall, 1e-9), 1),
+            "wall_s": round(wall, 3),
+        }
+
+    base_streams, base_stats = run_tp(None)
+    deterministic = True
+    tp_rows = {"mesh_free": base_stats}
+    for tp in (1, 2, 4):
+        s1, stats = run_tp(tp)
+        s2, _ = run_tp(tp)
+        bit = s1 == base_streams
+        same = s1 == s2
+        deterministic = deterministic and bit and same
+        tp_rows[f"tp{tp}"] = {
+            **stats,
+            "bit_identical_to_mesh_free": bit,
+            "identical_across_runs": same,
+        }
+    sq, stats_q = run_tp(2, tp_comms=QuantizedAllReduceConfig(enabled=True))
+    agree = sum(
+        1 for a, b in zip(sq, base_streams)
+        if a[: min(len(a), len(b))] == b[: min(len(a), len(b))]
+    ) / len(base_streams)
+    tp_rows["tp2_quantized_comms"] = {
+        **stats_q, "stream_agreement_vs_exact": round(agree, 3),
+    }
+    mesh_lib.destroy_model_parallel()
+
+    # analytical wire bytes of ONE decode step's row-parallel all-reduces
+    # (attention o_proj + MLP down_proj per layer, hidden-sized activations
+    # across the active slots), with and without the EQuARX int8 ring
+    reduces = 2 * cfg.num_layers
+    wire = {}
+    for tp in (2, 4, 8):
+        per = comm_bytes(cfg.hidden_size * SLOTS, tp)
+        wire[f"tp{tp}"] = {
+            "fp_bytes_per_step": per["fp_bytes"] * reduces,
+            "quantized_bytes_per_step": per["quantized_bytes"] * reduces,
+            "ratio": per["ratio"],
+        }
+
+    # --- coupled vs disaggregated under the ISSUE 11 bursty tape ---------
+    tenants = [
+        TenantProfile(
+            "chat", rate_rps=4.0, arrival="bursty", workload="chat",
+            priority="interactive", burst_factor=4.0, burst_period_s=2.0,
+            burst_duty=0.25,
+        ),
+        TenantProfile(
+            "docs", rate_rps=1.0, arrival="bursty", workload="longdoc",
+            priority="batch", burst_factor=3.0, burst_period_s=3.0,
+            burst_duty=0.3,
+        ),
+    ]
+    tape = generate_tape(
+        tenants, duration_s=4.0, seed=7, vocab_size=cfg.vocab_size
+    )
+    raw = tape_bytes(tape)
+    tape_identical = raw == tape_bytes(
+        generate_tape(
+            tenants, duration_s=4.0, seed=7, vocab_size=cfg.vocab_size
+        )
+    )
+    deterministic = deterministic and tape_identical
+
+    def wall_replay(make):
+        target, engine = make()
+        t0 = time.monotonic()
+        i = 0
+        while i < len(tape) or target.has_work:
+            now = time.monotonic() - t0
+            while i < len(tape) and tape[i].t <= now:
+                a = tape[i]
+                i += 1
+                try:
+                    target.submit(
+                        np.asarray(a.prompt, np.int32),
+                        GenerationConfig(
+                            max_new_tokens=a.max_new_tokens,
+                            temperature=a.temperature,
+                        ),
+                        key=jax.random.PRNGKey(a.key_seed),
+                        tenant=a.tenant,
+                    )
+                except Exception:
+                    pass  # backpressure under the burst is signal, not error
+            if target.has_work:
+                target.step()
+            elif i < len(tape):
+                time.sleep(0.001)
+        snap = engine.metrics.snapshot()
+        return {
+            "arrivals": len(tape),
+            "completed": snap["completed"],
+            "ttft_p50_ms": round(snap["ttft_p50_s"] * 1e3, 2),
+            "ttft_p99_ms": round(snap["ttft_p99_s"] * 1e3, 2),
+            "tpot_p50_ms": round(snap["tpot_p50_s"] * 1e3, 3),
+            "tpot_p99_ms": round(snap["tpot_p99_s"] * 1e3, 3),
+            "preemptions": snap["preemptions"],
+        }
+
+    def coupled():
+        e = ServingEngine(
+            model, params, num_slots=SLOTS, decode_chunk_size=4,
+            prefix_cache=None, kv_page_size=16,
+        )
+        return e, e
+
+    def disagg():
+        e = ServingEngine(
+            model, params, num_slots=SLOTS, decode_chunk_size=4,
+            prefix_cache=None, kv_page_size=16,
+        )
+        return DisaggregatedServer(e, n_workers=1), e
+
+    coupled_row = wall_replay(coupled)
+    srv_holder = {}
+
+    def disagg_capture():
+        s, e = disagg()
+        srv_holder["s"], srv_holder["e"] = s, e
+        return s, e
+
+    disagg_row = wall_replay(disagg_capture)
+    disagg_row["handoffs"] = srv_holder["s"].stats["handoffs"]
+    disagg_row["coupled_fallbacks"] = (
+        srv_holder["s"].stats["coupled_fallbacks"]
+    )
+    disagg_row["copy_bytes"] = srv_holder["e"].cache.alloc.copy_bytes
+    improvement = (
+        coupled_row["tpot_p99_ms"] / disagg_row["tpot_p99_ms"]
+        if disagg_row["tpot_p99_ms"] > 0 else None
+    )
+    return {
+        "tp_scaling": tp_rows,
+        "allreduce_wire_bytes_per_decode_step": wire,
+        "bursty_tape": {
+            "arrivals": len(tape),
+            "sha256": hashlib.sha256(raw).hexdigest()[:16],
+            "identical_across_gens": tape_identical,
+        },
+        "coupled": coupled_row,
+        "disaggregated": disagg_row,
+        "coupled_over_disagg_tpot_p99": (
+            round(improvement, 3) if improvement else None
+        ),
+        "deterministic": deterministic,
+    }
+
+
+def child_multichip() -> None:
+    """Multi-chip serving child (``--child-multichip``, ISSUE 14): tp
+    bit-identity/scaling on the CPU mesh proxy, all-reduce wire bytes
+    with/without quantized collectives, and coupled-vs-disaggregated TPOT
+    under the bursty tape. Prints one JSON line; merged into the BENCH
+    artifact as ``extras.serving_multichip``."""
+    os.environ.setdefault("BENCH_FORCE_PLATFORM", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_multichip",
+                "unit": "tp bit-identity + TPOT p99 (CPU mesh proxy)",
+                "platform": devs[0].platform,
+                **_measure_serving_multichip(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_multichip",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
 def child_sweep() -> None:
     """Remat-policy × batch MFU sweep on the real chip (VERDICT r4 next #1b):
     the r2 record (MFU 0.492) ran full per-layer remat; this measures the
@@ -2511,6 +2782,7 @@ def main() -> None:
     quant_result = None
     traffic_result = None
     efficiency_result = None
+    multichip_result = None
 
     import signal
 
@@ -2575,6 +2847,11 @@ def main() -> None:
             efficiency_result
             if efficiency_result is not None
             else {"error": "efficiency child did not finish"}
+        )
+        extras["serving_multichip"] = (
+            multichip_result
+            if multichip_result is not None
+            else {"error": "multichip child did not finish"}
         )
         extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
@@ -2773,6 +3050,16 @@ def main() -> None:
     else:
         efficiency_result = {"error": f"efficiency child: {err}"}
 
+    # 14. Multi-chip serving child (ISSUE 14): tp bit-identity/scaling on
+    #     the CPU mesh proxy, quantized-collective wire bytes, and
+    #     coupled-vs-disaggregated TPOT under the bursty tape.
+    multichip, err = _run_child("--child-multichip", MULTICHIP_TIMEOUT_S)
+    if multichip is not None:
+        multichip.pop("metric", None)
+        multichip_result = multichip
+    else:
+        multichip_result = {"error": f"multichip child: {err}"}
+
     _finalize()
 
 
@@ -2801,6 +3088,8 @@ if __name__ == "__main__":
         child_prefix()
     elif "--child-observe" in sys.argv:
         child_observe()
+    elif "--child-multichip" in sys.argv:
+        child_multichip()
     elif "--child-efficiency" in sys.argv:
         child_efficiency()
     elif "--child" in sys.argv:

@@ -209,6 +209,33 @@ def parse_args(argv=None):
     p.add_argument("--slo-tpot-ms", type=float, default=20.0,
                    help="per-request mean-TPOT bound for interactive "
                         "tenants (batch tenants get 4x)")
+    p.add_argument("--tp", type=int, default=0,
+                   help="shard the engine over a tensor-parallel mesh of "
+                        "this many devices (ISSUE 14; CPU hosts fan out "
+                        "virtual devices automatically — streams stay "
+                        "bit-identical to tp=0/1)")
+    p.add_argument("--tp-comms-quantized", action="store_true",
+                   help="route the TP row-parallel all-reduces through "
+                        "the EQuARX int8 ring (approximate; ~4x fewer "
+                        "wire bytes per decode step)")
+    p.add_argument("--paged-attention", default="auto",
+                   choices=["auto", "gather", "fused"],
+                   help="paged decode transport: 'fused' streams K/V "
+                        "straight from pool pages through the paged "
+                        "flash-decode kernel on TPU (bit-identical gather "
+                        "fallback elsewhere)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="serve through a ReplicaRouter over this many "
+                        "engine replicas (queue-depth + page-pressure "
+                        "balancing, shared-prefix affinity, halt "
+                        "re-homing)")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="split prefill from decode: dedicated prefill "
+                        "workers hand contexts to the decode engine as "
+                        "zero-copy page-table handoffs (paged layout "
+                        "only)")
+    p.add_argument("--prefill-workers", type=int, default=1,
+                   help="prefill workers under --disaggregate")
     p.add_argument("--force-cpu-devices", type=int, default=None)
     return p.parse_args(argv)
 
@@ -292,7 +319,14 @@ def _run_traffic(args, cfg, model, params):
         time_fn=clock,
         sleep_fn=lambda s: None,
     )
-    report = replay(engine, tape, clock, step_dt=0.05)
+    target = engine
+    if args.disaggregate:
+        from neuronx_distributed_tpu.serving import DisaggregatedServer
+
+        target = DisaggregatedServer(
+            engine, n_workers=args.prefill_workers
+        )
+    report = replay(target, tape, clock, step_dt=0.05)
 
     print(f"=== traffic replay: {args.traffic} ({arrival}), "
           f"{len(tape)} arrivals / {len(tenants)} tenants, seed "
@@ -325,12 +359,87 @@ def _run_traffic(args, cfg, model, params):
     return report
 
 
+def _run_router(args, cfg, model, params):
+    """``--replicas N``: N engines behind one router — balanced routing,
+    shared-prefix affinity, and one labeled registry scrape."""
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.observability import MetricsRegistry
+    from neuronx_distributed_tpu.serving import RejectedError, ReplicaRouter
+    from neuronx_distributed_tpu.serving.router import RID_STRIDE
+
+    rng = np.random.RandomState(args.seed)
+    page, quant = _engine_layout(args)
+    registry = MetricsRegistry()
+    router = ReplicaRouter.build(
+        model, params, args.replicas, registry=registry,
+        num_slots=args.slots, admission=args.admission,
+        decode_chunk_size=args.decode_chunk,
+        prefix_cache=None if args.no_prefix_cache else "auto",
+        kv_page_size=page, kv_num_pages=args.kv_pages, quantize=quant,
+        tp=args.tp if args.tp > 1 else None,
+    )
+    shared = (
+        rng.randint(1, cfg.vocab_size, size=args.shared_prefix).astype(
+            np.int32
+        )
+        if args.shared_prefix > 0 else None
+    )
+    reqs = []
+    for i in range(args.requests):
+        plen = int(rng.randint(3, 17))
+        prompt = rng.randint(1, cfg.vocab_size, size=plen).astype(np.int32)
+        if shared is not None:
+            prompt = np.concatenate([shared, prompt])
+        gcfg = GenerationConfig(
+            max_new_tokens=int(rng.randint(4, args.max_new_tokens + 1)),
+            temperature=float(rng.choice([0.0, 0.7])),
+        )
+        try:
+            reqs.append(
+                router.submit(prompt, gcfg, key=jax.random.PRNGKey(100 + i))
+            )
+        except RejectedError as e:
+            print(f"r{i} rejected: {e}")
+        router.step()
+    router.run()
+    snap = router.snapshot()
+    print(f"\n=== {len(reqs)} requests through {args.replicas} replicas "
+          f"x {args.slots} slots (affinity "
+          f"{'on' if not args.no_prefix_cache else 'off'}) ===")
+    for req in reqs:
+        replica = req.rid // RID_STRIDE
+        print(f"r{req.rid % RID_STRIDE:<3d} -> replica{replica} "
+              f"{req.state.value:<9s} new={len(req.tokens):>2d}")
+    r = snap["router"]
+    print(f"\nrouted={r['routed']} by_replica={r['routed_by_replica']} "
+          f"affinity_hits={r['affinity_hits']} "
+          f"spillovers={r['spillovers']} rehomed={r['rehomed_requests']}")
+    print(f"health: {r['health']}")
+    for name, rep in snap["replicas"].items():
+        print(f"  {name}: completed={rep['completed']} "
+              f"prefix_hits={rep.get('prefix_hits', 0)} "
+              f"preemptions={rep['preemptions']}")
+    if args.prometheus:
+        print("\n=== one scrape, all replicas (engine-labeled) ===")
+        print(registry.prometheus_text())
+    return snap
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.force_cpu_devices:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
+        )
+    elif args.tp > 1:
+        # the CPU fan-out dryrun_multichip uses — a TP mesh needs devices
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(args.tp, 8)}"
         )
 
     import jax
@@ -352,6 +461,13 @@ def main(argv=None):
 
     if args.traffic != "none":
         return _run_traffic(args, cfg, model, params)
+    if args.replicas > 1:
+        if args.disaggregate:
+            raise SystemExit(
+                "--replicas and --disaggregate are separate demos — pick "
+                "one (the bench composes them)"
+            )
+        return _run_router(args, cfg, model, params)
 
     draft_model, draft_params = None, None
     if args.draft_layers > 0:
@@ -409,6 +525,15 @@ def main(argv=None):
 
             injector.skew_clock(by=3600.0, after=_time.monotonic() + 0.3)
 
+    tp_comms = None
+    if args.tp_comms_quantized:
+        if args.tp <= 1:
+            raise SystemExit("--tp-comms-quantized needs --tp > 1")
+        from neuronx_distributed_tpu.parallel.quantized_collectives import (
+            QuantizedAllReduceConfig,
+        )
+
+        tp_comms = QuantizedAllReduceConfig(enabled=True)
     shared = (
         rng.randint(1, cfg.vocab_size, size=args.shared_prefix).astype(np.int32)
         if args.shared_prefix > 0 else None
@@ -429,10 +554,20 @@ def main(argv=None):
         kv_page_size=page,
         kv_num_pages=args.kv_pages,
         quantize=quant,
+        tp=args.tp if args.tp > 1 else None,
+        tp_comms=tp_comms,
+        paged_attention=args.paged_attention,
         fault_injector=injector,
         timeline=timeline,
         profile_dir=args.profile,
     )
+    frontend = engine
+    if args.disaggregate:
+        from neuronx_distributed_tpu.serving import DisaggregatedServer
+
+        frontend = DisaggregatedServer(
+            engine, n_workers=args.prefill_workers
+        )
 
     from neuronx_distributed_tpu.serving import RejectedError
 
@@ -453,7 +588,7 @@ def main(argv=None):
             eos_token_id=None,
         )
         try:
-            return engine.submit(
+            return frontend.submit(
                 prompt, gcfg, key=jax.random.PRNGKey(100 + i),
                 deadline_s=args.deadline,
                 queue_timeout_s=args.queue_timeout,
@@ -467,16 +602,16 @@ def main(argv=None):
     upfront = min(args.slots, args.requests)
     reqs = [r for i in range(upfront) if (r := make_request(i)) is not None]
     i = upfront
-    while engine.has_work or i < args.requests:
-        engine.step()
+    while frontend.has_work or i < args.requests:
+        frontend.step()
         if i < args.requests:
             req = make_request(i)
             if req is not None:
                 reqs.append(req)
             i += 1
-        if not engine.has_work and i >= args.requests:
+        if not frontend.has_work and i >= args.requests:
             break
-    engine.run()
+    frontend.run()
 
     prefix_desc = (
         "off" if args.no_prefix_cache
@@ -528,6 +663,14 @@ def main(argv=None):
         snap["halt_reason"] = engine.halt_reason
     if injector is not None:
         snap["injected_faults"] = dict(injector.counters)
+    if args.disaggregate:
+        d = frontend.stats
+        snap["disagg_handoffs"] = d["handoffs"]
+        snap["disagg_prefills"] = d["prefills"]
+        snap["disagg_coupled_fallbacks"] = d["coupled_fallbacks"]
+        snap["disagg_copy_bytes"] = engine.cache.alloc.copy_bytes
+    if args.tp > 1:
+        snap["tp"] = args.tp
     print(f"\n=== engine health: {engine.health().value} ===")
     print("=== metrics snapshot ===")
     for k, v in snap.items():

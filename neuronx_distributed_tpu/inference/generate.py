@@ -82,7 +82,8 @@ def decode_write_mask(done: jax.Array) -> jax.Array:
 
 
 def chunked_decode_step(decode_model, chunk_size: int, max_seq_len: int,
-                        page_size: Optional[int] = None):
+                        page_size: Optional[int] = None,
+                        paged_attention: str = "gather"):
     """Build the fused multi-token decode step shared by the serving engine
     (and any other slot-based consumer): ``chunk_size`` decode steps run as
     ONE jitted ``lax.scan`` — the serving analogue of ``generate``'s
@@ -133,24 +134,50 @@ def chunked_decode_step(decode_model, chunk_size: int, max_seq_len: int,
     the gather dequantizes the logical view and the scatter re-quantizes
     the window pages inside the same program — the row math in between is
     untouched, and the stream contract becomes the engine's pinned
-    logit-divergence budget instead of bit-identity."""
+    logit-divergence budget instead of bit-identity.
+
+    ``paged_attention`` (ISSUE 14) picks the paged transport's ATTENTION
+    read path: ``"gather"`` (default) attends the materialized logical
+    view; ``"fused"`` routes every decode-attention call through
+    ``kernels/flash_decode.paged_flash_decode_attention`` — the block
+    table rides the kernel's scalar prefetch and K/V stream straight from
+    the physical pool pages on TPU, while the kernel's gather fallback
+    keeps every other backend bit-identical to ``"gather"``. Fused mode
+    does not speak quantized pools (the in-kernel page stream is float)."""
     from neuronx_distributed_tpu.inference.utils import unwrap_logits
     from neuronx_distributed_tpu.modules.attention import (
         cache_cursor,
+        fused_paged_attention_scope,
         gather_cache_pages,
+        ordered_kv_pool_pairs,
         scatter_cache_window,
     )
     from neuronx_distributed_tpu.utils.sampling import sample_per_row
 
     if chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    if paged_attention not in ("gather", "fused"):
+        raise ValueError(
+            f"unknown paged_attention mode {paged_attention!r} "
+            "(expected 'gather' or 'fused')"
+        )
 
     def chunk_fn(params, cache, state):
         if page_size is not None:
             paged = cache
             start = cache_cursor(paged)
-            out = _row_chunk(params, gather_cache_pages(paged, page_size),
-                             state)
+            logical = gather_cache_pages(paged, page_size)
+            if paged_attention == "fused":
+                pools = ordered_kv_pool_pairs(paged["pool"])
+                n_log = paged["pages"].shape[1]
+                n_win = min((chunk_size - 1) // page_size + 2, n_log)
+                with fused_paged_attention_scope(
+                    pools, paged["pages"], page_size,
+                    start // page_size, n_win,
+                ):
+                    out = _row_chunk(params, logical, state)
+            else:
+                out = _row_chunk(params, logical, state)
             return (
                 scatter_cache_window(
                     paged, out[0], page_size, start, chunk_size

@@ -594,6 +594,118 @@ def scatter_cache_window(paged, logical, page_size: int, start_col,
     }
 
 
+# --- fused paged decode attention (ISSUE 14) ----------------------------------
+#
+# The serving chunk's paged transport gathers the whole logical K/V view
+# before the model ever attends — on TPU that is an HBM round-trip of the
+# full mapped cache per chunk that ``kernels/flash_decode.
+# paged_flash_decode_attention`` (PR 12) exists to eliminate: the block
+# table rides scalar prefetch and the kernel streams each slot's PHYSICAL
+# pool pages directly. The trace-scope below is how the serving chunk routes
+# attention through that kernel without touching the flax modules: while a
+# scope is active, every ``decode_attention`` call consumes the next
+# attention layer's (k, v) pool pair — layers call in execution order, the
+# scope holds the pools in the same order — scatters the chunk's write
+# window (the in-chunk columns the pool has not seen yet; pre-window columns
+# rewrite their own bytes, so shared CoW pages stay bit-stable) and attends
+# straight off the pool. On TPU that is the fused kernel; elsewhere
+# ``paged_flash_decode_attention`` falls back to gather + this very
+# function, making the fused mode BIT-identical to the gather transport
+# (pinned in tests/serving/test_multichip.py).
+
+_FUSED_PAGED_STACK: list = []
+
+
+class fused_paged_attention_scope:
+    """Trace-scope carrying the paged pool into the decode attention calls
+    traced inside it. ``pools`` is a list of per-attention-layer
+    ``(k_pool, v_pool)`` leaves in model execution order; ``page0``/
+    ``n_win`` bound the chunk's write window (the columns the pool does not
+    hold yet)."""
+
+    def __init__(self, pools, tables, page_size: int, page0, n_win: int):
+        self.frame = {
+            "pools": pools, "tables": tables, "page_size": page_size,
+            "page0": page0, "n_win": n_win, "idx": 0, "busy": False,
+        }
+
+    def __enter__(self):
+        _FUSED_PAGED_STACK.append(self.frame)
+        return self.frame
+
+    def __exit__(self, *exc):
+        _FUSED_PAGED_STACK.pop()
+
+
+def ordered_kv_pool_pairs(pool):
+    """Per-attention-layer ``(k, v)`` pool leaf pairs in MODEL EXECUTION
+    order — natural sort of the tree paths, so ``layers_10`` follows
+    ``layers_9`` (lexicographic flatten order would interleave them and
+    hand layer 2 another layer's pages). The one ordering assumption of
+    the fused transport: sequential-layer models name their layers with
+    their execution index, which every family in this repo does."""
+    import re
+
+    from neuronx_distributed_tpu.utils.tree import path_keys
+
+    def natural(keys):
+        return tuple(
+            tuple(
+                int(part) if part.isdigit() else part
+                for part in re.split(r"(\d+)", str(k))
+                if part != ""
+            )
+            for k in keys
+        )
+
+    nodes = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(pool)[0]:
+        keys = tuple(path_keys(path))
+        if keys[-1] in _PAGED_LEAVES:
+            nodes.setdefault(keys[:-1], {})[keys[-1]] = leaf
+        elif pool_scale_base(keys[-1]) is not None:
+            raise ValueError(
+                "fused paged attention does not speak quantized pools "
+                "(the in-kernel page stream is float) — use the gather "
+                "transport with kv_quant"
+            )
+    return [
+        (nodes[parent]["k"], nodes[parent]["v"])
+        for parent in sorted(nodes, key=natural)
+    ]
+
+
+def _fused_paged_decode(frame, q, k_cache, v_cache, q_pos, kv_valid):
+    from neuronx_distributed_tpu.kernels.flash_decode import (
+        paged_flash_decode_attention,
+        paged_scatter_window_leaf,
+    )
+
+    pools = frame["pools"]
+    i = frame["idx"] % len(pools)
+    frame["idx"] += 1
+    k_pool, v_pool = pools[i]
+    ps, bt = frame["page_size"], frame["tables"]
+    # bring the pool current through THIS step: scatter the chunk window
+    # from the logical view (which the model just wrote) — columns before
+    # the window rewrite their own bytes, so the scatter is idempotent on
+    # shared pages and the pool equals the logical view wherever kv_valid
+    # holds
+    k_pool = paged_scatter_window_leaf(
+        k_pool, k_cache, bt, frame["page0"], frame["n_win"], ps
+    )
+    v_pool = paged_scatter_window_leaf(
+        v_pool, v_cache, bt, frame["page0"], frame["n_win"], ps
+    )
+    frame["busy"] = True  # the off-TPU fallback re-enters decode_attention
+    try:
+        return paged_flash_decode_attention(
+            q, k_pool, v_pool, bt, q_pos, kv_valid=kv_valid, page_size=ps
+        )
+    finally:
+        frame["busy"] = False
+
+
 def cache_fingerprint(cache):
     """Cheap integrity fingerprint of a cache(-prefix) tree: a float32
     reduction over every leaf, position-weighted along the column axis so a
@@ -638,7 +750,19 @@ def decode_attention(q, k_cache, v_cache, q_pos, mask=None, kv_valid=None):
     Long caches on TPU route to the Pallas flash-decode kernel
     (kernels/flash_decode.py — the reference's flash-decoding KV groups,
     parallel_state.py:1368); Medusa tree steps keep the einsum (their
-    ``mask`` replaces the positional mask the kernel implements)."""
+    ``mask`` replaces the positional mask the kernel implements).
+
+    Inside a :class:`fused_paged_attention_scope` (the serving chunk's
+    ``paged_attention="fused"`` transport, ISSUE 14) the call attends the
+    PAGED POOL directly through ``paged_flash_decode_attention`` instead of
+    the materialized view passed in — bit-identical off TPU (the kernel's
+    fallback is gather + this function), fused on it."""
+    if _FUSED_PAGED_STACK and mask is None:
+        frame = _FUSED_PAGED_STACK[-1]
+        if not frame["busy"]:
+            return _fused_paged_decode(
+                frame, q, k_cache, v_cache, q_pos, kv_valid
+            )
     if (
         mask is None
         and k_cache.shape[1] >= FLASH_DECODE_MIN_CONTEXT

@@ -201,3 +201,83 @@ def comm_bytes(n_elems: int, n_ranks: int, block_size: int = 256,
         "quantized_bytes": int(q),
         "ratio": round(fp / max(q, 1), 3),
     }
+
+
+# --- TP serving comms routing (ISSUE 14) --------------------------------------
+#
+# The GSPMD serving forward has no explicit psum to reroute — XLA inserts the
+# row-parallel reduction from the layers' sharding constraints. The opt-in
+# below gives the TP-sharded serving engine an explicit reduction to own:
+# while a ``tp_comms`` trace-scope is active, every RowParallelLinear routes
+# its output reduction through :func:`tp_dot_allreduce` — a manual-SPMD
+# region computing the local partial product and reducing it with the
+# EQuARX ring above — instead of the implicit GSPMD psum. The scope is
+# TRACE-time state: the engine wraps its jitted programs so only its own
+# traces see the config, and two engines in one process (one quantized, one
+# exact) never contaminate each other.
+
+_TP_COMMS_STACK: list = []
+
+
+class tp_comms:
+    """Trace-scope installing a :class:`QuantizedAllReduceConfig` for the
+    row-parallel layers traced inside it (``None``/disabled = exact)."""
+
+    def __init__(self, config: Optional[QuantizedAllReduceConfig]):
+        self.config = config
+
+    def __enter__(self):
+        _TP_COMMS_STACK.append(self.config)
+        return self.config
+
+    def __exit__(self, *exc):
+        _TP_COMMS_STACK.pop()
+
+
+def current_tp_comms() -> Optional[QuantizedAllReduceConfig]:
+    return _TP_COMMS_STACK[-1] if _TP_COMMS_STACK else None
+
+
+def tp_comms_applicable(axis) -> bool:
+    """Whether the active mesh can route a row-parallel reduction through
+    the explicit manual region: an initialized mesh with > 1 rank on
+    ``axis`` and EVERY other axis trivial (the serving tp mesh) — the
+    manual region claims all axes, so a live dp/pp/cp extent would need
+    sharded operands this entry point does not speak."""
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    if not mesh_lib.model_parallel_is_initialized():
+        return False
+    mesh = mesh_lib.get_mesh()
+    if int(mesh.shape[axis]) <= 1:
+        return False
+    return all(
+        int(size) == 1 for name, size in mesh.shape.items() if name != axis
+    )
+
+
+def tp_dot_allreduce(x: jax.Array, kernel: jax.Array,
+                     config: QuantizedAllReduceConfig, axis) -> jax.Array:
+    """Row-parallel linear with an EXPLICIT (optionally quantized) ring
+    all-reduce: ``x`` tp-sharded on its last dim, ``kernel`` tp-sharded on
+    its input dim; each rank computes its partial product and the ring
+    merges them — int8 wire traffic when ``config.enabled``, the exact
+    ``psum`` otherwise (bit-for-bit the GSPMD reduction)."""
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    lead = x.ndim - 1
+    x_spec = P(*([None] * lead), axis)
+    k_spec = P(axis, None)
+    out_spec = P(*([None] * lead), None)
+
+    def body(xv, kv):
+        part = lax.dot_general(
+            xv, kv, (((xv.ndim - 1,), (0,)), ((), ())), precision=None
+        )
+        return all_reduce(part, axis, config)
+
+    return mesh_lib.manual_shard_map(
+        body, in_specs=(x_spec, k_spec), out_specs=out_spec
+    )(x, kernel)

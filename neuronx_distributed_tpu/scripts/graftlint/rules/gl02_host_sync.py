@@ -99,6 +99,16 @@ HOT_SUFFIXES = (
     # hot loops; both modules must stay pure traced jnp
     "quantization/layers.py",
     "parallel/quantized_collectives.py",
+    # multi-chip serving (ISSUE 14): the router's balancing/affinity path
+    # wraps every submission and the disaggregation server's handoff loop
+    # wraps every decode chunk — both must stay pure host arithmetic (an
+    # implicit coercion of a queued request's device key or a staged
+    # context's pool leaf would sync per routed request); the partitioner
+    # runs at placement time next to live device trees, where a stray
+    # host read would stall engine construction and weight swaps
+    "serving/router.py",
+    "serving/disagg.py",
+    "parallel/sharding.py",
 )
 HOT_MARKER = "graftlint: hot-path"
 

@@ -41,6 +41,17 @@ Layers:
 * :mod:`faults` — deterministic, schedule-driven fault injection
   (``FaultInjector``) for the engine's chaos hooks: dispatch failures,
   poisoned readbacks, prefill faults, clock skew.
+* :mod:`router` — :class:`ReplicaRouter` (ISSUE 14): N engine replicas
+  behind one ``submit()`` — queue-depth + projected-page-pressure
+  balancing, shared-prefix affinity with an overcommit guard,
+  drain-around DEGRADED/HALTED, and bit-identical re-homing of a halted
+  replica's requeued work to survivors (``tokens_lost == 0``).
+* :mod:`disagg` — :class:`DisaggregatedServer`/:class:`PrefillWorker`
+  (ISSUE 14): dedicated prefill workers hand finished contexts to the
+  paged decode engine as zero-copy PAGE-TABLE handoffs
+  (``PageAllocator.copy_bytes`` stays 0 on the shared-pool path; an
+  explicit export/import device transfer covers distinct pools), so
+  bursty prefill load cannot inflate steady-state decode TPOT.
 * :mod:`traffic` — the deterministic open-loop load harness (ISSUE 11):
   seeded multi-tenant workload generation (Poisson + bursty/diurnal
   arrivals, chat vs long-doc length mixes) materialized as a
@@ -79,19 +90,27 @@ from neuronx_distributed_tpu.serving.engine import (
     RejectedError,
     ServingEngine,
 )
+from neuronx_distributed_tpu.serving.disagg import (
+    DisaggregatedServer,
+    PrefillWorker,
+)
 from neuronx_distributed_tpu.serving.faults import (
     FaultInjector,
     InjectedDispatchError,
     InjectedDraftError,
     InjectedFault,
+    InjectedHandoffError,
     InjectedPrefillError,
 )
 from neuronx_distributed_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_tpu.serving.paging import (
+    ExportedContext,
     PageAllocator,
     PagedCacheManager,
     PageExhausted,
+    StagedContext,
 )
+from neuronx_distributed_tpu.serving.router import RID_STRIDE, ReplicaRouter
 from neuronx_distributed_tpu.serving.scheduler import (
     Request,
     RequestState,
@@ -109,25 +128,32 @@ from neuronx_distributed_tpu.serving.traffic import (
 
 __all__ = [
     "Arrival",
+    "DisaggregatedServer",
     "EngineHealth",
+    "ExportedContext",
     "FaultInjector",
     "InjectedDispatchError",
     "InjectedDraftError",
     "InjectedFault",
+    "InjectedHandoffError",
     "InjectedPrefillError",
     "PageAllocator",
     "PageExhausted",
     "PagedCacheManager",
+    "PrefillWorker",
     "PrefixCache",
     "PrefixEntry",
     "QuantConfig",
+    "RID_STRIDE",
     "RejectedError",
+    "ReplicaRouter",
     "Request",
     "RequestState",
     "Scheduler",
     "ServingEngine",
     "ServingMetrics",
     "SlotCacheManager",
+    "StagedContext",
     "TenantProfile",
     "VirtualClock",
     "build_report",

@@ -96,6 +96,10 @@ class SlotCacheManager:
         self._admit_fn = jax.jit(per_instance(_admit_row), donate_argnums=(0,))
         self._free_fn = jax.jit(per_instance(reset_cache_slot), donate_argnums=(0,))
         self._reset_fn = jax.jit(per_instance(reset_cache), donate_argnums=(0,))
+        # TP serving (ISSUE 14): optional placement hook applied once at
+        # allocation — the engine installs the partitioner's kv-head-axis
+        # placement so the donated programs inherit a committed layout
+        self.placement = None
 
     def register_programs(self, programs, prefix: str = "") -> None:
         """Wrap the manager's jitted programs in a
@@ -168,6 +172,8 @@ class SlotCacheManager:
             return jnp.zeros(tuple(shape), r_leaf.dtype)
 
         self.cache = jax.tree_util.tree_map_with_path(fn, row_cache)
+        if self.placement is not None:
+            self.cache = self.placement(self.cache)
 
     def admit(self, row_cache, slot: int, padded_len: int,
               cursor: Optional[int] = None) -> None:

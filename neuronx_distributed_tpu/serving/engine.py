@@ -178,6 +178,19 @@ with everything enabled):
   families. ``serving/traffic.py`` replays seeded multi-tenant load
   through the engine on a virtual clock for reproducible SLO reports.
 
+Multi-chip serving (ISSUE 14): ``tp=``/``mesh=`` shards this whole engine
+over a TP mesh — params by their ``nn.Partitioned`` axis rules (the T5X
+partitioner pattern, ``parallel/sharding.py``), KV storage on the kv-head
+axis, slot state replicated — with every guarantee above intact: one
+decode program, the same host-sync budgets (the chunk readback is
+replicated scalars/tokens, never sharded KV), and streams bit-identical
+to the mesh-free engine. ``tp_comms=`` optionally routes the row-parallel
+all-reduces through the EQuARX int8 ring; ``paged_attention="fused"``
+streams paged attention straight off the pool pages on TPU. N engines
+scale out behind ``serving/router.py``'s ReplicaRouter, and
+``serving/disagg.py`` splits prefill from decode with zero-copy
+page-table handoffs (``admit_staged``).
+
 Cache capacity: all slots share one write cursor (see
 ``serving/cache_manager.py``), which advances every decode step while ANY
 slot is active. The fused chunk clamps itself against ``max_seq_len`` on
@@ -411,6 +424,27 @@ def _slot_clear(state, slot):
     return dict(state, active=state["active"].at[slot].set(False))
 
 
+class _TraceScope:
+    """Forwarding wrapper entering a context manager around every call of
+    a jitted program — the program's (lazy) TRACE then happens inside the
+    scope, which is how the engine's ``tp_comms`` config reaches the
+    row-parallel layers without global state leaking between engines.
+    Attribute reads (``_cache_size``, ``lower``, ``last_call_compiled``)
+    forward to the wrapped callable so every compile-count property and
+    ledger proxy keeps working unchanged."""
+
+    def __init__(self, fn, make_ctx):
+        self._fn = fn
+        self._make_ctx = make_ctx
+
+    def __call__(self, *args, **kwargs):
+        with self._make_ctx():
+            return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
 class ServingEngine:
     """Slot-based continuous batching over a mode-capable causal LM."""
 
@@ -429,6 +463,11 @@ class ServingEngine:
         kv_page_size: Optional[int] = None,
         kv_num_pages: Optional[int] = None,
         quantize=None,
+        tp: Optional[int] = None,
+        mesh=None,
+        tp_comms=None,
+        paged_attention: str = "auto",
+        rid_base: int = 0,
         prefix_cache="auto",
         dispatch_retry: Optional[RetryPolicy] = None,
         degraded_cooldown_chunks: int = 8,
@@ -506,6 +545,69 @@ class ServingEngine:
                 "ServingEngine needs model.config.max_seq_len (the fixed "
                 "slot cache length)"
             )
+        # multi-chip serving (ISSUE 14): tp= shards the WHOLE hot path over
+        # the TP mesh — params by their nn.Partitioned axis rules (the T5X
+        # partitioner pattern: rules own the sharding, the engine's program
+        # code never changes), the KV pool/rows on the kv-head axis, slot
+        # state and block tables replicated. Every jitted program below then
+        # partitions off the placed operands plus the layers' activation
+        # constraints: decode_compilations stays 1, the chunk readback stays
+        # ONE device_get of replicated scalars/tokens (never sharded KV),
+        # and streams are bit-identical to the mesh-free engine at any tp
+        # on the CPU mesh proxy. tp_comms= (QuantizedAllReduceConfig)
+        # optionally routes the row-parallel all-reduces through the EQuARX
+        # int8 ring — a wire-byte dial behind an explicit accuracy opt-in.
+        from neuronx_distributed_tpu.parallel.sharding import (
+            ServingPartitioner,
+            serving_mesh,
+        )
+
+        if mesh is not None and tp is None:
+            tp = int(mesh.mesh.shape["tp"]) if hasattr(mesh, "mesh") else None
+        self.tp = tp
+        self._partitioner = None
+        if tp is not None:
+            state = mesh if mesh is not None else serving_mesh(tp)
+            self._partitioner = ServingPartitioner(state)
+        self._tp_comms = tp_comms
+        if tp_comms is not None and self._partitioner is None:
+            raise ValueError(
+                "tp_comms= needs a TP mesh (pass tp=/mesh=) — there is no "
+                "all-reduce to route on a mesh-free engine"
+            )
+        # fused paged attention (ISSUE 14, the PR 12 leftover): "fused"
+        # streams K/V straight from the physical pool pages through
+        # paged_flash_decode_attention's scalar-prefetch block table on
+        # TPU; its gather fallback keeps every other backend bit-identical
+        # to the "gather" transport. "auto" = fused exactly where the
+        # kernel is real (TPU, plain chunk, float pool), gather elsewhere.
+        if paged_attention not in ("auto", "gather", "fused"):
+            raise ValueError(
+                f"unknown paged_attention {paged_attention!r} "
+                "(expected 'auto', 'gather' or 'fused')"
+            )
+        _fusable = (
+            kv_page_size is not None
+            and draft_model is None
+            and (quantize is None or quantize.kv is None)
+            and not getattr(
+                getattr(model, "config", None), "scan_layers", False
+            )
+        )
+        if paged_attention == "auto":
+            paged_attention = (
+                "fused"
+                if _fusable and jax.devices()[0].platform == "tpu"
+                else "gather"
+            )
+        elif paged_attention == "fused" and not _fusable:
+            raise ValueError(
+                "paged_attention='fused' needs a paged (kv_page_size=), "
+                "non-speculative engine with a float KV pool and "
+                "scan_layers=False (the fused transport pairs per-layer "
+                "pool leaves with attention calls by layer name)"
+            )
+        self.paged_attention = paged_attention
         # speculative decoding (ISSUE 9): a draft model turns every decode
         # chunk into `decode_chunk_size` fused draft–verify ROUNDS, each
         # emitting 1..gamma tokens per slot. draft_model=None is a strict
@@ -596,6 +698,10 @@ class ServingEngine:
             if kv_num_pages is not None:
                 raise ValueError("kv_num_pages needs kv_page_size")
             self.cache = SlotCacheManager(num_slots)
+        if self._partitioner is not None:
+            # KV storage commits to the mesh at allocation (kv-head axis
+            # over tp where it divides); every donated successor keeps it
+            self.cache.placement = self._partitioner.place_kv
         # draft-side twins: mode clones, a SECOND donated cache collection
         # (admit/free/recover/quarantine mirrored 1:1 with the target's),
         # and per-bucket draft prefill programs. The draft cache cursor
@@ -615,6 +721,8 @@ class ServingEngine:
                 if kv_page_size is not None
                 else SlotCacheManager(num_slots)
             )
+            if self._partitioner is not None:
+                self.draft_cache.placement = self._partitioner.place_kv
         else:
             self._draft_params_src = None
             self._draft_params = None
@@ -663,9 +771,17 @@ class ServingEngine:
         self._active = np.zeros((num_slots,), bool)
         self._slot_req: List[Optional[Request]] = [None] * num_slots
         self._on_token: Dict[int, Callable[[Request, int], None]] = {}
-        self._next_rid = 0
+        # rid_base namespaces request ids across engines (the replica
+        # router re-homes live Request objects between replicas — two
+        # engines must never mint the same rid)
+        self._next_rid = int(rid_base)
         self._prefill_fns: Dict[int, Callable] = {}
         self._state = self._fresh_slot_state()
+        # disaggregated serving (ISSUE 14): True routes ALL prefill work to
+        # external workers — step() keeps decoding but never self-admits;
+        # the DisaggregatedServer pulls from the queue, prefills on its
+        # workers, and hands contexts back through admit_staged()
+        self.external_prefill = False
         # fault-tolerance state machine
         self._halted = False
         self._halt_reason: Optional[str] = None
@@ -699,7 +815,7 @@ class ServingEngine:
             # _cache_size()/lower, so the compile-count properties below
             # read through unchanged
             self._spec_chunk = self.programs.wrap(
-                "spec_decode_chunk", self._spec_chunk
+                "spec_decode_chunk", self._comms_scoped(self._spec_chunk)
             )
             self._decode_chunk = None
         else:
@@ -708,11 +824,15 @@ class ServingEngine:
                 chunked_decode_step(
                     self._decode_model, decode_chunk_size, max_seq_len,
                     page_size=kv_page_size,
+                    paged_attention=(
+                        self.paged_attention
+                        if kv_page_size is not None else "gather"
+                    ),
                 ),
                 donate_argnums=(1, 2),
             )
             self._decode_chunk = self.programs.wrap(
-                "decode_chunk", self._decode_chunk
+                "decode_chunk", self._comms_scoped(self._decode_chunk)
             )
         # per_instance: module-level helpers share a pjit cache across
         # engines in this jax (PR 4's lambda-wrapper note) — a fresh
@@ -731,7 +851,8 @@ class ServingEngine:
         # donates — a stored entry must stay a live COPY (the decode chunk's
         # donation regime must never be able to consume prefix storage)
         self._suffix_fn = self.programs.wrap(
-            "suffix_prefill", jax.jit(suffix_prefill_step(self._decode_model))
+            "suffix_prefill",
+            self._comms_scoped(jax.jit(suffix_prefill_step(self._decode_model))),
         )
         # per-engine lambda wrappers: in this jax (0.4.37), _cache_size()
         # is SHARED between jax.jit wrappers of the same function object
@@ -842,7 +963,7 @@ class ServingEngine:
 
     def _fresh_slot_state(self):
         b = self.num_slots
-        return {
+        state = {
             "tok": jnp.zeros((b,), jnp.int32),
             "keys": jnp.zeros((b, 2), jnp.uint32),
             "active": jnp.zeros((b,), jnp.bool_),
@@ -852,8 +973,25 @@ class ServingEngine:
             "remaining": jnp.zeros((b,), jnp.int32),
             "eos": jnp.full((b,), -1, jnp.int32),
         }
+        if self._partitioner is not None:
+            # committed-replicated over the mesh so every donated
+            # successor keeps the layout (and no uncommitted-operand
+            # recompile can ever hide here — the PR 5 zeros lesson)
+            state = self._partitioner.replicate(state)
+        return state
 
     # --- paged-KV helpers ---------------------------------------------------
+
+    def _comms_scoped(self, fn):
+        """Wrap a model-forward jit so its trace runs under the engine's
+        ``tp_comms`` scope (no-op without one)."""
+        if self._tp_comms is None:
+            return fn
+        from neuronx_distributed_tpu.parallel.quantized_collectives import (
+            tp_comms,
+        )
+
+        return _TraceScope(fn, lambda: tp_comms(self._tp_comms))
 
     def _on_prefix_evict(self, entry) -> None:
         """PrefixCache eviction hook: a PAGED entry leaving the store (LRU
@@ -1019,6 +1157,11 @@ class ServingEngine:
             if not is_quantized_tree(value):
                 value = quantize_param_tree(value, qcfg)
         self._params_src = value
+        if getattr(self, "_partitioner", None) is not None:
+            # TP placement happens HERE, once per assignment — the axis
+            # rules (nn.Partitioned metadata) own the layout, every jitted
+            # program below just follows the committed operands
+            value = self._partitioner.shard_params(value)
         self._params = dict(value)
         # a weight swap invalidates every stored prefix: its KV was computed
         # under the OLD weights, and the cache-off path would recompute it —
@@ -1045,6 +1188,8 @@ class ServingEngine:
         if value is None:
             raise ValueError("draft_params cannot be unset on a live engine")
         self._draft_params_src = value
+        if getattr(self, "_partitioner", None) is not None:
+            value = self._partitioner.shard_params(value)
         self._draft_params = dict(value)
 
     def _now(self) -> float:
@@ -1225,6 +1370,80 @@ class ServingEngine:
             # here or it leaks for the engine's lifetime
             self._on_token.pop(rid, None)
         return ok
+
+    # --- router / disaggregation surface (ISSUE 14) -------------------------
+
+    def page_pressure(self) -> float:
+        """Projected page demand of all admitted + queued work relative to
+        pool capacity (0.0 on row engines). This is the router's
+        overcommit signal: queue depth alone says nothing about how much
+        POOL a replica's backlog will claim, so shared-prefix affinity
+        steering a long-context burst at one replica would overcommit its
+        pages while its queue still looked short. Worst-case accounting
+        (per-request aligned spans, sharing ignored) — a value >= 1.0
+        means the backlog cannot coexist and the replica will be churning
+        the preemption wall."""
+        if self._page_size is None:
+            return 0.0
+        cap = max(self.cache.alloc.capacity, 1)
+        span = 0
+        live = [r for r in self._slot_req if r is not None]
+        live += [r for r in self.scheduler.queued_requests]
+        for r in live:
+            cols = (
+                len(r.prompt) + len(r.tokens) + r.remaining_new_tokens
+                + self._round_cols - 1
+            )
+            # +1: the page-alignment gap a paged admission may pay
+            span += self.cache.page_span(0, min(cols, self.max_seq_len)) + 1
+        return span / cap
+
+    def load_score(self) -> float:
+        """The router's balancing signal: work in the building (active
+        slots + queued requests) plus the page-pressure term scaled to
+        slot units, so a replica whose pool is nearly committed reads as
+        loaded even with a short queue."""
+        return (
+            float(int(self._active.sum()) + self.scheduler.queued)
+            + self.page_pressure() * self.num_slots
+        )
+
+    def adopt(self, req: Request, on_token=None) -> Request:
+        """Take over a live ``Request`` minted by ANOTHER engine (the
+        replica router's re-homing path — a HALTED replica's requeued work
+        moves to survivors). The request keeps its rid (engines under one
+        router mint from disjoint ``rid_base`` ranges), its streamed
+        tokens, and its host-current key, so the continuation here is
+        bit-identical to the stream the dead replica would have produced:
+        admission re-prefills ``context_ids`` and resumes at ``req.key``
+        — the same contract as preemption-resume."""
+        health = self.health()
+        if health in (EngineHealth.DRAINING, EngineHealth.HALTED):
+            raise RejectedError(
+                f"engine is {health.value}; cannot adopt re-homed work",
+                queue_depth=self.scheduler.queued,
+            )
+        if req.rid in self.scheduler.requests:
+            raise ValueError(
+                f"rid {req.rid} already known to this engine — replicas "
+                "under one router must mint from disjoint rid_base ranges"
+            )
+        req.slot = None
+        self.scheduler.submit(req)
+        self.metrics.record_adopt(req, self._now())
+        if on_token is not None:
+            self._on_token[req.rid] = on_token
+        self.tracer.begin(
+            req.rid,
+            args={
+                "prompt_len": int(len(req.prompt)),
+                "tenant": req.tenant,
+                "priority": req.priority,
+                "rehomed": True,
+                "tokens_streamed": len(req.tokens),
+            },
+        )
+        return req
 
     # --- health / drain -----------------------------------------------------
 
@@ -1509,6 +1728,8 @@ class ServingEngine:
         )
 
     def _admit(self, now: float) -> None:
+        if self.external_prefill:
+            return  # the disaggregation server owns admission
         if self.cache.free_slots == 0 or self.scheduler.queued == 0:
             return
         proj = self.cache.cursor
@@ -1649,7 +1870,9 @@ class ServingEngine:
                 )
                 return unwrap_logits(out)[0, -1], variables["cache"]
 
-            fn = self.programs.wrap(f"prefill[{padded_len}]", fn)
+            fn = self.programs.wrap(
+                f"prefill[{padded_len}]", self._comms_scoped(fn)
+            )
             self._prefill_fns[padded_len] = fn
         return fn
 
@@ -1665,7 +1888,9 @@ class ServingEngine:
                 )
                 return variables["cache"]
 
-            fn = self.programs.wrap(f"draft_prefill[{padded_len}]", fn)
+            fn = self.programs.wrap(
+                f"draft_prefill[{padded_len}]", self._comms_scoped(fn)
+            )
             self._draft_prefill_fns[padded_len] = fn
         return fn
 
@@ -1852,6 +2077,14 @@ class ServingEngine:
                     m_shared // self._page_size
                 )
             self._remember_prefix_paged(ctx, p, slot, matched=m_shared)
+        self._bind_slot(req, slot, logits, now)
+
+    def _bind_slot(self, req: Request, slot: int, logits, now: float) -> None:
+        """The admission tail shared by coupled prefill and the
+        disaggregated page-table handoff: record the admit, sample the
+        first token off ``logits`` (fresh requests only — one explicit
+        device_get of the token+key pair), and activate the slot's
+        device-resident state."""
         self.metrics.record_admit(req, now)
         if req.admit_time is None:
             req.admit_time = now
@@ -1903,6 +2136,83 @@ class ServingEngine:
         # a request can be born finished (max_new_tokens == 1, or EOS as
         # its very first token) — retire before it ever decodes
         self._maybe_finish(req, now)
+
+    def admit_staged(self, req: Request, staged, logits,
+                     now: Optional[float] = None) -> bool:
+        """Disaggregated handoff (ISSUE 14): bind an EXTERNALLY-prefilled
+        context to a slot as a PAGE-TABLE operation. ``staged`` is a
+        :class:`~neuronx_distributed_tpu.serving.paging.StagedContext`
+        whose pages already live in THIS engine's pool (the prefill worker
+        staged them there — shared-pool handoff moves zero KV bytes,
+        ``PageAllocator.copy_bytes`` untouched; a distinct-pool worker
+        routes through export/import first). ``logits`` is the prefill's
+        last-token logits row (fresh requests sample their first token
+        here, exactly like coupled admission — streams stay bit-identical).
+
+        Returns False — staged context intact, request untouched — when
+        the slot/cursor/page accounting cannot place it RIGHT NOW (no free
+        slot, conservative-cursor overflow, page-span overflow); the
+        caller retries at a later chunk boundary."""
+        if self._page_size is None:
+            raise ValueError(
+                "admit_staged needs a paged engine (kv_page_size=) — the "
+                "handoff is a block-table operation"
+            )
+        if self._halted:
+            return False
+        if not self.cache.staged_live(staged):
+            # a pool recovery or page quarantine between prefill and
+            # handoff voided the staged pages — fail loudly so the caller
+            # re-prefills (returning False would retry a dead context
+            # forever)
+            raise ValueError(
+                "staged context is no longer live (pool recovery or page "
+                "quarantine voided it) — re-prefill"
+            )
+        if self.cache.free_slots == 0:
+            return False
+        now = self._now() if now is None else now
+        p = staged.p
+        rem = req.remaining_new_tokens
+        maxrem = max(
+            (r.remaining_new_tokens for r in self._slot_req if r is not None),
+            default=0,
+        )
+        target = self.cache.aligned_target(max(self.cache.cursor, p), p)
+        end = target + max(maxrem, rem) + self._round_cols - 1
+        if end > self.max_seq_len:
+            return False
+        # conservative page projection, exactly the coupled fits() math:
+        # every in-flight context's span plus the staged one through the
+        # projected final cursor must fit the pool
+        t_end = min(self.max_seq_len, end)
+        spans = self.cache.page_span(target - p, t_end) + sum(
+            self.cache.page_span(s, t_end)
+            for s in self.cache.active_spans()
+        )
+        if spans > self.cache.alloc.capacity:
+            return False
+        slot = self.cache.acquire()
+        try:
+            self.cache.map_staged(slot, staged, cursor=target)
+        except Exception:
+            # nothing mapped — the slot must rejoin the rotation, or each
+            # failed handoff would permanently shrink capacity
+            self.cache.free(slot)
+            raise
+        self.tracer.step(
+            req.rid, "admission",
+            args={"slot": slot, "handoff": "page_table", "pages": len(
+                staged.page_ids
+            )},
+        )
+        if self.timeline is not None:
+            self.timeline.instant(
+                f"handoff r{req.rid}", "serving",
+                args={"slot": slot, "pages": len(staged.page_ids)},
+            )
+        self._bind_slot(req, slot, logits, now)
+        return True
 
     # --- prefix reuse -------------------------------------------------------
 
@@ -2131,12 +2441,12 @@ class ServingEngine:
             self._decode_chunk = jax.jit(
                 chunked_decode_step(
                     self._decode_model, self.decode_chunk_size,
-                    self.max_seq_len,
+                    self.max_seq_len, page_size=self._page_size,
                 ),
                 donate_argnums=(1, 2),
             )
             self._decode_chunk = self.programs.wrap(
-                "decode_chunk", self._decode_chunk
+                "decode_chunk", self._comms_scoped(self._decode_chunk)
             )
         return self._decode_chunk
 

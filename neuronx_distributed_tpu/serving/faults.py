@@ -74,6 +74,13 @@ class InjectedPrefillError(InjectedFault):
     """Scheduled prefill failure (OOM-like admission fault)."""
 
 
+class InjectedHandoffError(InjectedFault):
+    """Scheduled DISAGGREGATED handoff failure (ISSUE 14): the page-table
+    transfer between a prefill worker and the decode engine fails — the
+    disaggregation server must fall back to coupled prefill on the decode
+    engine, streams bit-identical, zero tokens lost."""
+
+
 class FaultInjector:
     """Schedule-driven fault source consulted by ``ServingEngine`` hooks."""
 
@@ -85,6 +92,7 @@ class FaultInjector:
         self._prefix_windows: List[Tuple[int, Optional[int]]] = []
         self._draft_dispatch_windows: List[Tuple[int, Optional[int]]] = []
         self._draft_poison_windows: List[Tuple[int, Optional[int]]] = []
+        self._handoff_windows: List[Tuple[int, Optional[int]]] = []
         self._page_poisons: Dict[int, List[int]] = {}  # readback -> [slot]
         self._skew: float = 0.0
         self._skew_after: Optional[float] = None
@@ -96,6 +104,7 @@ class FaultInjector:
             "draft_dispatch_failures": 0,
             "poisoned_drafts": 0,
             "poisoned_pages": 0,
+            "handoff_failures": 0,
         }
 
     # --- schedule construction ----------------------------------------------
@@ -156,6 +165,27 @@ class FaultInjector:
         for i in range(times):
             self._page_poisons.setdefault(at + i, []).append(slot)
         return self
+
+    def fail_handoff(self, at: int = 0,
+                     times: Optional[int] = 1) -> "FaultInjector":
+        """The ``at``-th..(at+times-1)-th disaggregated HANDOFF attempts
+        raise :class:`InjectedHandoffError` before the page-table transfer
+        binds a slot (nothing half-mapped — the staged context survives
+        for the server to release). The server must fall back to coupled
+        prefill on the decode engine for the affected request; streams
+        stay bit-identical and ``tokens_lost == 0``."""
+        end = None if times is None else at + times
+        self._handoff_windows.append((at, end))
+        return self
+
+    def on_handoff(self, attempt: int) -> None:
+        """Called by the disaggregation server with the 0-based handoff
+        attempt index before ``admit_staged`` runs."""
+        if self._hit(self._handoff_windows, attempt):
+            self.counters["handoff_failures"] += 1
+            raise InjectedHandoffError(
+                f"injected handoff failure at attempt {attempt}"
+            )
 
     def skew_clock(self, by: float, after: Optional[float] = None) -> "FaultInjector":
         self._skew = by

@@ -288,6 +288,31 @@ class ServingMetrics:
             # first finish — idle-tail truncation would inflate it
             self.slo.touch(now)
 
+    def record_adopt(self, req, now: float) -> None:
+        """Register a RE-HOMED request (the replica router moved it here
+        from a halted replica): per-request bookkeeping only, keyed to the
+        ORIGINAL submit time so TTFT/latency spans the request's whole
+        life — the submit itself was already counted where it happened."""
+        tenant = _tenant_of(req)
+        self._requests[req.rid] = {
+            "rid": req.rid,
+            "prompt_len": int(len(req.prompt)),
+            "submit_time": (
+                req.submit_time if req.submit_time is not None else now
+            ),
+            "tenant": tenant,
+            "priority": getattr(req, "priority", "standard"),
+        }
+        if req.first_token_time is not None:
+            # the dead replica already streamed its first token — keep the
+            # TTFT it measured out of this engine's histograms (it was
+            # observed there) but make decode-span math exact here
+            self._requests[req.rid]["first_token_time"] = (
+                req.first_token_time
+            )
+        if self.slo is not None:
+            self.slo.touch(now)
+
     def record_admit(self, req, now: float) -> None:
         r = self._requests[req.rid]
         # first admission sets the queue wait; re-admissions after preemption

@@ -43,6 +43,7 @@ live managers — the serving test suite calls it after every test teardown.
 
 from __future__ import annotations
 
+import dataclasses
 import weakref
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -76,6 +77,37 @@ def check_all_live() -> int:
         mgr.check()
         n += 1
     return n
+
+
+@dataclasses.dataclass
+class StagedContext:
+    """A prefilled context parked in the page pool with NO slot bound — the
+    disaggregated prefill→decode handoff unit (ISSUE 14). ``page_ids`` hold
+    the context's K/V page-aligned from the first page's column 0 (so a
+    later ``map_staged`` can bind them at any page-aligned cursor — K/V
+    content is position-relative, exactly why CoW prefix pages are
+    remappable); the staging itself holds one pool reference per page
+    until the handoff transfers it to a slot's block table (zero KV bytes
+    move — ``PageAllocator.copy_bytes`` untouched) or ``release_staged``
+    drops it."""
+
+    page_ids: Tuple[int, ...]
+    p: int        # real context tokens staged
+    padded: int   # the prefill bucket the row was computed at
+
+
+@dataclasses.dataclass
+class ExportedContext:
+    """Device-transfer form of a staged context for DISTINCT prefill and
+    decode pools (different hosts/meshes): raw page blocks per pool k/v
+    leaf (quantized pools export their scale sibling blocks too). Import
+    is a REAL copy — ``PageAllocator.copy_bytes`` charges it, which is
+    precisely how the shared-pool path proves it moved nothing."""
+
+    items: list   # [(tree keys tuple, (..., n, page_size_or_1, ...) block)]
+    n_pages: int
+    p: int
+    padded: int
 
 
 class PageExhausted(RuntimeError):
@@ -236,6 +268,9 @@ class PagedCacheManager:
         # engine-installed pressure valve: evict one unpinned prefix entry,
         # return whether anything was reclaimed
         self.reclaim: Optional[Callable[[], bool]] = None
+        # TP serving (ISSUE 14): placement hook applied once at pool
+        # allocation (kv-head-axis sharding over the engine's mesh)
+        self.placement = None
         self.prefix_pages_shared_total = 0
         ps, n_log = page_size, self.pages_per_row
 
@@ -333,6 +368,101 @@ class PagedCacheManager:
                 _rebuild_tree(items), m, start, max_seq_len
             )
 
+        def _stage_context(paged, row, shift, ids):
+            """Disaggregated handoff, write half (ISSUE 14): scatter a
+            prefill row's context pages into the pool at host-chosen ids —
+            rolled so the context's first token sits at the first page's
+            column 0 (position-relative K/V makes the block mappable at
+            any aligned cursor later). kv_valid/index are untouched: slot
+            binding is ``_map_slot_context``'s, at handoff time."""
+            from neuronx_distributed_tpu.kernels.flash_decode import (
+                paged_write_pages_leaf,
+                quantize_page_block,
+            )
+
+            n_st = ids.shape[0]
+            pool_in = paged["pool"]
+
+            def fn(path, pool_leaf):
+                name = cache_leaf_name(path)
+                base = pool_scale_base(name) or name
+                if base not in ("k", "v"):
+                    return pool_leaf
+                row_leaf = cache_node_at(row, path[:-1])[base]
+                r_ax = row_leaf.ndim - 4
+                col = r_ax + 1
+                rolled = jnp.roll(row_leaf, shift, axis=col)
+                lead = row_leaf.shape[:r_ax]
+                tail = row_leaf.shape[col + 1:]
+                pg = rolled.reshape(lead + (1, n_log, ps) + tail)
+                win = jax.lax.dynamic_slice_in_dim(
+                    pg, 0, n_st, axis=r_ax + 1
+                )
+                pages = win.reshape(lead + (n_st, ps) + tail)
+                if pool_scale_sibling(pool_in, path, base) is not None:
+                    q, s = quantize_page_block(pages)
+                    pages = q if base == name else s
+                return paged_write_pages_leaf(pool_leaf, pages, ids)
+
+            return {
+                "pages": paged["pages"],
+                "pool": jax.tree_util.tree_map_with_path(fn, pool_in),
+            }
+
+        def _map_slot_context(paged, slot, start, p, cursor):
+            """Disaggregated handoff, bind half: the METADATA-only program
+            — set the slot's kv_valid over its context columns and the
+            shared cursor. No K/V byte moves; the block-table row (host
+            side) is what carries the pages."""
+            def fn(path, leaf):
+                name = cache_leaf_name(path)
+                base = pool_scale_base(name) or name
+                if base in ("k", "v"):
+                    return leaf
+                ax = cache_batch_axis(name, leaf.ndim)
+                if name == "kv_valid":
+                    length = leaf.shape[-1]
+                    cols = jnp.arange(length, dtype=jnp.int32)
+                    rowv = (cols >= start) & (cols < start + p)
+                    rowv = jnp.broadcast_to(
+                        rowv, leaf.shape[:ax] + (1, length)
+                    )
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        leaf, rowv, slot, axis=ax
+                    )
+                return jnp.full_like(leaf, cursor)
+
+            return {
+                "pages": paged["pages"],
+                "pool": jax.tree_util.tree_map_with_path(
+                    fn, paged["pool"]
+                ),
+            }
+
+        def _import_blocks(paged, blocks, ids):
+            """Distinct-pool handoff fallback: write exported page blocks
+            (k/v and any scale siblings, already in pool storage form)
+            into this pool at ``ids`` — the explicit device transfer the
+            shared-pool path never pays."""
+            from neuronx_distributed_tpu.kernels.flash_decode import (
+                paged_write_pages_leaf,
+            )
+
+            def fn(path, pool_leaf):
+                name = cache_leaf_name(path)
+                base = pool_scale_base(name) or name
+                if base not in ("k", "v"):
+                    return pool_leaf
+                block = cache_node_at(blocks, path[:-1])[name]
+                return paged_write_pages_leaf(pool_leaf, block, ids)
+
+            return {
+                "pages": paged["pages"],
+                "pool": jax.tree_util.tree_map_with_path(
+                    fn, paged["pool"]
+                ),
+            }
+
         # _paged_admit/_seed_from_pages are per-manager closures already;
         # the module-level reset helpers need per_instance for the same
         # pjit-cache-per-function-object reason as SlotCacheManager
@@ -340,6 +470,12 @@ class PagedCacheManager:
         self._seed_fn = jax.jit(_seed_from_pages)
         self._free_fn = jax.jit(per_instance(reset_cache_slot), donate_argnums=(0,))
         self._reset_fn = jax.jit(per_instance(reset_cache), donate_argnums=(0,))
+        self._stage_fn = jax.jit(_stage_context, donate_argnums=(0,))
+        self._map_fn = jax.jit(_map_slot_context, donate_argnums=(0,))
+        self._import_fn = jax.jit(_import_blocks, donate_argnums=(0,))
+        # page -> outstanding staged-context holds (disaggregated handoff);
+        # counted into the leak invariant like pins
+        self._staged: Dict[int, int] = {}
         _LIVE_MANAGERS.add(self)
 
     def register_programs(self, programs, prefix: str = "") -> None:
@@ -351,6 +487,11 @@ class PagedCacheManager:
         self._seed_fn = programs.wrap(f"{prefix}paged_seed", self._seed_fn)
         self._free_fn = programs.wrap(f"{prefix}paged_free", self._free_fn)
         self._reset_fn = programs.wrap(f"{prefix}paged_reset", self._reset_fn)
+        self._stage_fn = programs.wrap(f"{prefix}paged_stage", self._stage_fn)
+        self._map_fn = programs.wrap(f"{prefix}paged_map", self._map_fn)
+        self._import_fn = programs.wrap(
+            f"{prefix}paged_import", self._import_fn
+        )
 
     # --- HBM accounting ----------------------------------------------------
 
@@ -536,9 +677,13 @@ class PagedCacheManager:
 
     def _upload_tables(self) -> None:
         if self.cache is not None:
-            self.cache = dict(
-                self.cache, pages=jnp.asarray(self._tables)
-            )
+            pages = jnp.asarray(self._tables)
+            if self.placement is not None:
+                # keep the uploaded table committed-replicated like the
+                # allocation-time one — a layout flip between chunks would
+                # recompile the decode program (decode_compilations pin)
+                pages = self.placement({"pages": pages})["pages"]
+            self.cache = dict(self.cache, pages=pages)
 
     def allocate_from(self, row_cache) -> None:
         """Build the page pool + block table from a batch-1 prefill row's
@@ -590,6 +735,42 @@ class PagedCacheManager:
             "pages": jnp.asarray(self._tables),
             "pool": _rebuild_tree(items),
         }
+        if self.placement is not None:
+            self.cache = self.placement(self.cache)
+
+    def allocate_like(self, other: "PagedCacheManager") -> None:
+        """Build this pool from ANOTHER manager's allocated pool structure
+        (own ``num_pages``/``num_slots`` geometry) — the distinct-pool
+        disaggregation path's decode-side bootstrap, where the decode
+        engine may never have run a prefill of its own."""
+        if other.cache is None:
+            raise RuntimeError("source manager has no allocated pool")
+        if self.cache is not None:
+            return
+
+        def fn(path, leaf):
+            name = cache_leaf_name(path)
+            base = pool_scale_base(name) or name
+            if base in ("k", "v"):
+                pax = leaf.ndim - 4
+                shape = list(leaf.shape)
+                shape[pax] = self.alloc.num_pages
+                return jnp.zeros(tuple(shape), leaf.dtype)
+            if name == "kv_valid":
+                ax = cache_batch_axis(name, leaf.ndim)
+                shape = list(leaf.shape)
+                shape[ax] = self.num_slots
+                return jnp.zeros(tuple(shape), jnp.bool_)
+            return jnp.zeros(leaf.shape, leaf.dtype)
+
+        self.cache = {
+            "pages": jnp.asarray(self._tables),
+            "pool": jax.tree_util.tree_map_with_path(
+                fn, other.cache["pool"]
+            ),
+        }
+        if self.placement is not None:
+            self.cache = self.placement(self.cache)
 
     def admit(self, row_cache, slot: int, padded_len: int,
               cursor: Optional[int] = None, p: Optional[int] = None,
@@ -680,6 +861,162 @@ class PagedCacheManager:
             jnp.asarray(start, jnp.int32),
         )
 
+    # --- disaggregated prefill/decode handoff (ISSUE 14) --------------------
+
+    def stage_context(self, row_cache, p: int, padded: int) -> StagedContext:
+        """Park a prefill row's context in the pool with no slot bound:
+        allocate ``ceil(p / page_size)`` pages, scatter the row's context
+        K/V into them page-aligned from column 0, and hold one reference
+        per page until a handoff maps them (``map_staged``) or the caller
+        releases them. This is the prefill worker's half of the
+        disaggregated handoff — the decode side then binds the pages by
+        block-table mapping alone."""
+        if self.cache is None:
+            if self.cursor > 0:
+                raise RuntimeError(
+                    "cache collection missing mid-flight (cursor "
+                    f"{self.cursor}): a take() was never paired with "
+                    "update_after_decode/restore"
+                )
+            self.allocate_from(row_cache)
+        if p < 1 or p > padded:
+            raise ValueError(f"bad staged context length p={p} (padded "
+                             f"{padded})")
+        n = -(-p // self.page_size)
+        ids = self._alloc_pages(n)
+        for pid in ids:
+            self._staged[pid] = self._staged.get(pid, 0) + 1
+        self.cache = self._stage_fn(
+            self.cache, row_cache,
+            jnp.asarray(p - padded, jnp.int32),  # context start -> column 0
+            jnp.asarray(np.asarray(ids, np.int32)),
+        )
+        return StagedContext(tuple(int(i) for i in ids), p, padded)
+
+    def staged_live(self, staged: StagedContext) -> bool:
+        """Whether a staged context's pages are all still held and
+        un-quarantined — a salvaged-recovery or page-poison event between
+        stage and handoff voids it (the caller re-prefills)."""
+        return bool(staged.page_ids) and all(
+            self._staged.get(int(pid), 0) > 0
+            and int(pid) not in self.alloc._quarantined
+            for pid in staged.page_ids
+        )
+
+    def map_staged(self, slot: int, staged: StagedContext,
+                   cursor: int) -> None:
+        """Bind a staged context to ``slot`` at ``cursor`` as a PAGE-TABLE
+        operation: the staging holds transfer to the slot's block-table
+        mappings (no refcount motion, no K/V byte moves —
+        ``PageAllocator.copy_bytes`` provably untouched) and one small
+        jitted program sets the slot's kv_valid/cursor metadata. The
+        context start ``cursor - p`` must be page-aligned."""
+        if not self.staged_live(staged):
+            raise ValueError(
+                "staged context is no longer live (pool recovery or page "
+                "quarantine voided it) — re-prefill"
+            )
+        p = staged.p
+        start = cursor - p
+        if start < 0 or start % self.page_size != 0:
+            raise ValueError(
+                f"handoff cursor {cursor} puts the context start at "
+                f"{start} — not page-aligned (page_size {self.page_size})"
+            )
+        if (self._tables[slot] != 0).any():
+            raise ValueError(f"slot {slot} still maps pages (not freed?)")
+        s0 = start // self.page_size
+        for j, pid in enumerate(staged.page_ids):
+            pid = int(pid)
+            # ref TRANSFER: the staging hold becomes the table mapping
+            holds = self._staged.get(pid, 0)
+            if holds <= 1:
+                self._staged.pop(pid, None)
+            else:
+                self._staged[pid] = holds - 1
+            self._tables[slot, s0 + j] = pid
+        self._slot_start[slot] = start
+        self.cache = self._map_fn(
+            self.cache,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(start, jnp.int32),
+            jnp.asarray(p, jnp.int32),
+            jnp.asarray(cursor, jnp.int32),
+        )
+        self.cursor = cursor
+        self._upload_tables()
+        staged.page_ids = ()
+
+    def release_staged(self, staged: StagedContext) -> None:
+        """Drop an unconsumed staged context (handoff abandoned): the
+        staging holds release and unshared pages flow back to the free
+        list. VOID-safe: pages whose staged hold is already gone (pool
+        recovery cleared ``_staged`` and dropped every hold) are skipped —
+        a deref there would raise inside the caller's fallback path, or
+        worse steal a reference from a page since re-allocated to another
+        request."""
+        for pid in staged.page_ids:
+            pid = int(pid)
+            holds = self._staged.get(pid, 0)
+            if holds <= 0:
+                continue  # voided by recovery: nothing left to release
+            if holds == 1:
+                self._staged.pop(pid, None)
+            else:
+                self._staged[pid] = holds - 1
+            self.alloc.deref(pid)
+        staged.page_ids = ()
+
+    def export_pages(self, staged: StagedContext) -> ExportedContext:
+        """Read a staged context's raw page blocks out of the pool (k/v
+        and any quantized scale siblings, in pool storage form) for a
+        DISTINCT decode pool to import — the device-transfer fallback when
+        prefill and decode do not share a pool."""
+        if not self.staged_live(staged):
+            raise ValueError("staged context is no longer live")
+        from neuronx_distributed_tpu.utils.tree import path_keys
+
+        ids = jnp.asarray(np.asarray(staged.page_ids, np.int32))
+        items = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            self.cache["pool"]
+        )[0]:
+            keys = tuple(path_keys(path))
+            base = pool_scale_base(keys[-1]) or keys[-1]
+            if base in ("k", "v"):
+                pax = leaf.ndim - 4
+                items.append((keys, jnp.take(leaf, ids, axis=pax)))
+        return ExportedContext(
+            items=items, n_pages=len(staged.page_ids),
+            p=staged.p, padded=staged.padded,
+        )
+
+    def import_pages(self, exported: ExportedContext) -> StagedContext:
+        """Write exported page blocks into THIS pool as a fresh staged
+        context — a REAL device transfer, charged to
+        ``PageAllocator.copy_bytes`` (the accounting that proves the
+        shared-pool handoff moved nothing)."""
+        if self.cache is None:
+            raise RuntimeError(
+                "import_pages needs an allocated pool — serve one "
+                "admission first (or share the prefill worker's pool)"
+            )
+        from neuronx_distributed_tpu.modules.attention import _rebuild_tree
+
+        ids = self._alloc_pages(exported.n_pages)
+        for pid in ids:
+            self._staged[pid] = self._staged.get(pid, 0) + 1
+        blocks = _rebuild_tree(exported.items)
+        self.cache = self._import_fn(
+            self.cache, blocks, jnp.asarray(np.asarray(ids, np.int32))
+        )
+        self.alloc.copy_bytes += sum(
+            int(block.nbytes) for _, block in exported.items
+        )
+        return StagedContext(
+            tuple(int(i) for i in ids), exported.p, exported.padded
+        )
+
     def ensure_decode_window(self, active_slots, width: int) -> bool:
         """Map real pages under every active slot's next write window
         (columns ``[cursor, cursor + width)``) before a chunk dispatch.
@@ -753,6 +1090,13 @@ class PagedCacheManager:
         )
         self.cursor = 0
         self._release_all_mappings()
+        # staged handoff contexts are VOID either way: their holder (the
+        # disaggregation server) observes staged_live() False and
+        # re-prefills — recovery must not leave holds that block the pool
+        for pid, holds in list(self._staged.items()):
+            for _ in range(holds):
+                self.alloc.deref(pid)
+        self._staged.clear()
         if consumed:
             self.cache = None
             return False
@@ -803,11 +1147,15 @@ class PagedCacheManager:
             for pid in row:
                 mapped[pid] = mapped.get(pid, 0) + 1
         for pid in range(1, a.num_pages):
-            expect = mapped.get(pid, 0) + self._pins.get(pid, 0)
+            expect = (
+                mapped.get(pid, 0) + self._pins.get(pid, 0)
+                + self._staged.get(pid, 0)
+            )
             have = a.refcount(pid)
             assert have == expect, (
                 f"page {pid}: refcount {have} != mapped({mapped.get(pid, 0)})"
                 f" + pinned({self._pins.get(pid, 0)})"
+                f" + staged({self._staged.get(pid, 0)})"
             )
             states = [
                 pid in free,

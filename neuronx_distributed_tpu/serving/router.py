@@ -1,0 +1,304 @@
+"""Replica router: N data-parallel serving engines behind one ``submit()``.
+
+One TP-sharded engine is a single failure/capacity domain; production
+traffic wants N of them (ISSUE 14, ROADMAP "Multi-chip serving"). The
+router is deliberately a HOST-side policy layer — it owns no device state
+and never touches a hot path:
+
+* **Balancing** — each submission routes to the healthiest, least-loaded
+  replica. The load signal is ``ServingEngine.load_score()``: active slots
+  + queue depth + the replica's PROJECTED PAGE FOOTPRINT scaled to slot
+  units. The page term is the overcommit fix (satellite of ISSUE 14):
+  queue depth alone cannot see that a backlog of long shared-prefix
+  contexts will claim a paged replica's whole pool, so affinity steering
+  could pile work onto a replica that then lives at the page-pressure
+  preemption wall. ``page_pressure() >= overcommit`` disqualifies a
+  replica from affinity steering (and deprioritizes it for balancing)
+  before that happens.
+* **Prefix affinity** — a session whose prompt prefix is already resident
+  in some replica's ``PrefixCache`` (CoW pool pages under paging) steers
+  to that replica: the hit turns a full prefill into a suffix prefill and
+  shares pages instead of duplicating them. Affinity never overrides
+  health or the overcommit guard.
+* **Drain-around** — DEGRADED replicas stop receiving new work while any
+  OK replica exists; DRAINING/HALTED replicas receive nothing. A replica
+  that HALTS mid-decode has, by the engine's halt contract, requeued every
+  in-flight request with host-current tokens and keys — the router
+  RE-HOMES that work to survivors (``ServingEngine.adopt``): each request
+  keeps its rid (replicas mint from disjoint ``rid_base`` ranges), its
+  streamed tokens, and its key, so the survivor's continuation is
+  bit-identical and ``tokens_lost == 0`` (chaos-pinned in
+  tests/serving/test_router.py).
+* **One scrape** — replicas built by :meth:`ReplicaRouter.build` share one
+  ``MetricsRegistry`` as engine-labeled metric families (the ISSUE 11
+  machinery), so tenant/SLO attribution and the program/HBM ledgers of all
+  replicas aggregate into a single Prometheus exposition with zero
+  merging.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from neuronx_distributed_tpu.inference.generate import GenerationConfig
+from neuronx_distributed_tpu.serving.engine import (
+    EngineHealth,
+    RejectedError,
+    ServingEngine,
+)
+from neuronx_distributed_tpu.serving.scheduler import Request
+
+# replicas mint rids from disjoint ranges so re-homed Request objects can
+# never collide on a survivor (requests keep their rid across re-homing)
+RID_STRIDE = 1_000_000_000
+
+
+class ReplicaRouter:
+    """Host-side request router over N ``ServingEngine`` replicas."""
+
+    def __init__(self, replicas: List[ServingEngine],
+                 affinity: bool = True,
+                 affinity_overcommit: float = 0.85):
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        bases = [e._next_rid for e in replicas]
+        if len(set(bases)) != len(bases):
+            raise ValueError(
+                "replicas must mint request ids from disjoint rid_base "
+                "ranges (ServingEngine(rid_base=i * router.RID_STRIDE)) — "
+                "re-homed requests keep their rid on the survivor"
+            )
+        self.replicas = list(replicas)
+        self.affinity = affinity
+        self.affinity_overcommit = float(affinity_overcommit)
+        self._dead: set = set()  # replica indices already drained/re-homed
+        self.stats: Dict[str, int] = {
+            "routed": 0,
+            "affinity_hits": 0,
+            "rehomed_requests": 0,
+            "replicas_drained": 0,
+            "spillovers": 0,
+        }
+        self.routed_by_replica = [0] * len(replicas)
+
+    # --- construction -------------------------------------------------------
+
+    @classmethod
+    def build(cls, model, params, n_replicas: int, registry=None,
+              engine_label: str = "replica", **engine_kwargs
+              ) -> "ReplicaRouter":
+        """Build N identically-configured replicas sharing ``params`` (one
+        host copy — placement may still differ per mesh) and, when a
+        ``registry`` is given, one labeled metrics registry
+        (``{engine_label}{i}`` children) so all replicas scrape as one
+        endpoint."""
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        replicas = []
+        for i in range(n_replicas):
+            kwargs = dict(engine_kwargs)
+            if registry is not None:
+                kwargs.setdefault("registry", registry)
+                kwargs.setdefault("engine_label", f"{engine_label}{i}")
+            replicas.append(
+                ServingEngine(
+                    model, params, rid_base=i * RID_STRIDE, **kwargs
+                )
+            )
+        return cls(replicas)
+
+    # --- routing ------------------------------------------------------------
+
+    def _live(self) -> List[int]:
+        return [i for i in range(len(self.replicas)) if i not in self._dead]
+
+    def _accepting(self) -> List[int]:
+        """Replica indices that may receive NEW work: OK first; DEGRADED
+        only when no OK replica exists (drain-around); DRAINING/HALTED
+        never."""
+        ok, degraded = [], []
+        for i in self._live():
+            h = self.replicas[i].health()
+            if h is EngineHealth.OK:
+                ok.append(i)
+            elif h is EngineHealth.DEGRADED:
+                degraded.append(i)
+        return ok if ok else degraded
+
+    def _pick(self, prompt: np.ndarray) -> List[int]:
+        """Replica indices to try, best first."""
+        candidates = self._accepting()
+        if not candidates:
+            raise RejectedError(
+                "no replica is accepting work (all draining/halted)",
+                queue_depth=sum(
+                    self.replicas[i].scheduler.queued for i in self._live()
+                ),
+            )
+        order = sorted(
+            candidates, key=lambda i: self.replicas[i].load_score()
+        )
+        if self.affinity:
+            best_i, best_m = None, 0
+            for i in candidates:
+                e = self.replicas[i]
+                if e.prefix is None:
+                    continue
+                if e.page_pressure() >= self.affinity_overcommit:
+                    # the overcommit guard: a page-saturated replica's
+                    # resident prefix is not worth living at its
+                    # preemption wall — balance instead
+                    continue
+                m = e.prefix.match_len(prompt)
+                if m > best_m:
+                    best_i, best_m = i, m
+            if best_i is not None:
+                self.stats["affinity_hits"] += 1
+                order = [best_i] + [i for i in order if i != best_i]
+        return order
+
+    def submit(
+        self,
+        prompt_ids,
+        config: GenerationConfig = GenerationConfig(),
+        key=None,
+        on_token: Optional[Callable[[Request, int], None]] = None,
+        deadline_s: Optional[float] = None,
+        queue_timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+    ) -> Request:
+        """Route one request to the best replica (affinity → health →
+        load), spilling to the next-best on a bounded-queue rejection;
+        raises :class:`RejectedError` only when EVERY accepting replica
+        refused."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        last_reject: Optional[RejectedError] = None
+        for rank, i in enumerate(self._pick(prompt)):
+            try:
+                req = self.replicas[i].submit(
+                    prompt, config, key=key, on_token=on_token,
+                    deadline_s=deadline_s, queue_timeout_s=queue_timeout_s,
+                    tenant=tenant, priority=priority,
+                )
+            except RejectedError as e:
+                last_reject = e
+                if rank == 0:
+                    self.stats["spillovers"] += 1
+                continue
+            self.stats["routed"] += 1
+            self.routed_by_replica[i] += 1
+            return req
+        assert last_reject is not None
+        raise last_reject
+
+    # --- stepping / fault handling ------------------------------------------
+
+    def _rehome(self, dead_idx: int) -> int:
+        """Move a HALTED replica's queued work (requeued in-flight victims
+        included — the engine's halt contract put them back with
+        host-current tokens/keys) to survivors. Returns how many requests
+        moved; unfinished work with no accepting survivor stays queued on
+        the dead replica for operator handoff."""
+        dead = self.replicas[dead_idx]
+        moved = 0
+        for req in list(dead.scheduler.queued_requests):
+            targets = self._accepting()
+            targets = [t for t in targets if t != dead_idx]
+            if not targets:
+                break
+            target = min(
+                targets, key=lambda i: self.replicas[i].load_score()
+            )
+            cb = dead._on_token.pop(req.rid, None)
+            self.replicas[target].adopt(req, on_token=cb)
+            moved += 1
+        self._dead.add(dead_idx)
+        self.stats["replicas_drained"] += 1
+        self.stats["rehomed_requests"] += moved
+        return moved
+
+    def step(self) -> bool:
+        """One router iteration: re-home any newly-halted replica's work,
+        then step every live replica that has work. Returns whether work
+        remains anywhere."""
+        for i in self._live():
+            if self.replicas[i].health() is EngineHealth.HALTED:
+                self._rehome(i)
+        for i in self._live():
+            e = self.replicas[i]
+            if e.has_work:
+                e.step()
+        return self.has_work
+
+    def run(self, max_steps: int = 1_000_000) -> Dict[int, Request]:
+        """Step until no replica has work (or ``max_steps``); returns every
+        request any replica has seen, by rid."""
+        steps = 0
+        while self.has_work and steps < max_steps:
+            self.step()
+            steps += 1
+        return self.requests
+
+    # --- introspection ------------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        for i in self._live():
+            e = self.replicas[i]
+            if e.has_work:
+                return True
+            # a halted replica makes no progress itself, but its requeued
+            # work is the ROUTER's to move — run() must keep stepping
+            # until the re-home happens
+            if (
+                e.health() is EngineHealth.HALTED
+                and e.scheduler.queued > 0
+            ):
+                return True
+        return False
+
+    @property
+    def requests(self) -> Dict[int, Request]:
+        out: Dict[int, Request] = {}
+        for e in self.replicas:
+            out.update(e.scheduler.requests)
+        return out
+
+    def health(self) -> Dict[str, str]:
+        """Per-replica health map plus the aggregate: ``ok`` while any
+        replica accepts work, ``degraded`` when only DEGRADED replicas do,
+        ``halted`` when none does."""
+        per = {
+            f"replica{i}": e.health().value
+            for i, e in enumerate(self.replicas)
+        }
+        accepting = self._accepting() if self._live() else []
+        if not accepting:
+            agg = "halted"
+        elif all(
+            self.replicas[i].health() is EngineHealth.DEGRADED
+            for i in accepting
+        ):
+            agg = "degraded"
+        else:
+            agg = "ok"
+        return {"aggregate": agg, **per}
+
+    def snapshot(self) -> dict:
+        """Router bookkeeping + per-replica metrics snapshots (replicas
+        built over one labeled registry ALSO aggregate into a single
+        Prometheus scrape — this is the JSON view of the same data)."""
+        return {
+            "router": {
+                **self.stats,
+                "routed_by_replica": list(self.routed_by_replica),
+                "health": self.health(),
+            },
+            "replicas": {
+                f"replica{i}": e.metrics.snapshot()
+                for i, e in enumerate(self.replicas)
+            },
+        }

@@ -321,6 +321,43 @@ def test_gl02_programs_and_hbm_modules_are_hot(tmp_path):
     assert report.violations == []
 
 
+def test_gl02_router_disagg_sharding_modules_are_hot(tmp_path):
+    """ISSUE 14 satellite: the replica router wraps every submission, the
+    disaggregation server's handoff loop wraps every decode chunk, and the
+    serving partitioner places live device trees — all three are hot BY
+    PATH, so an implicit coercion smuggled into any of them trips GL02
+    with no marker needed."""
+    fixture = """\
+        import jax.numpy as jnp
+
+        def load_score(engine, pressure):
+            return float(jnp.sum(pressure)) + engine.queued
+        """
+    for name in (
+        "serving/router.py", "serving/disagg.py", "parallel/sharding.py"
+    ):
+        assert "GL02" in rules_of(lint(tmp_path, fixture, name=name)), name
+    # an undocumented explicit device_get in the handoff loop trips too
+    # (a handoff is a METADATA operation — reading staged KV back to host
+    # would sync the very chunk boundary disaggregation protects)
+    v = lint(tmp_path, """\
+        import jax
+
+        def handoff(engine, staged, logits):
+            return engine.admit_staged(staged, jax.device_get(logits))
+        """, name="serving/disagg.py")
+    assert any("device_get" in x.message for x in v if x.rule == "GL02")
+    # ...and the shipped modules scan clean
+    targets = [
+        os.path.join(PKG, "serving", "router.py"),
+        os.path.join(PKG, "serving", "disagg.py"),
+        os.path.join(PKG, "parallel", "sharding.py"),
+    ]
+    assert all(os.path.exists(t) for t in targets)
+    report = runner.scan(targets, root=REPO_ROOT)
+    assert report.violations == []
+
+
 # --- GL03 recompile-hazard ----------------------------------------------------
 
 

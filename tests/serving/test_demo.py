@@ -4,6 +4,8 @@ the CPU mesh and report sane metrics."""
 import importlib.util
 import os
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -80,3 +82,53 @@ def test_serving_demo_traffic_mode_runs():
     s = report["slo"]
     assert s["attained"] + s["violated"] == report["replay"]["submitted"]
     assert report["replay"]["truncated"] is False
+
+
+@pytest.mark.slow
+def test_serving_demo_tp_mode_runs():
+    """--tp 2 (ISSUE 14): the TP-sharded engine serves the same workload
+    on the CPU mesh proxy with ONE decode program; mesh state is torn
+    down afterwards so later demo invocations stay mesh-free."""
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+
+    try:
+        snap = _load_demo().main(
+            ["--requests", "4", "--slots", "2", "--max-new-tokens", "6",
+             "--tp", "2"]
+        )
+    finally:
+        mesh_lib.destroy_model_parallel()
+    assert snap["completed"] == 4
+    assert snap["decode_compilations"] == 1
+    assert snap["tp"] == 2
+
+
+@pytest.mark.slow
+def test_serving_demo_replicas_mode_runs():
+    """--replicas 2 --shared-prefix (ISSUE 14): the router demo — every
+    request completes, affinity steers the shared-prefix sessions."""
+    snap = _load_demo().main(
+        ["--requests", "5", "--slots", "2", "--replicas", "2",
+         "--shared-prefix", "12", "--max-new-tokens", "6"]
+    )
+    assert snap["router"]["routed"] == 5
+    assert snap["router"]["affinity_hits"] >= 1
+    total = sum(
+        rep["completed"] for rep in snap["replicas"].values()
+    )
+    assert total == 5
+
+
+@pytest.mark.slow
+def test_serving_demo_disaggregate_mode_runs():
+    """--disaggregate (ISSUE 14): prefill workers hand contexts to the
+    decode engine by page-table mapping — zero copy bytes, every request
+    served, no coupled fallbacks on the clean path."""
+    snap = _load_demo().main(
+        ["--requests", "5", "--slots", "2", "--disaggregate",
+         "--max-new-tokens", "6"]
+    )
+    assert snap["completed"] == 5
+    assert snap["disagg_handoffs"] == 5
+    assert snap["disagg_coupled_fallbacks"] == 0
+    assert snap["disagg_copy_bytes"] == 0
