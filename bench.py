@@ -63,6 +63,7 @@ QUANT_TIMEOUT_S = 540
 TRAFFIC_TIMEOUT_S = 540
 EFFICIENCY_TIMEOUT_S = 540
 MULTICHIP_TIMEOUT_S = 540
+GRAFTVERIFY_TIMEOUT_S = 420
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -1996,6 +1997,147 @@ def child_multichip() -> None:
         )
 
 
+def _measure_graftverify(jax):
+    """IR-level verification census (``--child-graftverify``, ISSUE 15):
+    drive a small paged engine plus a tp=2 exact/quantized pair on the CPU
+    mesh proxy, run graftverify over their ledgers, and report the
+    donation/transfer/collective tables plus the STATIC EQuARX wire-byte
+    ratio — the static twin of ``extras.graftlint``."""
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+    from neuronx_distributed_tpu.parallel.quantized_collectives import (
+        QuantizedAllReduceConfig,
+    )
+    from neuronx_distributed_tpu.scripts.graftlint import baseline as bl
+    from neuronx_distributed_tpu.scripts.graftverify import (
+        runner as gv_runner,
+    )
+    from neuronx_distributed_tpu.scripts.graftverify.core import (
+        DEFAULT_BASELINE_NAME,
+    )
+    from neuronx_distributed_tpu.serving import ServingEngine
+
+    # hidden 256 / 4 slots: the row-parallel reduction is 1024 elements —
+    # divisible by tp*block_size, so the quantized ring pads nothing and
+    # the static ratio is the pure EQuARX 4/(1+4/256)
+    cfg = tiny_llama(num_layers=2, hidden_size=256,
+                     intermediate_size=768, vocab_size=128)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), ids)
+    gcfg = GenerationConfig(max_new_tokens=2, temperature=0.0)
+
+    def drive(engine):
+        r = np.random.RandomState(3)
+        for i in range(2):
+            engine.submit(
+                r.randint(1, cfg.vocab_size, size=6).astype(np.int32),
+                gcfg, key=jax.random.PRNGKey(i),
+            )
+        engine.run()
+        return engine
+
+    def build(tp, quantized, paged):
+        mesh_lib.destroy_model_parallel()
+        kw = {}
+        if tp > 1:
+            kw = dict(
+                tp=tp,
+                tp_comms=QuantizedAllReduceConfig(enabled=quantized),
+            )
+        return drive(ServingEngine(
+            model, params, num_slots=4, decode_chunk_size=2,
+            prefix_cache=None, kv_page_size=8 if paged else None, **kw,
+        ))
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    baseline_path = os.path.join(root, DEFAULT_BASELINE_NAME)
+    plain = build(tp=1, quantized=False, paged=True)
+    report = gv_runner.verify(
+        {"serving": plain.programs}, baseline_path=baseline_path
+    )
+    exact = build(tp=2, quantized=False, paged=True)
+    rep_exact = gv_runner.verify(
+        {"serving": exact.programs}, use_baseline=False
+    )
+    quant = build(tp=2, quantized=True, paged=False)
+    rep_quant = gv_runner.verify(
+        {"serving": quant.programs}, use_baseline=False
+    )
+    te = rep_exact.audit("decode_chunk").collective_table
+    tq = rep_quant.audit("decode_chunk").collective_table
+    residual = tq["by_kind"].get("all_reduce", {"wire_bytes": 0})[
+        "wire_bytes"
+    ]
+    ring_quant = sum(
+        tq["by_kind"].get(k, {"wire_bytes": 0})["wire_bytes"]
+        for k in ("collective_permute", "all_gather")
+    )
+    routed_exact = (
+        te["by_kind"].get("all_reduce", {"wire_bytes": 0})["wire_bytes"]
+        - residual
+    )
+    stats = report.stats()
+    tp_stats = rep_exact.stats()
+    mesh_lib.destroy_model_parallel()
+    return {
+        "programs_checked": stats["programs_checked"],
+        "variants_checked": stats["variants_checked"],
+        "donations_declared": stats["donations_declared"],
+        "donations_aliased": stats["donations_aliased"],
+        "donations_deferred": tp_stats["donations_deferred"],
+        "donations_pruned": stats["donations_pruned"],
+        "donations_dropped": (
+            stats["donations_dropped"] + tp_stats["donations_dropped"]
+        ),
+        "transfer_ops": stats["transfer_ops"] + tp_stats["transfer_ops"],
+        "collective_table_tp2_exact": te,
+        "collective_table_tp2_quant": tq,
+        "equarx_static_wire_ratio": (
+            round(routed_exact / ring_quant, 3) if ring_quant else None
+        ),
+        "findings_by_rule": report.by_rule(),
+        "baseline_size": len(bl.load(baseline_path)),
+        "clean": not report.failed,
+    }
+
+
+def child_graftverify() -> None:
+    """IR-verification child (``--child-graftverify``): prints one JSON
+    line; merged into the BENCH artifact as ``extras.graftverify`` next
+    to ``extras.graftlint``."""
+    os.environ.setdefault("BENCH_FORCE_PLATFORM", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax = _child_setup_jax()
+    try:
+        _emit(
+            {
+                "metric": "graftverify",
+                "unit": "IR-verified donations / wire bytes (CPU proxy)",
+                "platform": jax.devices()[0].platform,
+                **_measure_graftverify(jax),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "graftverify",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
 def child_sweep() -> None:
     """Remat-policy × batch MFU sweep on the real chip (VERDICT r4 next #1b):
     the r2 record (MFU 0.492) ran full per-layer remat; this measures the
@@ -2783,6 +2925,7 @@ def main() -> None:
     traffic_result = None
     efficiency_result = None
     multichip_result = None
+    graftverify_result = None
 
     import signal
 
@@ -2852,6 +2995,11 @@ def main() -> None:
             multichip_result
             if multichip_result is not None
             else {"error": "multichip child did not finish"}
+        )
+        extras["graftverify"] = (
+            graftverify_result
+            if graftverify_result is not None
+            else {"error": "graftverify child did not finish"}
         )
         extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
@@ -3060,6 +3208,17 @@ def main() -> None:
     else:
         multichip_result = {"error": f"multichip child: {err}"}
 
+    # 15. IR-verification child (ISSUE 15): graftverify's donation /
+    #     transfer / collective-wire-byte census over real engine ledgers
+    #     — static facts (lowered IR), serialized like the rest only so
+    #     its compiles never contend with a wall-clock measurement.
+    graftverify, err = _run_child("--child-graftverify", GRAFTVERIFY_TIMEOUT_S)
+    if graftverify is not None:
+        graftverify.pop("metric", None)
+        graftverify_result = graftverify
+    else:
+        graftverify_result = {"error": f"graftverify child: {err}"}
+
     _finalize()
 
 
@@ -3090,6 +3249,8 @@ if __name__ == "__main__":
         child_observe()
     elif "--child-multichip" in sys.argv:
         child_multichip()
+    elif "--child-graftverify" in sys.argv:
+        child_graftverify()
     elif "--child-efficiency" in sys.argv:
         child_efficiency()
     elif "--child" in sys.argv:

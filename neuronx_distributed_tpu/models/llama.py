@@ -216,10 +216,10 @@ class LlamaAttention(nn.Module):
         k = k.reshape(b, s, cfg.num_kv_heads, d)
         v = v.reshape(b, s, cfg.num_kv_heads, d)
         # heads sharded over tp (kv heads too when divisible)
-        q = constrain(q, P(UNC, UNC, mesh_lib.TP_AXIS, None))
+        q = constrain(q, P(UNC, UNC, mesh_lib.TP_AXIS))
         if self._kv_heads_shardable():
-            k = constrain(k, P(UNC, UNC, mesh_lib.TP_AXIS, None))
-            v = constrain(v, P(UNC, UNC, mesh_lib.TP_AXIS, None))
+            k = constrain(k, P(UNC, UNC, mesh_lib.TP_AXIS))
+            v = constrain(v, P(UNC, UNC, mesh_lib.TP_AXIS))
 
         if self.mode == "train":
             q = apply_rope(q, freqs, positions)
@@ -409,7 +409,7 @@ class LlamaForCausalLM(nn.Module):
         )
         if cfg.sequence_parallel and x.ndim >= 3:
             # leave SP for the logits: gather the sequence back
-            x = constrain(x, P(UNC, None, None))
+            x = constrain(x, P(UNC))
         logits = ColumnParallelLinear(
             cfg.hidden_size, cfg.vocab_size, use_bias=False,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,

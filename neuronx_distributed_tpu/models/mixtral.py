@@ -243,7 +243,7 @@ class MixtralForCausalLM(nn.Module):
             input_ids, positions, deterministic, segment_ids, padding_mask
         )
         if cfg.sequence_parallel and x.ndim >= 3:
-            x = constrain(x, P(UNC, None, None))
+            x = constrain(x, P(UNC))
         logits = ColumnParallelLinear(
             cfg.hidden_size, cfg.vocab_size, use_bias=False,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,

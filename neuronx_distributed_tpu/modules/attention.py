@@ -854,7 +854,7 @@ class ParallelSelfAttention(nn.Module):
         q = q.reshape(b, s, h, d)
         k = k.reshape(b, s, hkv, d)
         v = v.reshape(b, s, hkv, d)
-        q = constrain(q, P(UNC, UNC, mesh_lib.TP_AXIS, None))
+        q = constrain(q, P(UNC, UNC, mesh_lib.TP_AXIS))
         if self.mode == "train":
             q, k = self._rope(q, k, positions)
             out = attention_op(

@@ -35,5 +35,5 @@ class LayerNorm(nn.Module):
             name="ln",
         )(x.astype(jnp.float32)).astype(self.dtype)
         if self.sequence_parallel_enabled and y.ndim >= 3:
-            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis, None))
+            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis))
         return y

@@ -353,7 +353,7 @@ class ExpertMLPs(nn.Module):
         else:
             h = _act(self.hidden_act)(h)
         y = jnp.einsum("tei,eih->teh", h, down)
-        y = constrain(y, P(UNC, mesh_lib.EP_AXIS, None))
+        y = constrain(y, P(UNC, mesh_lib.EP_AXIS))
         return jnp.einsum("teh,te->th", y, comb.astype(y.dtype))
 
     # --- strategy: capacity factor (reference expert_mlps.py:218) -------------
@@ -388,7 +388,7 @@ class ExpertMLPs(nn.Module):
         # which under GSPMD is exactly the enter-EP all-to-all
         # (reference mappings.py:474 enter_expert_parallel_region)
         xin = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
-        xin = constrain(xin, P(mesh_lib.EP_AXIS, None, None))
+        xin = constrain(xin, P(mesh_lib.EP_AXIS))
         h = jnp.einsum("ech,ehi->eci", xin, up)
         h = constrain(h, P(mesh_lib.EP_AXIS, None, mesh_lib.TP_AXIS))
         if self.glu_mlp:
@@ -397,7 +397,7 @@ class ExpertMLPs(nn.Module):
         else:
             h = _act(self.hidden_act)(h)
         y = jnp.einsum("eci,eih->ech", h, down)
-        y = constrain(y, P(mesh_lib.EP_AXIS, None, None))
+        y = constrain(y, P(mesh_lib.EP_AXIS))
         # combine einsum contracts (e, c) → the exit-EP all-to-all + weighting
         return jnp.einsum("tec,ech->th", combine.astype(y.dtype), y)
 

@@ -68,7 +68,7 @@ class MoE(nn.Module):
         if self.sequence_parallel_enabled:
             # exit SP: routing needs the full sequence per data shard
             # (reference SP exit all-gather, model.py:116)
-            x = constrain(x, P(UNC, None, None))
+            x = constrain(x, P(UNC))
         tokens = x.reshape(B * S, H)
 
         perm = None
@@ -108,7 +108,7 @@ class MoE(nn.Module):
         out = out.reshape(B, S, H).astype(x.dtype)
         if self.sequence_parallel_enabled:
             # re-enter SP layout (reference delayed reduce-scatter, model.py:200)
-            out = constrain(out, P(UNC, (mesh_lib.CP_AXIS, mesh_lib.TP_AXIS), None))
+            out = constrain(out, P(UNC, (mesh_lib.CP_AXIS, mesh_lib.TP_AXIS)))
 
         aux = {
             "load_balancing_loss": load_balancing_loss_func(

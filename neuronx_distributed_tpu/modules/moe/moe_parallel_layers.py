@@ -82,5 +82,5 @@ class ExpertFusedRowParallelLinear(nn.Module):
         x = constrain(x, P(mesh_lib.EP_AXIS, UNC, mesh_lib.TP_AXIS))
         y = jnp.einsum("eci,eio->eco", x.astype(self.dtype), kernel.astype(self.dtype))
         if self.reduce_output:
-            y = constrain(y, P(mesh_lib.EP_AXIS, UNC, None))
+            y = constrain(y, P(mesh_lib.EP_AXIS, UNC))
         return y

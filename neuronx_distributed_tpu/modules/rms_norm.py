@@ -42,5 +42,5 @@ class RMSNorm(nn.Module):
         y = x32 * jax.lax.rsqrt(var + self.eps)
         y = (y * weight.astype(jnp.float32)).astype(self.dtype)
         if self.sequence_parallel_enabled and y.ndim >= 3:
-            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis, None))
+            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis))
         return y

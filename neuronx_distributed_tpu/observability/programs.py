@@ -55,7 +55,9 @@ import jax
 __all__ = [
     "UNAVAILABLE",
     "LedgeredProgram",
+    "ProgramInfo",
     "ProgramLedger",
+    "VariantInfo",
     "device_peaks",
     "per_instance",
     "weak_reader",
@@ -192,22 +194,42 @@ def _normalize_cost(cost) -> Optional[dict]:
 
 class _Variant:
     """One compiled signature of a program: the pending abstract args (for
-    lazy analysis) and, once analyzed, the compiler-reported numbers."""
+    lazy analysis) and, once analyzed, the compiler-reported numbers.
+
+    ``abstract_call`` is retained PAST :meth:`ensure` (``pending`` is
+    consumed by it) so external verifiers — graftverify's IR checks — can
+    re-``lower()`` the program on demand without racing the cost-analysis
+    lifecycle."""
 
     __slots__ = (
-        "sig", "pending", "analyzed", "flops", "bytes_accessed",
-        "donated_argnums", "memory", "cost_source",
+        "sig", "pending", "abstract_call", "analyzed", "flops",
+        "bytes_accessed", "donated_argnums", "memory", "cost_source",
     )
 
     def __init__(self, sig: str, pending=None):
         self.sig = sig
         self.pending = pending  # (fn, a_args, a_kwargs) until analyzed
+        self.abstract_call = pending  # survives ensure(); see lower()
         self.analyzed = False
         self.flops: Any = UNAVAILABLE
         self.bytes_accessed: Any = UNAVAILABLE
         self.donated_argnums: Any = UNAVAILABLE
         self.memory: Dict[str, Any] = dict(_EMPTY_MEMORY)
         self.cost_source: str = UNAVAILABLE
+
+    def lower(self):
+        """Fresh ``Lowered`` handle for this signature — a TRACE of the
+        wrapped callable over the captured abstract args, never a compile.
+        Returns None when the signature was not captured (AOT records
+        carry their analysis eagerly and keep no callable). Not memoized:
+        a Lowered pins the traced jaxpr/module, and verification passes
+        are episodic — holding one per variant for the process lifetime
+        would be a silent memory tax on the serving ledger."""
+        call = self.abstract_call
+        if call is None:
+            return None
+        fn, a_args, a_kwargs = call
+        return fn.lower(*a_args, **a_kwargs)
 
     def fill_from(self, lowered, compiled=None) -> None:
         """Record analysis from a ``Lowered`` (cheap — no compile) and,
@@ -282,6 +304,64 @@ class _Variant:
         # memory fields keep their UNAVAILABLE markers — the numbers exist
         # on most backends, the caller just did not pay the AOT compile
         self.fill_from(lowered, compiled)
+
+
+class VariantInfo:
+    """Read-only view of one compiled signature of a ledgered program.
+
+    ``signature`` is the ledger's stable digest id;
+    ``abstract_args``/``abstract_kwargs`` are the captured
+    ``ShapeDtypeStruct`` skeleton (None when not captured — AOT records);
+    ``lower()`` re-traces the program over that skeleton and returns the
+    ``jax.stages.Lowered`` (None when uncapturable). A trace, never a
+    compile — the graftverify contract."""
+
+    __slots__ = ("signature", "_variant")
+
+    def __init__(self, variant: "_Variant"):
+        self.signature = variant.sig
+        self._variant = variant
+
+    @property
+    def captured(self) -> bool:
+        return self._variant.abstract_call is not None
+
+    @property
+    def abstract_args(self):
+        call = self._variant.abstract_call
+        return call[1] if call is not None else None
+
+    @property
+    def abstract_kwargs(self):
+        call = self._variant.abstract_call
+        return call[2] if call is not None else None
+
+    def lower(self):
+        return self._variant.lower()
+
+
+class ProgramInfo:
+    """Read-only view of one ledgered program for enumeration consumers."""
+
+    __slots__ = ("name", "_record")
+
+    def __init__(self, name: str, record: "_ProgramRecord"):
+        self.name = name
+        self._record = record
+
+    @property
+    def dispatches(self) -> int:
+        return self._record.dispatches
+
+    @property
+    def compiles(self) -> int:
+        return self._record.compiles
+
+    @property
+    def variants(self) -> Tuple[VariantInfo, ...]:
+        return tuple(
+            VariantInfo(v) for v in self._record.variants.values()
+        )
 
 
 # memory_analysis() field mapping (CompiledMemoryStats attribute names);
@@ -560,6 +640,20 @@ class ProgramLedger:
     def dispatches(self, name: str) -> int:
         rec = self._records.get(name)
         return rec.dispatches if rec is not None else 0
+
+    def programs(self) -> "OrderedDict[str, ProgramInfo]":
+        """Public enumeration of every registered program: name →
+        :class:`ProgramInfo` (host-side counts plus per-variant lazy
+        ``lower()`` handles). This is the supported surface for external
+        verification passes (scripts/graftverify) — tools iterate THIS, not
+        ``_records``. Enumeration itself is pure host metadata: zero
+        compiles, zero device→host syncs (regression-pinned in
+        tests/observability/test_programs.py); only an explicit
+        ``VariantInfo.lower()`` call traces, and even that never compiles."""
+        return OrderedDict(
+            (name, ProgramInfo(name, rec))
+            for name, rec in self._records.items()
+        )
 
     def _analyzed_sole(self, name: str, analyze: bool = True):
         rec = self._records.get(name)

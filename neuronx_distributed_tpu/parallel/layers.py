@@ -228,7 +228,7 @@ class ColumnParallelLinear(nn.Module):
             # Declare the incoming SP layout so the partitioner knows to
             # all-gather seq right here (reference fwd all-gather,
             # layers_utils.py:16).
-            x = constrain(x, P(*([UNC] * (x.ndim - 2)), self.axis, None))
+            x = constrain(x, P(*([UNC] * (x.ndim - 2)), self.axis))
         if qscale is not None:
             y = _quantized_forward(
                 self.quantization_config, x, kernel, qscale, act_scale,
@@ -241,7 +241,7 @@ class ColumnParallelLinear(nn.Module):
         if self.use_bias:
             y = y + bias.astype(self.dtype)
         if self.gather_output:
-            y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+            y = constrain(y, P(*[UNC] * (y.ndim - 1)))
         else:
             y = constrain(y, P(*([UNC] * (y.ndim - 1)), self.axis))
         return y
@@ -322,9 +322,9 @@ class RowParallelLinear(nn.Module):
         if self.sequence_parallel_enabled and y.ndim >= 3:
             # partial sums → reduce-scatter over the sequence dim
             # (reference mappings.py:320 path)
-            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis, None))
+            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis))
         else:
-            y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+            y = constrain(y, P(*[UNC] * (y.ndim - 1)))
         if self.use_bias:
             y = y + bias.astype(self.dtype)
         return y
@@ -409,7 +409,7 @@ class InputChannelParallelConv2d(nn.Module):
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
-        y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+        y = constrain(y, P(*[UNC] * (y.ndim - 1)))
         if self.use_bias:
             bias = self.param(
                 "bias",
@@ -477,11 +477,11 @@ class ParallelEmbedding(nn.Module):
         y = self._lookup(table.astype(self.dtype), ids)
         if self.sequence_parallel_enabled and y.ndim >= 3:
             # hand off straight into SP layout: seq sharded over tp
-            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis, None))
+            y = constrain(y, P(*([UNC] * (y.ndim - 2)), self.axis))
         elif self.shard_dim == 1:
             y = constrain(y, P(*([UNC] * (y.ndim - 1)), self.axis))
         else:
-            y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+            y = constrain(y, P(*[UNC] * (y.ndim - 1)))
         return y
 
     def _lookup(self, table, ids):
@@ -505,7 +505,7 @@ class ParallelEmbedding(nn.Module):
         # and inside the region that sharding collides with the (B, S)-
         # sharded mask of the where() — the SPMD partitioner resolved it by
         # involuntary full rematerialization (MULTICHIP_r04.json CP phase)
-        table = constrain(table, P(self.axis, None))
+        table = constrain(table, P(self.axis))
         return _vocab_parallel_lookup(
             mesh if ctx_mesh.empty else ctx_mesh, self.axis
         )(table, ids)

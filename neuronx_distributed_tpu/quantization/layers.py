@@ -104,7 +104,7 @@ class QuantizedColumnParallel(nn.Module):
             )
             y = y + bias.astype(self.dtype)
         if self.gather_output:
-            y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+            y = constrain(y, P(*[UNC] * (y.ndim - 1)))
         else:
             y = constrain(y, P(*([UNC] * (y.ndim - 1)), self.axis))
         return y
@@ -210,7 +210,7 @@ class QuantizedExpertFusedRowParallel(nn.Module):
         )
         y = jnp.einsum("eci,eio->eco", x, w)
         if self.reduce_output:
-            y = constrain(y, P(mesh_lib.EP_AXIS, UNC, None))
+            y = constrain(y, P(mesh_lib.EP_AXIS, UNC))
         return y
 
 
@@ -243,7 +243,7 @@ class QuantizedRowParallel(nn.Module):
         if self.input_is_parallel:
             x = constrain(x, P(*([UNC] * (x.ndim - 1)), self.axis))
         y = quantized_matmul(x, kernel, scale, self.dtype)
-        y = constrain(y, P(*([UNC] * (y.ndim - 1)), None))
+        y = constrain(y, P(*[UNC] * (y.ndim - 1)))
         if self.use_bias:
             bias = self.param(
                 "bias",

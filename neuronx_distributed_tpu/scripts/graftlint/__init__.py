@@ -15,6 +15,22 @@ it):
   XLA; PR 5).
 * **GL05 nondeterminism** — unseeded/wall-clock RNG in library code
   (breaks bit-identical chaos/resume; PR 3/PR 5).
+* **GL06 sharding-spec drift** — trailing-``None`` ``PartitionSpec``s at
+  layout-commitment sites, raw ``NamedSharding`` in ``serving/`` outside
+  the placement hooks (the PR 13 second-dispatch recompile).
+* **GL07 trace-scope leakage** — ``tp_comms``/``fused_paged_attention_scope``
+  entered manually, around a jit CONSTRUCTION, or re-entrantly
+  (cross-engine trace contamination).
+* **GL08 hold/refcount pairing** — except handlers that orphan allocator
+  refs / staged holds / pins acquired in the try body (the PR 13
+  staged-hold capacity leak).
+* **GL09 labeled-metrics hygiene** — interpolated label values, dynamic
+  label names (series collision/steering ahead of the exposition-time
+  escaping).
+
+The IR-level sibling — donation aliasing, transfer census and the
+collective wire-byte ratchet verified on the LOWERED programs themselves
+— is ``scripts/graftverify``.
 
 Run it::
 

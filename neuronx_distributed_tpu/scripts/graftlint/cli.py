@@ -24,8 +24,9 @@ def _build_parser() -> argparse.ArgumentParser:
         prog="graftlint",
         description=(
             "Repo-native static analysis enforcing the donation, host-sync, "
-            "recompile, compat-layer and determinism invariants the hot "
-            "paths depend on (rules GL01-GL05; see --explain RULE)."
+            "recompile, compat-layer, determinism, sharding-spec, "
+            "trace-scope, hold-pairing and metrics-label invariants the hot "
+            "paths depend on (rules GL01-GL09; see --explain RULE)."
         ),
     )
     p.add_argument(
@@ -34,7 +35,7 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--explain", metavar="RULE",
-        help="print the catalog entry for RULE (GL00-GL05) and exit",
+        help="print the catalog entry for RULE (GL00-GL09) and exit",
     )
     p.add_argument(
         "--select", metavar="RULES",

@@ -17,6 +17,10 @@ from neuronx_distributed_tpu.scripts.graftlint.rules import (
     gl03_recompile,
     gl04_compat,
     gl05_determinism,
+    gl06_sharding,
+    gl07_trace_scope,
+    gl08_holds,
+    gl09_metrics_labels,
 )
 
 RULE_MODULES = (
@@ -25,6 +29,10 @@ RULE_MODULES = (
     gl03_recompile,
     gl04_compat,
     gl05_determinism,
+    gl06_sharding,
+    gl07_trace_scope,
+    gl08_holds,
+    gl09_metrics_labels,
 )
 
 RULES: Dict[str, object] = {m.RULE: m for m in RULE_MODULES}
@@ -35,7 +43,7 @@ GL00 pragma hygiene
 Emitted by the pragma layer itself, not a scanner: a
 `# graftlint: ok[RULE]` suppression that is malformed, names no rules, or
 is missing its MANDATORY reason. A suppression without a documented why is
-how the incident classes GL01-GL05 encode crept into the codebase the
+how the incident classes GL01-GL09 encode crept into the codebase the
 first time — the pragma exists to leave the rationale next to the code.
 """
 

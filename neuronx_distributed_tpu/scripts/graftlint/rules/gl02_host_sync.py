@@ -47,6 +47,10 @@ Flagged inside hot modules:
   * `jax.device_get(...)` — EVERY explicit sync must either be the
     documented one (pragma with reason: `# graftlint: ok[GL02] ...`) or not
     exist
+  * f-string interpolation of a device-resident value (`f"{x}"` calls
+    str()/format() on it — the same blocking transfer as float())
+  * walrus bindings propagate taint: `(x := device_val)` makes `x`
+    device-resident for everything after it in the walk
 
 "Device-resident" is decided by a conservative per-function taint walk
 (came from jnp/jax.random/jax.lax/a jitted callable; laundered back to host
@@ -137,6 +141,26 @@ class _FnChecker:
 
     def check_expr(self, node: ast.AST) -> None:
         for sub in ast.walk(node):
+            if isinstance(sub, ast.NamedExpr):
+                # walrus binds mid-expression: `(x := device_val)` makes x
+                # device-resident for everything downstream — without this
+                # the statement walk loses the taint and a later float(x)
+                # goes unflagged (the ISSUE 15 census gap)
+                self.env.assign(
+                    sub.target, self.env.taint(sub.value), sub.value
+                )
+                continue
+            if isinstance(sub, ast.FormattedValue):
+                if self.env.taint(sub.value) == DEVICE:
+                    self.out.append(self.src.violation(
+                        RULE, sub,
+                        "f-string interpolation of a device value calls "
+                        "str()/format() on it — an implicit blocking "
+                        "device->host sync no profiler labels; device_get "
+                        "it through the path's explicit sync (or log the "
+                        "host-side copy)",
+                    ))
+                continue
             if not isinstance(sub, ast.Call):
                 continue
             path = self.aliases.resolve(sub.func)

@@ -1,0 +1,146 @@
+"""GL06 — sharding-spec drift."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from neuronx_distributed_tpu.scripts.graftlint.analysis import AliasMap
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL06"
+TITLE = "sharding-spec drift"
+
+EXPLAIN = """\
+GL06 sharding-spec drift
+
+Incident: PR 13's TP engine recompiled the decode chunk on its SECOND
+dispatch because a PartitionSpec was constructed with a trailing None —
+`P(None, None, 'tp')` and `P(None, None, 'tp', None)` describe the same
+placement but KEY DIFFERENTLY in the pjit dispatch cache, so the operand
+placed with one and constrained with the other forced a silent retrace.
+The fix (a trailing-None trim in parallel/sharding.py) is policy now:
+specs are normalized at the placement layer, nowhere else.
+
+Flagged:
+  * a `PartitionSpec(...)`/`P(...)` with a trailing literal `None` used at
+    a COMMITMENT site — inside `constrain(...)`,
+    `with_sharding_constraint(...)`, `NamedSharding(...)` or
+    `device_put(...)` — where the spec's spelling reaches operand layouts
+    and therefore the dispatch-cache key. A trailing-None constraint next
+    to a TRIMMED placement is exactly the incident's mismatch. (Specs that
+    only describe trace structure — shard_map in_specs/out_specs, weight
+    axis rules — are rank-complete on purpose and stay quiet.)
+  * a raw `NamedSharding(...)` construction in `serving/` outside
+    `parallel/sharding.py` — serving placement goes through the
+    ServingPartitioner placement hooks (`place_kv`, `replicate`,
+    `shard_params`), which own divisibility fallbacks and spec trimming;
+    an ad-hoc NamedSharding commit bypasses both and reintroduces the
+    recompile class the partitioner exists to kill.
+"""
+
+# calls whose spec argument reaches operand layouts / the dispatch cache
+_COMMIT_SUFFIXES = (
+    "constrain",
+    "with_sharding_constraint",
+    "NamedSharding",
+    "device_put",
+)
+
+_SPEC_PATHS = {
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.pjit.PartitionSpec",
+    "jax.interpreters.pxla.PartitionSpec",
+    "PartitionSpec",
+    "P",
+}
+_NAMED_SHARDING_PATHS = {
+    "jax.sharding.NamedSharding",
+    "NamedSharding",
+}
+# the placement layer that owns spec normalization; NamedSharding is legal
+# there (it is what the hooks emit)
+_PLACEMENT_SUFFIX = "parallel/sharding.py"
+_SERVING_PREFIXES = ("serving/",)
+
+
+def _is_spec_call(node: ast.Call, aliases: AliasMap) -> bool:
+    path = aliases.resolve(node.func)
+    if path in _SPEC_PATHS:
+        return True
+    # `from jax.sharding import PartitionSpec as P` resolves to the full
+    # path; a bare unimported P() in fixtures resolves to "P"
+    return path is not None and path.endswith(".PartitionSpec")
+
+
+def _is_named_sharding_call(node: ast.Call, aliases: AliasMap) -> bool:
+    path = aliases.resolve(node.func)
+    if path in _NAMED_SHARDING_PATHS:
+        return True
+    return path is not None and path.endswith(".NamedSharding")
+
+
+def _trailing_none_spec(node: ast.AST, aliases: AliasMap):
+    """The P(...) call under ``node`` whose last positional arg is the
+    literal None, if any."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call) or not _is_spec_call(sub, aliases):
+            continue
+        if not sub.args:
+            continue
+        last = sub.args[-1]  # a Starred last arg is never Constant None
+        if isinstance(last, ast.Constant) and last.value is None:
+            return sub
+    return None
+
+
+def _is_commit_call(node: ast.Call, aliases: AliasMap) -> bool:
+    path = aliases.resolve(node.func)
+    if path is None:
+        return False
+    return any(
+        path == suf or path.endswith(f".{suf}") for suf in _COMMIT_SUFFIXES
+    )
+
+
+def check(src: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    aliases = AliasMap(src.tree)
+    in_serving = any(
+        f"/{p}" in f"/{src.relpath}" for p in _SERVING_PREFIXES
+    )
+    is_placement_layer = src.relpath.endswith(_PLACEMENT_SUFFIX)
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_commit_call(node, aliases):
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in args:
+                spec = _trailing_none_spec(arg, aliases)
+                if spec is not None:
+                    out.append(src.violation(
+                        RULE, spec,
+                        "PartitionSpec with a trailing literal None at a "
+                        "layout-commitment site — P(..., 'tp') and "
+                        "P(..., 'tp', None) key DIFFERENTLY in the pjit "
+                        "dispatch cache next to a trimmed placement (the "
+                        "PR 13 second-dispatch recompile); drop the "
+                        "trailing None (missing trailing dims are "
+                        "replicated) to match the placement layer's "
+                        "trimmed spelling",
+                    ))
+        if (
+            _is_named_sharding_call(node, aliases)
+            and in_serving
+            and not is_placement_layer
+        ):
+            out.append(src.violation(
+                RULE, node,
+                "raw NamedSharding construction in serving code — "
+                "placement goes through the ServingPartitioner hooks "
+                "(place_kv/replicate/shard_params in "
+                "parallel/sharding.py), which own the divisibility "
+                "fallbacks and trailing-None spec normalization this "
+                "bypasses",
+            ))
+    return out

@@ -1,0 +1,130 @@
+"""GL07 — trace-scope leakage."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from neuronx_distributed_tpu.scripts.graftlint.analysis import AliasMap
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL07"
+TITLE = "trace-scope leakage"
+
+EXPLAIN = """\
+GL07 trace-scope leakage
+
+Incident: `tp_comms` and `fused_paged_attention_scope` are TRACE-time
+context managers — a thread-local stack the row-parallel layers /
+decode attention consult while jax traces. The engine enters them through
+its `_TraceScope` wrapper, which re-enters the scope around EVERY call of
+the wrapped jit, so the (lazy, possibly repeated) trace always happens
+inside and two engines in one process never contaminate each other. Every
+other entry pattern has burned us or will:
+
+  * `scope.__enter__()` called directly — nothing guarantees the exit;
+    the scope leaks into every later trace in the process (the
+    cross-engine contamination incident).
+  * `with tp_comms(...)` (or the fused scope) wrapped around a
+    `jax.jit(...)` CONSTRUCTION — jit traces LAZILY at first call, which
+    happens after the `with` block closed: the scope covers nothing, the
+    program silently traces with exact psum / row transport. Wrap the
+    CALL (engine `_comms_scoped` / `_TraceScope`), not the build.
+  * the same scope entered RE-ENTRANTLY (a `with` nested inside another
+    `with` of the same scope in one function) — the inner exit pops the
+    outer frame's config early on the shared stack.
+
+A `with` around the traced-side code itself (inside a function that runs
+under trace, e.g. the chunk builder entering the fused scope around the
+model apply) is the legal non-wrapper use and stays quiet.
+"""
+
+# scope constructors, by canonical dotted suffix
+_SCOPE_SUFFIXES = (
+    "quantized_collectives.tp_comms",
+    "attention.fused_paged_attention_scope",
+)
+_SCOPE_BARE = {"tp_comms", "fused_paged_attention_scope"}
+
+
+def _scope_name(node: ast.AST, aliases: AliasMap) -> Optional[str]:
+    """The scope's bare name if ``node`` is a call of one of the guarded
+    trace scopes, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    path = aliases.resolve(node.func)
+    if path is None:
+        return None
+    if path in _SCOPE_BARE:
+        return path
+    for suf in _SCOPE_SUFFIXES:
+        if path.endswith(suf):
+            return suf.rsplit(".", 1)[1]
+    return None
+
+
+def _contains_jit_build(body, aliases: AliasMap) -> Optional[ast.AST]:
+    """First jax.jit(...) construction anywhere in ``body``."""
+    from neuronx_distributed_tpu.scripts.graftlint.analysis import is_jit_call
+
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and is_jit_call(sub, aliases):
+                return sub
+    return None
+
+
+def check(src: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    aliases = AliasMap(src.tree)
+
+    # 1) manual __enter__ on a scope constructor (leak by construction)
+    for node in ast.walk(src.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "__enter__"
+            and _scope_name(node.func.value, aliases) is not None
+        ):
+            out.append(src.violation(
+                RULE, node,
+                "manual __enter__ on a trace scope — nothing pairs the "
+                "exit, so the config leaks into every later trace in the "
+                "process (cross-engine contamination); use `with` or the "
+                "engine's _TraceScope wrapper",
+            ))
+
+    # 2) with-entry hazards: jit built inside the scope, and re-entrancy
+    def walk(node, active: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            entered = []
+            for item in node.items:
+                name = _scope_name(item.context_expr, aliases)
+                if name is None:
+                    continue
+                entered.append(name)
+                if name in active:
+                    out.append(src.violation(
+                        RULE, item.context_expr,
+                        f"re-entrant `with {name}(...)` — the scopes share "
+                        "one stack; the inner exit pops the outer frame's "
+                        "config early. Enter the scope once per trace",
+                    ))
+                jit_build = _contains_jit_build(node.body, aliases)
+                if jit_build is not None:
+                    out.append(src.violation(
+                        RULE, item.context_expr,
+                        f"`with {name}(...)` wraps a jax.jit CONSTRUCTION "
+                        "— jit traces lazily at first CALL, after this "
+                        "block closed, so the scope covers nothing and "
+                        "the program silently traces without it; wrap the "
+                        "call (engine _TraceScope pattern), not the build",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                walk(child, active + tuple(entered))
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, active)
+
+    walk(src.tree, ())
+    return out

@@ -1,0 +1,118 @@
+"""GL08 — hold/refcount pairing on exception paths."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL08"
+TITLE = "hold/refcount pairing"
+
+EXPLAIN = """\
+GL08 hold/refcount pairing
+
+Incident: the PR 13 review-fix class — the disaggregated handoff staged
+context pages (`stage_context` takes one pool reference per page), then a
+later step in the same try-block failed, and the except handler requeued
+the request WITHOUT releasing the staged holds. Every such failure
+permanently shrank the page pool; under chaos the engine ran out of
+admission capacity with zero tokens lost and zero errors logged. The same
+shape exists for `PageAllocator` refs, page pins, and slot acquisition.
+
+Flagged: a function that ACQUIRES a hold inside a `try` body — a call
+whose method name is one of the acquire family (`acquire`,
+`stage_context`, `pin_pages`, `ref`, `alloc`) — where some `except`
+handler of that try neither RELEASES any hold (`release`,
+`release_staged`, `deref`, `unpin_pages`, `free`, `free_slot`,
+`release_all`, `quarantine`, `quarantine_page`, `map_staged`,
+`void_staged`) nor delegates to a local cleanup helper that does (a
+`self._*` call inside the handler counts as delegation — recovery
+routines own their own pairing). An acquire that can orphan its hold on
+the exception path is a capacity leak with no functional symptom.
+
+A `finally` block that releases covers every handler; handlers that only
+re-raise still leak (the caller cannot release a hold it never saw) —
+release first, then raise.
+"""
+
+_ACQUIRE_METHODS = {
+    "acquire", "stage_context", "pin_pages", "ref", "alloc",
+}
+_RELEASE_METHODS = {
+    "release", "release_staged", "deref", "unpin_pages", "free",
+    "free_slot", "release_all", "quarantine", "quarantine_page",
+    "map_staged", "void_staged",
+}
+
+
+def _method_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _calls_in(body) -> Set[str]:
+    names: Set[str] = set()
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                n = _method_name(sub)
+                if n is not None:
+                    names.add(n)
+    return names
+
+
+def _delegates_cleanup(body) -> bool:
+    """A handler calling a private helper/method (`self._recover...`,
+    `self._void...`) is delegating — the helper owns its own pairing
+    (flagging through module-local helpers would force every recovery
+    routine inline)."""
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                n = _method_name(sub)
+                if n is not None and n.startswith("_"):
+                    return True
+    return False
+
+
+def check(src: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Try) or not node.handlers:
+                continue
+            # acquires in the try body (not in nested handlers)
+            acquires = [
+                sub for stmt in node.body for sub in ast.walk(stmt)
+                if isinstance(sub, ast.Call)
+                and _method_name(sub) in _ACQUIRE_METHODS
+            ]
+            if not acquires:
+                continue
+            if _calls_in(node.finalbody) & _RELEASE_METHODS:
+                continue  # finally releases: every handler is covered
+            for handler in node.handlers:
+                called = _calls_in(handler.body)
+                if called & _RELEASE_METHODS:
+                    continue
+                if _delegates_cleanup(handler.body):
+                    continue
+                acq_names = sorted({
+                    _method_name(a) for a in acquires
+                })
+                out.append(src.violation(
+                    RULE, handler,
+                    f"except handler after {'/'.join(acq_names)}() in the "
+                    "try body releases NO hold — if the failure lands "
+                    "after the acquire, the page/slot reference is "
+                    "orphaned and capacity leaks permanently (the PR 13 "
+                    "staged-hold incident); release in the handler (or a "
+                    "finally), then requeue/re-raise",
+                ))
+    return out

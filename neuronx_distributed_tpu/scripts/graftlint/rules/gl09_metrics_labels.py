@@ -1,0 +1,126 @@
+"""GL09 — labeled-metrics hygiene."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from neuronx_distributed_tpu.scripts.graftlint.core import SourceFile, Violation
+
+RULE = "GL09"
+TITLE = "labeled-metrics hygiene"
+
+EXPLAIN = """\
+GL09 labeled-metrics hygiene
+
+The registry's contract (observability/registry.py): label NAMES are
+fixed at family creation and sanitized there; label VALUES are raw
+strings resolved to a child via `family.labels(value)` and escaped ONLY
+at Prometheus exposition. Two patterns break it:
+
+  * INTERPOLATED label values — `family.labels(f"{tenant}-{shard}")`,
+    `"%s" % tenant`, `tenant + suffix`, `"{}".format(tenant)`: the
+    request-controlled string is baked into the labelset identity before
+    the escaping path sees it, so two tenants can collide into one series
+    ("a-b"+"c" vs "a"+"b-c") and a crafted tenant name steers WHICH
+    series another tenant's traffic lands in. Pass each raw value as its
+    own label; exposition escapes it.
+  * DYNAMIC label names — `view.family(kind, name, labels=some_list)`
+    where the label tuple is not a literal of string constants: label
+    names become data, cardinality is unbounded, and the sanitize-once
+    guarantee at family creation is void.
+"""
+
+
+def _is_labels_call(node: ast.Call) -> bool:
+    """``<something>.labels(...)`` — the registry child resolver."""
+    return (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "labels"
+        and bool(node.args or node.keywords)
+    )
+
+
+def _is_family_call(node: ast.Call) -> bool:
+    """``<view|registry>.family(kind, name, ...)``."""
+    return (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "family"
+    )
+
+
+def _interpolation(expr: ast.AST) -> Optional[str]:
+    """How ``expr`` interpolates, or None for a raw value. A plain f-string
+    of ONE bare formatted value (``f"{x}"``) is a str() coercion, not a
+    concatenation — still flagged: coercion belongs to the record site's
+    caller, and non-str tenants must be normalized ONCE at submit."""
+    if isinstance(expr, ast.JoinedStr):
+        return "f-string"
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, (ast.Add, ast.Mod)):
+        # chained concatenation parses left-heavy (`a + "-" + b` is
+        # `(a + "-") + b`), so the str constant that proves this is string
+        # building can sit at ANY depth of the Add/Mod chain — walk it
+        def _has_str_const(side: ast.AST) -> bool:
+            if isinstance(side, ast.Constant) and isinstance(side.value, str):
+                return True
+            if isinstance(side, ast.JoinedStr):
+                return True
+            if isinstance(side, ast.BinOp) and isinstance(
+                side.op, (ast.Add, ast.Mod)
+            ):
+                return _has_str_const(side.left) or _has_str_const(side.right)
+            return False
+
+        if _has_str_const(expr.left) or _has_str_const(expr.right):
+            return "string concatenation/%"
+        return None
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "format"
+        and isinstance(expr.func.value, (ast.Constant, ast.JoinedStr))
+    ):
+        return ".format()"
+    return None
+
+
+def _literal_label_names(expr: ast.AST) -> bool:
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in expr.elts
+        )
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return True  # single-label shorthand
+    return False
+
+
+def check(src: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_labels_call(node):
+            values = list(node.args) + [kw.value for kw in node.keywords]
+            for v in values:
+                how = _interpolation(v)
+                if how is not None:
+                    out.append(src.violation(
+                        RULE, v,
+                        f"label value built by {how} — the interpolated "
+                        "string becomes labelset identity BEFORE the "
+                        "family's exposition-time escaping, so values can "
+                        "collide/steer series; pass each raw value as its "
+                        "own label",
+                    ))
+        elif _is_family_call(node):
+            for kw in node.keywords:
+                if kw.arg == "labels" and not _literal_label_names(kw.value):
+                    out.append(src.violation(
+                        RULE, kw.value,
+                        "dynamic label NAMES at family creation — names "
+                        "are sanitized once when the family is created, "
+                        "so they must be a literal tuple of string "
+                        "constants, never data",
+                    ))
+    return out

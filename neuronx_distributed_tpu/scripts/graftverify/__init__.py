@@ -1,0 +1,60 @@
+"""graftverify — IR-level static verification of ledgered programs.
+
+graftlint (scripts/graftlint) proves invariants about SOURCE TEXT; the
+incidents since it shipped (the trailing-``None`` ``PartitionSpec``
+recompile, the staged-hold leak, trace-scope cross-engine contamination)
+live in what XLA actually compiles. graftverify closes that gap: it
+iterates the :class:`ProgramLedger`'s registered programs, re-``lower()``s
+each captured signature (a trace — NEVER a compile), and checks the
+invariants on the lowered StableHLO itself:
+
+* **GV01 donation aliasing** — every ``donate_argnums`` declaration must
+  materialize as an ``input_output_alias`` (``tf.aliasing_output``) in the
+  IR; a silently dropped donation doubles HBM on the hot path.
+* **GV02 transfer census** — zero callback/infeed/outfeed/host-transfer
+  ops inside hot programs (the ground-truth complement of GL02's
+  source-level taint walk).
+* **GV03 collective wire-byte table** — every collective op enumerated
+  with element counts and a per-rank ring-model wire-byte figure, ratcheted
+  through ``graftverify_baseline.json`` so a TP-path change that regresses
+  wire bytes fails CI.
+* **GV04 dispatch-key stability** — more XLA compiles than distinct
+  shape/dtype signatures means the dispatch cache is churning on
+  weak-type/uncommitted hazards (GL03's class, verified at the cache
+  layer).
+
+Same runner/baseline machinery as graftlint (fingerprinted findings, an
+empty checked-in baseline, ``--explain``, exit codes 0/1/2); suppression
+is by WAIVER (``verify(..., waivers=...)``) since lowered IR has no
+comment lines to carry pragmas.
+"""
+
+from neuronx_distributed_tpu.scripts.graftverify.core import (
+    CHECKS,
+    DEFAULT_BASELINE_NAME,
+    EXPLAINS,
+    TITLES,
+)
+from neuronx_distributed_tpu.scripts.graftverify.ir import (
+    collective_table,
+    donation_table,
+    transfer_census,
+    wire_ratio,
+)
+from neuronx_distributed_tpu.scripts.graftverify.runner import (
+    VerifyReport,
+    verify,
+)
+
+__all__ = [
+    "CHECKS",
+    "DEFAULT_BASELINE_NAME",
+    "EXPLAINS",
+    "TITLES",
+    "VerifyReport",
+    "collective_table",
+    "donation_table",
+    "transfer_census",
+    "verify",
+    "wire_ratio",
+]

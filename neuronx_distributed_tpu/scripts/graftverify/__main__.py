@@ -1,0 +1,4 @@
+from neuronx_distributed_tpu.scripts.graftverify.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

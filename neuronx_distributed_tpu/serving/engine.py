@@ -441,6 +441,15 @@ class _TraceScope:
         with self._make_ctx():
             return self._fn(*args, **kwargs)
 
+    def lower(self, *args, **kwargs):
+        # lowering must enter the SAME scope as dispatch: a lower() outside
+        # it traces the exact-psum program even on a tp_comms engine, so
+        # every IR-level consumer (ledger cost analysis, graftverify's
+        # donation/collective checks) would verify a program the engine
+        # never runs — the trace-scope-leakage class GL07 encodes
+        with self._make_ctx():
+            return self._fn.lower(*args, **kwargs)
+
     def __getattr__(self, name):
         return getattr(self._fn, name)
 

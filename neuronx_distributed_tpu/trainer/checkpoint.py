@@ -196,11 +196,34 @@ class FsspecCheckpointStorage(BaseCheckpointStorage):
     """
 
     def __init__(self, url: str):
-        import fsspec
-
         super().__init__(url.rstrip("/"))
-        self._fs, self._root = fsspec.core.url_to_fs(self._dirname)
+        self._lazy_fs = None  # built on first IO; see _fs
         self._protocol = self._dirname.split("://", 1)[0]
+
+    @property
+    def _fs(self):
+        # Filesystem construction can open network connections and run
+        # credential discovery (gcsfs probes the GCE metadata server, with
+        # multi-second timeouts off-GCP) — URI dispatch and storage
+        # selection must stay pure, so defer until the first real IO.
+        if self._lazy_fs is None:
+            import fsspec
+
+            self._lazy_fs, self._lazy_root = fsspec.core.url_to_fs(
+                self._dirname
+            )
+        return self._lazy_fs
+
+    @_fs.setter
+    def _fs(self, fs):
+        # fault-injection harnesses wrap the live filesystem in place
+        self._fs  # materialize so _lazy_root is set
+        self._lazy_fs = fs
+
+    @property
+    def _root(self):
+        self._fs
+        return self._lazy_root
 
     def _path(self, filename: str) -> str:
         return f"{self._root}/{filename}"

@@ -3,6 +3,7 @@
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from neuronx_distributed_tpu.inference import GenerationConfig
 from neuronx_distributed_tpu.inference.benchmark import (
@@ -37,6 +38,8 @@ def test_latency_collector_counts():
     assert len(c.latency_list) == 4 and all(t > 0 for t in c.latency_list)
 
 
+@pytest.mark.slow  # heavy report-shape variant (tier-1 budget, PR 5/13
+# lean-core policy): collector mechanics stay tier-1 in the tests above
 def test_benchmark_generate_submodule_report():
     cfg = tiny_llama()
     model = LlamaForCausalLM(cfg, attention_impl="xla")

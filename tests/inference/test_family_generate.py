@@ -5,6 +5,7 @@ through its inference stack (§2.8 + per-model examples)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from neuronx_distributed_tpu.inference import GenerationConfig, generate
 from neuronx_distributed_tpu.models.codegen import CodeGenForCausalLM, tiny_codegen
@@ -27,6 +28,9 @@ def _greedy_nocache(model, params, ids, steps):
     return jnp.stack(out, axis=1)
 
 
+@pytest.mark.slow  # heavy family variant (tier-1 budget, PR 5/13 lean-core
+# policy): cached-greedy-vs-recompute stays tier-1 for llama
+# (tests/inference/test_generate.py) and mixtral (test_moe_generate.py)
 def test_gpt_neox_cached_greedy_matches_full_recompute():
     cfg = tiny_gpt_neox()
     model = GPTNeoXForCausalLM(cfg)

@@ -62,6 +62,9 @@ def test_mixtral_generate_on_ep_tp_mesh():
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
 
 
+@pytest.mark.slow  # heavy MoE family variant (tier-1 budget, PR 5/13
+# lean-core policy): MoE cached-greedy-vs-recompute stays tier-1 via
+# test_mixtral_cached_greedy_matches_full_recompute
 def test_dbrx_cached_greedy_matches_full_recompute():
     cfg = tiny_dbrx()
     model = DbrxForCausalLM(cfg, attention_impl="xla")

@@ -65,6 +65,10 @@ def test_hf_native_logits_match():
     np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.slow  # heavy full-model roundtrip (tier-1 budget, PR 5/13
+# lean-core policy): roundtrip identity stays tier-1 via
+# test_tied_embeddings_roundtrip and
+# test_gpt_neox_fused_qkv_roundtrip_and_logits
 def test_roundtrip_identity():
     hf_model, _ = _tiny_hf_model()
     state = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}

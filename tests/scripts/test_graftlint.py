@@ -890,3 +890,289 @@ def test_python_dash_m_entry_point(tmp_path):
     )
     assert r.returncode == 1
     assert "GL04" in r.stdout
+
+
+# --- GL06 sharding-spec drift (ISSUE 15) --------------------------------------
+
+
+def test_gl06_trailing_none_spec_at_commit_site(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from neuronx_distributed_tpu.parallel.sharding import constrain
+
+        def f(x):
+            x = constrain(x, P("tp", None))
+            return jax.lax.with_sharding_constraint(x, P(None, "tp", None))
+    """)
+    assert rules_of(v) == ["GL06"]
+    assert len([x for x in v if x.rule == "GL06"]) == 2
+
+
+def test_gl06_reinjection_trailing_none_in_sharding_py(tmp_path):
+    # the acceptance re-injection: a trailing-None spec in
+    # parallel/sharding.py itself (the trim owner) must trip
+    v = lint(tmp_path, """\
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def place(mesh, x):
+            return NamedSharding(mesh, P(None, None, "tp", None))
+    """, name="parallel/sharding.py")
+    assert "GL06" in rules_of(v)
+
+
+def test_gl06_negative_trimmed_and_structural_specs(tmp_path):
+    # trimmed commit specs and rank-complete shard_map STRUCTURE specs
+    # (in_specs/out_specs) are both fine
+    v = lint(tmp_path, """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from neuronx_distributed_tpu.parallel.sharding import constrain
+
+        def f(x, mesh):
+            x = constrain(x, P(None, "tp"))
+            return shard_map(
+                lambda v: v, mesh=mesh,
+                in_specs=P("tp", None), out_specs=P("tp", None),
+            )(x)
+    """)
+    assert "GL06" not in rules_of(v)
+
+
+def test_gl06_raw_named_sharding_in_serving(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def place(mesh, x):
+            return jax.device_put(x, NamedSharding(mesh, P("tp")))
+    """, name="serving/engine_helper.py")
+    assert "GL06" in rules_of(v)
+    # the SAME code in the placement layer is the blessed path
+    v2 = lint(tmp_path, """\
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def place(mesh, x):
+            return jax.device_put(x, NamedSharding(mesh, P("tp")))
+    """, name="parallel/sharding.py")
+    assert "GL06" not in rules_of(v2)
+
+
+# --- GL07 trace-scope leakage (ISSUE 15) --------------------------------------
+
+
+def test_gl07_manual_enter_leaks(tmp_path):
+    v = lint(tmp_path, """\
+        from neuronx_distributed_tpu.parallel.quantized_collectives import (
+            tp_comms,
+        )
+
+        def install(cfg):
+            tp_comms(cfg).__enter__()  # never exited
+    """)
+    assert rules_of(v) == ["GL07"]
+
+
+def test_gl07_jit_built_inside_scope(tmp_path):
+    v = lint(tmp_path, """\
+        import jax
+        from neuronx_distributed_tpu.parallel.quantized_collectives import (
+            tp_comms,
+        )
+
+        def build(cfg, step):
+            with tp_comms(cfg):
+                fn = jax.jit(step)  # traces lazily, AFTER the scope closed
+            return fn
+    """)
+    assert rules_of(v) == ["GL07"]
+
+
+def test_gl07_reentrant_scope(tmp_path):
+    v = lint(tmp_path, """\
+        from neuronx_distributed_tpu.modules.attention import (
+            fused_paged_attention_scope,
+        )
+
+        def f(frame, inner):
+            with fused_paged_attention_scope(*frame):
+                with fused_paged_attention_scope(*inner):
+                    pass
+    """)
+    assert rules_of(v) == ["GL07"]
+
+
+def test_gl07_negative_scoped_call_and_in_trace_use(tmp_path):
+    # wrapping the CALL (the engine _TraceScope pattern) and entering the
+    # scope inside traced code (the generate.py chunk builder) are the two
+    # legal shapes
+    v = lint(tmp_path, """\
+        import jax
+        from neuronx_distributed_tpu.parallel.quantized_collectives import (
+            tp_comms,
+        )
+
+        def scoped(fn, cfg):
+            def call(*args):
+                with tp_comms(cfg):
+                    return fn(*args)
+            return call
+
+        def chunk_fn(params, state, cfg):
+            with tp_comms(cfg):
+                out = params["w"] @ state
+            return out
+    """)
+    assert "GL07" not in rules_of(v)
+
+
+# --- GL08 hold/refcount pairing (ISSUE 15) ------------------------------------
+
+
+def test_gl08_acquire_without_release_in_handler(tmp_path):
+    v = lint(tmp_path, """\
+        class Server:
+            def handoff(self, req):
+                try:
+                    staged = self.cache.stage_context(req.row, req.p, req.padded)
+                    self.engine.admit_staged(staged)
+                except Exception:
+                    self.queue.append(req)  # staged holds orphaned: the leak
+    """)
+    assert rules_of(v) == ["GL08"]
+
+
+def test_gl08_reinjection_in_paging_py(tmp_path):
+    # the acceptance re-injection: an acquire-without-release handler in
+    # serving/paging.py trips by construction
+    v = lint(tmp_path, """\
+        class PagedCacheManager:
+            def admit_with_pin(self, ids):
+                try:
+                    self.pin_pages(ids)
+                    return self._bind(ids)
+                except Exception:
+                    raise RuntimeError("admit failed")
+    """, name="serving/paging.py")
+    assert "GL08" in rules_of(v)
+
+
+def test_gl08_negative_release_delegation_and_finally(tmp_path):
+    v = lint(tmp_path, """\
+        class Server:
+            def handoff(self, req):
+                try:
+                    staged = self.cache.stage_context(req.row, req.p, req.padded)
+                    self.engine.admit_staged(staged)
+                except Exception:
+                    self.cache.release_staged(staged)
+                    self.queue.append(req)
+
+            def handoff2(self, req):
+                staged = None
+                try:
+                    staged = self.cache.stage_context(req.row, req.p, req.padded)
+                    self.engine.admit_staged(staged)
+                finally:
+                    if staged is not None:
+                        self.cache.release_staged(staged)
+
+            def handoff3(self, req):
+                try:
+                    slot = self.cache.acquire()
+                    self._admit(slot, req)
+                except Exception:
+                    self._recover_admission(req)  # delegated cleanup
+    """)
+    assert "GL08" not in rules_of(v)
+
+
+# --- GL09 labeled-metrics hygiene (ISSUE 15) ----------------------------------
+
+
+def test_gl09_interpolated_label_value(tmp_path):
+    v = lint(tmp_path, """\
+        def record(fam, tenant, shard):
+            fam.labels(f"{tenant}-{shard}").inc()
+            fam.labels("t-%s" % tenant).observe(1.0)
+            fam.labels("{}".format(tenant)).inc()
+    """)
+    assert rules_of(v) == ["GL09"]
+    assert len(v) == 3
+
+
+def test_gl09_chained_concatenation(tmp_path):
+    # `a + "-" + b` parses left-heavy: the str constant sits one BinOp
+    # deep, exactly the "a-b"+"c" vs "a"+"b-c" collision vector — the walk
+    # must find it at any chain depth
+    v = lint(tmp_path, """\
+        def record(fam, tenant, shard):
+            fam.labels(tenant + "-" + shard).inc()
+    """)
+    assert rules_of(v) == ["GL09"]
+
+
+def test_gl09_dynamic_label_names(tmp_path):
+    v = lint(tmp_path, """\
+        def build(view, names):
+            return view.family("counter", "reqs", labels=tuple(names))
+    """)
+    assert rules_of(v) == ["GL09"]
+
+
+def test_gl09_negative_raw_values_and_literal_names(tmp_path):
+    v = lint(tmp_path, """\
+        def build(view, tenant, engine):
+            fam = view.family("counter", "reqs", labels=("tenant", "engine"))
+            fam.labels(tenant, engine).inc()
+            solo = view.family("gauge", "depth", labels="engine")
+            solo.labels(engine).set(3)
+    """)
+    assert "GL09" not in rules_of(v)
+
+
+# --- GL02 walrus + f-string census gaps (ISSUE 15) ----------------------------
+
+
+def test_gl02_walrus_binding_carries_device_taint(tmp_path):
+    v = lint(tmp_path, """\
+        # graftlint: hot-path
+        import jax.numpy as jnp
+
+        def f(vals):
+            y = (x := jnp.asarray(vals)) + 1
+            return float(x)
+    """)
+    assert "GL02" in rules_of(v)
+    assert any("float" in x.message for x in v if x.rule == "GL02")
+
+
+def test_gl02_fstring_of_device_value(tmp_path):
+    v = lint(tmp_path, """\
+        # graftlint: hot-path
+        import jax.numpy as jnp
+
+        def log_max(x):
+            m = jnp.max(x)
+            return f"max={m}"
+    """)
+    assert "GL02" in rules_of(v)
+    assert any("f-string" in x.message for x in v if x.rule == "GL02")
+
+
+def test_gl02_fstring_of_host_metadata_clean(tmp_path):
+    v = lint(tmp_path, """\
+        # graftlint: hot-path
+        import numpy as np
+
+        def log_shape(x, raw):
+            host = np.asarray(raw)  # unknown provenance: stays quiet
+            w = (n := len(x))
+            return f"shape={x.shape} n={n} host={host} w={w}"
+    """)
+    assert "GL02" not in rules_of(v)

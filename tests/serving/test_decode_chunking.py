@@ -72,6 +72,10 @@ def _serve(model, params, prompts, gcfgs, keys, chunk, upfront=2, **kw):
     return engine, reqs
 
 
+@pytest.mark.slow  # heavy staggered A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): chunked bit-identity stays tier-1 via
+# test_odd_chunk_size_matches, test_eos_mid_chunk_freezes_slot...,
+# and test_preemption_resume_chunked_streams_identical
 def test_chunked_streams_bit_identical_staggered(setup):
     """Acceptance: chunk=8 vs chunk=1 vs solo generate() — token streams
     bit-identical for a staggered stream of mixed greedy/sampled/EOS

@@ -315,6 +315,10 @@ def test_draft_dispatch_failure_falls_back_bit_identical(setup):
     assert engine.health() in (EngineHealth.OK, EngineHealth.DEGRADED)
 
 
+@pytest.mark.slow  # heavy spec-fault A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): draft-fault fallback bit-identity stays tier-1 via
+# test_draft_dispatch_failure_falls_back_bit_identical, spec poisoning via
+# test_spec_readback_poison_quarantines_slot
 def test_poisoned_draft_all_reject_streams_bit_identical(setup):
     """Mid-chunk all-reject poisoning: corrupted draft params make every
     proposal garbage — rounds degrade to one corrected token per slot,

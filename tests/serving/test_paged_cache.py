@@ -185,6 +185,9 @@ def test_decode_compilations_stay_one_across_table_layouts(setup):
     eng.cache.check()
 
 
+@pytest.mark.slow  # heavy spec×paged A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): paged A/Bs stay tier-1 in this file, spec-decode
+# bit-identity in tests/serving/test_spec_decode.py
 def test_speculative_paged_streams_match_row(setup):
     cfg, model, params = setup
     draft = LlamaForCausalLM(cfg, attention_impl="xla")

@@ -83,6 +83,11 @@ def _run(model, params, prompts, gcfgs, keys, prefix_cache, **kw):
 # --- bit-identity acceptance --------------------------------------------------
 
 
+@pytest.mark.slow  # heavy hit/miss matrix (tier-1 budget, PR 5/13 lean-core
+# policy): prefix bit-identity stays tier-1 via
+# test_eviction_then_readmit_streams_bit_identical,
+# test_exact_resubmit_hits_and_matches, and
+# test_preemption_resume_with_prefix_cache_streams_identical
 def test_hit_miss_partial_and_full_match_streams_bit_identical(setup):
     """Acceptance: cache-on vs cache-off vs solo generate() on a
     shared-prefix workload — misses (the seeding request), partial matches

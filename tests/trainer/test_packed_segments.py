@@ -6,6 +6,7 @@ RoPE restarted per document. Golden = each document trained unpacked."""
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
 from neuronx_distributed_tpu.parallel.losses import parallel_cross_entropy
@@ -99,6 +100,9 @@ def test_packed_corpus_emits_segments(tmp_path):
     assert "segment_ids" not in next(iter(c2))
 
 
+@pytest.mark.slow  # heavy family variant (tier-1 budget, PR 5/13 lean-core
+# policy): the packed-vs-unpacked loss identity stays tier-1 via the llama
+# variant above; rotary/MoE layouts ride the slow tier
 def test_packed_loss_equals_unpacked_documents_gpt_neox():
     """Round-5 family plumbing: the non-Llama families now thread
     segment_ids into their attention blocks — same per-document parity
@@ -139,6 +143,7 @@ def test_packed_loss_equals_unpacked_documents_gpt_neox():
     )
 
 
+@pytest.mark.slow  # see test_packed_loss_equals_unpacked_documents_gpt_neox
 def test_packed_loss_equals_unpacked_documents_mixtral():
     """MoE-family packed training goes through model.loss (the aux-loss
     objective): segment_ids/loss_mask forwarded, per-document parity of the
