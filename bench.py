@@ -61,6 +61,7 @@ SPEC_TIMEOUT_S = 540
 PAGED_TIMEOUT_S = 540
 QUANT_TIMEOUT_S = 540
 TRAFFIC_TIMEOUT_S = 540
+SCHED_TIMEOUT_S = 540
 EFFICIENCY_TIMEOUT_S = 540
 MULTICHIP_TIMEOUT_S = 540
 GRAFTVERIFY_TIMEOUT_S = 420
@@ -1727,6 +1728,146 @@ def child_traffic() -> None:
         )
 
 
+def _measure_sched(devs) -> dict:
+    """Scheduler A/B (``--child-sched``, ISSUE 16): the SAME PR-10 bursty
+    two-tenant tape (seed 7 — interactive chat bursts against a batch
+    long-doc grind) replayed through a FIFO engine and an SLO-policy
+    engine, everything else identical. Reports per-tenant attainment and
+    goodput under both policies plus the deltas — the judge for the
+    tentpole's claim: the interactive tenant's attainment/goodput must
+    move UP under contention without collapsing the batch tenant. Two
+    slots (not three): the A/B needs a regime where slots are scarce
+    during the burst, or FIFO already attains and the policies are
+    indistinguishable. Determinism is part of the contract: the tape is
+    sha-pinned and every leg runs twice from the same seed with
+    byte-identical reports."""
+    import dataclasses
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.observability import SLOSpec
+    from neuronx_distributed_tpu.serving import (
+        ServingEngine,
+        TenantProfile,
+        VirtualClock,
+        generate_tape,
+        replay,
+        tape_bytes,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=2, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(0)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+
+    STEP_DT = 0.05
+    slo = {
+        "chat": SLOSpec(ttft_p99_s=0.15, tpot_p99_s=0.02),
+        "docs": SLOSpec(ttft_p99_s=1.00, tpot_p99_s=0.05),
+    }
+    tenants = [
+        TenantProfile(
+            "chat", rate_rps=4.0, arrival="bursty", workload="chat",
+            priority="interactive", burst_factor=4.0,
+            burst_period_s=4.0, burst_duty=0.25, deadline_s=2.0,
+        ),
+        TenantProfile(
+            "docs", rate_rps=1.0, arrival="bursty", workload="longdoc",
+            priority="batch",
+        ),
+    ]
+    tape = generate_tape(tenants, duration_s=6.0, seed=7,
+                         vocab_size=cfg.vocab_size)
+    raw = tape_bytes(tape)
+
+    def run_once(scheduling):
+        clock = VirtualClock()
+        engine = ServingEngine(
+            model, params, num_slots=2, decode_chunk_size=4,
+            admission="eager", scheduling=scheduling, prefix_cache=None,
+            slo=slo, timeline=None, flight_recorder=None, kv_page_size=16,
+            time_fn=clock, sleep_fn=lambda s: None,
+        )
+        report = replay(engine, tape, clock, step_dt=STEP_DT)
+        report["decode_compilations"] = engine.decode_compilations
+        report["policy"] = engine.policy.snapshot()
+        return report
+
+    out = {
+        "step_dt_s": STEP_DT,
+        "num_slots": 2,
+        "tape_arrivals": len(tape),
+        "tape_sha256": hashlib.sha256(raw).hexdigest()[:16],
+        "slo_specs": {
+            t: dataclasses.asdict(s) for t, s in sorted(slo.items())
+        },
+    }
+    deterministic = True
+    reports = {}
+    for scheduling in ("fifo", "slo"):
+        first = run_once(scheduling)
+        second = run_once(scheduling)
+        same = json.dumps(first, sort_keys=True) == json.dumps(
+            second, sort_keys=True
+        )
+        deterministic = deterministic and same
+        deterministic = deterministic and first["decode_compilations"] == 1
+        reports[scheduling] = first
+        out[scheduling] = {
+            **first,
+            "report_identical_across_runs": same,
+        }
+    out["delta"] = {
+        t: {
+            "attainment": (
+                reports["slo"]["tenants"][t]["attainment"]
+                - reports["fifo"]["tenants"][t]["attainment"]
+            ),
+            "goodput_tok_s": (
+                reports["slo"]["tenants"][t]["goodput_tok_s"]
+                - reports["fifo"]["tenants"][t]["goodput_tok_s"]
+            ),
+        }
+        for t in sorted(reports["fifo"]["tenants"])
+    }
+    out["deterministic"] = deterministic
+    return out
+
+
+def child_sched() -> None:
+    """Scheduler A/B child (``--child-sched``): FIFO vs SLO policy on the
+    bursty two-tenant tape, determinism-checked. Prints one JSON line;
+    merged into the BENCH artifact as ``extras.serving_sched``."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_sched",
+                "unit": "per-tenant attainment/goodput deltas, FIFO vs SLO",
+                "platform": devs[0].platform,
+                **_measure_sched(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_sched",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
 def _measure_serving_multichip(devs) -> dict:
     """Multi-chip serving (``--child-multichip``, ISSUE 14), three legs on
     the CPU mesh proxy (the bench TPU relay has been dead since r3 — these
@@ -2923,6 +3064,7 @@ def main() -> None:
     paged_result = None
     quant_result = None
     traffic_result = None
+    sched_result = None
     efficiency_result = None
     multichip_result = None
     graftverify_result = None
@@ -2985,6 +3127,11 @@ def main() -> None:
             traffic_result
             if traffic_result is not None
             else {"error": "traffic child did not finish"}
+        )
+        extras["serving_sched"] = (
+            sched_result
+            if sched_result is not None
+            else {"error": "sched child did not finish"}
         )
         extras["device_efficiency"] = (
             efficiency_result
@@ -3187,6 +3334,16 @@ def main() -> None:
     else:
         traffic_result = {"error": f"traffic child: {err}"}
 
+    # 12b. Scheduler A/B child (ISSUE 16): FIFO vs SLO policy on the same
+    #      bursty tape — per-tenant attainment/goodput deltas, virtual
+    #      clock (wall-independent), determinism-checked.
+    sched, err = _run_child("--child-sched", SCHED_TIMEOUT_S)
+    if sched is not None:
+        sched.pop("metric", None)
+        sched_result = sched
+    else:
+        sched_result = {"error": f"sched child: {err}"}
+
     # 13. Device-efficiency child: compiler-truth per-program cost/memory
     #     table + MFU proxy + HBM ledger (ISSUE 12) — wall-independent
     #     (cost analysis is compile-time metadata), serialized like the
@@ -3237,6 +3394,8 @@ if __name__ == "__main__":
         child_quant()
     elif "--child-traffic" in sys.argv:
         child_traffic()
+    elif "--child-sched" in sys.argv:
+        child_sched()
     elif "--child-spec" in sys.argv:
         child_spec()
     elif "--child-train-faults" in sys.argv:

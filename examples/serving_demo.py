@@ -209,6 +209,22 @@ def parse_args(argv=None):
     p.add_argument("--slo-tpot-ms", type=float, default=20.0,
                    help="per-request mean-TPOT bound for interactive "
                         "tenants (batch tenants get 4x)")
+    p.add_argument("--scheduler", default="fifo",
+                   choices=["fifo", "slo"],
+                   help="admission policy for --traffic (ISSUE 16): "
+                        "'fifo' is the classic arrival-order engine; "
+                        "'slo' replays the SAME tape twice — FIFO "
+                        "baseline first, then the SLO-aware policy "
+                        "(priority tiers + aging, per-tenant DWRR token "
+                        "fairness, attainment-feedback admission/"
+                        "preemption) — and prints the before/after "
+                        "per-tenant attainment tables plus deltas")
+    p.add_argument("--priority", action="append", default=None,
+                   metavar="TENANT=TIER",
+                   help="override a --traffic tenant's priority class "
+                        "(repeatable), e.g. --priority tenant0-chat="
+                        "realtime; tiers: realtime > interactive > "
+                        "standard > batch")
     p.add_argument("--tp", type=int, default=0,
                    help="shard the engine over a tensor-parallel mesh of "
                         "this many devices (ISSUE 14; CPU hosts fan out "
@@ -280,6 +296,8 @@ def _run_traffic(args, cfg, model, params):
         replay,
     )
 
+    from neuronx_distributed_tpu.serving.sched import TIER_RANK
+
     arrival = "poisson" if args.traffic == "steady" else "bursty"
     tenants, slo = [], {}
     for i in range(max(1, args.tenants)):
@@ -300,59 +318,99 @@ def _run_traffic(args, cfg, model, params):
             ttft_p99_s=args.slo_ttft_ms * scale / 1e3,
             tpot_p99_s=args.slo_tpot_ms * scale / 1e3,
         )
+    names = {t.name: i for i, t in enumerate(tenants)}
+    for override in args.priority or []:
+        tenant, sep, tier = override.partition("=")
+        if not sep or tenant not in names or tier not in TIER_RANK:
+            raise SystemExit(
+                f"--priority {override!r}: expected TENANT=TIER with "
+                f"TENANT in {sorted(names)} and TIER in "
+                f"{sorted(TIER_RANK, key=TIER_RANK.get)}"
+            )
+        import dataclasses as _dc
+
+        tenants[names[tenant]] = _dc.replace(
+            tenants[names[tenant]], priority=tier
+        )
     tape = generate_tape(
         tenants, duration_s=args.traffic_duration, seed=args.seed,
         vocab_size=cfg.vocab_size,
     )
-    clock = VirtualClock()
-    page, quant = _engine_layout(args)
-    engine = ServingEngine(
-        model, params,
-        num_slots=args.slots,
-        admission=args.admission,
-        decode_chunk_size=args.decode_chunk,
-        prefix_cache=None if args.no_prefix_cache else "auto",
-        kv_page_size=page,
-        kv_num_pages=args.kv_pages,
-        quantize=quant,
-        slo=slo,
-        time_fn=clock,
-        sleep_fn=lambda s: None,
-    )
-    target = engine
-    if args.disaggregate:
-        from neuronx_distributed_tpu.serving import DisaggregatedServer
 
-        target = DisaggregatedServer(
-            engine, n_workers=args.prefill_workers
+    def run_once(scheduling):
+        clock = VirtualClock()
+        page, quant = _engine_layout(args)
+        engine = ServingEngine(
+            model, params,
+            num_slots=args.slots,
+            admission=args.admission,
+            decode_chunk_size=args.decode_chunk,
+            scheduling=scheduling,
+            prefix_cache=None if args.no_prefix_cache else "auto",
+            kv_page_size=page,
+            kv_num_pages=args.kv_pages,
+            quantize=quant,
+            slo=slo,
+            time_fn=clock,
+            sleep_fn=lambda s: None,
         )
-    report = replay(target, tape, clock, step_dt=0.05)
+        target = engine
+        if args.disaggregate:
+            from neuronx_distributed_tpu.serving import DisaggregatedServer
 
-    print(f"=== traffic replay: {args.traffic} ({arrival}), "
-          f"{len(tape)} arrivals / {len(tenants)} tenants, seed "
-          f"{args.seed}, {report['replay']['steps']} engine steps over "
-          f"{report['replay']['virtual_end_s']:.2f} virtual s ===")
-    for name, row in report["tenants"].items():
-        spec = slo[name]
-        print(
-            f"{name:>16s}  submitted={row['submitted']:>3d} "
-            f"done={row['completed']:>3d} shed={row['sheds']:>2d} "
-            f"rej={row['rejects']:>2d} | "
-            f"ttft p50/p99 {row['ttft_p50_s'] * 1e3:6.1f}/"
-            f"{row['ttft_p99_s'] * 1e3:6.1f}ms "
-            f"(SLO {spec.ttft_p99_s * 1e3:.0f}ms) | "
-            f"tpot p99 {row['tpot_p99_s'] * 1e3:5.2f}ms "
-            f"(SLO {spec.tpot_p99_s * 1e3:.0f}ms) | "
-            f"attain {row.get('attainment', 1.0):5.1%} "
-            f"goodput {row.get('goodput_tok_s', 0.0):7.1f} tok/s"
-        )
-    s = report["slo"]
-    print(f"\n=== SLO totals: attained {s['attained']} / violated "
-          f"{s['violated']} (attainment {s['attainment']:.1%}), goodput "
-          f"{s['goodput_tok_s']:.1f} tok/s over {s['span_s']:.2f} "
-          f"virtual s ===")
-    if s["violation_reasons"]:
-        print(f"violation reasons: {s['violation_reasons']}")
+            target = DisaggregatedServer(
+                engine, n_workers=args.prefill_workers
+            )
+        return engine, replay(target, tape, clock, step_dt=0.05)
+
+    def show(report, label):
+        print(f"=== traffic replay [{label}]: {args.traffic} ({arrival}), "
+              f"{len(tape)} arrivals / {len(tenants)} tenants, seed "
+              f"{args.seed}, {report['replay']['steps']} engine steps over "
+              f"{report['replay']['virtual_end_s']:.2f} virtual s ===")
+        for name, row in report["tenants"].items():
+            spec = slo[name]
+            print(
+                f"{name:>16s}  submitted={row['submitted']:>3d} "
+                f"done={row['completed']:>3d} shed={row['sheds']:>2d} "
+                f"rej={row['rejects']:>2d} | "
+                f"ttft p50/p99 {row['ttft_p50_s'] * 1e3:6.1f}/"
+                f"{row['ttft_p99_s'] * 1e3:6.1f}ms "
+                f"(SLO {spec.ttft_p99_s * 1e3:.0f}ms) | "
+                f"tpot p99 {row['tpot_p99_s'] * 1e3:5.2f}ms "
+                f"(SLO {spec.tpot_p99_s * 1e3:.0f}ms) | "
+                f"attain {row.get('attainment', 1.0):5.1%} "
+                f"goodput {row.get('goodput_tok_s', 0.0):7.1f} tok/s"
+            )
+        s = report["slo"]
+        print(f"\n=== SLO totals [{label}]: attained {s['attained']} / "
+              f"violated {s['violated']} (attainment {s['attainment']:.1%}),"
+              f" goodput {s['goodput_tok_s']:.1f} tok/s over "
+              f"{s['span_s']:.2f} virtual s ===")
+        if s["violation_reasons"]:
+            print(f"violation reasons: {s['violation_reasons']}")
+
+    baseline = None
+    if args.scheduler == "slo":
+        # before/after on the SAME tape: FIFO baseline first, then the
+        # SLO-aware policy — the deltas are the subsystem's deliverable
+        _, baseline = run_once("fifo")
+        show(baseline, "fifo baseline")
+        print()
+    engine, report = run_once(args.scheduler)
+    show(report, args.scheduler)
+    if baseline is not None:
+        print(f"\n=== fifo -> slo deltas (policy "
+              f"{engine.policy.snapshot()}) ===")
+        for name in report["tenants"]:
+            b, a = baseline["tenants"][name], report["tenants"][name]
+            print(
+                f"{name:>16s}  attain {b.get('attainment', 1.0):5.1%} -> "
+                f"{a.get('attainment', 1.0):5.1%} | goodput "
+                f"{b.get('goodput_tok_s', 0.0):7.1f} -> "
+                f"{a.get('goodput_tok_s', 0.0):7.1f} tok/s"
+            )
+        report["fifo_baseline"] = baseline
     if args.prometheus:
         print("\n=== prometheus exposition ===")
         print(engine.metrics.registry.prometheus_text())

@@ -247,6 +247,27 @@ class SLOTracker:
         )
         self._export(tenant, state, 0, 1, 0)
 
+    # --- scheduler feedback reads (ISSUE 16) --------------------------------
+    # O(1) per-tenant accessors for the SLO-aware scheduling policy's
+    # control loop — read every admission round, so they must not build
+    # the full per_tenant() dict. Host ints only (GL02-hot module).
+
+    def decided(self, tenant: str) -> int:
+        """How many of ``tenant``'s requests have been classified (attained
+        + violated) — the feedback controller's sample-count gate."""
+        s = self._tenants.get(tenant)
+        return (s.attained + s.violated) if s is not None else 0
+
+    def attainment(self, tenant: str) -> float:
+        """``tenant``'s running attainment fraction; 1.0 before any
+        classification (no evidence is not a violation — the controller
+        gates on :meth:`decided` before trusting this)."""
+        s = self._tenants.get(tenant)
+        if s is None:
+            return 1.0
+        total = s.attained + s.violated
+        return s.attained / total if total else 1.0
+
     # --- export -------------------------------------------------------------
 
     @property

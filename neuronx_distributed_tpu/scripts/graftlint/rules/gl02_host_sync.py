@@ -113,6 +113,17 @@ HOT_SUFFIXES = (
     "serving/router.py",
     "serving/disagg.py",
     "parallel/sharding.py",
+    # SLO-aware scheduling (ISSUE 16): the policy's select/victims hooks
+    # run on EVERY admission round, the fairness charge on every emitted
+    # token, and the feedback reads (tracker attainment, histogram
+    # percentiles) inside both — all must stay pure host arithmetic over
+    # already-host counters; an implicit coercion anywhere here would add
+    # a per-step sync the re-pinned budgets (submit=1, admission=2,
+    # steady chunk=1 with the SLO policy ON) never accounted for
+    "serving/sched/policy.py",
+    "serving/sched/priority.py",
+    "serving/sched/fairness.py",
+    "serving/sched/feedback.py",
 )
 HOT_MARKER = "graftlint: hot-path"
 

@@ -111,6 +111,15 @@ from neuronx_distributed_tpu.serving.paging import (
     StagedContext,
 )
 from neuronx_distributed_tpu.serving.router import RID_STRIDE, ReplicaRouter
+from neuronx_distributed_tpu.serving.sched import (
+    FairnessConfig,
+    FeedbackConfig,
+    FifoPolicy,
+    PriorityConfig,
+    SchedulingPolicy,
+    SloPolicy,
+    make_policy,
+)
 from neuronx_distributed_tpu.serving.scheduler import (
     Request,
     RequestState,
@@ -131,7 +140,10 @@ __all__ = [
     "DisaggregatedServer",
     "EngineHealth",
     "ExportedContext",
+    "FairnessConfig",
     "FaultInjector",
+    "FeedbackConfig",
+    "FifoPolicy",
     "InjectedDispatchError",
     "InjectedDraftError",
     "InjectedFault",
@@ -143,6 +155,7 @@ __all__ = [
     "PrefillWorker",
     "PrefixCache",
     "PrefixEntry",
+    "PriorityConfig",
     "QuantConfig",
     "RID_STRIDE",
     "RejectedError",
@@ -150,14 +163,17 @@ __all__ = [
     "Request",
     "RequestState",
     "Scheduler",
+    "SchedulingPolicy",
     "ServingEngine",
     "ServingMetrics",
+    "SloPolicy",
     "SlotCacheManager",
     "StagedContext",
     "TenantProfile",
     "VirtualClock",
     "build_report",
     "generate_tape",
+    "make_policy",
     "replay",
     "tape_bytes",
 ]

@@ -464,6 +464,7 @@ class ServingEngine:
         num_slots: int,
         max_tokens_in_flight: Optional[int] = None,
         admission: str = "conservative",
+        scheduling="fifo",
         decode_chunk_size: int = 8,
         max_queue: Optional[int] = None,
         draft_model=None,
@@ -689,7 +690,14 @@ class ServingEngine:
             prefix_cache.on_evict = self._on_prefix_evict
         self._prefix_reuses = 0  # reuse-attempt index (poison-hook schedule)
         self._prefill_model, self._decode_model = serving_clones(model)
-        self.scheduler = Scheduler(max_tokens_in_flight)
+        # scheduling policy (ISSUE 16): "fifo" (default — bit-identical to
+        # the pre-policy engine), "slo" (priority tiers + DWRR token
+        # fairness + attainment-feedback admission/preemption), or a
+        # SchedulingPolicy instance. The policy owns queue ORDER and
+        # victim choice; every mechanism it rides (requeue/resume, slot
+        # release) is the existing bit-identical machinery
+        self.scheduler = Scheduler(max_tokens_in_flight, policy=scheduling)
+        self.policy = self.scheduler.policy
         # paged KV (ISSUE 10): kv_page_size switches the cache path from
         # row-per-slot to block/page granularity — a ref-counted page pool
         # with per-slot device-resident block tables, free-page admission
@@ -744,6 +752,9 @@ class ServingEngine:
         self.metrics = ServingMetrics(
             num_slots, registry=registry, engine_label=engine_label, slo=slo
         )
+        # late policy wiring: the SLO policy reads metrics/prefix/cache
+        # feedback surfaces (all host state; FIFO ignores the engine)
+        self.policy.bind(self)
         # observability layer (ISSUE 8): request-scoped flow tracing on the
         # shared timeline, and an always-on flight recorder whose ring is
         # dumped as a redacted post-mortem the moment the engine HALTs.
@@ -1407,15 +1418,25 @@ class ServingEngine:
             span += self.cache.page_span(0, min(cols, self.max_seq_len)) + 1
         return span / cap
 
-    def load_score(self) -> float:
+    def load_score(self, tenant: Optional[str] = None) -> float:
         """The router's balancing signal: work in the building (active
         slots + queued requests) plus the page-pressure term scaled to
         slot units, so a replica whose pool is nearly committed reads as
-        loaded even with a short queue."""
-        return (
+        loaded even with a short queue.
+
+        With ``tenant=`` the score grows the policy's per-tenant
+        attainment term (ISSUE 16 tentpole (d)): a replica where THIS
+        tenant's SLO is under water reads as more loaded for its next
+        request, so the router steers toward the replica where the
+        tenant's SLO is healthiest. The FIFO policy's bias is always 0.0
+        — tenant-blind routing is unchanged."""
+        score = (
             float(int(self._active.sum()) + self.scheduler.queued)
             + self.page_pressure() * self.num_slots
         )
+        if tenant is not None:
+            score += self.policy.route_bias(tenant) * self.num_slots
+        return score
 
     def adopt(self, req: Request, on_token=None) -> Request:
         """Take over a live ``Request`` minted by ANOTHER engine (the
@@ -1659,6 +1680,14 @@ class ServingEngine:
             self.cache.reset()
             if self.draft_cache is not None:
                 self.draft_cache.reset()
+        # SLO-driven preemption (ISSUE 16): when the slot set is full and
+        # an under-attaining tenant's work is waiting, the policy may
+        # nominate victims (FIFO never does) — vacated through the same
+        # host bookkeeping as quarantine-requeue, so streams stay
+        # bit-identical and the freed slots admit below in THIS step
+        victims = self.policy.victims(now)
+        if victims:
+            self._preempt_victims(victims, now)
         self._admit(now)
         if not self._halted and any(self._active):
             self._decode()
@@ -1855,7 +1884,7 @@ class ServingEngine:
 
         selected = self.scheduler.select(
             self.cache.free_slots, self._in_flight_tokens(), fits,
-            prefill_cost=cost,
+            prefill_cost=cost, now=now,
         )
         for idx, req in enumerate(selected):  # longest-prefill-first
             self._prefill_into_slot(req, self.cache.acquire(), now)
@@ -2897,6 +2926,9 @@ class ServingEngine:
     def _emit_token(self, req: Request, tok: int, now: float,
                     first: bool = False) -> None:
         req.tokens.append(tok)
+        # fairness accounting (ISSUE 16): charge the tenant's decode-token
+        # budget — host ints the loop already owns, FIFO's hook is a no-op
+        self.policy.on_tokens(req.tenant, 1)
         if first:
             req.first_token_time = now
             self.metrics.record_first_token(req, now)
@@ -2956,6 +2988,44 @@ class ServingEngine:
             self._slot_req[slot] = None
             self._active[slot] = False
         return vacated
+
+    def _preempt_victims(self, victims: List[Request], now: float) -> None:
+        """Policy-chosen SELECTIVE preemption (ISSUE 16): vacate just the
+        nominated slots — host bookkeeping + the per-slot clear/free the
+        retirement path already uses — and requeue the victims with their
+        host-current tokens and keys. Resume re-prefills ``context_ids``
+        and continues at ``req.key``, the same contract as preempt-all and
+        quarantine-requeue, so the victim's stream is bit-identical. The
+        shared cursor is NOT rewound (other slots keep decoding); the
+        paged layout gets the victim's exclusive pages back immediately."""
+        for req in victims:
+            slot = req.slot
+            if slot is None or req.finished:
+                continue  # retired or shed since nomination — nothing held
+            req.slot = None
+            self._slot_req[slot] = None
+            self._active[slot] = False
+            self._state = self._slot_clear(self._state, np.int32(slot))
+            self.cache.free(slot)
+            if self.draft_cache is not None:
+                self.draft_cache.free(slot)
+            req.preemptions += 1
+            self.metrics.record_preemption(req)
+            self.tracer.step(
+                req.rid, "slo_preempt",
+                args={"slot": slot, "tokens": len(req.tokens),
+                      "tenant": req.tenant},
+            )
+            if self.timeline is not None:
+                self.timeline.instant(
+                    f"slo_preempt r{req.rid}", "serving",
+                    args={"slot": slot, "tenant": req.tenant},
+                )
+            if self.flight is not None:
+                self.flight.record("slo_preempt", rid=req.rid, slot=slot,
+                                   tenant=req.tenant,
+                                   tokens=len(req.tokens))
+            self.scheduler.requeue_front([req])
 
     def _preempt_all(self) -> None:
         """Out of cache columns: push every active request back to the queue

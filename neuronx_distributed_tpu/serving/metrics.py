@@ -556,6 +556,24 @@ class ServingMetrics:
             return 0
         return int(self.view.child(fam, tenant).value)
 
+    def tenant_latency(self, kind: str, tenant: str, q: float) -> float:
+        """Live read of one tenant's latency quantile off its labeled
+        histogram child (``kind`` = ``"ttft"`` | ``"tpot"`` |
+        ``"queue_wait"``): the SLO-aware scheduler's early-warning signal
+        (ISSUE 16) — the attainment tracker only classifies at finish
+        time, but a burst's damage shows here first. READ-only (0.0 for a
+        tenant that never recorded — never materializes an empty child)
+        and pure host arithmetic over bucket counts: safe on the
+        admission path, zero syncs."""
+        fam = {
+            "ttft": self._th_ttft,
+            "tpot": self._th_tpot,
+            "queue_wait": self._th_queue_wait,
+        }[kind]
+        if not self.view.has_child(fam, tenant):
+            return 0.0
+        return float(self.view.child(fam, tenant).percentile(q))
+
     def tenant_snapshot(self) -> Dict[str, dict]:
         """Per-tenant breakdown (tenant-sorted, deterministic keys):
         attribution counters + the tenant's latency percentiles off its

@@ -127,8 +127,13 @@ class ReplicaRouter:
                 degraded.append(i)
         return ok if ok else degraded
 
-    def _pick(self, prompt: np.ndarray) -> List[int]:
-        """Replica indices to try, best first."""
+    def _pick(self, prompt: np.ndarray,
+              tenant: Optional[str] = None) -> List[int]:
+        """Replica indices to try, best first. With a ``tenant`` the load
+        score grows that tenant's attainment bias (ISSUE 16): under an
+        SLO-scheduling replica set, a replica where this tenant's SLO is
+        under water sorts later, so the tenant's next request lands where
+        its SLO is healthiest. FIFO replicas bias 0.0 — order unchanged."""
         candidates = self._accepting()
         if not candidates:
             raise RejectedError(
@@ -138,7 +143,8 @@ class ReplicaRouter:
                 ),
             )
         order = sorted(
-            candidates, key=lambda i: self.replicas[i].load_score()
+            candidates,
+            key=lambda i: self.replicas[i].load_score(tenant=tenant),
         )
         if self.affinity:
             best_i, best_m = None, 0
@@ -176,7 +182,7 @@ class ReplicaRouter:
         refused."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         last_reject: Optional[RejectedError] = None
-        for rank, i in enumerate(self._pick(prompt)):
+        for rank, i in enumerate(self._pick(prompt, tenant=tenant)):
             try:
                 req = self.replicas[i].submit(
                     prompt, config, key=key, on_token=on_token,
@@ -210,7 +216,10 @@ class ReplicaRouter:
             if not targets:
                 break
             target = min(
-                targets, key=lambda i: self.replicas[i].load_score()
+                targets,
+                key=lambda i: self.replicas[i].load_score(
+                    tenant=req.tenant
+                ),
             )
             cb = dead._on_token.pop(req.rid, None)
             self.replicas[target].adopt(req, on_token=cb)

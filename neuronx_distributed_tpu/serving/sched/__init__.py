@@ -1,0 +1,70 @@
+"""SLO-aware multi-tenant scheduling subsystem (ISSUE 16).
+
+The FIFO queue behind :class:`~neuronx_distributed_tpu.serving.scheduler.
+Scheduler` is now a POLICY: ``ServingEngine(scheduling=)`` selects
+``"fifo"`` (the default — decision-for-decision the pre-policy scheduler,
+bit-identical streams), ``"slo"`` (priority tiers with starvation-free
+aging, deficit-weighted round-robin token fairness, and feedback-driven
+admission/preemption off the live SLO surfaces), or any
+:class:`SchedulingPolicy` instance.
+
+Modules:
+
+* :mod:`.policy` — the interface, the ONE selection scan + round ordering
+  every policy shares, and :class:`FifoPolicy`.
+* :mod:`.priority` — strict tiers (realtime > interactive > standard >
+  batch) with a continuous aging discount so nothing starves.
+* :mod:`.fairness` — :class:`DeficitRoundRobin` over decode token
+  budgets: one tenant's longdoc burst cannot monopolize slots.
+* :mod:`.feedback` — :class:`SloPolicy`: attainment-pressure admission
+  boost, cheapest-victim preemption (pages held x resume-prefill work),
+  and the router's per-tenant attainment bias.
+
+Everything is host-side over already-host state — zero added device→host
+syncs (graftlint GL02 lists all four modules as hot; budgets re-pinned in
+tests/serving/test_host_sync.py with the SLO policy ON).
+"""
+
+from neuronx_distributed_tpu.serving.sched.fairness import (
+    DeficitRoundRobin,
+    FairnessConfig,
+    tier_weight,
+)
+from neuronx_distributed_tpu.serving.sched.feedback import (
+    FeedbackConfig,
+    SloFeedback,
+    SloPolicy,
+    victim_cost,
+)
+from neuronx_distributed_tpu.serving.sched.policy import (
+    FifoPolicy,
+    SchedulingPolicy,
+    make_policy,
+    order_round,
+    scan_queue,
+)
+from neuronx_distributed_tpu.serving.sched.priority import (
+    TIER_RANK,
+    PriorityConfig,
+    effective_rank,
+    tier_rank,
+)
+
+__all__ = [
+    "DeficitRoundRobin",
+    "FairnessConfig",
+    "FeedbackConfig",
+    "FifoPolicy",
+    "PriorityConfig",
+    "SchedulingPolicy",
+    "SloFeedback",
+    "SloPolicy",
+    "TIER_RANK",
+    "effective_rank",
+    "make_policy",
+    "order_round",
+    "scan_queue",
+    "tier_rank",
+    "tier_weight",
+    "victim_cost",
+]

@@ -1,0 +1,113 @@
+"""Per-tenant token-rate fairness: deficit-weighted round-robin (ISSUE 16
+tentpole (b)).
+
+Slots are the engine's scarce resource, but TOKENS are what tenants
+consume them in — one longdoc tenant's burst of 400-token generations can
+monopolize every slot while a chat tenant's 12-token turns queue behind
+it, even though the chat tenant is asking for a fraction of the
+throughput. Classic deficit round-robin (Shreedhar & Varghese), adapted
+to decode token budgets:
+
+* every admission round, each tenant WITH QUEUED WORK earns
+  ``quantum_tokens x weight(tenant)`` of deficit (weights default to the
+  priority-tier ladder, so an interactive tenant earns credit faster than
+  batch);
+* every decode token a tenant's requests emit is CHARGED against its
+  deficit (the engine's ``_emit_token`` hook — host ints, zero syncs);
+* the scheduler prefers tenants with the largest deficit — the ones
+  furthest below their earned token rate.
+
+Deficits are clamped to ``[-burst_tokens, burst_tokens]``: the cap keeps
+an idle tenant from banking unbounded credit and then starving everyone
+on return (the standard DRR idle-flush, softened to a burst allowance so
+a briefly-quiet chat tenant still gets its latency-friendly head start),
+and the floor keeps one saturated tenant's debt from overflowing into
+permanent last place once its competitors drain.
+
+GL02-hot module: pure host arithmetic over dict counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional
+
+from neuronx_distributed_tpu.serving.sched.priority import tier_rank
+
+# weight per priority tier rank (0 = realtime ... 3 = batch): one tier up
+# doubles the earned token rate
+_TIER_WEIGHTS = (8.0, 4.0, 2.0, 1.0)
+
+
+def tier_weight(priority: Optional[str]) -> float:
+    return _TIER_WEIGHTS[tier_rank(priority)]
+
+
+@dataclasses.dataclass(frozen=True)
+class FairnessConfig:
+    """``quantum_tokens`` is the per-round earn rate for a weight-1.0
+    (batch) tenant — sized to a typical decode chunk so one round's credit
+    is about one slot-chunk of work. ``burst_tokens`` bounds banked credit
+    and debt."""
+
+    quantum_tokens: int = 32
+    burst_tokens: int = 512
+
+    def __post_init__(self):
+        if self.quantum_tokens < 1:
+            raise ValueError(
+                f"quantum_tokens must be >= 1, got {self.quantum_tokens}"
+            )
+        if self.burst_tokens < self.quantum_tokens:
+            raise ValueError(
+                "burst_tokens must be >= quantum_tokens "
+                f"({self.burst_tokens} < {self.quantum_tokens})"
+            )
+
+
+class DeficitRoundRobin:
+    """Per-tenant deficit counters over decode token budgets."""
+
+    def __init__(self, config: Optional[FairnessConfig] = None,
+                 weight=tier_weight):
+        self.config = config or FairnessConfig()
+        self._weight = weight
+        self._deficit: Dict[str, float] = {}
+        self.tokens_charged = 0  # lifetime accounting (snapshot only)
+
+    def replenish(self, queued: Iterable[tuple]) -> None:
+        """One admission round's credit: each ``(tenant, priority)`` pair
+        with queued work earns ``quantum x weight``. Tenants with nothing
+        queued earn nothing (credit accrues toward WAITING work, not in
+        absentia — the burst clamp would cap it anyway, but this keeps the
+        counters honest for the snapshot)."""
+        cap = float(self.config.burst_tokens)
+        for tenant, priority in queued:
+            d = self._deficit.get(tenant, 0.0)
+            d += self.config.quantum_tokens * self._weight(priority)
+            self._deficit[tenant] = min(d, cap)
+
+    def charge(self, tenant: str, tokens: int) -> None:
+        """Decode tokens consumed by ``tenant`` — spend its deficit."""
+        floor = -float(self.config.burst_tokens)
+        d = self._deficit.get(tenant, 0.0) - tokens
+        self._deficit[tenant] = max(d, floor)
+        self.tokens_charged += tokens
+
+    def deficit(self, tenant: str) -> float:
+        return self._deficit.get(tenant, 0.0)
+
+    def rank(self, tenant: str) -> float:
+        """Ordering component: NEGATIVE normalized deficit (most-starved
+        tenant sorts first). Normalized by the burst cap so the value is
+        in [-1, 1] and composes with the priority ladder on a known
+        scale."""
+        return -self.deficit(tenant) / float(self.config.burst_tokens)
+
+    def snapshot(self) -> dict:
+        return {
+            "deficits": {
+                t: round(d, 3) for t, d in sorted(self._deficit.items())
+            },
+            "tokens_charged": self.tokens_charged,
+        }

@@ -1,0 +1,72 @@
+"""Priority classes with starvation-free aging (ISSUE 16 tentpole (a)).
+
+``Request.priority`` has threaded through the stack since ISSUE 11 as an
+attribution string; this module makes it MEAN something. Four strict
+tiers (rank 0 admits first):
+
+    realtime (0) > interactive (1) > standard (2) > batch (3)
+
+Unknown strings map to ``standard`` — the tiers are a contract with the
+traffic layer's ``TenantProfile.priority``, not an enum, so a tenant label
+like ``"bulk-reindex"`` degrades gracefully instead of raising mid-admit.
+
+Strict tiers starve: one saturated interactive tenant would pin batch work
+in the queue forever. Aging fixes that with a time-derived rank DISCOUNT:
+a queued request's effective rank drops by one tier per ``aging_s``
+seconds of queue wait, so any request eventually outranks fresh top-tier
+arrivals — the classic aging ladder, continuous rather than stepped so
+two batch requests submitted 1ms apart never flap order. All host float
+arithmetic over timestamps the request already carries (GL02-hot module:
+nothing here may touch device values).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from neuronx_distributed_tpu.serving.scheduler import Request
+
+# strict tier ranks — lower admits first
+TIER_RANK = {
+    "realtime": 0,
+    "interactive": 1,
+    "standard": 2,
+    "batch": 3,
+}
+_DEFAULT_RANK = TIER_RANK["standard"]
+
+
+def tier_rank(priority: Optional[str]) -> int:
+    """Rank of a priority string; unknown labels are ``standard``."""
+    return TIER_RANK.get(priority or "standard", _DEFAULT_RANK)
+
+
+@dataclasses.dataclass(frozen=True)
+class PriorityConfig:
+    """Aging dial: ``aging_s`` seconds of queue wait promote a request by
+    one tier. The default (2s) is sized to the serving SLOs this repo
+    ships (ttft p99 bounds are 0.1–1s): batch work waits at most
+    ``3 * aging_s`` before it outranks everything, bounding its queue
+    delay without letting it flap ahead of a live interactive burst."""
+
+    aging_s: float = 2.0
+
+    def __post_init__(self):
+        if self.aging_s <= 0:
+            raise ValueError(f"aging_s must be > 0, got {self.aging_s}")
+
+
+def effective_rank(req: "Request", now: float,
+                   config: PriorityConfig) -> float:
+    """Tier rank minus the aging discount — the priority component of the
+    SLO policy's ordering key. Monotonically decreasing in queue wait, so
+    no request waits forever behind a higher tier. Preempted requests age
+    from their ORIGINAL submit time: a victim resumes with its accumulated
+    seniority intact (it was admitted once already — re-queuing must not
+    demote it behind the arrivals it beat the first time)."""
+    waited = 0.0
+    if req.submit_time is not None and now > req.submit_time:
+        waited = now - req.submit_time
+    return tier_rank(req.priority) - waited / config.aging_s

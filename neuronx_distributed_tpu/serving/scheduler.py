@@ -107,10 +107,20 @@ class Request:
 
 
 class Scheduler:
-    """FIFO + longest-prefill-first admission with a token-budget guard."""
+    """Request bookkeeping + the policy seam (ISSUE 16). Queue ORDER and
+    admission selection belong to the bound
+    :class:`~neuronx_distributed_tpu.serving.sched.SchedulingPolicy`
+    (default :class:`~neuronx_distributed_tpu.serving.sched.FifoPolicy` —
+    FIFO + longest-prefill-first with the token-budget guard, verbatim
+    the pre-policy behavior); intake, cancellation, deadline expiry, and
+    the request index stay here."""
 
-    def __init__(self, max_tokens_in_flight: Optional[int] = None):
+    def __init__(self, max_tokens_in_flight: Optional[int] = None,
+                 policy=None):
+        from neuronx_distributed_tpu.serving.sched import make_policy
+
         self.max_tokens_in_flight = max_tokens_in_flight
+        self.policy = make_policy(policy)
         self._queue: Deque[Request] = deque()
         self._requests: Dict[int, Request] = {}
         # monotone flag: set once any deadline-carrying request enters the
@@ -186,41 +196,29 @@ class Scheduler:
         in_flight_tokens: int,
         fits: Optional[Callable[[Request], bool]] = None,
         prefill_cost: Optional[Callable[[Request], int]] = None,
+        now: Optional[float] = None,
     ) -> List[Request]:
-        """Pick the FIFO prefix that fits ``free_slots``, the token budget,
-        and the engine's capacity predicate ``fits`` (checked in queue
-        order, so ``fits`` may accumulate a projected cursor). Selected
-        requests leave the queue in state PREFILL, returned
-        longest-prefill-first.
+        """Delegate one admission round to the bound policy: pick the
+        queue-order prefix that fits ``free_slots``, the token budget, and
+        the engine's capacity predicate ``fits`` (checked in queue order,
+        so ``fits`` may accumulate a projected cursor). Selected requests
+        leave the queue in state PREFILL, returned longest-prefill-first.
 
-        ``prefill_cost`` replaces the ordering key with the EFFECTIVE
-        prefill work (the prefix-cache-aware engine passes context length
-        minus reusable tokens): a long context whose prefix is cached is a
-        cheap suffix prefill, so the truly-expensive prefill still goes
-        first and overlaps the least work. Ordering only — selection,
+        The scan and ordering live in ONE place —
+        :func:`~neuronx_distributed_tpu.serving.sched.scan_queue` /
+        :func:`~neuronx_distributed_tpu.serving.sched.order_round` (they
+        used to be duplicated between this method and the engine's
+        admission path). ``prefill_cost`` replaces the ordering key with
+        the EFFECTIVE prefill work (the prefix-cache-aware engine passes
+        context length minus reusable tokens): ordering only — selection,
         capacity projection, and the cursor targets ``fits`` accumulates
-        stay in queue order, so token streams are unaffected."""
-        selected: List[Request] = []
-        budget = in_flight_tokens
-        while self._queue and len(selected) < free_slots:
-            req = self._queue[0]
-            if req.finished:  # cancelled/shed while queued — drop in place
-                self._queue.popleft()
-                continue
-            if (
-                self.max_tokens_in_flight is not None
-                and budget + req.token_footprint > self.max_tokens_in_flight
-            ):
-                break  # strict FIFO: nothing overtakes the blocked head
-            if fits is not None and not fits(req):
-                break
-            self._queue.popleft()
-            req.state = RequestState.PREFILL
-            budget += req.token_footprint
-            selected.append(req)
-        key = prefill_cost or (lambda r: len(r.context_ids))
-        selected.sort(key=key, reverse=True)
-        return selected
+        follow the policy's queue order, so FIFO token streams are
+        unaffected. ``now`` feeds time-aware policies (aging, preemption
+        cooldowns); the FIFO policy ignores it."""
+        return self.policy.select(
+            self._queue, free_slots, in_flight_tokens,
+            self.max_tokens_in_flight, fits, prefill_cost, now=now,
+        )
 
     # --- introspection ------------------------------------------------------
 

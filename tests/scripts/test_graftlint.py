@@ -358,6 +358,45 @@ def test_gl02_router_disagg_sharding_modules_are_hot(tmp_path):
     assert report.violations == []
 
 
+def test_gl02_sched_modules_are_hot(tmp_path):
+    """ISSUE 16 satellite: every scheduling-policy module runs inside the
+    admission/decode loop (the policy reorders the queue each round,
+    fairness charges each emitted token, feedback reads pressure per
+    step) — all four are hot BY PATH, so a device value leaking into any
+    policy decision trips GL02 with no marker needed."""
+    fixture = """\
+        import jax.numpy as jnp
+
+        def order_key(req, pressure):
+            return float(jnp.max(pressure)) - req.rid
+        """
+    for name in (
+        "serving/sched/policy.py",
+        "serving/sched/priority.py",
+        "serving/sched/fairness.py",
+        "serving/sched/feedback.py",
+    ):
+        assert "GL02" in rules_of(lint(tmp_path, fixture, name=name)), name
+    # an explicit device_get inside a victim-cost estimate trips too —
+    # preemption choice is HOST bookkeeping (block tables, match_len);
+    # reading device state to price a victim would sync every round
+    v = lint(tmp_path, """\
+        import jax
+
+        def victim_cost(engine, req):
+            return len(jax.device_get(engine.cache.pages(req.slot)))
+        """, name="serving/sched/feedback.py")
+    assert any("device_get" in x.message for x in v if x.rule == "GL02")
+    # ...and the shipped modules scan clean
+    targets = [
+        os.path.join(PKG, "serving", "sched", m)
+        for m in ("policy.py", "priority.py", "fairness.py", "feedback.py")
+    ]
+    assert all(os.path.exists(t) for t in targets)
+    report = runner.scan(targets, root=REPO_ROOT)
+    assert report.violations == []
+
+
 # --- GL03 recompile-hazard ----------------------------------------------------
 
 

@@ -85,6 +85,41 @@ def test_serving_demo_traffic_mode_runs():
 
 
 @pytest.mark.slow
+def test_serving_demo_slo_scheduler_runs():
+    """--scheduler slo (ISSUE 16): the A/B path replays the SAME tape
+    through a FIFO baseline and the SLO policy, returns the SLO report
+    with the baseline attached, and honors --priority overrides. Slow
+    tier like the other mode-specific demo smokes (tp/replicas/disagg);
+    tier-1 siblings: test_serving_demo_priority_override_rejects_garbage
+    plus the engine-level A/B in test_sched_engine.py."""
+    report = _load_demo().main(
+        ["--traffic", "bursty", "--tenants", "2", "--slots", "2",
+         "--traffic-duration", "3.0", "--scheduler", "slo",
+         "--priority", "tenant1-docs=standard"]
+    )
+    base = report["fifo_baseline"]
+    assert set(report["tenants"]) == {"tenant0-chat", "tenant1-docs"}
+    assert set(base["tenants"]) == set(report["tenants"])
+    # same tape both legs: identical arrival counts per tenant
+    for t in report["tenants"]:
+        assert (base["tenants"][t]["submitted"]
+                == report["tenants"][t]["submitted"])
+    s = report["slo"]
+    assert s["attained"] + s["violated"] == report["replay"]["submitted"]
+    assert report["replay"]["truncated"] is False
+
+
+def test_serving_demo_priority_override_rejects_garbage():
+    demo = _load_demo()
+    with pytest.raises(SystemExit, match="--priority"):
+        demo.main(["--traffic", "steady", "--traffic-duration", "1.0",
+                   "--priority", "nobody=realtime"])
+    with pytest.raises(SystemExit, match="--priority"):
+        demo.main(["--traffic", "steady", "--traffic-duration", "1.0",
+                   "--priority", "tenant0-chat=vip"])
+
+
+@pytest.mark.slow
 def test_serving_demo_tp_mode_runs():
     """--tp 2 (ISSUE 14): the TP-sharded engine serves the same workload
     on the CPU mesh proxy with ONE decode program; mesh state is torn

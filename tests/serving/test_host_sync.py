@@ -305,3 +305,71 @@ def test_sync_budget_unchanged_with_tenants_and_slo(setup, tmp_path):
     snap = engine.metrics.snapshot()
     assert snap["slo"]["attained"] == 1
     assert snap["tenants"]["acme"]["completed"] == 1
+
+
+def test_sync_budget_unchanged_with_slo_scheduling(setup, tmp_path):
+    """ISSUE 16 re-pin: the SLO-aware scheduling policy — priority tiers
+    with aging, DWRR fairness charging on every emitted token, and
+    attainment/histogram feedback read on every admission round — decides
+    everything over host state the loop already owns. Budgets identical
+    to the bare engine: submit=1, admission step=2, steady chunk=1, with
+    the policy, fairness accounting, and feedback all ON and a contending
+    second tenant forcing the reorder + victim-scan paths to actually
+    run."""
+    from neuronx_distributed_tpu.observability import (
+        MetricsRegistry,
+        SLOSpec,
+    )
+    from neuronx_distributed_tpu.serving.sched import (
+        FeedbackConfig,
+        SloPolicy,
+    )
+
+    cfg, model, params = setup
+    # ONE slot: the batch contender below stays queued the whole run, so
+    # every steady step exercises the full-slot victim scan and every
+    # admission round reorders a non-trivial queue
+    engine = ServingEngine(
+        model, params, num_slots=1, decode_chunk_size=4, prefix_cache=None,
+        registry=MetricsRegistry(),
+        scheduling=SloPolicy(
+            # cooldown 0 + min_decided 1: the victim-scan and feedback
+            # reads run every step instead of hiding behind their gates
+            feedback=FeedbackConfig(min_decided=1, cooldown_s=0.0),
+        ),
+        slo={
+            "acme": SLOSpec(ttft_p99_s=10.0, tpot_p99_s=1.0),
+            "bulk": SLOSpec(ttft_p99_s=10.0, tpot_p99_s=1.0),
+        },
+    )
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    with _SyncCounter() as c:
+        req = engine.submit(
+            prompt, gcfg, key=jax.random.PRNGKey(7),
+            tenant="acme", priority="interactive",
+        )
+    assert c.calls == 1, f"SLO-policy submit must stay 1 sync, saw {c.calls}"
+    # a batch-tier contender in the queue: select() now reorders, the
+    # fairness ledger replenishes/charges, and the feedback pressure reads
+    # run — none of which may touch the device
+    engine.submit(
+        np.arange(1, 9, dtype=np.int32), gcfg,
+        key=jax.random.PRNGKey(8), tenant="bulk", priority="batch",
+    )
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 2, (
+        f"SLO-policy admission must stay 2 syncs, saw {c.calls}"
+    )
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 1, (
+        f"SLO-policy steady chunk must stay 1 sync, saw {c.calls}"
+    )
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 12
+    assert engine.decode_compilations == 1
+    snap = engine.metrics.snapshot()
+    assert snap["slo"]["attained"] == 2
+    assert snap["tenants"]["acme"]["completed"] == 1

@@ -1,0 +1,219 @@
+"""Chaos coverage for the SLO scheduler (ISSUE 16 satellite): the policy's
+preemptions must COMPOSE with the robustness machinery it rides — dispatch
+recovery, replica halt/re-home, slot quarantine — without losing a token,
+duplicating an SLO classification, or recompiling the decode step.
+
+Three pins: (1) an SLO preemption victim that is ALSO hit by a dispatch
+fault mid-generation; (2) SLO preemption racing a replica halt/re-home
+through the router; (3) an SLO admission decision against a
+quarantine-shrunk slot set."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig, generate
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.observability import SLOSpec
+from neuronx_distributed_tpu.serving import (
+    FaultInjector,
+    FeedbackConfig,
+    ReplicaRouter,
+    RequestState,
+    ServingEngine,
+    SloPolicy,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+def _solo(model, params, prompt, key, gcfg):
+    toks = np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], key, gcfg)
+    )[0].tolist()
+    if gcfg.eos_token_id is not None and gcfg.eos_token_id in toks:
+        toks = toks[: toks.index(gcfg.eos_token_id) + 1]
+    return toks
+
+
+def _hot_policy():
+    """A deterministically-triggerable SLO policy: one decided sample is
+    enough pressure, no cooldown, any victim size."""
+    return SloPolicy(feedback=FeedbackConfig(
+        min_decided=1, cooldown_s=0.0, min_victim_remaining=1,
+    ))
+
+
+# chat's spec is unmeetable (any real TTFT violates) -> pressure 1.0 after
+# one finish; docs carries no spec -> always "attaining", eligible victim
+_CHAT_SLO = {"chat": SLOSpec(ttft_p99_s=1e-9, tpot_p99_s=1e6)}
+
+
+def _stage_pressured_engine(model, params, cfg, rng, *, injector=None,
+                            rid_base=0):
+    """Stage the preemption precondition on a live engine: one violated
+    chat finish (pressure), both slots full of healthy docs work. Returns
+    (engine, reqs, refs) with docs still mid-generation."""
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3,
+        scheduling=_hot_policy(), sleep_fn=lambda s: None,
+        slo=dict(_CHAT_SLO), fault_injector=injector, rid_base=rid_base,
+    )
+    chat_cfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    docs_cfg = GenerationConfig(max_new_tokens=14, temperature=0.0)
+    reqs, refs = {}, {}
+
+    def sub(name, tenant, priority, gcfg, plen):
+        prompt = rng.randint(1, cfg.vocab_size, size=plen).astype(np.int32)
+        key = jax.random.PRNGKey(1000 * (rid_base + 1) + len(reqs))
+        refs[name] = _solo(model, params, prompt, key, gcfg)
+        reqs[name] = engine.submit(
+            prompt, gcfg, key=key, tenant=tenant, priority=priority
+        )
+
+    sub("chat_a", "chat", "interactive", chat_cfg, 5)
+    while not reqs["chat_a"].finished:
+        engine.step()
+    sub("docs_a", "docs", "batch", docs_cfg, 7)
+    sub("docs_b", "docs", "batch", docs_cfg, 9)
+    engine.step()
+    assert engine.cache.free_slots == 0
+    sub_fn = sub
+    return engine, reqs, refs, sub_fn
+
+
+@pytest.mark.chaos
+def test_preemption_victim_hit_by_dispatch_fault(setup):
+    """Chaos pin 1: the SLO victim is preempted mid-chunk AND a later
+    decode dispatch fails (recovery preempts the whole slot set). Both
+    requeue paths interleave on the same requests; every stream still
+    equals solo generate() (tokens_lost == 0), one decode compilation,
+    each spec'd request classified exactly once."""
+    cfg, model, params = setup
+    rng = np.random.RandomState(8)
+    # the 4th decode dispatch fails once: by then chat_a is done (~2
+    # chunks) and the SLO preemption around chat_b's admission is in
+    # flight, so recovery's preempt-all lands on a policy-reshuffled set
+    inj = FaultInjector().fail_dispatch(at=4, times=1)
+    engine, reqs, refs, sub = _stage_pressured_engine(
+        model, params, cfg, rng, injector=inj
+    )
+    sub("chat_b", "chat", "interactive",
+        GenerationConfig(max_new_tokens=4, temperature=0.0), 4)
+    engine.run()
+
+    assert engine.policy.preemptions_requested >= 1
+    assert inj.counters["dispatch_failures"] == 1
+    assert engine.metrics.snapshot()["recoveries"] == 1
+    for name, req in reqs.items():
+        assert req.state is RequestState.DONE, f"{name} stranded"
+        assert req.tokens == refs[name], f"{name} lost tokens in the race"
+    assert engine.decode_compilations == 1
+    slo = engine.metrics.snapshot()["slo"]
+    assert slo["attained"] + slo["violated"] == 2  # chat_a, chat_b: once each
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_preemption_races_replica_halt_rehome(setup):
+    """Chaos pin 2 (slow tier — two engine builds; tier-1 siblings
+    test_preemption_victim_hit_by_dispatch_fault and the router halt
+    re-home pins in test_router.py cover each half of the race
+    separately): replica 0 halts mid-decode (unbounded dispatch
+    failures) while replica 1 is running SLO preemptions. The dead
+    replica's work re-homes into replica 1's policy-ordered queue; every
+    request from BOTH replicas completes bit-identically, no SLO
+    observation is lost or duplicated across the fleet."""
+    cfg, model, params = setup
+    rng = np.random.RandomState(9)
+    inj = FaultInjector().fail_dispatch(at=2, times=None)
+    # r0: healthy docs work that will be orphaned mid-stream by the halt
+    r0, reqs0, refs0, _ = _stage_pressured_engine(
+        model, params, cfg, rng, injector=inj, rid_base=0
+    )
+    # r1: the pressured engine where SLO preemption fires
+    r1, reqs1, refs1, sub1 = _stage_pressured_engine(
+        model, params, cfg, rng, rid_base=10_000_000
+    )
+    sub1("chat_b", "chat", "interactive",
+         GenerationConfig(max_new_tokens=4, temperature=0.0), 4)
+    router = ReplicaRouter([r0, r1])
+    router.run()
+
+    assert r0.health().value == "halted"
+    assert router.stats["rehomed_requests"] > 0
+    assert r1.policy.preemptions_requested >= 1
+    for label, reqs, refs in (("r0", reqs0, refs0), ("r1", reqs1, refs1)):
+        for name, req in reqs.items():
+            assert req.state is RequestState.DONE, f"{label}/{name} stranded"
+            assert req.tokens == refs[name], (
+                f"{label}/{name} lost tokens across the re-home"
+            )
+    # fleet-wide exactly-once: 3 chat requests spec'd (r0 staged one, r1
+    # staged two), each classified on exactly one replica's tracker —
+    # never twice, never dropped across the re-home
+    decided = 0
+    for eng in (r0, r1):
+        s = eng.metrics.snapshot()["slo"]
+        decided += s["attained"] + s["violated"]
+    assert decided == 3
+    assert r1.decode_compilations == 1
+
+
+@pytest.mark.chaos
+def test_slo_admission_against_quarantine_shrunk_slots(setup):
+    """Chaos pin 3: a poisoned readback quarantines slot 0 mid-run; the
+    SLO policy keeps making admission decisions against the shrunk slot
+    set — priority order intact, streams bit-identical, the quarantine
+    victim resumed without token loss, every spec'd request classified
+    exactly once, still one decode compilation."""
+    cfg, model, params = setup
+    rng = np.random.RandomState(10)
+    inj = FaultInjector().poison_readback(at=2, slot=0, token=-1)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=3,
+        scheduling="slo", sleep_fn=lambda s: None, fault_injector=inj,
+        slo={
+            "chat": SLOSpec(ttft_p99_s=1e6, tpot_p99_s=1e6),
+            "docs": SLOSpec(ttft_p99_s=1e6, tpot_p99_s=1e6),
+        },
+    )
+    names = ["chat_a", "docs_a", "chat_b", "docs_b", "chat_c"]
+    tenants = [n.split("_")[0] for n in names]
+    priorities = ["interactive" if t == "chat" else "batch"
+                  for t in tenants]
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(4, 11)).astype(
+            np.int32
+        )
+        for _ in names
+    ]
+    gcfgs = [GenerationConfig(max_new_tokens=5 + i % 3, temperature=0.0)
+             for i in range(len(names))]
+    keys = [jax.random.PRNGKey(300 + i) for i in range(len(names))]
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    reqs = [
+        engine.submit(p, c, key=k, tenant=t, priority=pr)
+        for p, c, k, t, pr in zip(prompts, gcfgs, keys, tenants, priorities)
+    ]
+    engine.run()
+
+    assert engine.cache.quarantined_slots == [0]
+    assert engine.metrics.snapshot()["quarantines"] == 1
+    for name, req, ref in zip(names, reqs, refs):
+        assert req.state is RequestState.DONE, f"{name} stranded"
+        assert req.tokens == ref, f"{name} diverged across the quarantine"
+    assert engine.decode_compilations == 1
+    slo = engine.metrics.snapshot()["slo"]
+    assert slo["attained"] + slo["violated"] == len(names)

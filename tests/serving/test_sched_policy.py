@@ -1,0 +1,445 @@
+"""Unit tests for the scheduling-policy subsystem (ISSUE 16): the FIFO
+policy's decision-for-decision regression against the pre-policy
+``Scheduler.select`` semantics, the priority/aging ladder, the DWRR
+fairness ledger, and the SLO policy's ordering + victim choice — all
+host-side, no model in the loop."""
+
+from collections import deque
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig
+from neuronx_distributed_tpu.serving.sched import (
+    DeficitRoundRobin,
+    FairnessConfig,
+    FeedbackConfig,
+    FifoPolicy,
+    PriorityConfig,
+    SchedulingPolicy,
+    SloPolicy,
+    effective_rank,
+    make_policy,
+    tier_rank,
+    tier_weight,
+)
+from neuronx_distributed_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+
+
+def _req(rid, plen, max_new=8, tenant="default", priority="standard",
+         submit_time=None):
+    r = Request(
+        rid=rid,
+        prompt=np.arange(1, plen + 1, dtype=np.int32),
+        config=GenerationConfig(max_new_tokens=max_new),
+        key=np.zeros((2,), np.uint32),
+        tenant=tenant,
+        priority=priority,
+    )
+    r.submit_time = submit_time
+    return r
+
+
+# --- FIFO policy: the pre-policy scheduler, verbatim ------------------------
+
+
+def _reference_select(queue, free_slots, in_flight_tokens, limit,
+                      fits=None, prefill_cost=None):
+    """The pre-ISSUE-16 ``Scheduler.select`` body, kept here as the
+    regression oracle: the FIFO policy must reproduce it decision for
+    decision on any queue."""
+    selected = []
+    budget = in_flight_tokens
+    while queue and len(selected) < free_slots:
+        req = queue[0]
+        if req.finished:
+            queue.popleft()
+            continue
+        if limit is not None and budget + req.token_footprint > limit:
+            break
+        if fits is not None and not fits(req):
+            break
+        queue.popleft()
+        req.state = RequestState.PREFILL
+        budget += req.token_footprint
+        selected.append(req)
+    key = prefill_cost or (lambda r: len(r.context_ids))
+    selected.sort(key=key, reverse=True)
+    return selected
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fifo_policy_matches_pre_policy_select(seed):
+    """Satellite (fold regression): randomized queues + budgets + fits
+    predicates through BOTH paths — the policy's one selection path and
+    the inlined pre-policy algorithm — must agree exactly (same picks,
+    same order, same leftover queue)."""
+    rng = np.random.RandomState(seed)
+    for _ in range(25):
+        n = int(rng.randint(1, 9))
+        plens = rng.randint(1, 30, size=n)
+        news = rng.randint(1, 12, size=n)
+        limit = int(rng.randint(10, 120)) if rng.rand() < 0.7 else None
+        free = int(rng.randint(1, 5))
+        cutoff = int(rng.randint(0, 40))
+
+        def mk_queue():
+            q = deque()
+            for i in range(n):
+                r = _req(i, int(plens[i]), int(news[i]))
+                if rng_state[i] < 0.15:
+                    r.state = RequestState.CANCELLED  # finished in queue
+                q.append(r)
+            return q
+
+        rng_state = rng.rand(n)
+        fits = (lambda r: len(r.prompt) <= cutoff) if rng.rand() < 0.5 else None
+        cost = (lambda r: -r.rid) if rng.rand() < 0.5 else None
+
+        qa, qb = mk_queue(), mk_queue()
+        sched = Scheduler(max_tokens_in_flight=limit)
+        sched._queue = qa
+        got = sched.select(free, 0, fits, prefill_cost=cost)
+        want = _reference_select(qb, free, 0, limit, fits, cost)
+        assert [r.rid for r in got] == [r.rid for r in want]
+        assert [r.rid for r in qa] == [r.rid for r in qb]
+        assert all(r.state is RequestState.PREFILL for r in got)
+
+
+def test_make_policy_resolution():
+    assert isinstance(make_policy(None), FifoPolicy)
+    assert isinstance(make_policy("fifo"), FifoPolicy)
+    assert isinstance(make_policy("slo"), SloPolicy)
+    p = SloPolicy()
+    assert make_policy(p) is p
+    with pytest.raises(ValueError, match="unknown scheduling policy"):
+        make_policy("lifo")
+
+
+def test_scheduler_binds_policy_and_default_is_fifo():
+    assert isinstance(Scheduler().policy, FifoPolicy)
+    sched = Scheduler(policy="slo")
+    assert isinstance(sched.policy, SloPolicy)
+
+
+# --- priority tiers + aging -------------------------------------------------
+
+
+def test_tier_ranks_and_unknown_degrades_to_standard():
+    assert tier_rank("realtime") < tier_rank("interactive")
+    assert tier_rank("interactive") < tier_rank("standard")
+    assert tier_rank("standard") < tier_rank("batch")
+    assert tier_rank("bulk-reindex") == tier_rank("standard")
+    assert tier_rank(None) == tier_rank("standard")
+
+
+def test_aging_promotes_one_tier_per_aging_s():
+    cfg = PriorityConfig(aging_s=2.0)
+    fresh_rt = _req(0, 4, priority="realtime", submit_time=100.0)
+    old_batch = _req(1, 4, priority="batch", submit_time=100.0)
+    # at submit: strict tiers
+    assert effective_rank(old_batch, 100.0, cfg) > effective_rank(
+        fresh_rt, 100.0, cfg
+    )
+    # after 3 tiers' worth of wait the batch request outranks a FRESH
+    # realtime arrival — starvation-free
+    late_rt = _req(2, 4, priority="realtime", submit_time=106.5)
+    assert effective_rank(old_batch, 106.5, cfg) < effective_rank(
+        late_rt, 106.5, cfg
+    )
+
+
+def test_priority_config_validates():
+    with pytest.raises(ValueError):
+        PriorityConfig(aging_s=0.0)
+
+
+# --- DWRR fairness ledger ---------------------------------------------------
+
+
+def test_dwrr_earn_charge_and_rank():
+    drr = DeficitRoundRobin(FairnessConfig(quantum_tokens=10,
+                                           burst_tokens=100))
+    drr.replenish([("chat", "interactive"), ("docs", "batch")])
+    # interactive earns 4x the batch rate (tier weights 4.0 vs 1.0)
+    assert drr.deficit("chat") == 40.0
+    assert drr.deficit("docs") == 10.0
+    drr.charge("chat", 60)
+    assert drr.deficit("chat") == -20.0
+    # docs is now the more entitled tenant: lower (earlier) rank
+    assert drr.rank("docs") < drr.rank("chat")
+    assert drr.tokens_charged == 60
+
+
+def test_dwrr_burst_clamps():
+    drr = DeficitRoundRobin(FairnessConfig(quantum_tokens=50,
+                                           burst_tokens=100))
+    for _ in range(10):
+        drr.replenish([("idle", "batch")])
+    assert drr.deficit("idle") == 100.0  # banked credit capped
+    drr.charge("hog", 10_000)
+    assert drr.deficit("hog") == -100.0  # debt floored
+
+
+def test_tier_weight_ladder():
+    assert tier_weight("realtime") > tier_weight("interactive")
+    assert tier_weight("interactive") > tier_weight("standard")
+    assert tier_weight("standard") > tier_weight("batch")
+
+
+def test_fairness_config_validates():
+    with pytest.raises(ValueError):
+        FairnessConfig(quantum_tokens=0)
+    with pytest.raises(ValueError):
+        FairnessConfig(quantum_tokens=64, burst_tokens=10)
+
+
+# --- SLO policy ordering ----------------------------------------------------
+
+
+class _FakeHisto:
+    def __init__(self, p99):
+        self._p99 = p99
+
+    def percentile(self, q):
+        return self._p99
+
+
+class _FakeTracker:
+    """Minimal SLOTracker stand-in: per-tenant (decided, attainment)."""
+
+    def __init__(self, stats, specs):
+        self._stats = stats
+        self._specs = specs
+
+    def spec_for(self, tenant):
+        return self._specs.get(tenant)
+
+    def decided(self, tenant):
+        return self._stats.get(tenant, (0, 1.0))[0]
+
+    def attainment(self, tenant):
+        return self._stats.get(tenant, (0, 1.0))[1]
+
+
+class _FakeMetrics:
+    def __init__(self, tracker, ttft_p99=None):
+        self.slo = tracker
+        self._ttft = ttft_p99 or {}
+
+    def tenant_latency(self, kind, tenant, q):
+        return self._ttft.get(tenant, 0.0)
+
+
+class _Spec:
+    def __init__(self, ttft_p99_s=None):
+        self.ttft_p99_s = ttft_p99_s
+
+
+class _FakeEngine:
+    """Just enough engine surface for SloPolicy.bind/victims."""
+
+    def __init__(self, metrics, slot_reqs, queued, free_slots=0,
+                 page_size=None, cache=None, prefix=None):
+        self.metrics = metrics
+        self._slot_req = slot_reqs
+        self._page_size = page_size
+        self.prefix = prefix
+        self.cache = cache or type(
+            "C", (), {"free_slots": free_slots}
+        )()
+        self.scheduler = type(
+            "S", (), {"queued_requests": queued}
+        )()
+
+
+def _slo_policy(metrics, **feedback):
+    pol = SloPolicy(feedback=FeedbackConfig(cooldown_s=0.0, **feedback))
+    eng = _FakeEngine(metrics, [], [])
+    pol.bind(eng)
+    return pol, eng
+
+
+def test_slo_select_orders_pressured_tenant_first():
+    """Two same-tier tenants, same arrival: the under-attaining one admits
+    first; with no pressure the order falls back to arrival (rid)."""
+    tracker = _FakeTracker(
+        {"hurt": (10, 0.5), "fine": (10, 1.0)},
+        {"hurt": _Spec(), "fine": _Spec()},
+    )
+    pol, _ = _slo_policy(_FakeMetrics(tracker))
+    q = deque([
+        _req(0, 4, tenant="fine", submit_time=0.0),
+        _req(1, 4, tenant="hurt", submit_time=0.0),
+    ])
+    got = pol.select(q, 2, 0, None, now=0.0)
+    assert [r.rid for r in got] == [1, 0] or [
+        r.tenant for r in got
+    ][0] == "hurt"
+
+
+def test_slo_select_priority_tiers_beat_arrival_order():
+    tracker = _FakeTracker({}, {})
+    pol, _ = _slo_policy(_FakeMetrics(tracker))
+    q = deque([
+        _req(0, 4, tenant="a", priority="batch", submit_time=0.0),
+        _req(1, 4, tenant="b", priority="interactive", submit_time=0.0),
+    ])
+    got = pol.select(q, 1, 0, None, now=0.0)
+    assert [r.rid for r in got] == [1]
+    # the batch request is still queued, not dropped
+    assert [r.rid for r in q] == [0]
+
+
+def test_slo_select_aging_unstarves_batch():
+    tracker = _FakeTracker({}, {})
+    pol, _ = _slo_policy(_FakeMetrics(tracker))
+    pol.priority = PriorityConfig(aging_s=1.0)
+    q = deque([
+        _req(0, 4, tenant="a", priority="batch", submit_time=0.0),
+        _req(1, 4, tenant="b", priority="interactive", submit_time=9.5),
+    ])
+    # 9.5s of wait >> 2 tiers of gap: the batch request goes first
+    got = pol.select(q, 2, 0, None, now=9.5)
+    assert [r.rid for r in got][0] == 0
+
+
+def test_slo_select_fairness_charges_reorder():
+    """Same tier, no SLO pressure: the tenant that burned tokens sorts
+    behind the starved one."""
+    tracker = _FakeTracker({}, {})
+    pol, _ = _slo_policy(_FakeMetrics(tracker))
+    for _ in range(4):
+        pol.fairness.replenish([("hog", "standard"), ("starved", "standard")])
+    pol.on_tokens("hog", 400)
+    q = deque([
+        _req(0, 4, tenant="hog", submit_time=0.0),
+        _req(1, 4, tenant="starved", submit_time=0.0),
+    ])
+    got = pol.select(q, 2, 0, None, now=0.0)
+    assert [r.tenant for r in got][0] == "starved"
+
+
+def test_slo_select_respects_budget_and_fits():
+    """The shared scan still guards the token budget and the capacity
+    predicate — policy order changes WHO leads, not what fits."""
+    tracker = _FakeTracker({}, {})
+    pol, _ = _slo_policy(_FakeMetrics(tracker))
+    q = deque([
+        _req(0, 20, max_new=20, tenant="a", submit_time=0.0),
+        _req(1, 2, max_new=2, tenant="a", submit_time=0.0),
+    ])
+    got = pol.select(q, 2, 0, 30, now=0.0)
+    # head (40 footprint) blocks; nothing overtakes it
+    assert got == []
+    assert len(q) == 2
+
+
+def test_live_ttft_early_warning_pressures_without_decided_samples():
+    """The histogram read fires before the tracker has classified anything
+    — one bad burst is signal."""
+    tracker = _FakeTracker({}, {"chat": _Spec(ttft_p99_s=0.1)})
+    metrics = _FakeMetrics(tracker, ttft_p99={"chat": 0.5})
+    pol, _ = _slo_policy(metrics)
+    assert pol._feedback.pressure("chat") > 0.0
+    assert pol.route_bias("chat") > 0.0
+    assert pol.route_bias("unknown") == 0.0
+    assert pol.route_bias(None) == 0.0
+
+
+# --- SLO policy victim choice ----------------------------------------------
+
+
+def _victim_setup(free_slots=0, preempt=True, remaining=10):
+    tracker = _FakeTracker(
+        {"hurt": (10, 0.2), "fine": (10, 1.0)},
+        {"hurt": _Spec(), "fine": _Spec()},
+    )
+    pol = SloPolicy(feedback=FeedbackConfig(
+        cooldown_s=0.0, preempt=preempt, min_decided=1,
+    ))
+    active = [
+        _req(0, 8, max_new=remaining, tenant="fine", submit_time=0.0),
+        _req(1, 30, max_new=remaining, tenant="fine", submit_time=0.0),
+        None,
+    ]
+    for slot, r in enumerate(active):
+        if r is not None:
+            r.slot = slot
+            r.state = RequestState.DECODE
+    queued = [_req(9, 4, tenant="hurt", submit_time=0.0)]
+    eng = _FakeEngine(_FakeMetrics(tracker), active, queued,
+                      free_slots=free_slots)
+    pol.bind(eng)
+    return pol, active
+
+
+def test_victims_picks_cheapest_healthy_tenant():
+    pol, active = _victim_setup()
+    got = pol.victims(now=1.0)
+    # rid 0's resume-prefill work (8 ctx) < rid 1's (30 ctx): cheapest wins
+    assert [r.rid for r in got] == [0]
+    assert pol.preemptions_requested == 1
+
+
+def test_victims_none_when_slots_free_or_preempt_off():
+    pol, _ = _victim_setup(free_slots=1)
+    assert pol.victims(now=1.0) == []
+    pol, _ = _victim_setup(preempt=False)
+    assert pol.victims(now=1.0) == []
+
+
+def test_victims_spares_nearly_done_requests():
+    pol, active = _victim_setup(remaining=2)  # < min_victim_remaining
+    assert pol.victims(now=1.0) == []
+
+
+def test_victims_cooldown_spaces_preemptions():
+    tracker = _FakeTracker(
+        {"hurt": (10, 0.2), "fine": (10, 1.0)},
+        {"hurt": _Spec(), "fine": _Spec()},
+    )
+    pol = SloPolicy(feedback=FeedbackConfig(cooldown_s=5.0, min_decided=1))
+    active = [_req(0, 8, max_new=10, tenant="fine", submit_time=0.0)]
+    active[0].slot = 0
+    active[0].state = RequestState.DECODE
+    eng = _FakeEngine(
+        _FakeMetrics(tracker), active,
+        [_req(9, 4, tenant="hurt", submit_time=0.0)],
+    )
+    pol.bind(eng)
+    assert len(pol.victims(now=1.0)) == 1
+    assert pol.victims(now=2.0) == []  # inside cooldown
+    assert len(pol.victims(now=7.0)) == 1
+
+
+def test_victims_never_from_pressured_tenant():
+    """The waiting tenant's own active work is not a victim candidate —
+    preempting yourself buys nothing."""
+    tracker = _FakeTracker(
+        {"hurt": (10, 0.2)}, {"hurt": _Spec()},
+    )
+    pol = SloPolicy(feedback=FeedbackConfig(cooldown_s=0.0, min_decided=1))
+    active = [_req(0, 8, max_new=10, tenant="hurt", submit_time=0.0)]
+    active[0].slot = 0
+    active[0].state = RequestState.DECODE
+    eng = _FakeEngine(
+        _FakeMetrics(tracker), active,
+        [_req(9, 4, tenant="hurt", submit_time=0.0)],
+    )
+    pol.bind(eng)
+    assert pol.victims(now=1.0) == []
+
+
+def test_policy_interface_defaults():
+    base = SchedulingPolicy()
+    assert base.victims(0.0) == []
+    assert base.route_bias("t") == 0.0
+    base.on_tokens("t", 3)  # no-op
+    assert base.snapshot() == {"policy": "base"}
+    with pytest.raises(NotImplementedError):
+        base.select(deque(), 1, 0, None)
