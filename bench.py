@@ -48,6 +48,11 @@ import subprocess
 import sys
 import time
 
+# Cold-start clock zero: captured at bench-module import, BEFORE jax import
+# (the --coldstart-leg children measure process-start → first-token, and the
+# jax import itself is part of the bill a served process pays).
+_PROC_T0 = time.perf_counter()
+
 PROBE_TIMEOUT_S = 90
 TINY_TIMEOUT_S = 300
 FULL_TIMEOUT_S = 600
@@ -65,6 +70,8 @@ SCHED_TIMEOUT_S = 540
 EFFICIENCY_TIMEOUT_S = 540
 MULTICHIP_TIMEOUT_S = 540
 GRAFTVERIFY_TIMEOUT_S = 420
+COLDSTART_TIMEOUT_S = 600
+COLDSTART_LEG_TIMEOUT_S = 150
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -129,17 +136,17 @@ def _child_setup_jax():
         jax.config.update("jax_platforms", forced)
 
     # Persistent compilation cache: a retried attempt (or a rerun in the same
-    # round) skips the 20-40 s first compile. Namespaced per host CPU — a
+    # round) skips the 20-40 s first compile. One owner for the knob
+    # (ISSUE 17): aot.enable_persistent_cache namespaces per host CPU — a
     # cache that moved hosts with the container loads foreign AOT entries
-    # that can SIGILL/abort mid-run (see utils/platform.host_cache_dir).
+    # that can SIGILL/abort mid-run — and honors NXD_TPU_PERSISTENT_CACHE=0.
     try:
-        from neuronx_distributed_tpu.utils.platform import host_cache_dir
+        from neuronx_distributed_tpu.inference import aot
 
-        cache_dir = host_cache_dir(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        aot.enable_persistent_cache(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+            min_compile_time_secs=1.0,
         )
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
     return jax
@@ -2494,6 +2501,211 @@ def child_paged() -> None:
         )
 
 
+def _coldstart_workload(jax):
+    """Shared model/workload for every --coldstart-leg process. Bigger than
+    the serving-chunk config (4 layers) so compile wall dominates the cold
+    leg and the prewarm ratio measures something real; prompts and sampling
+    keys are FIXED so streams must be bit-identical across regimes."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from neuronx_distributed_tpu.serving import ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=2048, hidden_size=256, intermediate_size=704,
+        num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=512,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        scan_layers=False,
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    rng = np.random.RandomState(7)
+    init_ids = rng.randint(1, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(1), init_ids)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=int(rng.randint(6, 14))).astype(np.int32)
+        for _ in range(4)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=10, temperature=0.7, top_k=8)
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, kv_page_size=16,
+    )
+    return engine, prompts, gcfg
+
+
+def coldstart_leg(leg: str, cache_dir: str) -> None:
+    """One cold-start process (``--coldstart-leg LEG DIR``). ``setup`` warms
+    an engine on the workload and writes the AOT cache (manifest + serialized
+    executables + the persistent XLA disk cache). The measurement legs each
+    start FRESH — ``cold`` with every cache disabled (the parent exports
+    NXD_TPU_PERSISTENT_CACHE=0), ``trace`` with ledger-driven replay prewarm
+    over the manifest (compiles land before the first request, disk-cache
+    backed), ``deser`` restoring serialized executables (no XLA at all) —
+    and report process-start → first-token wall plus the full streams."""
+    jax = _child_setup_jax()
+
+    from neuronx_distributed_tpu.inference import aot
+
+    if leg != "cold":
+        # the shared XLA disk cache lives INSIDE the leg workdir, so the
+        # cold leg (persistent cache disabled via env) cannot see it and
+        # the repo-level .jax_cache never pollutes the comparison
+        aot.enable_persistent_cache(os.path.join(cache_dir, aot.XLA_SUBDIR))
+
+    engine, prompts, gcfg = _coldstart_workload(jax)
+
+    if leg == "setup":
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            engine.submit(p, gcfg, key=jax.random.PRNGKey(i))
+        engine.run()
+        report = engine.save_aot(cache_dir)
+        _emit(
+            {
+                "metric": "coldstart_leg",
+                "leg": leg,
+                "saved": report["saved"],
+                "skipped": sorted(report["skipped"]),
+                "manifest_programs": sorted(engine.manifest().names()),
+                "wall_s": round(time.perf_counter() - t0, 3),
+            }
+        )
+        return
+
+    prewarm = None
+    if leg in ("trace", "deser"):
+        rep = engine.prewarm(
+            cache_dir=cache_dir, mode="trace" if leg == "trace" else "auto"
+        )
+        prewarm = {
+            "deserialized": len(rep["deserialized"]),
+            "compiled": len(rep["compiled"]),
+            "replayed": len(rep["replayed"]),
+            "skew": rep["skew"],
+            "skipped": sorted(rep["skipped"]),
+            "wall_s": rep["wall_s"],
+        }
+
+    req0 = engine.submit(prompts[0], gcfg, key=jax.random.PRNGKey(0))
+    guard = 0
+    while not req0.tokens and guard < 10_000:
+        engine.step()
+        guard += 1
+    first_token_s = time.perf_counter() - _PROC_T0
+    for i, p in enumerate(prompts[1:], start=1):
+        engine.submit(p, gcfg, key=jax.random.PRNGKey(i))
+    reqs = engine.run()
+    payload = {
+        "metric": "coldstart_leg",
+        "leg": leg,
+        "first_token_s": round(first_token_s, 3),
+        "e2e_s": round(time.perf_counter() - _PROC_T0, 3),
+        "decode_compilations": engine.decode_compilations,
+        "streams": [
+            [int(t) for t in reqs[rid].tokens] for rid in sorted(reqs)
+        ],
+        "prewarm": prewarm,
+    }
+    if leg == "trace":
+        # GV05 coverage over the leg that actually served traffic: every
+        # dispatched program must be named by the prewarmed manifest
+        from neuronx_distributed_tpu.scripts.graftverify import runner as gv
+
+        rep = gv.verify(
+            {"serving": engine.programs}, use_baseline=False,
+            select={"GV05"},
+            manifest=os.path.join(cache_dir, aot.MANIFEST_NAME),
+        )
+        payload["gv05_findings"] = [v.snippet for v in rep.findings]
+    _emit(payload)
+
+
+def _run_coldstart_leg(leg: str, workdir: str, env_extra=None):
+    """Spawn one --coldstart-leg process; returns (json_or_None, err)."""
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--coldstart-leg", leg, workdir],
+            capture_output=True, text=True, timeout=COLDSTART_LEG_TIMEOUT_S,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{leg} leg timed out after {COLDSTART_LEG_TIMEOUT_S}s"
+    result = _parse_result(proc.stdout)
+    if result is None:
+        tail = (proc.stderr or proc.stdout or "").strip()[-400:]
+        return None, f"{leg} leg rc={proc.returncode}, no JSON: {tail}"
+    return result, None
+
+
+def child_coldstart() -> None:
+    """Cold-start child (``--child-coldstart``, ISSUE 17): process-start →
+    first-token wall for a fresh serving process under three regimes — no
+    cache at all (cold trace+compile), ledger-driven trace prewarm backed by
+    the persistent XLA disk cache, serialized-executable deserialization —
+    against one AOT cache written by a setup leg. Every regime is its OWN
+    process (an in-process "cold start" is a contradiction); the clock
+    starts at bench-module import, before the jax import. Streams must be
+    bit-identical across regimes (``deterministic``). Merged into the BENCH
+    artifact as ``extras.serving_coldstart``."""
+    import shutil
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="nxd_coldstart_")
+    out = {
+        "metric": "serving_coldstart",
+        "unit": "process-start → first-token s",
+    }
+    try:
+        legs = {}
+        setup, err = _run_coldstart_leg("setup", workdir)
+        if setup is None:
+            _emit({**out, "error": f"setup: {err}"})
+            return
+        setup.pop("metric", None)
+        legs["setup"] = setup
+        for leg, env_extra in (
+            ("cold", {"NXD_TPU_PERSISTENT_CACHE": "0"}),
+            ("trace", None),
+            ("deser", None),
+        ):
+            r, err = _run_coldstart_leg(leg, workdir, env_extra)
+            if r is None:
+                _emit({**out, "error": err, "legs": legs})
+                return
+            r.pop("metric", None)
+            legs[leg] = r
+        cold_s = legs["cold"]["first_token_s"]
+        out["cold_first_token_s"] = cold_s
+        out["trace_first_token_s"] = legs["trace"]["first_token_s"]
+        out["deser_first_token_s"] = legs["deser"]["first_token_s"]
+        out["speedup_trace"] = round(
+            cold_s / max(legs["trace"]["first_token_s"], 1e-9), 2
+        )
+        out["speedup_deser"] = round(
+            cold_s / max(legs["deser"]["first_token_s"], 1e-9), 2
+        )
+        out["decode_compilations"] = {
+            k: legs[k]["decode_compilations"]
+            for k in ("cold", "trace", "deser")
+        }
+        out["deterministic"] = (
+            legs["cold"]["streams"] == legs["trace"]["streams"]
+            == legs["deser"]["streams"]
+        )
+        out["gv05_findings"] = legs["trace"].get("gv05_findings")
+        for k in ("cold", "trace", "deser"):
+            legs[k].pop("streams", None)
+        out["legs"] = legs
+        _emit(out)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def child_quant() -> None:
     """Quantized-serving child (``--child-quant``, ISSUE 13): fp32 vs
     int8-weights vs int8-weights+int8-KV decode throughput, HBM resident
@@ -3068,6 +3280,7 @@ def main() -> None:
     efficiency_result = None
     multichip_result = None
     graftverify_result = None
+    coldstart_result = None
 
     import signal
 
@@ -3147,6 +3360,11 @@ def main() -> None:
             graftverify_result
             if graftverify_result is not None
             else {"error": "graftverify child did not finish"}
+        )
+        extras["serving_coldstart"] = (
+            coldstart_result
+            if coldstart_result is not None
+            else {"error": "coldstart child did not finish"}
         )
         extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
@@ -3376,6 +3594,16 @@ def main() -> None:
     else:
         graftverify_result = {"error": f"graftverify child: {err}"}
 
+    # 16. Cold-start child (ISSUE 17): process-start → first-token wall,
+    #     cold trace+compile vs ledger-driven prewarm vs deserialized
+    #     executables, each regime a fresh process against one AOT cache.
+    coldstart, err = _run_child("--child-coldstart", COLDSTART_TIMEOUT_S)
+    if coldstart is not None:
+        coldstart.pop("metric", None)
+        coldstart_result = coldstart
+    else:
+        coldstart_result = {"error": f"coldstart child: {err}"}
+
     _finalize()
 
 
@@ -3410,6 +3638,11 @@ if __name__ == "__main__":
         child_multichip()
     elif "--child-graftverify" in sys.argv:
         child_graftverify()
+    elif "--coldstart-leg" in sys.argv:
+        _i = sys.argv.index("--coldstart-leg")
+        coldstart_leg(sys.argv[_i + 1], sys.argv[_i + 2])
+    elif "--child-coldstart" in sys.argv:
+        child_coldstart()
     elif "--child-efficiency" in sys.argv:
         child_efficiency()
     elif "--child" in sys.argv:

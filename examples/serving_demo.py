@@ -60,6 +60,14 @@ are bit-identical to the non-speculative engine; the summary gains
 affected chunk decodes non-speculatively (stream intact) and the draft
 cache resyncs.
 
+``--prewarm [--aot-cache DIR]`` is the AOT cold-start path (ISSUE 17):
+the first run of a cache dir serves cold and writes the AOT bundle
+(manifest + serialized executables + persistent XLA cache) at the end;
+a rerun restores every program BEFORE the first request — deserialized
+executables where the environment matches (zero compiles), trace replay
+backed by the disk cache otherwise — so the first request's TTFT carries
+no compile bill. Streams are bit-identical either way.
+
 CPU-runnable out of the box:
 
   python examples/serving_demo.py
@@ -77,6 +85,7 @@ CPU-runnable out of the box:
   python examples/serving_demo.py --traffic bursty --slo-ttft-ms 100
   python examples/serving_demo.py --draft-layers 1 --gamma 4  # speculative
   python examples/serving_demo.py --draft-layers 1 --inject-fault draft
+  python examples/serving_demo.py --prewarm --aot-cache /tmp/aot  # x2: warm
   python examples/serving_demo.py --inject-fault dispatch
   python examples/serving_demo.py --inject-fault poison --slots 4
   python examples/serving_demo.py --deadline 0.5 --inject-fault skew
@@ -252,6 +261,20 @@ def parse_args(argv=None):
                         "only)")
     p.add_argument("--prefill-workers", type=int, default=1,
                    help="prefill workers under --disaggregate")
+    p.add_argument("--prewarm", action="store_true",
+                   help="AOT cold-start path (ISSUE 17): restore-or-replay "
+                        "every program in the cache dir's manifest BEFORE "
+                        "the first request (serialized executables when "
+                        "fresh, trace replay backed by the persistent "
+                        "compile cache otherwise). The first run of a "
+                        "cache dir serves cold and writes the bundle; "
+                        "rerun to see the first request's TTFT without "
+                        "the compile bill")
+    p.add_argument("--aot-cache", default=None, metavar="DIR",
+                   help="AOT cache dir for --prewarm (manifest + "
+                        "serialized executables + persistent XLA cache); "
+                        "default: ~/.cache/nxd-tpu-aot-demo. The bundle "
+                        "is (re)written at the end of every run")
     p.add_argument("--force-cpu-devices", type=int, default=None)
     return p.parse_args(argv)
 
@@ -619,6 +642,41 @@ def main(argv=None):
         timeline=timeline,
         profile_dir=args.profile,
     )
+    aot_dir = None
+    if args.prewarm or args.aot_cache:
+        import time as _time
+
+        from neuronx_distributed_tpu.inference import aot as aot_mod
+
+        aot_dir = args.aot_cache or os.path.join(
+            os.path.expanduser("~"), ".cache", "nxd-tpu-aot-demo"
+        )
+        manifest_there = os.path.exists(
+            os.path.join(aot_dir, aot_mod.MANIFEST_NAME)
+        )
+        if args.prewarm and manifest_there:
+            t0 = _time.perf_counter()
+            rep = engine.prewarm(cache_dir=aot_dir)
+            print(
+                f"=== AOT prewarm from {aot_dir}: "
+                f"{len(rep['deserialized'])} deserialized, "
+                f"{len(rep['replayed'])} replayed "
+                f"({len(rep['compiled'])} compiled), "
+                f"{len(rep['skew'])} skew fallbacks, "
+                f"{len(rep['skipped'])} skipped in "
+                f"{_time.perf_counter() - t0:.2f}s ==="
+            )
+        else:
+            aot_mod.enable_persistent_cache(
+                os.path.join(aot_dir, aot_mod.XLA_SUBDIR)
+            )
+            if args.prewarm:
+                print(
+                    f"=== AOT prewarm: no manifest in {aot_dir} yet — "
+                    "serving cold this run; the bundle is written at the "
+                    "end, rerun --prewarm to start warm ==="
+                )
+
     frontend = engine
     if args.disaggregate:
         from neuronx_distributed_tpu.serving import DisaggregatedServer
@@ -729,6 +787,11 @@ def main(argv=None):
         snap["disagg_copy_bytes"] = engine.cache.alloc.copy_bytes
     if args.tp > 1:
         snap["tp"] = args.tp
+    if aot_dir is not None:
+        save_rep = engine.save_aot(aot_dir)
+        snap["aot_programs_saved"] = len(save_rep["saved"])
+        print(f"\nAOT bundle written to {aot_dir} "
+              f"({len(save_rep['saved'])} executables + manifest)")
     print(f"\n=== engine health: {engine.health().value} ===")
     print("=== metrics snapshot ===")
     for k, v in snap.items():

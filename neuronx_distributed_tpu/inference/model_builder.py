@@ -118,7 +118,7 @@ class ModelBuilder:
         return self
 
     def trace(self, donate_argnums: Tuple[int, ...] = (),
-              programs=None) -> NxDModel:
+              programs=None, aot_cache: Optional[str] = None) -> NxDModel:
         """AOT-compile every (key, bucket) (reference trace:189; the thread
         pool + priority-NEFF layout grafting are unnecessary — XLA compiles
         each executable with its own layout assignment).
@@ -128,21 +128,66 @@ class ModelBuilder:
         ``"{key}[{bucket}]"`` — compile wall, cost analysis AND memory
         analysis captured eagerly at zero extra compile cost (the
         ``Compiled`` is already in hand on this path), with the routed
-        calls dispatch-counted through ledger proxies."""
+        calls dispatch-counted through ledger proxies.
+
+        ``aot_cache`` (ISSUE 17) makes the trace restore-or-compile: the
+        persistent compile cache is pointed at ``aot_cache/xla``, and each
+        (key, bucket) first tries a serialized executable keyed by its
+        call signature — deserialization skips XLA entirely; a miss
+        compiles (a disk hit when the cache has seen the program) and
+        writes the artifact for the next process. Skew falls back to
+        compile, loudly, never fatally."""
+        aot = None
+        if aot_cache is not None:
+            from neuronx_distributed_tpu.inference import aot as aot_mod
+
+            aot = aot_mod
+            aot.enable_persistent_cache(os.path.join(aot_cache, aot.XLA_SUBDIR))
         model = NxDModel()
         for key, entry in self._entries.items():
             jitted = jax.jit(entry.fn, donate_argnums=donate_argnums)
             for args in entry.bucket_args:
                 size = args[entry.route_argnum].shape[entry.bucket_dim]
-                t0 = time.perf_counter()
-                lowered = jitted.lower(*args)
-                compiled = lowered.compile()
-                wall = time.perf_counter() - t0
-                logger.info("compiled %s bucket=%d", key, size)
+                name = f"{key}[{size}]"
+                compiled = lowered = None
+                if aot is not None:
+                    sig = aot.call_signature(args)
+                    try:
+                        compiled = aot.load_executable(aot_cache, name, sig)
+                    except aot.SkewError as e:
+                        logger.warning("AOT skew on %s (%s); recompiling",
+                                       name, e)
+                if compiled is not None:
+                    wall = 0.0
+                    logger.info("restored %s bucket=%d from AOT cache",
+                                key, size)
+                else:
+                    t0 = time.perf_counter()
+                    lowered = jitted.lower(*args)
+                    if aot is not None:
+                        # this executable will be serialized: bypass the
+                        # disk cache so the payload embeds its object code
+                        # (a cache-hit executable cannot cross processes —
+                        # aot.serializable_compiles)
+                        with aot.serializable_compiles():
+                            compiled = lowered.compile()
+                    else:
+                        compiled = lowered.compile()
+                    wall = time.perf_counter() - t0
+                    logger.info("compiled %s bucket=%d", key, size)
+                    if aot is not None:
+                        try:
+                            aot.save_executable(aot_cache, name, sig, compiled)
+                        except Exception as e:
+                            logger.warning(
+                                "AOT serialize failed for %s: %s", name, e
+                            )
                 call = compiled
                 if programs is not None:
-                    name = f"{key}[{size}]"
-                    programs.note_aot(name, lowered, compiled, wall)
+                    if lowered is not None:
+                        programs.note_aot(name, lowered, compiled, wall)
+                    # a restored program records NO compile — that is the
+                    # point — but its dispatches still count via the proxy
                     call = programs.wrap(name, compiled)
                 model.add_compiled(
                     key, size, call, entry.bucket_dim, entry.route_argnum,

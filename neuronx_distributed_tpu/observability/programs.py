@@ -43,6 +43,7 @@ records one float and the expensive math happens at scrape/snapshot time.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import hashlib
 import time
@@ -51,6 +52,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+import numpy as np
 
 __all__ = [
     "UNAVAILABLE",
@@ -160,6 +162,45 @@ def _abstract_leaf(x):
     return x
 
 
+def _leaf_pedigree(x) -> dict:
+    """Dispatch-key pedigree of one CONCRETE call-arg leaf, recorded at
+    compile time so an AOT replay (:mod:`..inference.aot`) can materialize
+    a dummy that lands in the SAME pjit dispatch-cache entry. The
+    ``ShapeDtypeStruct`` skeleton alone cannot: a ``np.int32`` scalar, a
+    committed jax array, and a weak-typed Python int are three DISTINCT
+    cache entries at identical shape/dtype (measured on this jax). Kinds:
+    ``jax`` (uncommitted arrays — jit outputs, ``jnp.*`` literals — all
+    share one entry), ``jax`` + ``committed`` (explicit ``device_put``;
+    ``spec`` records the partition spec when sharded), ``np`` (ndarray),
+    ``np_scalar`` (``np.generic``), ``py`` (static/weak Python scalar —
+    the recorded VALUE matters for ``static_argnums``)."""
+    if isinstance(x, jax.Array):
+        ped: Dict[str, Any] = {"kind": "jax"}
+        try:
+            if bool(getattr(x, "_committed", False)):
+                ped["committed"] = True
+                spec = getattr(getattr(x, "sharding", None), "spec", None)
+                if spec is not None and tuple(spec):
+                    ped["spec"] = [
+                        list(p) if isinstance(p, (tuple, list))
+                        else (None if p is None else str(p))
+                        for p in tuple(spec)
+                    ]
+        except Exception:
+            pass
+        try:
+            if bool(x.aval.weak_type):
+                ped["weak"] = True
+        except Exception:
+            pass
+        return ped
+    if isinstance(x, np.ndarray):
+        return {"kind": "np"}
+    if isinstance(x, np.generic):
+        return {"kind": "np_scalar"}
+    return {"kind": "py"}
+
+
 def _signature(a_args, a_kwargs) -> str:
     """Deterministic short id of an abstract call signature: a digest over
     every leaf's dtype/shape (or repr for static leaves) plus the leaf
@@ -204,12 +245,14 @@ class _Variant:
     __slots__ = (
         "sig", "pending", "abstract_call", "analyzed", "flops",
         "bytes_accessed", "donated_argnums", "memory", "cost_source",
+        "pedigree",
     )
 
     def __init__(self, sig: str, pending=None):
         self.sig = sig
         self.pending = pending  # (fn, a_args, a_kwargs) until analyzed
         self.abstract_call = pending  # survives ensure(); see lower()
+        self.pedigree = None  # per-leaf dispatch-key kinds (AOT manifest)
         self.analyzed = False
         self.flops: Any = UNAVAILABLE
         self.bytes_accessed: Any = UNAVAILABLE
@@ -336,6 +379,13 @@ class VariantInfo:
         call = self._variant.abstract_call
         return call[2] if call is not None else None
 
+    @property
+    def pedigree(self):
+        """Per-leaf dispatch-key pedigree (flatten order of
+        ``(args, kwargs)``) captured at compile time — see
+        :func:`_leaf_pedigree`. None when not captured (AOT records)."""
+        return self._variant.pedigree
+
     def lower(self):
         return self._variant.lower()
 
@@ -356,6 +406,14 @@ class ProgramInfo:
     @property
     def compiles(self) -> int:
         return self._record.compiles
+
+    @property
+    def prewarm_dispatches(self) -> int:
+        """Dispatches issued inside a :meth:`ProgramLedger.prewarming`
+        scope (AOT replay), kept OUT of ``dispatches`` so runtime-traffic
+        accounting — and graftverify GV05's "was it dispatched at
+        runtime" question — stays uncontaminated by warmup replays."""
+        return self._record.prewarm_dispatches
 
     @property
     def variants(self) -> Tuple[VariantInfo, ...]:
@@ -383,11 +441,13 @@ class _ProgramRecord:
     __slots__ = (
         "name", "dispatches", "compiles", "compile_wall_s", "variants",
         "last_wall_s", "wall_hist", "c_dispatch", "c_compiles",
+        "prewarm_dispatches",
     )
 
     def __init__(self, name: str):
         self.name = name
         self.dispatches = 0
+        self.prewarm_dispatches = 0
         self.compiles = 0
         self.compile_wall_s = 0.0
         self.variants: "OrderedDict[str, _Variant]" = OrderedDict()
@@ -448,9 +508,16 @@ class LedgeredProgram:
                     rec, self._inner, args, kwargs,
                     self._ledger._clock() - t0,
                 )
-        rec.dispatches += 1
-        if rec.c_dispatch is not None:
-            rec.c_dispatch.inc()
+        if self._ledger._prewarm_depth:
+            # AOT replay dispatches are warmup, not traffic: compiles
+            # above still count (decode_compilations semantics hold), but
+            # runtime dispatch counters — and GV05's coverage question —
+            # must not see them
+            rec.prewarm_dispatches += 1
+        else:
+            rec.dispatches += 1
+            if rec.c_dispatch is not None:
+                rec.c_dispatch.inc()
         return out
 
 
@@ -493,6 +560,7 @@ class ProgramLedger:
         self._timeline = timeline
         self.memory_analysis = memory_analysis
         self._clock = clock
+        self._prewarm_depth = 0
         self._records: "OrderedDict[str, _ProgramRecord]" = OrderedDict()
         self.peaks = device_peaks()
         name = self._name
@@ -565,6 +633,27 @@ class ProgramLedger:
             fn = fn.__wrapped__
         return LedgeredProgram(self, self._get_record(name), fn)
 
+    @contextlib.contextmanager
+    def prewarming(self):
+        """Scope marking every dispatch through this ledger's proxies as a
+        PREWARM replay: compiles still count (the ``decode_compilations``
+        contract is exactly that the replay eats them), but dispatch
+        counters route to ``prewarm_dispatches`` so runtime traffic
+        accounting stays clean. Re-entrant."""
+        self._prewarm_depth += 1
+        try:
+            yield self
+        finally:
+            self._prewarm_depth -= 1
+
+    def manifest(self):
+        """Serializable :class:`~..inference.aot.ProgramManifest` of every
+        captured program signature — the AOT prewarm input. Lazy import:
+        the ledger stays importable without the inference package."""
+        from neuronx_distributed_tpu.inference.aot import ProgramManifest
+
+        return ProgramManifest.from_ledger(self)
+
     def note_aot(self, name: str, lowered, compiled, wall_s: float) -> None:
         """Record a program the caller compiled AOT (the model builder's
         ``lower().compile()`` path): compile counted, wall recorded, and —
@@ -600,10 +689,24 @@ class ProgramLedger:
                 _abstract_leaf, (args, dict(kwargs))
             )
             sig = _signature(a_args, a_kwargs)
-            if sig not in rec.variants:
-                rec.variants[sig] = _Variant(
-                    sig, pending=(fn, a_args, a_kwargs)
-                )
+            var = rec.variants.get(sig)
+            if var is None:
+                var = _Variant(sig, pending=(fn, a_args, a_kwargs))
+                rec.variants[sig] = var
+            else:
+                # A re-compile under an EXISTING signature means a
+                # different function object now owns the program — a
+                # second engine's `per_instance` clone sharing this
+                # record, or a lazy rebuild. Refresh the captured
+                # callable so lower()/manifest() trace the LIVE program,
+                # not the first instance's retired clone.
+                var.abstract_call = (fn, a_args, a_kwargs)
+                if not var.analyzed:
+                    var.pending = (fn, a_args, a_kwargs)
+            var.pedigree = [
+                _leaf_pedigree(leaf)
+                for leaf in jax.tree_util.tree_leaves((args, dict(kwargs)))
+            ]
         except Exception:
             # signature capture is best-effort — the counts above are the
             # contract, the analysis degrades to UNAVAILABLE
@@ -744,6 +847,8 @@ class ProgramLedger:
                 else _EMPTY_MEMORY
             ),
         }
+        if rec.prewarm_dispatches:
+            entry["prewarm_dispatches"] = rec.prewarm_dispatches
         if len(rec.variants) > 1:
             entry["variant_cost"] = {
                 var.sig: {

@@ -124,6 +124,12 @@ HOT_SUFFIXES = (
     "serving/sched/priority.py",
     "serving/sched/fairness.py",
     "serving/sched/feedback.py",
+    # AOT serving (ISSUE 17): prewarm replays dispatch THROUGH the live
+    # ledger proxies with manufactured dummy arguments, and the AOTProgram
+    # shim wraps every dispatch of a deserialized program for the life of
+    # the engine — an implicit coercion in either would add a per-dispatch
+    # host sync to every program the prewarm touched
+    "inference/aot.py",
 )
 HOT_MARKER = "graftlint: hot-path"
 

@@ -33,13 +33,13 @@ def _build_parser() -> argparse.ArgumentParser:
         description=(
             "IR-level verification of the ledgered hot programs: donation "
             "aliasing, transfer census, the collective wire-byte ratchet "
-            "and dispatch-key stability (checks GV01-GV04; see "
-            "--explain RULE)."
+            "dispatch-key stability and AOT manifest coverage (checks "
+            "GV01-GV05; see --explain RULE)."
         ),
     )
     p.add_argument(
         "--explain", metavar="RULE",
-        help="print the catalog entry for RULE (GV01-GV04) and exit",
+        help="print the catalog entry for RULE (GV01-GV05) and exit",
     )
     p.add_argument(
         "--select", metavar="RULES",
@@ -82,6 +82,21 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--json", action="store_true",
         help="emit the stats + collective tables as one JSON object",
+    )
+    p.add_argument(
+        "--manifest", metavar="PATH",
+        help=(
+            "AOT manifest (file or cache dir) to check GV05 coverage "
+            "against: every program the workload dispatches must be in it, "
+            "and it must name no program the workload doesn't know"
+        ),
+    )
+    p.add_argument(
+        "--write-manifest", metavar="PATH",
+        help=(
+            "after driving the workload, save its ledger's AOT manifest "
+            "to PATH (a dir gets manifest.json inside) for prewarm/GV05"
+        ),
     )
     return p
 
@@ -183,9 +198,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "" if args.tp_comms == "off" else f"+{args.tp_comms}"
     )
     ledgers = _build_ledgers(args.tp, args.tp_comms)
+
+    if args.write_manifest:
+        saved = ledgers["serving"].manifest().save(args.write_manifest)
+        print(f"graftverify: wrote AOT manifest to {saved}")
+
     report = runner_mod.verify(
         ledgers, root=root, baseline_path=baseline_path, select=select,
         use_baseline=not args.no_baseline, scope=scope,
+        manifest=args.manifest,
     )
 
     if args.write_baseline:

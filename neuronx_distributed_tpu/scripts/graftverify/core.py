@@ -20,6 +20,7 @@ GV01 = "GV01"
 GV02 = "GV02"
 GV03 = "GV03"
 GV04 = "GV04"
+GV05 = "GV05"
 
 TITLES: Dict[str, str] = {
     "GV00": "verification hygiene",
@@ -27,6 +28,7 @@ TITLES: Dict[str, str] = {
     GV02: "transfer census",
     GV03: "collective wire-byte ratchet",
     GV04: "dispatch-key stability",
+    GV05: "manifest coverage (AOT)",
 }
 
 EXPLAINS: Dict[str, str] = {
@@ -108,6 +110,23 @@ mismatches. The ledger already holds both counts; graftverify cross-checks
 them per program. ``compiles > variants`` fails; an intentional rebuild
 (an engine's lazy plain-chunk fallback after a spec failure) gets a
 waiver with its reason.
+""",
+    GV05: """\
+GV05 manifest-coverage (AOT)
+
+The AOT prewarm contract (inference/aot.py, ISSUE 17) is only as good as
+its manifest: a hot program the ledger saw DISPATCHED at runtime but the
+prewarmed manifest never named pays its compile inside the first
+request's TTFT — exactly the cold-start bill prewarm exists to remove.
+The inverse is debt too: a manifest entry naming a program the ledger
+does not know is stale (a renamed program, a removed code path) and will
+silently skip forever.
+
+Check (runs only when ``verify(..., manifest=...)`` is given): every
+audited program with ``dispatches > 0`` (runtime traffic — prewarm
+replays are counted separately and do NOT satisfy coverage) must appear
+in the manifest; every manifest program must be known to some ledger.
+Prewarmed-but-unused programs are fine in both directions.
 """,
 }
 

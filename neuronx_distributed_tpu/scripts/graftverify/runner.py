@@ -295,6 +295,48 @@ def _check_findings(audit: ProgramAudit) -> List[Violation]:
     return out
 
 
+def _manifest_findings(audits: List[ProgramAudit], manifest
+                       ) -> List[Violation]:
+    """GV05: every program runtime traffic dispatched must appear in the
+    prewarmed manifest; every manifest entry must name a program some
+    ledger knows. ``dispatches`` excludes prewarm replays by construction
+    (the ledger routes those to ``prewarm_dispatches``), so a replay can
+    never fake coverage."""
+    names = (
+        set(manifest.names()) if hasattr(manifest, "names")
+        else set(manifest)
+    )
+    out: List[Violation] = []
+    known = set()
+    for a in audits:
+        known.add(a.name)
+        if a.dispatches > 0 and a.name not in names:
+            out.append(finding(
+                "GV05", a.ledger, a.name,
+                snippet=f"{a.name}:missing-from-manifest",
+                message=(
+                    f"program dispatched {a.dispatches}x at runtime but "
+                    "absent from the prewarm manifest — its compile lands "
+                    "inside the first request's TTFT on every cold start; "
+                    "regenerate the manifest from a run that exercises "
+                    "this path (ledger.manifest()) or waive with the "
+                    "reason"
+                ),
+            ))
+    for name in sorted(names - known):
+        out.append(finding(
+            "GV05", "manifest", name,
+            snippet=f"{name}:stale-manifest-entry",
+            message=(
+                "manifest names a program no audited ledger knows — a "
+                "stale entry (renamed program, removed code path) that "
+                "prewarm will silently skip forever; regenerate the "
+                "manifest or waive with the reason"
+            ),
+        ))
+    return out
+
+
 def _apply_waivers(
     findings: List[Violation],
     waivers: Optional[Mapping[str, Mapping[str, str]]],
@@ -338,6 +380,7 @@ def verify(
     use_baseline: bool = True,
     waivers: Optional[Mapping[str, Mapping[str, str]]] = None,
     scope: str = "tp1",
+    manifest=None,
 ) -> VerifyReport:
     """Run every IR check over every program of ``ledgers`` (a
     ProgramLedger or ``{name: ProgramLedger}``), then ratchet against the
@@ -348,7 +391,12 @@ def verify(
     ``tp2+quant``): one shared baseline file holds every configuration's
     pinned tables side by side, and a run only diffs against — and
     :func:`write_baseline` only refreshes — the entries of ITS scope, so
-    pinning the tp=2 byte table can never turn the tp=1 CI run stale."""
+    pinning the tp=2 byte table can never turn the tp=1 CI run stale.
+
+    ``manifest`` (a :class:`~...inference.aot.ProgramManifest`, a path to
+    one, or a bare set of program names) arms GV05: runtime-dispatched
+    programs must be covered by it, and it must carry no stale names.
+    Without a manifest GV05 does not run."""
     audits: List[ProgramAudit] = []
     for key, ledger in _normalize_ledgers(ledgers).items():
         for info in ledger.programs().values():
@@ -356,6 +404,15 @@ def verify(
     findings: List[Violation] = []
     for audit in audits:
         for f in _check_findings(audit):
+            if select is not None and f.rule not in select:
+                continue
+            findings.append(f)
+    if manifest is not None:
+        if isinstance(manifest, (str, os.PathLike)):
+            from neuronx_distributed_tpu.inference.aot import ProgramManifest
+
+            manifest = ProgramManifest.load(os.fspath(manifest))
+        for f in _manifest_findings(audits, manifest):
             if select is not None and f.rule not in select:
                 continue
             findings.append(f)

@@ -211,9 +211,11 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
+import re
 import time
 import weakref
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -1653,6 +1655,187 @@ class ServingEngine:
             int(fn._cache_size())
             for fn in (self._extract_fn, self._seed_fn, self._fingerprint_fn)
         ) + getattr(self.cache, "seed_compilations", 0)
+
+    # --- AOT serving (inference/aot.py) ----------------------------------
+
+    def manifest(self):
+        """:class:`~..inference.aot.ProgramManifest` of every program this
+        engine has compiled so far — the prewarm input for the NEXT
+        process (persist it next to the checkpoint via ``.save(dir)``)."""
+        return self.programs.manifest()
+
+    # manifest program name → engine attribute, for resolve and install
+    _AOT_FIXED = {
+        "decode_chunk": "_decode_chunk",
+        "spec_decode_chunk": "_spec_chunk",
+        "slot_write": "_slot_write",
+        "slot_clear": "_slot_clear",
+        "first_token": "_first_token",
+        "suffix_prefill": "_suffix_fn",
+        "prefix_extract": "_extract_fn",
+        "prefix_seed": "_seed_fn",
+        "prefix_fingerprint": "_fingerprint_fn",
+    }
+    # cache-manager program stem → manager attribute (Slot + Paged)
+    _AOT_CACHE = {
+        "cache_admit": "_admit_fn", "cache_free": "_free_fn",
+        "cache_reset": "_reset_fn",
+        "paged_admit": "_admit_fn", "paged_seed": "_seed_fn",
+        "paged_free": "_free_fn", "paged_reset": "_reset_fn",
+        "paged_stage": "_stage_fn", "paged_map": "_map_fn",
+        "paged_import": "_import_fn",
+    }
+
+    def _aot_cache_site(self, name: str):
+        """(manager, attr) for a cache-manager program name, else None."""
+        mgr, stem = self.cache, name
+        if name.startswith("draft_"):
+            mgr, stem = self.draft_cache, name[len("draft_"):]
+        attr = self._AOT_CACHE.get(stem)
+        if mgr is None or attr is None or not hasattr(mgr, attr):
+            return None
+        return mgr, attr
+
+    def _aot_resolve(self, name: str):
+        """Live ledger proxy for a manifest program name — building lazy
+        per-bucket programs on demand. None when this engine cannot host
+        the program (e.g. a draft program on a non-speculative engine)."""
+        attr = self._AOT_FIXED.get(name)
+        if attr is not None:
+            fn = getattr(self, attr, None)
+            if fn is None and name == "decode_chunk" and self._spec_chunk is not None:
+                # speculative engine: the plain-chunk fallback is built
+                # lazily — a manifest that saw it means prewarm should too
+                fn = self._nonspec_chunk()
+            return fn
+        m = re.fullmatch(r"(draft_)?prefill\[(\d+)\]", name)
+        if m is not None:
+            try:
+                if m.group(1):
+                    return self._draft_prefill_fn(int(m.group(2)))
+                return self._prefill_fn(int(m.group(2)))
+            except Exception:
+                return None
+        site = self._aot_cache_site(name)
+        if site is not None:
+            return getattr(site[0], site[1])
+        return None
+
+    def _aot_install(self, name: str, shim) -> bool:
+        """Install a deserialized-executable shim at the program's
+        dispatch site, re-wrapped by the ledger so counting survives."""
+        wrapped = self.programs.wrap(name, shim)
+        attr = self._AOT_FIXED.get(name)
+        if attr is not None:
+            setattr(self, attr, wrapped)
+            return True
+        m = re.fullmatch(r"(draft_)?prefill\[(\d+)\]", name)
+        if m is not None:
+            fns = (
+                self._draft_prefill_fns if m.group(1) else self._prefill_fns
+            )
+            fns[int(m.group(2))] = wrapped
+            return True
+        site = self._aot_cache_site(name)
+        if site is not None:
+            setattr(site[0], site[1], wrapped)
+            return True
+        return False
+
+    def prewarm(self, manifest=None, cache_dir: Optional[str] = None,
+                mode: str = "auto") -> dict:
+        """Restore or compile the full program set BEFORE the first
+        request — bucket prefills, decode/spec chunks, slot write/clear,
+        paged admit/seed/stage/map — so the first request's TTFT contains
+        zero compiles and ``decode_compilations`` stays 1 (or 0 when the
+        decode chunk deserialized). ``manifest`` is a
+        :class:`~..inference.aot.ProgramManifest` or a path; with
+        ``cache_dir`` alone the manifest is read from
+        ``cache_dir/manifest.json``, serialized executables from
+        ``cache_dir/*.aotx``, and the persistent compile cache is pointed
+        at ``cache_dir/xla``. ``mode="trace"`` skips executable artifacts
+        (pure replay prewarm). Fail-soft throughout: skew, unportable, or
+        unresolvable entries degrade to the next rung with a flight
+        event; returns the per-program report."""
+        from neuronx_distributed_tpu.inference import aot
+
+        if cache_dir is not None:
+            aot.enable_persistent_cache(os.path.join(cache_dir, aot.XLA_SUBDIR))
+        if manifest is None:
+            if cache_dir is None:
+                raise ValueError("prewarm needs a manifest or a cache_dir")
+            manifest = aot.ProgramManifest.load(cache_dir)
+        elif isinstance(manifest, (str, os.PathLike)):
+            manifest = aot.ProgramManifest.load(os.fspath(manifest))
+        report = aot.prewarm_programs(
+            manifest,
+            self._aot_resolve,
+            ledger=self.programs,
+            artifact_dir=cache_dir,
+            install=self._aot_install,
+            mode=mode,
+            flight=self.flight,
+        )
+        return report
+
+    def save_aot(self, cache_dir: str) -> dict:
+        """Persist this engine's full AOT bundle into ``cache_dir``:
+        ``manifest.json``, one serialized executable per captured program
+        signature, and the persistent compile cache under ``xla/`` (so a
+        later trace-level prewarm against this dir is all disk hits). The
+        per-program ``lower().compile()`` each serialization needs runs
+        with the disk cache BYPASSED — a cache-loaded executable
+        serializes without its object code and cannot cross a process
+        boundary (aot.serializable_compiles). Per-program failures are
+        skipped and reported, never raised."""
+        from neuronx_distributed_tpu.inference import aot
+
+        os.makedirs(cache_dir, exist_ok=True)
+        aot.enable_persistent_cache(os.path.join(cache_dir, aot.XLA_SUBDIR))
+        manifest = self.manifest()
+        # merge-don't-clobber: a PREWARMED engine's ledger has no captured
+        # signatures for deserialized programs (they never compiled here),
+        # so a blind overwrite would erase the very entries the next
+        # process needs; keep prior entries for programs this run can't
+        # re-describe
+        try:
+            prior = aot.ProgramManifest.load(cache_dir)
+            for pname, entries in prior.programs.items():
+                manifest.programs.setdefault(pname, entries)
+        except Exception:
+            pass
+        manifest.save(cache_dir)
+        report: Dict[str, Any] = {"saved": [], "skipped": {}}
+        for name, info in self.programs.programs().items():
+            for var in info.variants:
+                key = (
+                    f"{name}@{var.signature}"
+                    if len(info.variants) > 1 else name
+                )
+                try:
+                    lowered = var.lower()
+                    if lowered is None:
+                        report["skipped"][key] = "signature not captured"
+                        continue
+                    # bypass the disk cache for THIS compile: a cache-hit
+                    # executable serializes without object code and fails
+                    # cross-process (see aot.serializable_compiles)
+                    with aot.serializable_compiles():
+                        compiled = lowered.compile()
+                    aot.save_executable(
+                        cache_dir, name, var.signature, compiled
+                    )
+                    report["saved"].append(key)
+                except Exception as e:
+                    report["skipped"][key] = (
+                        f"{type(e).__name__}: {e}"[:200]
+                    )
+        if self.flight is not None:
+            self.flight.record(
+                "aot_save", dir=cache_dir,
+                saved=len(report["saved"]), skipped=len(report["skipped"]),
+            )
+        return report
 
     def step(self) -> bool:
         """One engine iteration: reap cancellations → shed expired deadlines

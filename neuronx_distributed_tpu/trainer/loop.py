@@ -355,6 +355,62 @@ class Trainer:
     train_seconds: float = 0.0
     halt_reason: Optional[str] = None
     preempted: bool = False
+    # AOT prewarm manifest recorded by prewarm() BEFORE fit() built the
+    # step — consumed right after the train step exists (ISSUE 17)
+    _aot_pending: Optional[Any] = dataclasses.field(default=None, repr=False)
+
+    # --- AOT serving (inference/aot.py, ISSUE 17) ---------------------------
+
+    def manifest(self):
+        """AOT :class:`~..inference.aot.ProgramManifest` of this trainer's
+        compiled programs (train/eval step) — available once fit() has
+        run; persist it next to the checkpoints for the next process."""
+        if getattr(self, "programs", None) is None:
+            raise RuntimeError("manifest() needs a fitted Trainer")
+        return self.programs.manifest()
+
+    def prewarm(self, manifest=None, cache_dir: Optional[str] = None) -> dict:
+        """Trainer equivalent of ``ServingEngine.prewarm``: point the
+        persistent compile cache at ``cache_dir`` (the next compile of a
+        known step becomes a disk hit) and, given a manifest (object,
+        path, or ``cache_dir/manifest.json``), replay the train/eval-step
+        entries with pedigree-faithful dummies so the first real step's
+        wall contains zero compiles. The step is built inside fit(), so a
+        pre-fit prewarm defers the replay until fit() has built it —
+        still BEFORE the first batch dispatches."""
+        import os as _os
+
+        from neuronx_distributed_tpu.inference import aot
+
+        if cache_dir is not None:
+            aot.enable_persistent_cache(
+                _os.path.join(cache_dir, aot.XLA_SUBDIR)
+            )
+            if manifest is None:
+                p = _os.path.join(cache_dir, aot.MANIFEST_NAME)
+                if _os.path.exists(p):
+                    manifest = aot.ProgramManifest.load(p)
+        if isinstance(manifest, (str, _os.PathLike)):
+            manifest = aot.ProgramManifest.load(_os.fspath(manifest))
+        if manifest is None:
+            return {"deferred": False, "replayed": [], "skipped": {}}
+        if getattr(self, "_train_step", None) is not None:
+            return self._replay_aot_manifest(manifest)
+        self._aot_pending = manifest
+        return {"deferred": True}
+
+    def _replay_aot_manifest(self, manifest) -> dict:
+        from neuronx_distributed_tpu.inference import aot
+
+        live = {
+            "train_step": getattr(self, "_train_step", None),
+            "eval_step": getattr(self, "_eval_step", None),
+        }
+        return aot.prewarm_programs(
+            manifest, lambda name: live.get(name),
+            ledger=self.programs, mode="trace",
+            flight=getattr(self, "_flight", None),
+        )
 
     # --- health -------------------------------------------------------------
 
@@ -910,6 +966,12 @@ class Trainer:
         # exposed for the compile-budget guard (one program must serve clean
         # AND anomalous batches — tests/trainer/test_faults.py)
         self._train_step = train_step
+        if self._aot_pending is not None:
+            # deferred AOT prewarm (ISSUE 17): the step now exists — eat
+            # the compile (a disk hit under the persistent cache) BEFORE
+            # the first batch dispatches
+            pending, self._aot_pending = self._aot_pending, None
+            self._replay_aot_manifest(pending)
         # HBM ledger (ISSUE 12): the trainer's static residents as weakref
         # closures over the live TrainState — params, optimizer state, the
         # anomaly-guard carry — reconciled against device limits

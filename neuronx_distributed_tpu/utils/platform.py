@@ -29,26 +29,19 @@ def force_cpu_devices(n_devices: int) -> None:
         pass  # backend already initialized; caller sees whatever platform is up
 
 
-def host_cache_dir(base_dir: str) -> str:
-    """Persistent-compile-cache directory namespaced by a host-CPU
-    fingerprint.
-
-    XLA:CPU AOT cache entries embed the COMPILE machine's CPU features;
-    loading one on a host missing those features only logs a warning
-    (cpu_aot_loader.cc: "could lead to execution errors such as SIGILL")
-    before executing — observed as nondeterministic mid-run SIGABRTs when a
-    shared cache survived a host change between build rounds. Namespacing by
-    the feature set makes a moved cache cold instead of lethal."""
+def host_fingerprint() -> str:
+    """Short digest of everything that changes XLA's CPU target features
+    — the namespace key for :func:`host_cache_dir` and the skew fence in
+    AOT executable headers (inference/aot.py). cpuinfo flags alone are
+    NOT enough: XLA adds tuning features like +prefer-no-gather/
+    +prefer-no-scatter based on microcode-level erratum detection (Intel
+    GDS/downfall), so two hosts with identical flag lists can still
+    produce incompatible AOT entries (observed round 5: "Target machine
+    feature +prefer-no-scatter is not supported on the host machine"
+    served from a same-fingerprint cache). Fold in the microcode
+    revision, model, and kernel release."""
     import hashlib
 
-    # The fingerprint must cover everything that changes XLA's target
-    # features. cpuinfo flags alone are NOT enough: XLA adds tuning features
-    # like +prefer-no-gather/+prefer-no-scatter based on microcode-level
-    # erratum detection (Intel GDS/downfall), so two hosts with identical
-    # flag lists can still produce incompatible AOT entries (observed round
-    # 5: "Target machine feature +prefer-no-scatter is not supported on the
-    # host machine" served from a same-fingerprint cache). Fold in the
-    # microcode revision, model, and kernel release.
     parts = []
     try:
         with open("/proc/cpuinfo") as f:
@@ -65,12 +58,24 @@ def host_cache_dir(base_dir: str) -> str:
         parts.append(os.uname().release)
     except Exception:
         pass
-    fp = (
+    return (
         hashlib.sha256("|".join(parts).encode()).hexdigest()[:10]
         if parts
         else "noinfo"
     )
-    path = os.path.join(base_dir, f"host-{fp}")
+
+
+def host_cache_dir(base_dir: str) -> str:
+    """Persistent-compile-cache directory namespaced by a host-CPU
+    fingerprint.
+
+    XLA:CPU AOT cache entries embed the COMPILE machine's CPU features;
+    loading one on a host missing those features only logs a warning
+    (cpu_aot_loader.cc: "could lead to execution errors such as SIGILL")
+    before executing — observed as nondeterministic mid-run SIGABRTs when a
+    shared cache survived a host change between build rounds. Namespacing by
+    the feature set makes a moved cache cold instead of lethal."""
+    path = os.path.join(base_dir, f"host-{host_fingerprint()}")
     os.makedirs(path, exist_ok=True)
     # Prune only what is provably dead (ADVICE r4: an unconditional prune on
     # a cache volume shared by hosts with different CPU features evicted

@@ -22,22 +22,21 @@ jax.config.update("jax_platforms", "cpu")
 # compiles of near-identical tiny programs; cached reruns (CI, local loops,
 # the judge's verification run) skip them entirely.
 #
-# The cache dir is NAMESPACED BY HOST-CPU FINGERPRINT: XLA:CPU AOT results
-# embed the compile machine's CPU features, and loading an entry compiled on
-# a different host only WARNS (cpu_aot_loader.cc "could lead to execution
-# errors such as SIGILL") before executing potentially-illegal instructions —
-# observed as mid-suite SIGABRTs when this container moved hosts between
-# rounds with a shared cache.
-from neuronx_distributed_tpu.utils.platform import host_cache_dir  # noqa: E402
+# One owner for the knob (ISSUE 17): aot.enable_persistent_cache namespaces
+# the dir by host-CPU fingerprint (XLA:CPU AOT results embed the compile
+# machine's CPU features; a shared cache across hosts SIGABRTs mid-suite)
+# and honors the NXD_TPU_PERSISTENT_CACHE=0 opt-out. The 0.5s floor is
+# MEASURED, not arbitrary: disk round-tripping a sub-0.5s program costs
+# more than its compile (floor 0.0 ran tests/serving/test_spec_decode.py
+# at 89s warm vs 50s at 0.5 vs 175s uncached — the win is entirely the
+# big programs, the tiny ones are pure overhead).
+from neuronx_distributed_tpu.inference import aot as _aot  # noqa: E402
 
 try:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        host_cache_dir(
-            os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache")
-        ),
+    _aot.enable_persistent_cache(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+        min_compile_time_secs=0.5,
     )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 except Exception:
     pass  # unwritable checkout: run without the persistent cache
 

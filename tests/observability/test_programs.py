@@ -507,3 +507,51 @@ def test_programs_enumeration_zero_compiles_and_syncs(monkeypatch):
         assert low is not None
     assert calls["n"] == 0
     assert led.record("mm").compiles == 1
+
+
+# --- AOT capture (ISSUE 17): pedigree, prewarm routing, manifest --------------
+
+
+def test_pedigree_captured_per_leaf_at_compile():
+    """Each compile records the CONCRETE call's per-leaf dispatch-key
+    pedigree (np vs jax vs static) in flatten order — the manifest codec
+    zips against it so an AOT replay lands in the same dispatch entry."""
+    led = ProgramLedger()
+    f = led.wrap("mix", jax.jit(lambda x, y: x + y))
+    f(np.ones((4,), np.float32), jnp.ones((4,)))
+    (var,) = led.programs()["mix"].variants
+    assert var.pedigree == [{"kind": "np"}, {"kind": "jax"}]
+
+
+def test_prewarming_scope_routes_dispatch_accounting():
+    """Inside prewarming(): compiles count (the replay EATS them — the
+    decode_compilations contract), dispatches route to
+    prewarm_dispatches so runtime traffic accounting stays clean (and
+    GV05 coverage cannot be faked by a replay)."""
+    led = ProgramLedger()
+    f = led.wrap("pw", jax.jit(lambda x: x * 2))
+    with led.prewarming():
+        f(jnp.zeros(3))
+    info = led.programs()["pw"]
+    assert info.dispatches == 0 and info.prewarm_dispatches == 1
+    assert info.compiles == 1
+    f(jnp.zeros(3))
+    info = led.programs()["pw"]
+    assert info.dispatches == 1 and info.prewarm_dispatches == 1
+    assert info.compiles == 1  # the real dispatch was a pure cache hit
+
+
+def test_ledger_manifest_entries_replay():
+    """ledger.manifest() emits a portable entry per captured variant;
+    materialize_call rebuilds dummies with the recorded shapes."""
+    from neuronx_distributed_tpu.inference.aot import materialize_call
+
+    led = ProgramLedger()
+    f = led.wrap("m", jax.jit(lambda x: x + 1))
+    f(jnp.zeros((2, 2)))
+    m = led.manifest()
+    (entry,) = m.entries("m")
+    assert entry["portable"] and entry["signature"]
+    args, kwargs = materialize_call(entry["call"])
+    assert not kwargs and args[0].shape == (2, 2)
+    assert str(args[0].dtype) == "float32"

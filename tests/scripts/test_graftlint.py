@@ -397,6 +397,35 @@ def test_gl02_sched_modules_are_hot(tmp_path):
     assert report.violations == []
 
 
+def test_gl02_aot_module_is_hot_by_path(tmp_path):
+    """ISSUE 17 satellite: the AOT prewarm module is on the GL02 hot-path
+    list BY PATH — its replay dispatches run through the live ledger
+    proxies and its AOTProgram shim wraps every dispatch of a deserialized
+    program for the life of the engine, so an implicit coercion smuggled
+    into a future edit trips with no marker needed — and the shipped
+    module scans clean."""
+    fixture = """\
+        import jax.numpy as jnp
+
+        def replay_ok(report, out):
+            return float(jnp.sum(out)) if report else 0.0
+        """
+    assert "GL02" in rules_of(lint(tmp_path, fixture, name="inference/aot.py"))
+    # an undocumented explicit device_get in the shim's dispatch path
+    # trips too — the shim must forward device values untouched
+    v = lint(tmp_path, """\
+        import jax
+
+        def dispatch(shim, args):
+            return shim.compiled(*jax.device_get(args))
+        """, name="inference/aot.py")
+    assert any("device_get" in x.message for x in v if x.rule == "GL02")
+    shipped = os.path.join(PKG, "inference", "aot.py")
+    assert os.path.exists(shipped)
+    report = runner.scan([shipped], root=REPO_ROOT)
+    assert report.violations == []
+
+
 # --- GL03 recompile-hazard ----------------------------------------------------
 
 

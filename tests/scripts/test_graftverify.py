@@ -417,6 +417,10 @@ def _assert_routed_table(table, tp):
     assert table["by_kind"]["all_reduce"]["ops"] == _ROUTED_OPS + 1
 
 
+@pytest.mark.slow  # the tp=2 mesh compile bill (tier-1 budget, PR 5/13
+# lean-core policy): the exact byte/donation models stay tier-1 via
+# test_collective_table_ring_model and test_clean_donation_aliases_and_counts;
+# tp streams via test_multichip.py
 def test_tp2_engine_donations_tables_and_static_ratio(comms_model):
     """THE tp=2 acceptance pin, on one real paged engine pair:
 
@@ -480,8 +484,8 @@ def test_tp2_engine_donations_tables_and_static_ratio(comms_model):
 
 
 @pytest.mark.slow  # the tp=4 mesh compile bill — the test_multichip
-# precedent: the tp=2 leg above is the tier-1 acceptance core, tp=4 runs
-# in the full (slow-inclusive) suite
+# precedent: the exact byte/donation models stay tier-1 via the unit
+# tests above; both tp engine legs run in the full (slow-inclusive) suite
 def test_tp4_engine_byte_table_and_static_ratio(comms_model):
     """The tp=4 leg: one exact engine pins the per-decode-chunk
     all-reduce byte table from the IR; the quantized side of the >= 3.9x
@@ -503,6 +507,10 @@ def test_tp4_engine_byte_table_and_static_ratio(comms_model):
     assert ratio >= 3.9, f"static EQuARX ratio {ratio:.3f} < 3.9 at tp=4"
 
 
+@pytest.mark.slow  # heavy spec-engine verify run (tier-1 budget,
+# PR 5/13 lean-core policy): donation aliasing stays tier-1 via
+# test_clean_donation_aliases_and_counts and
+# test_injected_dropped_donation_flags_gv01
 def test_speculative_engine_donations_all_aliased(tiny_model):
     """The spec chunk donates BOTH caches + slot state; every declared
     donation must reach the IR (mesh-free engine → exact
@@ -547,6 +555,10 @@ def tiny_engine(tiny_model):
     return cfg, engine
 
 
+@pytest.mark.slow  # heavy engine-enumeration verify run (tier-1 budget,
+# PR 5/13 lean-core policy): verify-on-a-live-ledger (trace, never a
+# compile) stays tier-1 via test_gv05_manifest_coverage_missing_stale_and_clean
+# and test_gv05_prewarm_replays_do_not_fake_coverage
 def test_enumeration_zero_compiles_zero_syncs(tiny_engine, monkeypatch):
     """ProgramLedger.programs() enumeration AND a full graftverify run
     re-trace but never compile and never sync: Lowered.compile is patched
@@ -588,6 +600,9 @@ def test_enumeration_zero_compiles_zero_syncs(tiny_engine, monkeypatch):
     assert compiles_after == compiles_before
 
 
+@pytest.mark.slow  # heavy in-process budget A/B (tier-1 budget, PR 5/13
+# lean-core policy): the host-sync budget pins themselves stay tier-1 in
+# tests/serving/test_host_sync.py
 def test_host_sync_budgets_with_graftverify_in_process(tiny_engine):
     """ISSUE 15 acceptance: the pinned budgets (submit=1, admission=2,
     steady chunk=1) hold with a graftverify enumeration+verify having run
@@ -641,6 +656,10 @@ def test_cli_explain_and_select_validation(capsys):
     assert cli.main(["--tp-comms", "quant"]) == 2  # needs --tp > 1
 
 
+@pytest.mark.slow  # heavy CLI end-to-end run (tier-1 budget, PR 5/13
+# lean-core policy): CLI arg handling stays tier-1 via
+# test_cli_explain_and_select_validation, the clean-repo contract via
+# test_checked_in_baseline_is_empty
 def test_cli_reference_workload_clean(capsys, tmp_path):
     """The CLI's tp=1 reference workload runs clean against an EMPTY
     baseline (the checked-in contract) and reports the verified-donation
@@ -656,3 +675,58 @@ def test_cli_reference_workload_clean(capsys, tmp_path):
     payload = json.loads(out[: out.rindex("}") + 1])
     assert payload["stats"]["donations_dropped"] == 0
     assert payload["stats"]["transfer_ops"] == 0
+
+
+# --- GV05: manifest coverage (AOT) --------------------------------------------
+
+
+def test_gv05_manifest_coverage_missing_stale_and_clean(tmp_path):
+    """GV05 arms only when a manifest is passed: a runtime-dispatched
+    program absent from it flags missing-from-manifest; a manifest name
+    no audited ledger knows flags stale; a manifest regenerated from the
+    ledger is clean both ways. Accepts the object or a saved path."""
+    from neuronx_distributed_tpu.inference import aot
+
+    led = ProgramLedger()
+    f = led.wrap("f", jax.jit(lambda x: x + 1))
+    f(jnp.zeros(4))
+
+    # clean: object form and saved-path form
+    assert rules_of(verify_nb(led, select={"GV05"}, manifest=led.manifest())) == []
+    path = led.manifest().save(str(tmp_path))
+    assert rules_of(verify_nb(led, select={"GV05"}, manifest=path)) == []
+
+    # missing: dispatched at runtime, absent from the prewarm manifest
+    nb = verify_nb(led, select={"GV05"}, manifest=aot.ProgramManifest({}, {}))
+    assert rules_of(nb) == ["GV05"]
+    [v] = nb.findings
+    assert v.snippet == "f:missing-from-manifest" and v.path == "<t/f>"
+
+    # stale: manifest names a program no audited ledger knows
+    m = led.manifest()
+    m.programs["ghost"] = []
+    nb = verify_nb(led, select={"GV05"}, manifest=m)
+    assert [v.snippet for v in nb.findings] == ["ghost:stale-manifest-entry"]
+    assert nb.findings[0].path == "<manifest/ghost>"
+
+    # unarmed (no manifest) and deselected: GV05 stays silent
+    assert rules_of(verify_nb(led, select={"GV05"})) == []
+    nb = verify_nb(led, select={"GV01"}, manifest=aot.ProgramManifest({}, {}))
+    assert rules_of(nb) == []
+
+
+def test_gv05_prewarm_replays_do_not_fake_coverage():
+    """dispatches excludes prewarm replays by construction (the ledger
+    routes them to prewarm_dispatches), so a prewarm-only program demands
+    nothing — and the first REAL dispatch starts demanding coverage."""
+    from neuronx_distributed_tpu.inference import aot
+
+    led = ProgramLedger()
+    g = led.wrap("g", jax.jit(lambda x: x * 2))
+    with led.prewarming():
+        g(jnp.zeros(3))
+    empty = aot.ProgramManifest({}, {})
+    assert rules_of(verify_nb(led, select={"GV05"}, manifest=empty)) == []
+    g(jnp.zeros(3))  # runtime traffic
+    nb = verify_nb(led, select={"GV05"}, manifest=empty)
+    assert [v.snippet for v in nb.findings] == ["g:missing-from-manifest"]

@@ -43,6 +43,10 @@ def test_serving_demo_row_cache_runs():
     assert "kv_pages_usable" not in snap
 
 
+@pytest.mark.slow  # heavy demo variant (tier-1 budget, PR 5/13
+# lean-core policy): the base demo smoke stays tier-1 via
+# test_serving_demo_runs, quant serving via
+# test_quantized_engine.py::test_greedy_smoke_token_identical
 def test_serving_demo_quantized_runs():
     """--quantize int8 --kv-quant (ISSUE 13): the quantized serving path —
     int8 weights dequantized-on-load + int8 KV pages — serves the same
@@ -56,6 +60,10 @@ def test_serving_demo_quantized_runs():
     assert snap["kv_pages_usable"] > 0
 
 
+@pytest.mark.slow  # heavy demo mode variant (tier-1 budget, PR 5/13
+# lean-core policy): the base demo smoke stays tier-1 via
+# test_serving_demo_runs, ledger reporting via
+# tests/observability/test_programs.py
 def test_serving_demo_programs_mode_runs(capsys):
     """--programs (ISSUE 12): the device-efficiency sections print the
     program ledger table and the HBM ledger with its capacity plan (the
@@ -71,6 +79,10 @@ def test_serving_demo_programs_mode_runs(capsys):
     assert "plan (no device limit" in out  # CPU container: explicit fallback
 
 
+@pytest.mark.slow  # heavy demo traffic variant (tier-1 budget, PR 5/13
+# lean-core policy): the base demo smoke stays tier-1 via
+# test_serving_demo_runs, tape determinism via
+# test_traffic.py::test_same_seed_identical_slo_report
 def test_serving_demo_traffic_mode_runs():
     """--traffic (ISSUE 11): the SLO-replay demo path runs end to end and
     returns the per-tenant attainment report."""
@@ -107,6 +119,30 @@ def test_serving_demo_slo_scheduler_runs():
     s = report["slo"]
     assert s["attained"] + s["violated"] == report["replay"]["submitted"]
     assert report["replay"]["truncated"] is False
+
+
+@pytest.mark.slow  # heavy demo prewarm variant (tier-1 budget, PR 5/13
+# lean-core policy): the same cold -> bundle -> restore-before-first-request
+# round trip stays tier-1 (subprocess-pinned, zero decode compiles) via
+# test_aot.py::test_cross_process_prewarm_serves_with_zero_compiles
+def test_serving_demo_prewarm_runs(tmp_path, capsys):
+    """--prewarm --aot-cache (ISSUE 17): first run serves cold and writes
+    the AOT bundle; the rerun restores from it before the first request.
+    Streams stay correct (same completion counts, one decode program)."""
+    demo = _load_demo()
+    cache = str(tmp_path / "aot")
+    argv = ["--requests", "3", "--slots", "2", "--max-new-tokens", "4",
+            "--prewarm", "--aot-cache", cache]
+    snap1 = demo.main(argv)
+    out1 = capsys.readouterr().out
+    assert "no manifest" in out1 and "AOT bundle written" in out1
+    assert snap1["completed"] == 3
+    assert snap1["aot_programs_saved"] > 0
+    snap2 = demo.main(argv)
+    out2 = capsys.readouterr().out
+    assert "AOT prewarm from" in out2
+    assert snap2["completed"] == 3
+    assert snap2["decode_compilations"] <= 1
 
 
 def test_serving_demo_priority_override_rejects_garbage():

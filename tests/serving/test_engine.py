@@ -42,6 +42,10 @@ def _prompts(rng, n, lo=3, hi=14, vocab=256):
     ]
 
 
+@pytest.mark.slow  # heavy staggered A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): staggered engine-vs-generate equality stays tier-1 via
+# test_sched_engine.py::test_slo_engine_streams_bit_identical_to_fifo_and_generate,
+# per-slot retirement via test_per_slot_eos_and_max_new_tokens
 def test_staggered_stream_matches_generate(setup):
     """Acceptance: a staggered stream of 8 variable-length requests through
     a 4-slot engine is token-identical to per-request generate() — greedy
@@ -94,6 +98,10 @@ def test_staggered_stream_matches_generate(setup):
         assert r["queue_wait"] <= r["ttft"]
 
 
+@pytest.mark.slow  # heavy lifecycle variant (tier-1 budget, PR 5/13
+# lean-core policy): slot retire/reuse legs stay tier-1 via
+# test_per_slot_eos_and_max_new_tokens, test_cancel_queued_and_running,
+# and test_preemption_resumes_token_identical
 def test_slot_reuse_and_lifecycle(setup):
     """More requests than slots: slots free and re-admit (QUEUED→PREFILL→
     DECODE→DONE), every stream still exact."""
@@ -180,6 +188,9 @@ def test_preemption_resumes_token_identical(setup):
     assert max(r.preemptions for r in reqs) > 0
 
 
+@pytest.mark.slow  # heavy sampled-preemption A/B variant (tier-1 budget,
+# PR 5/13 lean-core policy): the greedy preempt+resume leg stays tier-1 via
+# test_preemption_resumes_token_identical
 def test_preemption_with_sampling_keeps_key_streams_independent(setup):
     """Regression: req.key once aliased a VIEW of the engine's key mirror,
     so re-admission into a different slot after preemption overwrote a
@@ -288,6 +299,9 @@ def test_cancel_queued_drops_callback(setup):
     assert blocker.state is RequestState.DONE
 
 
+@pytest.mark.slow  # heavy admission A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): conservative admission under pressure stays tier-1 via
+# test_paged_cache.py::test_conservative_admission_queues_on_page_pressure
 def test_conservative_admission_never_preempts(setup):
     """Default policy defers admission instead of overrunning the cache —
     the preemption counter stays 0."""

@@ -64,6 +64,10 @@ def _workload(cfg, n=4, seed=17):
 # --- dispatch failure recovery ----------------------------------------------
 
 
+@pytest.mark.slow  # heavy recovery A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): recovery machinery stays tier-1 via
+# test_dispatch_failure_marks_degraded_then_cools_down, bit-identity after
+# a failed dispatch via test_draft_dispatch_failure_falls_back_bit_identical
 def test_dispatch_failure_recovery_streams_bit_identical(setup):
     """Acceptance: a dispatch failure injected MID-STREAM (chunk 1, with
     every slot active and tokens already emitted) recovers through the
@@ -220,6 +224,9 @@ def test_quarantine_isolates_poisoned_slot(setup):
     assert all(r.slot != 0 for r in reqs)
 
 
+@pytest.mark.slow  # heavy quarantine-policy variant (tier-1 budget,
+# PR 5/13 lean-core policy): quarantine isolation stays tier-1 via
+# test_quarantine_isolates_poisoned_slot
 def test_quarantine_fail_policy_fails_the_victim(setup):
     """``quarantine_policy="fail"`` terminates the victim with a reason
     instead of requeueing; neighbors still finish exactly."""
@@ -560,6 +567,9 @@ def test_drain_finishes_in_flight_and_admits_nothing_new(setup):
     assert queued.state is RequestState.DONE  # resumes after undrain
 
 
+@pytest.mark.slow  # heavy drain x preemption composition (tier-1 budget,
+# PR 5/13 lean-core policy): the drain contract stays tier-1 via
+# test_drain_finishes_in_flight_and_admits_nothing_new
 def test_drain_still_finishes_preempted_work(setup):
     """Preempted requests are in-flight work: drain must let them resume
     (they rejoin at the queue FRONT) and finish exactly."""
@@ -599,6 +609,10 @@ def test_drain_still_finishes_preempted_work(setup):
 # --- prefill faults ----------------------------------------------------------
 
 
+@pytest.mark.slow  # heavy prefill-fault variant (tier-1 budget, PR 5/13
+# lean-core policy): prefill fault isolation stays tier-1 via
+# test_prefill_fault_on_suffix_path_releases_pin and
+# test_persistent_prefill_failures_halt_not_silent
 def test_prefill_fault_fails_one_request_not_the_loop(setup):
     """An OOM-like prefill fault fails exactly the victim request (FAILED,
     reason recorded), returns its slot, and every other stream is exact."""
@@ -628,6 +642,10 @@ def test_prefill_fault_fails_one_request_not_the_loop(setup):
     assert engine.cache.free_slots == engine.num_slots  # slot returned
 
 
+@pytest.mark.slow  # heavy prefix-poison A/B variant (tier-1 budget,
+# PR 5/13 lean-core policy): page poisoning stays tier-1 via
+# test_paged_faults.py, prefix hit/readmit correctness via
+# test_prefix_cache.py::test_exact_resubmit_hits_and_matches
 def test_poisoned_prefix_entry_evicted_and_stream_bit_identical(setup):
     """Satellite: ``poison_prefix`` corrupts the STORED prefix entry the
     next reuse would copy from. The engine's reuse-time checksum validation
@@ -691,6 +709,9 @@ def test_prefill_fault_on_suffix_path_releases_pin(setup):
     assert engine.metrics.snapshot()["prefix_hits"] == 2  # r2's and r3's
 
 
+@pytest.mark.slow  # heavy shed x requeue composition (tier-1 budget,
+# PR 5/13 lean-core policy): queue-timeout shedding stays tier-1 via
+# test_queue_timeout_sheds_before_prefill
 def test_queue_timeout_spares_requeued_inflight_work(setup):
     """Regression (review): the queue timeout governs FIRST admission only.
     A request admitted in time and then requeued by dispatch recovery (or

@@ -97,6 +97,10 @@ def test_sync_budget_streams_unchanged(setup):
     assert req.tokens == ref
 
 
+@pytest.mark.slow  # heavy instrumentation A/B variant (tier-1 budget,
+# PR 5/13 lean-core policy): every other budget pin in this file runs
+# with instrumentation ON; stream equality stays tier-1 via
+# test_sync_budget_streams_unchanged
 def test_instrumented_sync_budget_matches_bare(setup, tmp_path):
     """ISSUE 8 regression pin: FULL observability — timeline + request-flow
     tracer + flight recorder + shared registry + TTFT/TPOT histograms —
@@ -373,3 +377,42 @@ def test_sync_budget_unchanged_with_slo_scheduling(setup, tmp_path):
     snap = engine.metrics.snapshot()
     assert snap["slo"]["attained"] == 2
     assert snap["tenants"]["acme"]["completed"] == 1
+
+
+def test_sync_budget_unchanged_with_prewarm(setup):
+    """ISSUE 17 re-pin: AOT prewarm replays every program through the
+    ledger proxies BEFORE the first request — warmup may sync all it
+    wants, but the serving hot path afterwards pays the IDENTICAL budget
+    (submit=1, admission step=2, steady chunk=1) with zero new compiles
+    hiding inside any of those steps."""
+    cfg, model, params = setup
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    donor = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None
+    )
+    donor.submit(prompt, gcfg, key=jax.random.PRNGKey(7))
+    donor.run()
+    manifest = donor.manifest()
+
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, prefix_cache=None
+    )
+    rep = engine.prewarm(manifest=manifest, mode="trace")
+    assert rep["replayed"], rep
+    with _SyncCounter() as c:
+        req = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(7))
+    assert c.calls == 1, f"prewarmed submit must stay 1 sync, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 2, (
+        f"prewarmed admission must stay 2 syncs, saw {c.calls}"
+    )
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 1, (
+        f"prewarmed steady chunk must stay 1 sync, saw {c.calls}"
+    )
+    engine.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 12
+    assert engine.decode_compilations == 1  # the replay ate the compile

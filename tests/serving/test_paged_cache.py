@@ -212,6 +212,10 @@ def test_speculative_paged_streams_match_row(setup):
     pg.draft_cache.check()
 
 
+@pytest.mark.slow  # heavy paged x preemption composition (tier-1 budget,
+# PR 5/13 lean-core policy): each leg stays tier-1 via
+# test_streams_bit_identical_mixed_lengths and
+# test_engine.py::test_preemption_resumes_token_identical
 def test_preemption_resume_bit_identical(setup):
     """Eager admission with a short row: the paged engine hits the wall
     (alignment gaps spend columns faster), preempts, and resumes — streams

@@ -145,6 +145,10 @@ def test_prefix_cache_size_zero_is_disabled(setup):
     assert engine.metrics.snapshot()["prefix_misses"] == 0
 
 
+@pytest.mark.slow  # heavy eviction A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): hit/readmit correctness stays tier-1 via
+# test_exact_resubmit_hits_and_matches, pin/release accounting via
+# test_paged_cache.py::test_prefix_insert_pins_pages_and_eviction_releases
 def test_eviction_then_readmit_streams_bit_identical(setup):
     """Acceptance pattern: a prefix evicted under LRU pressure and then
     re-admitted (miss → full prefill → re-insert) keeps the stream exact,
@@ -195,6 +199,10 @@ def test_exact_resubmit_hits_and_matches(setup):
     assert snap["prefix_tokens_reused"] == len(prompt) - 1
 
 
+@pytest.mark.slow  # heavy prefix x preemption composition (tier-1
+# budget, PR 5/13 lean-core policy): each leg stays tier-1 via
+# test_exact_resubmit_hits_and_matches and
+# test_engine.py::test_preemption_resumes_token_identical
 def test_preemption_resume_with_prefix_cache_streams_identical(setup):
     """Acceptance pattern: eager admission preempts under cursor pressure;
     resumes re-prefill through the prefix cache (the preempted context was

@@ -150,6 +150,10 @@ def test_kv_quant_stream_within_budget(setup):
     assert agree >= 0.9, (agree, ref[0], toks[0])
 
 
+@pytest.mark.slow  # heavy dtype variant (tier-1 budget, PR 5/13
+# lean-core policy): the int8 serve leg stays tier-1 via
+# test_greedy_smoke_token_identical; fp8 numerics via the
+# tests/quantization roundtrip + quantized-model units
 def test_fp8_weights_serve(setup):
     """fp8 (e4m3) weight quantization serves end to end — coarser grid, so
     only sanity (vocab-range tokens, full generation) is pinned."""
@@ -187,6 +191,10 @@ def test_quantized_params_bytes_shrink(setup):
     assert (budget // q_page) >= 1.8 * (budget // fp_page)
 
 
+@pytest.mark.slow  # heavy quant x paged composition (tier-1 budget,
+# PR 5/13 lean-core policy): each leg stays tier-1 via
+# test_greedy_smoke_token_identical and
+# test_paged_cache.py::test_prefix_hit_is_zero_copy_and_bit_identical
 def test_quantized_paged_prefix_sharing_zero_copy(setup):
     """CoW prefix sharing works unchanged on half-size quantized pages:
     shared-system-prompt traffic maps pool pages (scales ride along as
@@ -237,6 +245,10 @@ def test_weight_swap_requantizes(setup):
     assert req.state is RequestState.DONE
 
 
+@pytest.mark.slow  # heavy quant x spec composition (tier-1 budget,
+# PR 5/13 lean-core policy): each leg stays tier-1 via
+# test_greedy_smoke_token_identical and
+# test_spec_decode.py::test_spec_engine_equals_solo_speculative_generate
 def test_speculative_quantized_serving(setup):
     """quantize= composes with speculative decoding: the fused draft-verify
     chunk runs the QUANTIZED target verify (draft stays float), still one
@@ -298,6 +310,10 @@ def test_validation_errors(setup):
         )
 
 
+@pytest.mark.slow  # heavy quant x preemption composition (tier-1
+# budget, PR 5/13 lean-core policy): each leg stays tier-1 via
+# test_greedy_smoke_token_identical and
+# test_engine.py::test_preemption_resumes_token_identical
 def test_quantized_eager_admission_and_preemption(setup):
     """The preempt-and-rewind machinery is quantization-blind: eager
     admission over a small quantized pool preempts and resumes, streams

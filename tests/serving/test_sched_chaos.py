@@ -169,6 +169,10 @@ def test_preemption_races_replica_halt_rehome(setup):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow  # heavy chaos composition (tier-1 budget, PR 5/13
+# lean-core policy): chaos preemption stays tier-1 via
+# test_preemption_victim_hit_by_dispatch_fault, quarantine via
+# test_faults.py::test_quarantine_isolates_poisoned_slot
 def test_slo_admission_against_quarantine_shrunk_slots(setup):
     """Chaos pin 3: a poisoned readback quarantines slot 0 mid-run; the
     SLO policy keeps making admission decisions against the shrunk slot

@@ -142,6 +142,9 @@ def test_fifo_policy_is_the_default_engine(setup):
         assert b[k] == f[k]
 
 
+@pytest.mark.slow  # heavy live-preemption variant (tier-1 budget,
+# PR 5/13 lean-core policy): live victim preempt+resume stays tier-1 via
+# test_sched_chaos.py::test_preemption_victim_hit_by_dispatch_fault
 def test_slo_preemption_live_victim_resumes_bit_identical(setup):
     """Feedback-driven preemption on the live engine: a violated chat
     tenant pressures a full slot set, the policy vacates the cheapest

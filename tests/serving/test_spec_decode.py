@@ -84,6 +84,9 @@ def _serve(model, params, prompts, gcfgs, keys, upfront=2, num_slots=2,
     return engine, reqs
 
 
+@pytest.mark.slow  # heavy staggered A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): spec-vs-solo stream equality stays tier-1 via
+# test_spec_engine_equals_solo_speculative_generate
 def test_spec_streams_bit_identical_staggered(setup):
     """Acceptance: spec-on vs spec-off vs solo generate — token streams
     bit-identical for a staggered mix of greedy/sampled/EOS requests, with
@@ -165,6 +168,10 @@ def test_eos_mid_accepted_window(setup):
     assert engine.metrics.snapshot()["spec_accept_rate"] > 0.5
 
 
+@pytest.mark.slow  # heavy spec x preemption composition (tier-1 budget,
+# PR 5/13 lean-core policy): each leg stays tier-1 via
+# test_engine.py::test_preemption_resumes_token_identical and
+# test_spec_engine_equals_solo_speculative_generate
 def test_preemption_resume_spec_streams_identical(setup):
     """Eager admission against a small cache: speculation burns gamma
     columns per round, hits the wall, preempts, re-prefills BOTH caches —
@@ -204,6 +211,10 @@ def test_preemption_resume_spec_streams_identical(setup):
         assert req.tokens == ref, f"request {i} diverged across preemption"
 
 
+@pytest.mark.slow  # heavy spec x prefix composition (tier-1 budget,
+# PR 5/13 lean-core policy): each leg stays tier-1 via
+# test_paged_cache.py::test_prefix_hit_is_zero_copy_and_bit_identical and
+# test_spec_engine_equals_solo_speculative_generate
 def test_prefix_cache_hit_composes_with_speculation(setup):
     """PR 4 composition: a prefix-cache HIT admission (suffix-only target
     prefill) feeding the speculative chunk — streams bit-identical to the
@@ -282,6 +293,9 @@ def test_compile_budget_ragged_advance_no_retrace(setup):
     assert (engine.decode_compilations, engine.prefill_compilations) == before
 
 
+@pytest.mark.slow  # heavy metrics A/B variant (tier-1 budget, PR 5/13
+# lean-core policy): acceptance accounting through the registry stays
+# tier-1 via test_solo_speculative_reports_through_registry
 def test_spec_acceptance_metrics(setup):
     """Perfect draft → accept rate 1.0, zero waste; weak (random) draft →
     waste recorded, histogram keys live. Identical key names to the solo

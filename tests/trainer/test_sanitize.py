@@ -27,6 +27,10 @@ def _batches(cfg, n=20, bs=8, seq=16):
 
 
 @pytest.mark.sanitize
+@pytest.mark.slow  # heavy full-fit guard run (tier-1 budget, PR 5/13
+# lean-core policy): the no-implicit-transfer claim stays tier-1 via
+# tests/scripts/test_graftverify.py::test_compiled_in_callback_flags_gv02
+# (GV02 census) and the tier-1 trainer loop tests
 def test_trainer_fit_under_transfer_guard(transfer_guard_disallow):
     cfg = tiny_llama()
     trainer = Trainer(
