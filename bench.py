@@ -72,6 +72,7 @@ MULTICHIP_TIMEOUT_S = 540
 GRAFTVERIFY_TIMEOUT_S = 420
 COLDSTART_TIMEOUT_S = 600
 COLDSTART_LEG_TIMEOUT_S = 150
+FABRIC_TIMEOUT_S = 540
 
 METRIC = "llama2_7b_width_train_tokens_per_sec_per_chip"
 
@@ -2706,6 +2707,339 @@ def child_coldstart() -> None:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def _measure_serving_fabric(devs) -> dict:
+    """Elastic-fabric child (``--child-fabric``, ISSUE 18), two legs on the
+    virtual clock (wall-independent except where a latency is explicitly a
+    wall measurement):
+
+    * **fabric replay** — the bursty multi-tenant tape through a 2-replica
+      router whose every message rides the ChaosTransport (scattered
+      dup/drop/delay faults) with the watchdog ON; mid-tape, replica 0 is
+      killed and WARM-RESTARTED (``restart_replica``: fence → snapshot →
+      fresh engine → restore, streaming callbacks reattached), later
+      replica 1 is killed and its work RE-HOMED to the survivors, and
+      finally a fresh replica JOINS live. Per-arrival streams must equal a
+      fault-free FIFO single-engine oracle (``tokens_lost == 0``); the
+      soft-TTFT attainment per tape quarter shows the dip while the
+      fabric runs one replica short and the recovery after the join.
+    * **warm vs cold restart** — a standalone engine killed mid-stream;
+      restart-to-first-token of a snapshot/restore warm restart vs a cold
+      engine's first token (wall numbers, compiles pre-warmed out of both
+      paths), with the restored streams bit-identical to the
+      uninterrupted run."""
+    import hashlib
+    import time
+
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_tpu.inference import GenerationConfig
+    from neuronx_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+        tiny_llama,
+    )
+    from neuronx_distributed_tpu.observability import MetricsRegistry
+    from neuronx_distributed_tpu.serving import (
+        ChaosTransport,
+        FaultInjector,
+        ReplicaRouter,
+        RequestState,
+        ServingEngine,
+        SloPolicy,
+        TenantProfile,
+        VirtualClock,
+        WatchdogConfig,
+        generate_tape,
+        replay,
+        tape_bytes,
+    )
+
+    cfg = tiny_llama(
+        num_layers=2, hidden_size=32, intermediate_size=96, vocab_size=128
+    )
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = np.random.RandomState(0).randint(1, cfg.vocab_size, (1, 8))
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(1), ids.astype(np.int32)
+    )
+
+    tenants = [
+        TenantProfile(
+            "chat", rate_rps=2.5, arrival="bursty", workload="chat",
+            priority="interactive", temperature=0.8, burst_factor=4.0,
+            burst_period_s=2.0, burst_duty=0.3,
+        ),
+        TenantProfile(
+            "docs", rate_rps=0.8, arrival="poisson", workload="longdoc",
+            priority="batch",
+        ),
+    ]
+    tape = generate_tape(
+        tenants, duration_s=6.0, seed=18, vocab_size=cfg.vocab_size
+    )
+    raw = tape_bytes(tape)
+    tape_identical = raw == tape_bytes(generate_tape(
+        tenants, duration_s=6.0, seed=18, vocab_size=cfg.vocab_size
+    ))
+
+    # fault-free FIFO row-layout oracle: every fabric layer above it is
+    # placement and recovery, never math
+    oracle_clock = VirtualClock()
+    oracle = ServingEngine(
+        model, params, num_slots=4, decode_chunk_size=2,
+        prefix_cache=None, time_fn=oracle_clock,
+    )
+    replay(oracle, tape, oracle_clock, step_dt=0.05)
+    oracle_reqs = sorted(
+        oracle.scheduler.requests.values(), key=lambda r: r.rid
+    )
+    refs = [list(r.tokens) for r in oracle_reqs]
+
+    # --- leg 1: fabric replay with kill→restart, kill→re-home, join -----
+    n = len(tape)
+    k_restart = max(1, n // 4)
+    k_rehome = max(k_restart + 1, n // 2)
+    k_join = max(k_rehome + 1, (3 * n) // 4)
+
+    clock = VirtualClock()
+    inj = (
+        FaultInjector()
+        .dup_send(at=3, times=1)
+        .drop_send(at=11, times=1)
+        .delay_send(at=19, times=1, by=0.01)
+        .dup_send(at=31, times=1)
+        .drop_send(at=43, times=1)
+    )
+    transport = ChaosTransport(inj, time_fn=clock)
+    registry = MetricsRegistry()
+    router = ReplicaRouter.build(
+        model, params, 2, registry=registry, num_slots=2,
+        decode_chunk_size=2, prefix_cache=None, kv_page_size=8,
+        scheduling=SloPolicy(), time_fn=clock, transport=transport,
+        watchdog=WatchdogConfig(),
+    )
+
+    submit_t, first_tok_t = {}, {}
+
+    def on_token(req, tok):
+        if req.rid not in first_tok_t:
+            first_tok_t[req.rid] = clock.now
+
+    restart_wall_ms = None
+    reqs = []
+    i = 0
+    steps = 0
+    while i < len(tape) or router.has_work:
+        while i < len(tape) and tape[i].t <= clock.now:
+            a = tape[i]
+            i += 1
+            r = router.submit(
+                np.asarray(a.prompt, np.int32),
+                GenerationConfig(
+                    max_new_tokens=a.max_new_tokens,
+                    temperature=a.temperature, eos_token_id=None,
+                ),
+                key=jax.random.PRNGKey(a.key_seed),
+                tenant=a.tenant, priority=a.priority, on_token=on_token,
+            )
+            submit_t[r.rid] = clock.now
+            reqs.append(r)
+            if len(reqs) == k_restart:
+                # kill + WARM-RESTART: fence, snapshot, fresh engine,
+                # restore, callbacks reattached — before any step re-homes
+                router.replicas[0].fence("bench kill (restart)")
+                t0 = time.perf_counter()
+                router.restart_replica(0)
+                restart_wall_ms = (time.perf_counter() - t0) * 1e3
+            elif len(reqs) == k_rehome:
+                # kill + RE-HOME: the next step() notices the halt and
+                # moves the work to the survivors by halt/adopt
+                router.replicas[1].fence("bench kill (rehome)")
+            elif len(reqs) == k_join:
+                router.add_replica()  # live join, no pause
+        if not router.has_work:
+            if i < len(tape):
+                clock.advance_to(tape[i].t)
+                continue
+            break
+        if steps >= 200_000:
+            raise RuntimeError("fabric replay did not converge")
+        router.step()
+        steps += 1
+        clock.advance(0.05)
+
+    tokens_lost = 0
+    for req, ref in zip(reqs, refs):
+        final = router.requests[req.rid]
+        if final.state is not RequestState.DONE or final.tokens != ref:
+            tokens_lost += 1
+
+    # soft-TTFT attainment per tape quarter (virtual seconds): the dip is
+    # the one-replica stretch after the re-home kill, the recovery is the
+    # join — a measurement, never a pin
+    TTFT_TARGET_S = 1.0
+    bounds = [0, k_restart, k_rehome, k_join, len(reqs)]
+    names = ["full", "after_restart", "one_replica", "after_join"]
+    windows = {}
+    for w, name in enumerate(names):
+        chunk = reqs[bounds[w]:bounds[w + 1]]
+        ttfts = [
+            first_tok_t[r.rid] - submit_t[r.rid]
+            for r in chunk if r.rid in first_tok_t
+        ]
+        if not ttfts:
+            windows[name] = {"arrivals": 0}
+            continue
+        ttfts.sort()
+        windows[name] = {
+            "arrivals": len(chunk),
+            "attained_frac": round(
+                sum(1 for t in ttfts if t <= TTFT_TARGET_S) / len(ttfts), 3
+            ),
+            "ttft_p95_s": round(ttfts[int(0.95 * (len(ttfts) - 1))], 3),
+        }
+
+    stats = router.stats
+    fabric_row = {
+        "arrivals": n,
+        "kill_restart_at": k_restart,
+        "kill_rehome_at": k_rehome,
+        "join_at": k_join,
+        "tokens_lost": tokens_lost,
+        "rehomed_requests": stats["rehomed_requests"],
+        "replicas_restarted": stats["replicas_restarted"],
+        "replicas_joined": stats["replicas_joined"],
+        "restart_wall_ms": round(restart_wall_ms, 2),
+        "rehome_latency_p95_ms": round(
+            router._h_rehome.percentile(0.95) * 1e3, 2
+        ),
+        "watchdog_probes": stats["probes"],
+        "transport": {
+            k: transport.stats[k]
+            for k in ("messages", "retries", "dedup_hits")
+        },
+        "faults": {
+            k: inj.counters[k]
+            for k in ("dup_sends", "dropped_sends", "delayed_sends")
+        },
+        "ttft_target_s": TTFT_TARGET_S,
+        "ttft_attainment_by_window": windows,
+    }
+
+    # --- leg 2: warm restart-to-first-token vs cold first token ---------
+    def _mk(clock_):
+        return ServingEngine(
+            model, params, num_slots=2, decode_chunk_size=2,
+            prefix_cache=None, time_fn=clock_,
+        )
+
+    rng = np.random.RandomState(7)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=int(s)).astype(np.int32)
+        for s in rng.randint(5, 12, size=3)
+    ]
+    gcfgs = [
+        GenerationConfig(max_new_tokens=12, temperature=0.0),
+        GenerationConfig(max_new_tokens=10, temperature=0.8, top_k=13),
+        GenerationConfig(max_new_tokens=12, temperature=0.0),
+    ]
+    keys = [jax.random.PRNGKey(700 + j) for j in range(3)]
+
+    def _submit_all(e):
+        return [
+            e.submit(p, c, key=k)
+            for p, c, k in zip(prompts, gcfgs, keys)
+        ]
+
+    # uninterrupted golden (also pre-warms every compile out of the
+    # warm/cold wall measurements below)
+    g = _mk(VirtualClock())
+    g_reqs = _submit_all(g)
+    g.run()
+    goldens = [list(r.tokens) for r in g_reqs]
+
+    kill_clock = VirtualClock()
+    a = _mk(kill_clock)
+    a_reqs = _submit_all(a)
+    for _ in range(2):
+        a.step()
+    a.fence("bench kill")
+    snap = a.snapshot_serving_state()
+    pre = {r.rid: len(r.tokens) for r in a_reqs}
+
+    # warm: clock CONTINUES at the snapshot time (delta=0) so the restored
+    # run is the uninterrupted run, bit for bit
+    t0 = time.perf_counter()
+    b = _mk(VirtualClock(start=kill_clock.now))
+    b.restore_serving_state(snap)
+    while not any(
+        len(r.tokens) > pre[r.rid]
+        for r in b.scheduler.requests.values()
+    ):
+        b.step()
+    warm_ttft_ms = (time.perf_counter() - t0) * 1e3
+    b.run()
+    warm_bit = [
+        list(b.scheduler.requests[r.rid].tokens) for r in a_reqs
+    ] == goldens
+
+    t0 = time.perf_counter()
+    c = _mk(VirtualClock())
+    c_reqs = _submit_all(c)
+    while not any(r.tokens for r in c_reqs):
+        c.step()
+    cold_ttft_ms = (time.perf_counter() - t0) * 1e3
+    c.run()
+
+    restart_row = {
+        "restored": len(a_reqs),
+        "restart_to_first_token_ms": round(warm_ttft_ms, 2),
+        "cold_first_token_ms": round(cold_ttft_ms, 2),
+        "warm_over_cold": round(warm_ttft_ms / max(cold_ttft_ms, 1e-9), 3),
+        "streams_bit_identical": warm_bit,
+    }
+
+    return {
+        "tape": {
+            "arrivals": n,
+            "sha256": hashlib.sha256(raw).hexdigest()[:16],
+            "identical_across_gens": tape_identical,
+        },
+        "fabric": fabric_row,
+        "warm_restart": restart_row,
+        "deterministic": (
+            tape_identical and tokens_lost == 0 and warm_bit
+        ),
+    }
+
+
+def child_fabric() -> None:
+    """Elastic-fabric child (``--child-fabric``, ISSUE 18): bursty-tape
+    replay through a chaos-transport router with a mid-run kill→warm-
+    restart, a kill→re-home, and a live join (tokens_lost == 0 vs the
+    fault-free oracle), plus warm-restart-to-first-token vs cold. Prints
+    one JSON line; merged into the BENCH artifact as
+    ``extras.serving_fabric``."""
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "serving_fabric",
+                "unit": "tokens_lost + re-home/restart latency",
+                "platform": devs[0].platform,
+                **_measure_serving_fabric(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "serving_fabric",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
 def child_quant() -> None:
     """Quantized-serving child (``--child-quant``, ISSUE 13): fp32 vs
     int8-weights vs int8-weights+int8-KV decode throughput, HBM resident
@@ -3281,6 +3615,7 @@ def main() -> None:
     multichip_result = None
     graftverify_result = None
     coldstart_result = None
+    fabric_result = None
 
     import signal
 
@@ -3365,6 +3700,11 @@ def main() -> None:
             coldstart_result
             if coldstart_result is not None
             else {"error": "coldstart child did not finish"}
+        )
+        extras["serving_fabric"] = (
+            fabric_result
+            if fabric_result is not None
+            else {"error": "fabric child did not finish"}
         )
         extras["graftlint"] = _graftlint_summary()
         extras["prior_measurements"] = PRIOR_MEASUREMENTS
@@ -3604,6 +3944,17 @@ def main() -> None:
     else:
         coldstart_result = {"error": f"coldstart child: {err}"}
 
+    # 17. Elastic-fabric child (ISSUE 18): bursty-tape replay through the
+    #     chaos-transport router — mid-run kill→warm-restart, kill→re-home,
+    #     live join — tokens_lost==0 vs the fault-free oracle, plus warm
+    #     restart-to-first-token vs cold.
+    fabric, err = _run_child("--child-fabric", FABRIC_TIMEOUT_S)
+    if fabric is not None:
+        fabric.pop("metric", None)
+        fabric_result = fabric
+    else:
+        fabric_result = {"error": f"fabric child: {err}"}
+
     _finalize()
 
 
@@ -3643,6 +3994,8 @@ if __name__ == "__main__":
         coldstart_leg(sys.argv[_i + 1], sys.argv[_i + 2])
     elif "--child-coldstart" in sys.argv:
         child_coldstart()
+    elif "--child-fabric" in sys.argv:
+        child_fabric()
     elif "--child-efficiency" in sys.argv:
         child_efficiency()
     elif "--child" in sys.argv:

@@ -60,6 +60,17 @@ are bit-identical to the non-speculative engine; the summary gains
 affected chunk decodes non-speculatively (stream intact) and the draft
 cache resyncs.
 
+``--kill-replica K`` (with ``--replicas N``) is the elastic-fabric demo
+(ISSUE 18): replica K is fenced mid-run, after half the requests have been
+submitted. By default the router notices the halt on its next step and
+RE-HOMES the orphaned work to the survivors through the halt/adopt
+contract (original deadlines and tokens intact). With ``--restart`` the
+killed replica is WARM-RESTARTED instead: its host serving state (queue,
+per-request tokens/keys/cursors, deadlines, tenant attribution — never a
+device pytree) is snapshotted, a fresh replica spawns from the build
+recipe, the snapshot restores into it, and every stream continues
+bit-identically from where it stopped.
+
 ``--prewarm [--aot-cache DIR]`` is the AOT cold-start path (ISSUE 17):
 the first run of a cache dir serves cold and writes the AOT bundle
 (manifest + serialized executables + persistent XLA cache) at the end;
@@ -86,6 +97,8 @@ CPU-runnable out of the box:
   python examples/serving_demo.py --draft-layers 1 --gamma 4  # speculative
   python examples/serving_demo.py --draft-layers 1 --inject-fault draft
   python examples/serving_demo.py --prewarm --aot-cache /tmp/aot  # x2: warm
+  python examples/serving_demo.py --replicas 3 --kill-replica 0
+  python examples/serving_demo.py --replicas 3 --kill-replica 0 --restart
   python examples/serving_demo.py --inject-fault dispatch
   python examples/serving_demo.py --inject-fault poison --slots 4
   python examples/serving_demo.py --deadline 0.5 --inject-fault skew
@@ -254,6 +267,16 @@ def parse_args(argv=None):
                         "engine replicas (queue-depth + page-pressure "
                         "balancing, shared-prefix affinity, halt "
                         "re-homing)")
+    p.add_argument("--kill-replica", type=int, default=None, metavar="K",
+                   help="fence replica K mid-run (after half the requests "
+                        "have been submitted); the router re-homes its "
+                        "work to the survivors — streams intact, original "
+                        "deadlines kept. Needs --replicas > 1")
+    p.add_argument("--restart", action="store_true",
+                   help="with --kill-replica: warm-restart the killed "
+                        "replica instead of re-homing — snapshot its host "
+                        "serving state, spawn a fresh replica, restore, "
+                        "reattach streams (tokens continue, never replay)")
     p.add_argument("--disaggregate", action="store_true",
                    help="split prefill from decode: dedicated prefill "
                         "workers hand contexts to the decode engine as "
@@ -468,6 +491,13 @@ def _run_router(args, cfg, model, params):
         )
         if args.shared_prefix > 0 else None
     )
+    kill_at = None
+    if args.kill_replica is not None:
+        if not 0 <= args.kill_replica < args.replicas:
+            raise SystemExit(
+                f"--kill-replica must be in [0, {args.replicas})"
+            )
+        kill_at = max(1, args.requests // 2)
     reqs = []
     for i in range(args.requests):
         plen = int(rng.randint(3, 17))
@@ -484,6 +514,19 @@ def _run_router(args, cfg, model, params):
             )
         except RejectedError as e:
             print(f"r{i} rejected: {e}")
+        if kill_at is not None and i + 1 == kill_at:
+            k = args.kill_replica
+            router.replicas[k].fence("demo kill")
+            if args.restart:
+                new_idx = router.restart_replica(k)
+                print(f"\n*** replica{k} killed after {kill_at} submits "
+                      f"-> warm-restarted as replica{new_idx} (queue + "
+                      f"streams restored from its host-state snapshot)\n")
+            else:
+                router.step()  # the step notices the halt and re-homes
+                print(f"\n*** replica{k} killed after {kill_at} submits "
+                      f"-> {router.stats['rehomed_requests']} requests "
+                      f"re-homed to the survivors\n")
         router.step()
     router.run()
     snap = router.snapshot()
@@ -491,13 +534,18 @@ def _run_router(args, cfg, model, params):
           f"x {args.slots} slots (affinity "
           f"{'on' if not args.no_prefix_cache else 'off'}) ===")
     for req in reqs:
+        # look the final object up through the router: across a warm
+        # restart the restored replica owns a NEW Request under the same
+        # rid and the submit-time handle stops updating
+        final = router.requests.get(req.rid, req)
         replica = req.rid // RID_STRIDE
         print(f"r{req.rid % RID_STRIDE:<3d} -> replica{replica} "
-              f"{req.state.value:<9s} new={len(req.tokens):>2d}")
+              f"{final.state.value:<9s} new={len(final.tokens):>2d}")
     r = snap["router"]
     print(f"\nrouted={r['routed']} by_replica={r['routed_by_replica']} "
           f"affinity_hits={r['affinity_hits']} "
-          f"spillovers={r['spillovers']} rehomed={r['rehomed_requests']}")
+          f"spillovers={r['spillovers']} rehomed={r['rehomed_requests']} "
+          f"restarted={r['replicas_restarted']}")
     print(f"health: {r['health']}")
     for name, rep in snap["replicas"].items():
         print(f"  {name}: completed={rep['completed']} "

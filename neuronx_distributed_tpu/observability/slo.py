@@ -339,3 +339,56 @@ class SLOTracker:
             "per_tenant": self.per_tenant(),
             "violation_reasons": self.violation_reasons(),
         }
+
+    # --- warm restart (ISSUE 18) --------------------------------------------
+
+    def state(self) -> dict:
+        """JSON-safe serialization of the tracker's running counters — the
+        SLO half of ``engine.snapshot_serving_state()``. Specs are NOT
+        carried (they are configuration, re-supplied at engine build);
+        this is purely the accounting a restarted replica must not lose:
+        who already attained, who was violated and why, and the observed
+        span the goodput denominator runs over."""
+        return {
+            "t_first": self._t_first,
+            "t_last": self._t_last,
+            "tenants": {
+                t: {
+                    "attained": s.attained,
+                    "violated": s.violated,
+                    "attained_tokens": s.attained_tokens,
+                    "total_tokens": s.total_tokens,
+                    "violation_reasons": dict(
+                        sorted(s.violation_reasons.items())
+                    ),
+                }
+                for t, s in sorted(self._tenants.items())
+            },
+        }
+
+    def restore_state(self, state: dict, shift_s: float = 0.0) -> None:
+        """Merge a :meth:`state` snapshot into this tracker (additive —
+        the restored replica may already have classified new traffic).
+        ``shift_s`` moves the snapshot's span endpoints onto THIS
+        tracker's clock, matching the timestamp shift the engine restore
+        applies to request deadlines. Registry-backed counters re-export
+        the merged counts so the Prometheus surface and the host state
+        stay consistent."""
+        for key in ("t_first", "t_last"):
+            v = state.get(key)
+            if v is not None:
+                self.touch(v + shift_s)
+        for tenant, d in (state.get("tenants") or {}).items():
+            s = self._state(tenant)
+            attained = int(d.get("attained", 0))
+            violated = int(d.get("violated", 0))
+            attained_tokens = int(d.get("attained_tokens", 0))
+            s.attained += attained
+            s.violated += violated
+            s.attained_tokens += attained_tokens
+            s.total_tokens += int(d.get("total_tokens", 0))
+            for reason, n in (d.get("violation_reasons") or {}).items():
+                s.violation_reasons[reason] = (
+                    s.violation_reasons.get(reason, 0) + int(n)
+                )
+            self._export(tenant, s, attained_tokens, violated, attained)

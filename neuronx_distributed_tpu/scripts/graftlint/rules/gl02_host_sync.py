@@ -124,6 +124,12 @@ HOT_SUFFIXES = (
     "serving/sched/priority.py",
     "serving/sched/fairness.py",
     "serving/sched/feedback.py",
+    # elastic fabric (ISSUE 18): the transport seam wraps EVERY
+    # router->replica and prefill->decode interaction — submit, adopt,
+    # probe, handoff, restore all pass through call()/_deliver() — so an
+    # implicit coercion here (say of a request's device key riding an
+    # envelope) would add a host sync to every message on the fabric
+    "serving/transport.py",
     # AOT serving (ISSUE 17): prewarm replays dispatch THROUGH the live
     # ledger proxies with manufactured dummy arguments, and the AOTProgram
     # shim wraps every dispatch of a deserialized program for the life of

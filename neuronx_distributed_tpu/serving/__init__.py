@@ -110,7 +110,11 @@ from neuronx_distributed_tpu.serving.paging import (
     PageExhausted,
     StagedContext,
 )
-from neuronx_distributed_tpu.serving.router import RID_STRIDE, ReplicaRouter
+from neuronx_distributed_tpu.serving.router import (
+    RID_STRIDE,
+    ReplicaRouter,
+    WatchdogConfig,
+)
 from neuronx_distributed_tpu.serving.sched import (
     FairnessConfig,
     FeedbackConfig,
@@ -125,6 +129,14 @@ from neuronx_distributed_tpu.serving.scheduler import (
     RequestState,
     Scheduler,
 )
+from neuronx_distributed_tpu.serving.transport import (
+    ChaosTransport,
+    Envelope,
+    InProcessTransport,
+    PartitionedError,
+    TransportError,
+    TransportTimeout,
+)
 from neuronx_distributed_tpu.serving.traffic import (
     Arrival,
     TenantProfile,
@@ -137,13 +149,16 @@ from neuronx_distributed_tpu.serving.traffic import (
 
 __all__ = [
     "Arrival",
+    "ChaosTransport",
     "DisaggregatedServer",
     "EngineHealth",
+    "Envelope",
     "ExportedContext",
     "FairnessConfig",
     "FaultInjector",
     "FeedbackConfig",
     "FifoPolicy",
+    "InProcessTransport",
     "InjectedDispatchError",
     "InjectedDraftError",
     "InjectedFault",
@@ -152,6 +167,7 @@ __all__ = [
     "PageAllocator",
     "PageExhausted",
     "PagedCacheManager",
+    "PartitionedError",
     "PrefillWorker",
     "PrefixCache",
     "PrefixEntry",
@@ -170,7 +186,10 @@ __all__ = [
     "SlotCacheManager",
     "StagedContext",
     "TenantProfile",
+    "TransportError",
+    "TransportTimeout",
     "VirtualClock",
+    "WatchdogConfig",
     "build_report",
     "generate_tape",
     "make_policy",

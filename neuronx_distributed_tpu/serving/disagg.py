@@ -38,6 +38,18 @@ the engine back to self-admission entirely); a failed handoff
 (``FaultInjector.fail_handoff``) releases the staged pages and falls back
 the same way. Streams stay bit-identical in every case — the fallback is
 the very program a coupled engine runs.
+
+With a ``transport=`` (ISSUE 18) every prefill→decode interaction — the
+handoff admit, the distinct-pool page export/import — becomes a message
+on the elastic-fabric seam: retried under the transport's policy, deduped
+by ``(rid, seq)`` so a duplicated handoff can never double-admit, and a
+message the ChaosTransport drops lands in the SAME coupled-fallback path
+an injected handoff failure does. The server also speaks the router's
+replica surface (``adopt``/``fence``/``load_score``/…), so a
+disaggregated server can sit behind a :class:`~neuronx_distributed_tpu.
+serving.router.ReplicaRouter` and its watchdog; ``fence()`` first
+releases pending staged pages and requeues their requests so the re-home
+path sees every unfinished request in the queue.
 """
 
 from __future__ import annotations
@@ -132,7 +144,7 @@ class DisaggregatedServer:
 
     def __init__(self, engine: ServingEngine, n_workers: int = 1,
                  prefills_per_step: int = 1, shared_pool: bool = True,
-                 fault_injector=None):
+                 fault_injector=None, transport=None):
         if engine._page_size is None:
             raise ValueError(
                 "disaggregation needs a PAGED decode engine "
@@ -153,6 +165,7 @@ class DisaggregatedServer:
         self.shared_pool = shared_pool
         self.prefills_per_step = prefills_per_step
         self._faults = fault_injector
+        self.transport = transport
         engine.external_prefill = True
         self.workers: List[PrefillWorker] = []
         for i in range(n_workers):
@@ -204,6 +217,67 @@ class DisaggregatedServer:
     def has_work(self) -> bool:
         return bool(self._pending) or self.engine.has_work
 
+    # --- router replica surface (ISSUE 18) ----------------------------------
+    # a DisaggregatedServer can stand behind a ReplicaRouter: balancing,
+    # affinity, watchdog probes, and re-homing all speak these
+
+    @property
+    def prefix(self):
+        return self.engine.prefix
+
+    @property
+    def flight(self):
+        return self.engine.flight
+
+    @property
+    def _on_token(self):
+        return self.engine._on_token
+
+    @property
+    def _next_rid(self):
+        return self.engine._next_rid
+
+    def load_score(self, tenant: Optional[str] = None) -> float:
+        return self.engine.load_score(tenant=tenant)
+
+    def page_pressure(self) -> float:
+        return self.engine.page_pressure()
+
+    def adopt(self, req: Request, on_token=None) -> Request:
+        return self.engine.adopt(req, on_token=on_token)
+
+    def release_queued(self, rid: int):
+        return self.engine.release_queued(rid)
+
+    def drain(self) -> None:
+        self.engine.drain()
+
+    def resume(self) -> None:
+        self.engine.resume()
+
+    def fence(self, reason: str = "fenced") -> None:
+        """Kill switch through the engine's halt contract, made
+        handoff-aware: contexts prefilled but not yet admitted release
+        their staged pages and their requests rejoin the queue FIRST, so
+        the post-fence queue (what a router re-homes) holds every
+        unfinished request — none marooned in ``_pending``."""
+        pending, self._pending = self._pending, []
+        for req, staged, _logits in pending:
+            self._release(staged, self.engine.cache)
+            if not req.finished:
+                self.engine.scheduler.requeue_front([req])
+        self.engine.fence(reason)
+
+    # --- transport seam (ISSUE 18) ------------------------------------------
+
+    def _send(self, target: str, op: str, fn, rid: int = -1):
+        """Route one prefill/decode interaction over the fabric transport
+        (retries + ``(rid, seq)`` dedup), or call directly when no
+        transport is bound — bit-identical either way."""
+        if self.transport is None:
+            return fn()
+        return self.transport.call(target, op, fn, rid=rid)
+
     # --- the serving loop ----------------------------------------------------
 
     def _coupled_fallback(self, req: Request, now: float) -> None:
@@ -246,11 +320,17 @@ class DisaggregatedServer:
             try:
                 if self._faults is not None:
                     self._faults.on_handoff(attempt)
-                admitted = self.engine.admit_staged(req, staged, logits, now)
+                admitted = self._send(
+                    "decode", "handoff",
+                    lambda r=req, s=staged, lg=logits:
+                        self.engine.admit_staged(r, s, lg, now),
+                    rid=req.rid,
+                )
             except Exception:
-                # injected handoff failure, or a staged context voided by
-                # pool recovery/page quarantine: nothing is half-mapped —
-                # release the pages and fall back to coupled prefill
+                # injected handoff failure, an undeliverable handoff
+                # message (transport gave up), or a staged context voided
+                # by pool recovery/page quarantine: nothing is half-mapped
+                # — release the pages and fall back to coupled prefill
                 self.stats["handoff_failures"] += 1
                 self._release(staged, self.engine.cache)
                 self._coupled_fallback(req, now)
@@ -306,7 +386,11 @@ class DisaggregatedServer:
                 # healthy worker — this request just prefills coupled
                 # (whose own page-pressure machinery absorbs it)
                 try:
-                    exported = worker.pool.export_pages(staged)
+                    exported = self._send(
+                        "prefill", "page_export",
+                        lambda w=worker, s=staged: w.pool.export_pages(s),
+                        rid=req.rid,
+                    )
                 except Exception:
                     self._release(staged, worker.pool)
                     self.stats["handoff_failures"] += 1
@@ -316,7 +400,11 @@ class DisaggregatedServer:
                 try:
                     if self.engine.cache.cache is None:
                         self.engine.cache.allocate_like(worker.pool)
-                    staged = self.engine.cache.import_pages(exported)
+                    staged = self._send(
+                        "decode", "page_import",
+                        lambda e=exported: self.engine.cache.import_pages(e),
+                        rid=req.rid,
+                    )
                 except Exception:
                     self.stats["handoff_failures"] += 1
                     self._coupled_fallback(req, now)

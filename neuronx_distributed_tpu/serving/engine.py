@@ -1477,6 +1477,184 @@ class ServingEngine:
         )
         return req
 
+    def release_queued(self, rid: int):
+        """Withdraw a never-admitted QUEUED request from this engine so
+        another replica can :meth:`adopt` it (the router's live-join
+        rebalancing and drain paths). Returns ``(request, on_token)`` —
+        the live request plus its streaming callback, both now owned by
+        the caller — or ``(None, None)`` when the rid is not releasable
+        (unknown, finished, already admitted, or preempted-after-
+        admission: in-flight work belongs to this engine until it halts
+        or finishes)."""
+        req = self.scheduler.get(rid)
+        if req is None or req.admit_time is not None:
+            return None, None
+        released = self.scheduler.release(rid)
+        if released is None:
+            return None, None
+        cb = self._on_token.pop(rid, None)
+        self.tracer.end(rid, "released", args={"rehomed_away": True})
+        if self.flight is not None:
+            self.flight.record("release", rid=rid, tenant=released.tenant)
+        return released, cb
+
+    # --- warm restart (ISSUE 18) --------------------------------------------
+
+    def snapshot_serving_state(self) -> Dict[str, Any]:
+        """Serialize the HOST-current serving state the halt contract
+        defines — the queue (actives first, in slot-roll order by rid,
+        exactly as a halt would requeue them), every unfinished request's
+        tokens / PRNG key / deadlines / tenant+priority attribution, the
+        prefix-cache token index (which prefixes were hot — an advisory
+        for re-warming, NOT the KV bytes), and the SLO tracker's counters.
+        No device pytrees: programs come back via the AOT cache
+        (``prewarm``), KV is re-prefilled from host tokens by the same
+        resume machinery preemption uses.
+
+        The result is JSON-safe. Timestamps are absolute on THIS engine's
+        clock; :meth:`restore_serving_state` shifts them onto the restored
+        engine's clock so every remaining deadline budget is preserved
+        across the restart."""
+        active = sorted(
+            (r for r in self._slot_req if r is not None and not r.finished),
+            key=lambda r: r.rid,
+        )
+        seen = {r.rid for r in active}
+        ordered = active + [
+            r for r in self.scheduler.queued_requests if r.rid not in seen
+        ]
+        reqs = []
+        for req in ordered:
+            cfg = req.config
+            reqs.append({
+                "rid": int(req.rid),
+                "prompt": [int(x) for x in req.prompt],
+                "tokens": [int(t) for t in req.tokens],
+                "key": [int(k) for k in np.asarray(req.key, np.uint32).reshape(-1)],
+                "config": {
+                    "max_new_tokens": int(cfg.max_new_tokens),
+                    "temperature": float(cfg.temperature),
+                    "top_k": None if cfg.top_k is None else int(cfg.top_k),
+                    "top_p": None if cfg.top_p is None else float(cfg.top_p),
+                    "eos_token_id": (
+                        None if cfg.eos_token_id is None else int(cfg.eos_token_id)
+                    ),
+                },
+                "tenant": req.tenant,
+                "priority": req.priority,
+                "preemptions": int(req.preemptions),
+                "deadline": req.deadline,
+                "queue_deadline": req.queue_deadline,
+                "submit_time": req.submit_time,
+                "admit_time": req.admit_time,
+                "first_token_time": req.first_token_time,
+            })
+        snap: Dict[str, Any] = {
+            "version": 1,
+            "now": self._now(),
+            "next_rid": int(self._next_rid),
+            "halted": self._halted,
+            "halt_reason": self._halt_reason,
+            "requests": reqs,
+            "prefix_index": (
+                [list(e.tokens) for e in self.prefix.entries]
+                if self.prefix is not None and self.prefix.enabled
+                else None
+            ),
+            "tenant_queue_depths": self.scheduler.queued_by_tenant(),
+            "slo": (
+                self.metrics.slo.state() if self.metrics.slo is not None else None
+            ),
+        }
+        if self.flight is not None:
+            self.flight.record("snapshot", requests=len(reqs))
+        return snap
+
+    def restore_serving_state(self, snap: Dict[str, Any],
+                              on_token=None) -> Dict[str, Any]:
+        """Bring a freshly-constructed (or resumed) engine back WARM from
+        :meth:`snapshot_serving_state`: every unfinished request rejoins
+        the queue in snapshot order with its streamed tokens and
+        host-current key (the resume machinery continues each stream
+        bit-identically), and every absolute timestamp is shifted by the
+        clock delta between the snapshot and now — a request that had 4s
+        of deadline budget left when the replica died has exactly 4s left
+        here. ``on_token`` (optional) streams every restored request.
+
+        Raises ``ValueError`` if any snapshot rid is already known to this
+        engine — restore composes with the transport's idempotency the
+        same way adopt does: state is admitted exactly once."""
+        if snap.get("version") != 1:
+            raise ValueError(
+                f"unknown serving-state snapshot version {snap.get('version')!r}"
+            )
+        if self._halted:
+            raise RejectedError("engine is halted; cannot restore work")
+        now = self._now()
+        delta = now - float(snap["now"])
+        for r in snap["requests"]:
+            if int(r["rid"]) in self.scheduler.requests:
+                raise ValueError(
+                    f"rid {r['rid']} already known to this engine — "
+                    "a serving-state snapshot restores exactly once"
+                )
+
+        def _shift(t):
+            return None if t is None else t + delta
+
+        restored = 0
+        for r in snap["requests"]:
+            req = Request(
+                rid=int(r["rid"]),
+                prompt=np.asarray(r["prompt"], np.int32),
+                config=GenerationConfig(**r["config"]),
+                key=np.asarray(r["key"], np.uint32),
+                tenant=r.get("tenant", "default"),
+                priority=r.get("priority", "standard"),
+            )
+            req.tokens = [int(t) for t in r["tokens"]]
+            req.preemptions = int(r.get("preemptions", 0))
+            req.deadline = _shift(r.get("deadline"))
+            req.queue_deadline = _shift(r.get("queue_deadline"))
+            req.submit_time = _shift(r.get("submit_time"))
+            req.admit_time = _shift(r.get("admit_time"))
+            req.first_token_time = _shift(r.get("first_token_time"))
+            req.slot = None
+            self.scheduler.submit(req)
+            self.metrics.record_adopt(req, now)
+            if on_token is not None:
+                self._on_token[req.rid] = on_token
+            self.tracer.begin(
+                req.rid,
+                args={
+                    "prompt_len": int(len(req.prompt)),
+                    "tenant": req.tenant,
+                    "priority": req.priority,
+                    "restored": True,
+                    "tokens_streamed": len(req.tokens),
+                },
+            )
+            restored += 1
+        self._next_rid = max(self._next_rid, int(snap["next_rid"]))
+        if snap.get("slo") and self.metrics.slo is not None:
+            self.metrics.slo.restore_state(snap["slo"], shift_s=delta)
+        downtime = max(delta, 0.0)
+        self.metrics.record_restore(restored, downtime)
+        if self.flight is not None:
+            self.flight.record(
+                "restore", requests=restored, downtime_s=downtime
+            )
+        if self.timeline is not None:
+            self.timeline.instant(
+                "restore", "serving", args={"requests": restored}
+            )
+        self._sync_health()
+        return {
+            "restored": restored,
+            "downtime_s": downtime,
+            "rid_floor": int(self._next_rid),
+        }
+
     # --- health / drain -----------------------------------------------------
 
     def health(self) -> EngineHealth:
@@ -1514,6 +1692,18 @@ class ServingEngine:
         """Leave DRAINING and accept work again (no-op while HALTED)."""
         self._draining = False
         self._sync_health()
+
+    def fence(self, reason: str = "fenced") -> None:
+        """Operator/watchdog kill switch: take the engine to HALTED *now*
+        through the standard halt contract — in-flight work is vacated
+        with host-current tokens/keys and requeued (never stranded), the
+        post-mortem flight dump is written, and ``run()`` stops making
+        progress. The router's watchdog calls this when a replica is
+        declared dead so its queue can be re-homed or snapshot-restored;
+        idempotent on an already-halted engine."""
+        if self._halted:
+            return
+        self._halt(f"fenced: {reason}")
 
     def _halt(self, reason: str) -> None:
         # the HALTED contract: in-flight work is REQUEUED, never stranded.

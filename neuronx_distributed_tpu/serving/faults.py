@@ -46,6 +46,13 @@ Injection points (all indices are 0-based and deterministic):
 * ``poison_draft(at=k, times=t)`` — the k-th speculative dispatches run
   with a corrupted COPY of the draft params (mid-chunk all-reject rounds:
   every proposal garbage); the stream must stay bit-identical regardless.
+* ``drop_send / drop_ack / dup_send / delay_send / partition`` — transport
+  fault schedules consulted by ``serving/transport.ChaosTransport`` per
+  delivery-attempt index (transport-wide monotone, so deterministic for a
+  deterministic workload): drop the k-th send in flight, deliver the k-th
+  send but lose its ack (forcing a retry into the idempotency cache),
+  deliver the k-th send twice, delay it against its message deadline, or
+  make a specific target unreachable for a window of sends.
 
 ``counters`` records every fault actually fired so chaos tests can assert
 the schedule ran (an injection that never fired proves nothing).
@@ -94,6 +101,12 @@ class FaultInjector:
         self._draft_poison_windows: List[Tuple[int, Optional[int]]] = []
         self._handoff_windows: List[Tuple[int, Optional[int]]] = []
         self._page_poisons: Dict[int, List[int]] = {}  # readback -> [slot]
+        # transport fault schedules, all keyed by delivery-attempt index
+        self._send_drops: List[Tuple[int, Optional[int]]] = []
+        self._ack_drops: List[Tuple[int, Optional[int]]] = []
+        self._send_dups: List[Tuple[int, Optional[int]]] = []
+        self._send_delays: List[Tuple[int, Optional[int], float]] = []
+        self._partitions: Dict[object, List[Tuple[int, Optional[int]]]] = {}
         self._skew: float = 0.0
         self._skew_after: Optional[float] = None
         self.counters: Dict[str, int] = {
@@ -105,6 +118,11 @@ class FaultInjector:
             "poisoned_drafts": 0,
             "poisoned_pages": 0,
             "handoff_failures": 0,
+            "dropped_sends": 0,
+            "dropped_acks": 0,
+            "dup_sends": 0,
+            "delayed_sends": 0,
+            "partitioned_sends": 0,
         }
 
     # --- schedule construction ----------------------------------------------
@@ -186,6 +204,75 @@ class FaultInjector:
             raise InjectedHandoffError(
                 f"injected handoff failure at attempt {attempt}"
             )
+
+    def drop_send(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
+        """The ``at``-th..(at+times-1)-th transport delivery attempts are
+        dropped in flight (``TransportError`` before the target runs —
+        nothing delivered, the sender's retry delivers fresh)."""
+        end = None if times is None else at + times
+        self._send_drops.append((at, end))
+        return self
+
+    def drop_ack(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
+        """The ``at``-th.. transport deliveries RUN at the target but their
+        replies are lost — the sender retries a message that already
+        executed, which MUST land in the transport's idempotency cache.
+        This is the schedule that proves exactly-once admission."""
+        end = None if times is None else at + times
+        self._ack_drops.append((at, end))
+        return self
+
+    def dup_send(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":
+        """The ``at``-th.. transport deliveries arrive TWICE; the second
+        copy must be absorbed by the idempotency cache, never the app."""
+        end = None if times is None else at + times
+        self._send_dups.append((at, end))
+        return self
+
+    def delay_send(self, at: int = 0, times: Optional[int] = 1,
+                   by: float = 1.0) -> "FaultInjector":
+        """The ``at``-th.. transport deliveries are delayed ``by`` seconds
+        — a delay past the message's deadline becomes a terminal
+        ``TransportTimeout`` (probes use this to go unanswered)."""
+        end = None if times is None else at + times
+        self._send_delays.append((at, end, by))
+        return self
+
+    def partition(self, target, at: int = 0,
+                  times: Optional[int] = None) -> "FaultInjector":
+        """Make ``target`` (a replica index or disagg address) unreachable
+        for the ``at``-th.. delivery attempts — every send in the window
+        fails with ``PartitionedError``. ``times=None`` partitions forever:
+        the way to drive a live replica watchdog-DEAD."""
+        end = None if times is None else at + times
+        self._partitions.setdefault(target, []).append((at, end))
+        return self
+
+    def on_transport_send(self, send: int, target, op: str):
+        """Called by ``ChaosTransport`` with the transport-wide 0-based
+        delivery-attempt index, the target address and the op name.
+        Returns a fault action tuple — ``("partition",)``, ``("drop",)``,
+        ``("drop_ack",)``, ``("dup",)``, ``("delay", by)`` — or ``None``
+        for a clean delivery. Partition wins over per-send faults (an
+        unreachable target can't also deliver)."""
+        windows = self._partitions.get(target)
+        if windows is not None and self._hit(windows, send):
+            self.counters["partitioned_sends"] += 1
+            return ("partition",)
+        if self._hit(self._send_drops, send):
+            self.counters["dropped_sends"] += 1
+            return ("drop",)
+        if self._hit(self._ack_drops, send):
+            self.counters["dropped_acks"] += 1
+            return ("drop_ack",)
+        if self._hit(self._send_dups, send):
+            self.counters["dup_sends"] += 1
+            return ("dup",)
+        for at, end, by in self._send_delays:
+            if send >= at and (end is None or send < end):
+                self.counters["delayed_sends"] += 1
+                return ("delay", by)
+        return None
 
     def skew_clock(self, by: float, after: Optional[float] = None) -> "FaultInjector":
         self._skew = by

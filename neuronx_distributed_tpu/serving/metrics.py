@@ -91,6 +91,8 @@ _COUNTERS = (
     # mapped copy-on-write into a hitting slot (zero bytes moved)
     ("page_quarantines", "serving_page_quarantines", True),
     ("prefix_pages_shared", "serving_prefix_pages_shared", True),
+    # elastic fabric (ISSUE 18): requests brought back by a warm restart
+    ("restored", "serving_restored_requests", True),
     ("occupied_slot_steps", "serving_occupied_slot_steps", True),
     ("prefill_full_wall_s", "serving_prefill_full_wall_s", False),
     ("prefill_suffix_wall_s", "serving_prefill_suffix_wall_s", False),
@@ -187,6 +189,11 @@ class ServingMetrics:
         )
         self._h_queue_wait = own_histogram(
             "serving_queue_wait_s", help="submit -> first admission (s)"
+        )
+        self._h_restore_downtime = own_histogram(
+            "serving_restore_downtime_s",
+            help="snapshot -> restore_serving_state clock gap (s): how "
+                 "long a warm-restarted replica's work was dark",
         )
         self._g_cursor = self.view.gauge(
             "serving_cursor_high_water", help="highest shared cache cursor seen"
@@ -312,6 +319,15 @@ class ServingMetrics:
             )
         if self.slo is not None:
             self.slo.touch(now)
+
+    def record_restore(self, n_requests: int, downtime_s: float) -> None:
+        """Warm-restart accounting (ISSUE 18): how many requests
+        ``restore_serving_state`` brought back and how long they were
+        dark. The per-request bookkeeping itself rides
+        :meth:`record_adopt` (restore calls it per request)."""
+        if n_requests:
+            self._inc("restored", n_requests)
+        self._h_restore_downtime.observe(float(downtime_s))
 
     def record_admit(self, req, now: float) -> None:
         r = self._requests[req.rid]
@@ -660,6 +676,12 @@ class ServingMetrics:
             "prefill_suffix_wall_s": self.prefill_suffix_wall_s,
             "failed": self.failed,
             "timed_out": self.timed_out,
+            # warm restart (ISSUE 18): requests admitted from a serving-
+            # state snapshot, and how long the work was dark
+            "restored": self.restored,
+            "restore_downtime_p95_s": self._h_restore_downtime.percentile(
+                0.95
+            ),
             "health": self.health,
             "cursor_high_water": self.cursor_high_water,
             "mean_occupancy": self.mean_occupancy,

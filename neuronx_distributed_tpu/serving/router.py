@@ -29,15 +29,51 @@ and never touches a hot path:
   streamed tokens, and its key, so the survivor's continuation is
   bit-identical and ``tokens_lost == 0`` (chaos-pinned in
   tests/serving/test_router.py).
+
+The elastic-fabric layer (ISSUE 18) adds the failure semantics a fixed
+in-process replica set never needed:
+
+* **Transport seam** — every router→replica interaction (submit, adopt,
+  probe, restore) rides a
+  :class:`~neuronx_distributed_tpu.serving.transport.InProcessTransport`
+  (bit-identical to direct calls) or a fault-injecting
+  :class:`~neuronx_distributed_tpu.serving.transport.ChaosTransport`.
+  Messages carry ``(rid, seq)`` idempotency keys: a retried or duplicated
+  adopt admits exactly once. An unreachable replica's submit spills to
+  the next candidate instead of failing the caller.
+* **Watchdog** — with a :class:`WatchdogConfig`, ``step()`` fires
+  virtual-clock periodic health probes through the transport. Consecutive
+  failures walk a replica OK→SUSPECT→DEGRADED→DEAD (thresholds per
+  config); successes must accumulate (``recover_after`` in a row) to step
+  back UP one level at a time, so a flapping replica is *held* at SUSPECT
+  instead of oscillating between routable and dead. DEAD is terminal:
+  the replica is fenced (``engine.fence`` — the in-process STONITH that
+  guarantees it stops making progress) and its work re-homes through the
+  existing halt/adopt contract with remaining deadline budgets and
+  tenant/priority attribution intact.
+* **Live join/drain** — :meth:`add_replica` warm-spawns a replica (AOT
+  ``prewarm`` when a cache dir is given) and rebalances queued backlog
+  onto it without pausing survivors; :meth:`remove_replica` drains one
+  out through the DRAINING contract, re-homing its never-admitted queue.
+* **Warm restart** — :meth:`restart_replica` snapshots a fenced replica's
+  host-current serving state (``engine.snapshot_serving_state``), spawns
+  a replacement, and restores the state there — the dead replica's queue
+  is SURRENDERED to the snapshot first, so the halted-re-home path can
+  never double-admit what the restore now owns.
+
 * **One scrape** — replicas built by :meth:`ReplicaRouter.build` share one
   ``MetricsRegistry`` as engine-labeled metric families (the ISSUE 11
   machinery), so tenant/SLO attribution and the program/HBM ledgers of all
   replicas aggregate into a single Prometheus exposition with zero
-  merging.
+  merging. A router built with ``registry=`` adds the fabric's own
+  surface: the per-replica probe-state gauge, re-home / restart latency
+  histograms, and the transport's send/retry/drop/dup counters.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -49,10 +85,63 @@ from neuronx_distributed_tpu.serving.engine import (
     ServingEngine,
 )
 from neuronx_distributed_tpu.serving.scheduler import Request
+from neuronx_distributed_tpu.serving.transport import (
+    InProcessTransport,
+    TransportError,
+)
 
 # replicas mint rids from disjoint ranges so re-homed Request objects can
 # never collide on a survivor (requests keep their rid across re-homing)
 RID_STRIDE = 1_000_000_000
+
+# probe-state ladder: demotions move right (one failure threshold each),
+# recoveries move left one rung per `recover_after` consecutive successes
+_PROBE_ORDER = ("ok", "suspect", "degraded", "dead")
+_PROBE_CODE = {s: i for i, s in enumerate(_PROBE_ORDER)}
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchdogConfig:
+    """Watchdog probe cadence and state-machine thresholds.
+
+    Probes fire every ``probe_interval_s`` (router clock — virtual under
+    tests) with a per-message ``probe_timeout_s`` deadline; one probe is
+    ONE transport attempt (no retries — retrying is what the consecutive-
+    failure thresholds are for). ``suspect_after`` / ``degraded_after`` /
+    ``dead_after`` are CUMULATIVE consecutive-failure counts;
+    ``recover_after`` consecutive successes undo one demotion level.
+    DEAD is terminal — the replica is fenced and its work re-homed."""
+
+    probe_interval_s: float = 0.25
+    probe_timeout_s: float = 0.25
+    suspect_after: int = 1
+    degraded_after: int = 2
+    dead_after: int = 3
+    recover_after: int = 2
+
+    def __post_init__(self):
+        if not (1 <= self.suspect_after <= self.degraded_after
+                <= self.dead_after):
+            raise ValueError(
+                "thresholds must satisfy 1 <= suspect_after <= "
+                f"degraded_after <= dead_after, got {self.suspect_after}/"
+                f"{self.degraded_after}/{self.dead_after}"
+            )
+        if self.recover_after < 1:
+            raise ValueError(
+                f"recover_after must be >= 1, got {self.recover_after}"
+            )
+
+
+@dataclasses.dataclass
+class _ProbeState:
+    """One replica's watchdog view (host scalars only)."""
+
+    state: str = "ok"
+    fails: int = 0  # consecutive probe failures
+    oks: int = 0  # consecutive successes while demoted
+    next_probe: float = 0.0
+    last_err: Optional[str] = None
 
 
 class ReplicaRouter:
@@ -60,7 +149,11 @@ class ReplicaRouter:
 
     def __init__(self, replicas: List[ServingEngine],
                  affinity: bool = True,
-                 affinity_overcommit: float = 0.85):
+                 affinity_overcommit: float = 0.85,
+                 transport=None,
+                 watchdog: Optional[WatchdogConfig] = None,
+                 registry=None,
+                 time_fn: Optional[Callable[[], float]] = None):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         bases = [e._next_rid for e in replicas]
@@ -73,27 +166,90 @@ class ReplicaRouter:
         self.replicas = list(replicas)
         self.affinity = affinity
         self.affinity_overcommit = float(affinity_overcommit)
+        self._time_fn = time_fn
+        self.transport = (
+            transport if transport is not None
+            else InProcessTransport(time_fn=self._now)
+        )
+        self.watchdog = watchdog
+        self._probe: List[_ProbeState] = [
+            _ProbeState() for _ in self.replicas
+        ]
         self._dead: set = set()  # replica indices already drained/re-homed
+        self._draining_out: set = set()  # remove_replica in progress
+        # set by build(): how to construct another identical replica
+        self._factory: Optional[dict] = None
+        self._next_base = len(self.replicas)
         self.stats: Dict[str, int] = {
             "routed": 0,
             "affinity_hits": 0,
             "rehomed_requests": 0,
             "replicas_drained": 0,
             "spillovers": 0,
+            # elastic fabric (ISSUE 18)
+            "probes": 0,
+            "probe_failures": 0,
+            "watchdog_deaths": 0,
+            "transport_failures": 0,
+            "replicas_joined": 0,
+            "replicas_removed": 0,
+            "replicas_restarted": 0,
+            "rebalanced_requests": 0,
         }
         self.routed_by_replica = [0] * len(replicas)
+        # fabric observability (registry=): probe-state gauge children,
+        # re-home / restart latency histograms, transport event gauges
+        self._view = None
+        self._g_probe = None
+        self._g_transport = None
+        self._h_rehome = None
+        self._h_restart = None
+        if registry is not None:
+            from neuronx_distributed_tpu.observability.registry import (
+                MetricsView,
+            )
+
+            self._view = MetricsView(registry)
+            self._g_probe = self._view.family(
+                "gauge", "router_probe_state",
+                help="watchdog probe state per replica "
+                     "(0=ok 1=suspect 2=degraded 3=dead)",
+            )
+            self._g_transport = self._view.family(
+                "gauge", "router_transport_events",
+                help="transport seam counters (messages/retries/drops/"
+                     "dups/timeouts/partitions/dedup hits)",
+            )
+            self._h_rehome = self._view.histogram(
+                "router_rehome_latency_s",
+                help="wall time to re-home one dead replica's queue",
+            )
+            self._h_restart = self._view.histogram(
+                "router_restart_latency_s",
+                help="wall time of restart_replica (spawn + state restore)",
+            )
+            for i in range(len(self.replicas)):
+                self._view.child(self._g_probe, f"replica{i}").set(0)
+
+    def _now(self) -> float:
+        if self._time_fn is not None:
+            return self._time_fn()
+        return self.replicas[0]._clock()
 
     # --- construction -------------------------------------------------------
 
     @classmethod
     def build(cls, model, params, n_replicas: int, registry=None,
-              engine_label: str = "replica", **engine_kwargs
-              ) -> "ReplicaRouter":
+              engine_label: str = "replica", transport=None,
+              watchdog: Optional[WatchdogConfig] = None,
+              **engine_kwargs) -> "ReplicaRouter":
         """Build N identically-configured replicas sharing ``params`` (one
         host copy — placement may still differ per mesh) and, when a
         ``registry`` is given, one labeled metrics registry
         (``{engine_label}{i}`` children) so all replicas scrape as one
-        endpoint."""
+        endpoint. A router built this way remembers the recipe, so
+        :meth:`add_replica` / :meth:`restart_replica` can mint identical
+        replicas live."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         replicas = []
@@ -107,7 +263,218 @@ class ReplicaRouter:
                     model, params, rid_base=i * RID_STRIDE, **kwargs
                 )
             )
-        return cls(replicas)
+        router = cls(replicas, transport=transport, watchdog=watchdog,
+                     registry=registry)
+        router._factory = {
+            "model": model,
+            "params": params,
+            "engine_kwargs": dict(engine_kwargs),
+            "registry": registry,
+            "engine_label": engine_label,
+        }
+        return router
+
+    def _spawn(self, **overrides) -> ServingEngine:
+        """Construct one more replica from the build() recipe, minting
+        from the next disjoint rid range."""
+        if self._factory is None:
+            raise ValueError(
+                "this router was not built by ReplicaRouter.build() — "
+                "pass an engine to add_replica() instead"
+            )
+        f = self._factory
+        kwargs = dict(f["engine_kwargs"])
+        kwargs.update(overrides)
+        if f["registry"] is not None:
+            kwargs.setdefault("registry", f["registry"])
+            kwargs.setdefault(
+                "engine_label", f"{f['engine_label']}{self._next_base}"
+            )
+        return ServingEngine(
+            f["model"], f["params"],
+            rid_base=self._next_base * RID_STRIDE, **kwargs
+        )
+
+    def add_replica(self, engine: Optional[ServingEngine] = None,
+                    cache_dir: Optional[str] = None,
+                    manifest=None, adopt_backlog: bool = True,
+                    **overrides) -> int:
+        """Join one replica LIVE — survivors keep stepping throughout.
+        With no ``engine`` the router mints one from the build() recipe
+        (``overrides`` update its kwargs — e.g. ``fault_injector=None``
+        for a clean replacement). ``cache_dir`` warm-spawns: the new
+        replica's programs come off the AOT cache (``engine.prewarm``)
+        before it takes work. With ``adopt_backlog`` the router rebalances
+        queued (never-admitted) requests from the most-loaded survivors
+        onto the newcomer through the transport adopt path. Returns the
+        new replica's index."""
+        if engine is None:
+            engine = self._spawn(**overrides)
+        else:
+            bases = {e._next_rid for e in self.replicas}
+            if engine._next_rid in bases:
+                raise ValueError(
+                    "joining replica must mint from a disjoint rid_base "
+                    "range (rid collisions break re-homing)"
+                )
+        if cache_dir is not None or manifest is not None:
+            engine.prewarm(manifest=manifest, cache_dir=cache_dir)
+        idx = len(self.replicas)
+        self.replicas.append(engine)
+        self.routed_by_replica.append(0)
+        self._probe.append(_ProbeState(next_probe=self._now()))
+        self._next_base += 1
+        self.stats["replicas_joined"] += 1
+        if self._view is not None:
+            self._view.child(self._g_probe, f"replica{idx}").set(0)
+        flight = getattr(engine, "flight", None)
+        if flight is not None:
+            flight.record("join", replica=idx)
+        if adopt_backlog:
+            self._rebalance_into(idx)
+        return idx
+
+    def _rebalance_into(self, new_idx: int) -> int:
+        """Move queued never-admitted requests from the most-loaded
+        accepting survivors onto a fresh replica until depths are within
+        one of each other. Tail-first from each donor: older work keeps
+        its position in the donor's queue."""
+        moved = 0
+        while True:
+            new_depth = self.replicas[new_idx].scheduler.queued
+            donors = [
+                i for i in self._accepting()
+                if i != new_idx
+                and self.replicas[i].scheduler.queued > new_depth + 1
+            ]
+            if not donors:
+                break
+            donor_idx = max(
+                donors, key=lambda i: self.replicas[i].scheduler.queued
+            )
+            donor = self.replicas[donor_idx]
+            req = next(
+                (r for r in reversed(donor.scheduler.queued_requests)
+                 if r.admit_time is None),
+                None,
+            )
+            if req is None:
+                break
+            got, cb = donor.release_queued(req.rid)
+            if got is None:
+                break
+            self.transport.call(
+                new_idx, "adopt",
+                lambda r=got, c=cb: self.replicas[new_idx].adopt(
+                    r, on_token=c
+                ),
+                rid=got.rid,
+            )
+            moved += 1
+        self.stats["rebalanced_requests"] += moved
+        return moved
+
+    def remove_replica(self, idx: int,
+                       rehome_queued: bool = True) -> ServingEngine:
+        """Drain one replica OUT live: it stops receiving new work
+        (DRAINING contract), its never-admitted queue re-homes to
+        survivors (``rehome_queued``), its admitted in-flight work runs to
+        completion, and ``step()`` retires it once idle. Returns the
+        engine (still stepping until drained)."""
+        if idx in self._dead or not (0 <= idx < len(self.replicas)):
+            raise ValueError(f"replica {idx} is not live")
+        e = self.replicas[idx]
+        e.drain()
+        self._draining_out.add(idx)
+        if rehome_queued:
+            for req in list(e.scheduler.queued_requests):
+                if req.admit_time is not None:
+                    continue  # admitted work finishes here per the contract
+                targets = [t for t in self._accepting() if t != idx]
+                if not targets:
+                    break
+                target = min(
+                    targets,
+                    key=lambda t: self.replicas[t].load_score(
+                        tenant=req.tenant
+                    ),
+                )
+                got, cb = e.release_queued(req.rid)
+                if got is None:
+                    continue
+                self.transport.call(
+                    target, "adopt",
+                    lambda t=target, r=got, c=cb: self.replicas[t].adopt(
+                        r, on_token=c
+                    ),
+                    rid=got.rid,
+                )
+                self.stats["rehomed_requests"] += 1
+        flight = getattr(e, "flight", None)
+        if flight is not None:
+            flight.record("drain_out", replica=idx)
+        return e
+
+    def restart_replica(self, idx: int, cache_dir: Optional[str] = None,
+                        manifest=None, **overrides) -> int:
+        """Warm-restart a fenced/halted replica: snapshot its host-current
+        serving state, SURRENDER its queue to the snapshot (so the
+        halted-re-home path can never double-admit what the restore now
+        owns), spawn a replacement off the build() recipe (AOT-prewarmed
+        when ``cache_dir`` is given), and restore the state there — every
+        request continues bit-identically with its remaining deadline
+        budget and its streaming callback intact. Returns the replacement
+        index.
+
+        Raises ``ValueError`` if the replica's work was already re-homed
+        (``step()`` or the watchdog got there first — spawn a fresh
+        replica with :meth:`add_replica` instead)."""
+        if idx in self._dead:
+            raise ValueError(
+                f"replica {idx} was already re-homed — its work lives on "
+                "the survivors; use add_replica() for a fresh replacement"
+            )
+        if not (0 <= idx < len(self.replicas)):
+            raise ValueError(f"replica {idx} does not exist")
+        t0 = time.perf_counter()
+        dead = self.replicas[idx]
+        if dead.health() is not EngineHealth.HALTED:
+            dead.fence("restart_replica")
+        snap = dead.snapshot_serving_state()
+        # surrender: the snapshot now owns this work — withdraw it (and
+        # its callbacks) from the dead replica entirely
+        callbacks = {}
+        for req in list(dead.scheduler.queued_requests):
+            dead.scheduler.release(req.rid)
+            cb = dead._on_token.pop(req.rid, None)
+            if cb is not None:
+                callbacks[req.rid] = cb
+        self._dead.add(idx)
+        self._draining_out.discard(idx)
+        if self._view is not None:
+            self._view.child(self._g_probe, f"replica{idx}").set(
+                _PROBE_CODE["dead"]
+            )
+        new_idx = self.add_replica(
+            cache_dir=cache_dir, manifest=manifest, adopt_backlog=False,
+            **overrides,
+        )
+        e = self.replicas[new_idx]
+        report = self.transport.call(
+            new_idx, "restore", lambda: e.restore_serving_state(snap)
+        )
+        for rid, cb in callbacks.items():
+            e._on_token[rid] = cb
+        self.stats["replicas_restarted"] += 1
+        if self._h_restart is not None:
+            self._h_restart.observe(time.perf_counter() - t0)
+        flight = getattr(e, "flight", None)
+        if flight is not None:
+            flight.record(
+                "restart", replica=idx, replacement=new_idx,
+                restored=report["restored"],
+            )
+        return new_idx
 
     # --- routing ------------------------------------------------------------
 
@@ -117,14 +484,22 @@ class ReplicaRouter:
     def _accepting(self) -> List[int]:
         """Replica indices that may receive NEW work: OK first; DEGRADED
         only when no OK replica exists (drain-around); DRAINING/HALTED
-        never."""
+        never. The watchdog's view composes in: probe-DEGRADED replicas
+        drain around like engine-DEGRADED ones, probe-DEAD never accept.
+        Probe-SUSPECT still accepts — demotion to SUSPECT is a note, not
+        a verdict (that hysteresis is what keeps a flapping replica from
+        bouncing in and out of rotation)."""
         ok, degraded = [], []
         for i in self._live():
+            ps = self._probe[i].state
+            if ps == "dead":
+                continue
             h = self.replicas[i].health()
-            if h is EngineHealth.OK:
+            if h is EngineHealth.OK and ps in ("ok", "suspect"):
                 ok.append(i)
-            elif h is EngineHealth.DEGRADED:
-                degraded.append(i)
+            elif h is EngineHealth.DEGRADED or ps == "degraded":
+                if h not in (EngineHealth.DRAINING, EngineHealth.HALTED):
+                    degraded.append(i)
         return ok if ok else degraded
 
     def _pick(self, prompt: np.ndarray,
@@ -177,20 +552,34 @@ class ReplicaRouter:
         priority: Optional[str] = None,
     ) -> Request:
         """Route one request to the best replica (affinity → health →
-        load), spilling to the next-best on a bounded-queue rejection;
-        raises :class:`RejectedError` only when EVERY accepting replica
+        load), spilling to the next-best on a bounded-queue rejection OR
+        an unreachable replica (transport failure after retries); raises
+        :class:`RejectedError` only when EVERY accepting replica
         refused."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         last_reject: Optional[RejectedError] = None
         for rank, i in enumerate(self._pick(prompt, tenant=tenant)):
             try:
-                req = self.replicas[i].submit(
-                    prompt, config, key=key, on_token=on_token,
-                    deadline_s=deadline_s, queue_timeout_s=queue_timeout_s,
-                    tenant=tenant, priority=priority,
+                req = self.transport.call(
+                    i, "submit",
+                    lambda e=self.replicas[i]: e.submit(
+                        prompt, config, key=key, on_token=on_token,
+                        deadline_s=deadline_s,
+                        queue_timeout_s=queue_timeout_s,
+                        tenant=tenant, priority=priority,
+                    ),
                 )
             except RejectedError as e:
                 last_reject = e
+                if rank == 0:
+                    self.stats["spillovers"] += 1
+                continue
+            except TransportError as e:
+                self.stats["transport_failures"] += 1
+                last_reject = RejectedError(
+                    f"replica {i} unreachable: {e}",
+                    queue_depth=self.replicas[i].scheduler.queued,
+                )
                 if rank == 0:
                     self.stats["spillovers"] += 1
                 continue
@@ -200,15 +589,105 @@ class ReplicaRouter:
         assert last_reject is not None
         raise last_reject
 
+    # --- watchdog (ISSUE 18) ------------------------------------------------
+
+    def _probe_transition(self, i: int, ps: _ProbeState, new: str,
+                          why: Optional[str]) -> None:
+        was, ps.state = ps.state, new
+        if self._view is not None:
+            self._view.child(self._g_probe, f"replica{i}").set(
+                _PROBE_CODE[new]
+            )
+        flight = getattr(self.replicas[i], "flight", None)
+        if flight is not None:
+            flight.record(
+                "probe_state", replica=i, was=was, now=new,
+                fails=ps.fails, why=why,
+            )
+
+    def _run_watchdog(self, now: float) -> None:
+        cfg = self.watchdog
+        for i in self._live():
+            ps = self._probe[i]
+            if ps.state == "dead" or now < ps.next_probe:
+                continue
+            ps.next_probe = now + cfg.probe_interval_s
+            self.stats["probes"] += 1
+            err = None
+            try:
+                h = self.transport.probe(
+                    i, lambda e=self.replicas[i]: e.health().value,
+                    deadline_s=cfg.probe_timeout_s,
+                )
+                if h == "halted":
+                    err = "replica reports halted"
+            except TransportError as e:
+                err = f"{type(e).__name__}: {e}"
+            if err is None:
+                self._probe_success(i, ps)
+            else:
+                self._probe_failure(i, ps, err, now)
+
+    def _probe_success(self, i: int, ps: _ProbeState) -> None:
+        ps.fails = 0
+        ps.last_err = None
+        if ps.state == "ok":
+            ps.oks = 0
+            return
+        ps.oks += 1
+        if ps.oks >= self.watchdog.recover_after:
+            # hysteresis: recovery is earned one level at a time, and the
+            # success streak resets — a flapper stays demoted
+            ps.oks = 0
+            self._probe_transition(
+                i, ps, _PROBE_ORDER[_PROBE_CODE[ps.state] - 1], "recovered"
+            )
+
+    def _probe_failure(self, i: int, ps: _ProbeState, err: str,
+                       now: float) -> None:
+        ps.fails += 1
+        ps.oks = 0
+        ps.last_err = err
+        self.stats["probe_failures"] += 1
+        cfg = self.watchdog
+        if ps.fails >= cfg.dead_after:
+            new = "dead"
+        elif ps.fails >= cfg.degraded_after:
+            new = "degraded"
+        elif ps.fails >= cfg.suspect_after:
+            new = "suspect"
+        else:
+            new = ps.state
+        # demotion only — a failure never improves the state
+        if _PROBE_CODE[new] > _PROBE_CODE[ps.state]:
+            self._probe_transition(i, ps, new, err)
+            if new == "dead":
+                self._declare_dead(i, err)
+
+    def _declare_dead(self, idx: int, err: str) -> None:
+        """Probe-death: fence the replica (the in-process STONITH — a
+        partitioned-but-alive replica must stop making progress before
+        its work runs elsewhere, or two engines would stream one rid),
+        then re-home its queue through the standard halt/adopt path."""
+        self.stats["watchdog_deaths"] += 1
+        ps = self._probe[idx]
+        self.replicas[idx].fence(
+            f"watchdog: {ps.fails} consecutive probe failures ({err})"
+        )
+        self._rehome(idx)
+
     # --- stepping / fault handling ------------------------------------------
 
     def _rehome(self, dead_idx: int) -> int:
         """Move a HALTED replica's queued work (requeued in-flight victims
         included — the engine's halt contract put them back with
-        host-current tokens/keys) to survivors. Returns how many requests
-        moved; unfinished work with no accepting survivor stays queued on
-        the dead replica for operator handoff."""
+        host-current tokens/keys) to survivors. Adopt rides the transport,
+        so a duplicated or retried move still admits exactly once; a
+        request whose adopt cannot be delivered stays queued on the dead
+        replica for operator handoff (callback restored). Returns how many
+        requests moved."""
         dead = self.replicas[dead_idx]
+        t0 = time.perf_counter()
         moved = 0
         for req in list(dead.scheduler.queued_requests):
             targets = self._accepting()
@@ -222,17 +701,38 @@ class ReplicaRouter:
                 ),
             )
             cb = dead._on_token.pop(req.rid, None)
-            self.replicas[target].adopt(req, on_token=cb)
+            try:
+                self.transport.call(
+                    target, "adopt",
+                    lambda t=target, r=req, c=cb: self.replicas[t].adopt(
+                        r, on_token=c
+                    ),
+                    rid=req.rid,
+                )
+            except TransportError:
+                self.stats["transport_failures"] += 1
+                if cb is not None:
+                    dead._on_token[req.rid] = cb
+                continue
             moved += 1
         self._dead.add(dead_idx)
+        self._draining_out.discard(dead_idx)
         self.stats["replicas_drained"] += 1
         self.stats["rehomed_requests"] += moved
+        if self._h_rehome is not None:
+            self._h_rehome.observe(time.perf_counter() - t0)
+        flight = getattr(dead, "flight", None)
+        if flight is not None:
+            flight.record("rehome", replica=dead_idx, moved=moved)
         return moved
 
     def step(self) -> bool:
-        """One router iteration: re-home any newly-halted replica's work,
-        then step every live replica that has work. Returns whether work
-        remains anywhere."""
+        """One router iteration: run the watchdog (probe-deaths fence and
+        re-home inline), re-home any newly-halted replica's work, step
+        every live replica that has work, and retire replicas that
+        finished draining out. Returns whether work remains anywhere."""
+        if self.watchdog is not None:
+            self._run_watchdog(self._now())
         for i in self._live():
             if self.replicas[i].health() is EngineHealth.HALTED:
                 self._rehome(i)
@@ -240,6 +740,17 @@ class ReplicaRouter:
             e = self.replicas[i]
             if e.has_work:
                 e.step()
+        for i in list(self._draining_out):
+            if i not in self._dead and not self.replicas[i].has_work:
+                self._dead.add(i)
+                self._draining_out.discard(i)
+                self.stats["replicas_removed"] += 1
+                flight = getattr(self.replicas[i], "flight", None)
+                if flight is not None:
+                    flight.record("drained_out", replica=i)
+        if self._view is not None:
+            for k, v in self.transport.stats.items():
+                self._view.child(self._g_transport, k).set(v)
         return self.has_work
 
     def run(self, max_steps: int = 1_000_000) -> Dict[int, Request]:
@@ -296,6 +807,14 @@ class ReplicaRouter:
             agg = "ok"
         return {"aggregate": agg, **per}
 
+    def probe_states(self) -> Dict[str, str]:
+        """The watchdog's per-replica verdicts (``ok`` everywhere when no
+        watchdog is configured)."""
+        return {
+            f"replica{i}": self._probe[i].state
+            for i in range(len(self.replicas))
+        }
+
     def snapshot(self) -> dict:
         """Router bookkeeping + per-replica metrics snapshots (replicas
         built over one labeled registry ALSO aggregate into a single
@@ -305,6 +824,8 @@ class ReplicaRouter:
                 **self.stats,
                 "routed_by_replica": list(self.routed_by_replica),
                 "health": self.health(),
+                "probe_states": self.probe_states(),
+                "transport": self.transport.snapshot(),
             },
             "replicas": {
                 f"replica{i}": e.metrics.snapshot()

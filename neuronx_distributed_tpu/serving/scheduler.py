@@ -157,6 +157,24 @@ class Scheduler:
             pass  # already admitted; the engine frees its slot
         return True
 
+    def release(self, rid: int) -> Optional[Request]:
+        """Withdraw a QUEUED request from this scheduler entirely — queue
+        AND index — and return it live (state untouched) so ANOTHER engine
+        can adopt it (router rebalancing / surrendered warm-restart state).
+        Unlike :meth:`cancel` the request is not finished, and unlike a
+        halt handoff this scheduler forgets the rid, so a later restore
+        can never double-admit it here. Returns ``None`` when the rid is
+        unknown, finished, or not currently queued."""
+        req = self._requests.get(rid)
+        if req is None or req.finished or req.state is not RequestState.QUEUED:
+            return None
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            return None  # admitted into a slot — not releasable
+        del self._requests[rid]
+        return req
+
     def expire(self, now: float) -> List[tuple]:
         """Pop every still-queued request whose queue timeout or overall
         deadline has passed (``now >= deadline``) and return ``(request,

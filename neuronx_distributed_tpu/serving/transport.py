@@ -1,0 +1,319 @@
+"""Message seam for the elastic serving fabric.  # graftlint: hot-path
+
+Every router→replica and disagg handoff interaction (submit, adopt,
+page-span export/import, probe, restore) rides a :class:`Transport`
+instead of a bare Python method call.  Two implementations ship:
+
+* :class:`InProcessTransport` — the production default.  With no faults
+  the target callable runs synchronously exactly once and its result is
+  returned unchanged, so behaviour is **bit-identical to a direct
+  call**; the only additions are the envelope bookkeeping (host-side
+  integer arithmetic) and the idempotency cache.
+* :class:`ChaosTransport` — consults a
+  :class:`~neuronx_distributed_tpu.serving.faults.FaultInjector` before
+  each delivery attempt and deterministically drops, duplicates, delays
+  or partitions messages by send index.
+
+Reliability contract
+--------------------
+Each logical message is wrapped in an :class:`Envelope` carrying an
+``(rid, seq)`` idempotency key — ``seq`` is minted ONCE per
+:meth:`Transport.call`, so every retry of the same logical message
+reuses the same key while a genuinely new message gets a fresh one.
+Delivery consults a bounded dedup cache keyed by
+``(target, op, rid, seq)``: a duplicated or retried delivery whose
+first attempt already ran returns the **cached outcome** (result or
+application exception) without invoking the target again.  That is the
+exactly-once guarantee the fabric leans on: a retried or duplicated
+handoff can never double-admit a request or double-count tokens.
+
+Sends ride :func:`~neuronx_distributed_tpu.utils.retry.with_retries`
+with ``TransportError`` transient; an optional per-message deadline
+(``deadline_s``) is checked before every attempt and raises
+:class:`TransportTimeout` — a *passthrough* error, because once the
+deadline has lapsed more retries cannot help.
+
+Everything here is host-side scalar bookkeeping — no device values ever
+enter an envelope, so the seam adds zero host↔device syncs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from neuronx_distributed_tpu.utils.retry import RetryPolicy, with_retries
+
+__all__ = [
+    "Envelope",
+    "TransportError",
+    "TransportTimeout",
+    "PartitionedError",
+    "InProcessTransport",
+    "ChaosTransport",
+    "DEFAULT_TRANSPORT_RETRY",
+]
+
+
+class TransportError(RuntimeError):
+    """A send failed in flight (drop, lost ack, partition) — retryable."""
+
+
+class TransportTimeout(TransportError):
+    """The message's deadline lapsed before delivery — NOT retried."""
+
+
+class PartitionedError(TransportError):
+    """The target is unreachable (network partition window) — retryable,
+    but every attempt inside the window fails the same way."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Envelope:
+    """One logical message.  ``(rid, seq)`` is the idempotency key —
+    ``seq`` is per-transport monotone and minted once per logical
+    message, so retries reuse it and duplicates are detectable.
+    ``deadline`` is absolute on the transport's clock (None = no
+    deadline)."""
+
+    op: str
+    rid: int
+    seq: int
+    deadline: Optional[float] = None
+
+
+# Waits are tiny because the in-process "network" recovers instantly —
+# the retry count (5 attempts) is what matters for riding out bounded
+# fault windows, not the backoff duration.  sleep defaults to a no-op
+# so retries are free under virtual-clock tests.
+DEFAULT_TRANSPORT_RETRY = RetryPolicy(max_attempts=5, first_wait=0.02, min_wait=0.005)
+
+_PROBE_RETRY = RetryPolicy(max_attempts=1, first_wait=0.0, min_wait=0.0)
+
+
+class InProcessTransport:
+    """Default transport: synchronous local delivery, exactly once.
+
+    ``time_fn`` supplies the clock deadlines are checked against (share
+    the engines' virtual clock in tests).  ``sleep_fn`` is called with
+    each retry backoff — default no-op, since an in-process resend has
+    nothing to wait for.  The dedup cache is bounded (FIFO eviction at
+    ``dedup_capacity`` entries) — old outcomes age out long after any
+    retry of their message could still arrive.
+    """
+
+    #: True on transports that may inject faults — lets callers log it.
+    faulty = False
+
+    def __init__(
+        self,
+        time_fn: Callable[[], float] = time.monotonic,
+        sleep_fn: Optional[Callable[[float], None]] = None,
+        retry: RetryPolicy = DEFAULT_TRANSPORT_RETRY,
+        dedup_capacity: int = 4096,
+    ):
+        if dedup_capacity < 1:
+            raise ValueError(f"dedup_capacity must be >= 1, got {dedup_capacity}")
+        self._clock = time_fn
+        self._sleep = sleep_fn if sleep_fn is not None else (lambda _s: None)
+        self.retry = retry
+        self._seq = 0
+        self._send_idx = 0  # delivery attempts, across all messages
+        self._dedup: "OrderedDict[Tuple[Any, str, int, int], Tuple[str, Any]]" = OrderedDict()
+        self._dedup_capacity = int(dedup_capacity)
+        self.stats: Dict[str, int] = {
+            "messages": 0,
+            "deliveries": 0,
+            "retries": 0,
+            "drops": 0,
+            "ack_drops": 0,
+            "dup_deliveries": 0,
+            "delays": 0,
+            "timeouts": 0,
+            "partitioned": 0,
+            "dedup_hits": 0,
+            "give_ups": 0,
+        }
+
+    # --- sending ------------------------------------------------------------
+
+    def call(
+        self,
+        target: Any,
+        op: str,
+        fn: Callable[[], Any],
+        *,
+        rid: int = -1,
+        deadline_s: Optional[float] = None,
+        retry: Optional[RetryPolicy] = None,
+    ) -> Any:
+        """Send one logical message: run ``fn`` at ``target`` and return
+        its result.  ``target`` is any hashable address (replica index,
+        ``"decode"``, ...).  ``rid`` attributes the message to a request
+        for the idempotency key; ``deadline_s`` bounds total delivery
+        time from now; ``retry`` overrides the transport policy (probes
+        pass a single-attempt policy so one probe = one verdict).
+
+        Application exceptions raised by ``fn`` propagate unchanged (and
+        are cached, so a duplicate delivery re-raises without re-running
+        ``fn``).  :class:`TransportError` is retried per policy;
+        :class:`TransportTimeout` is terminal.
+        """
+        self._seq += 1
+        now = self._clock()
+        env = Envelope(
+            op=op,
+            rid=int(rid),
+            seq=self._seq,
+            deadline=None if deadline_s is None else now + float(deadline_s),
+        )
+        self.stats["messages"] += 1
+        attempts = 0
+
+        def _once():
+            nonlocal attempts
+            if attempts:
+                self.stats["retries"] += 1
+            attempts += 1
+            if env.deadline is not None and self._clock() > env.deadline:
+                self.stats["timeouts"] += 1
+                raise TransportTimeout(
+                    f"transport {env.op} to {target!r} (rid={env.rid}, "
+                    f"seq={env.seq}) missed its {deadline_s}s deadline"
+                )
+            return self._attempt(target, env, fn)
+
+        try:
+            return with_retries(
+                _once,
+                what=f"transport {op} -> {target!r}",
+                policy=self.retry if retry is None else retry,
+                transient=(TransportError,),
+                passthrough=(TransportTimeout,),
+                sleep=self._sleep,
+            )
+        except TransportError:
+            self.stats["give_ups"] += 1
+            raise
+
+    def probe(self, target: Any, fn: Callable[[], Any], *, deadline_s: Optional[float] = None) -> Any:
+        """A single-attempt health probe: one send, one verdict — probe
+        retrying is the watchdog's job (that's what its consecutive-
+        failure thresholds count)."""
+        return self.call(target, "probe", fn, deadline_s=deadline_s, retry=_PROBE_RETRY)
+
+    # --- delivery -----------------------------------------------------------
+
+    def _attempt(self, target: Any, env: Envelope, fn: Callable[[], Any]) -> Any:
+        """One delivery attempt.  Subclasses inject faults here."""
+        self._send_idx += 1
+        return self._deliver(target, env, fn)
+
+    def _deliver(self, target: Any, env: Envelope, fn: Callable[[], Any]) -> Any:
+        key = (target, env.op, env.rid, env.seq)
+        hit = self._dedup.get(key)
+        if hit is not None:
+            # Exactly-once: this (rid, seq) already ran — hand back the
+            # recorded outcome instead of invoking the target again.
+            self.stats["dedup_hits"] += 1
+            kind, value = hit
+            if kind == "raise":
+                raise value
+            return value
+        self.stats["deliveries"] += 1
+        try:
+            result = fn()
+        except Exception as e:  # application outcome — cached, never retried
+            self._remember(key, ("raise", e))
+            raise
+        self._remember(key, ("ok", result))
+        return result
+
+    def _remember(self, key, outcome: Tuple[str, Any]) -> None:
+        self._dedup[key] = outcome
+        while len(self._dedup) > self._dedup_capacity:
+            self._dedup.popitem(last=False)
+
+    # --- introspection ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, int]:
+        out = dict(self.stats)
+        out["dedup_entries"] = len(self._dedup)
+        return out
+
+
+class ChaosTransport(InProcessTransport):
+    """Fault-injecting transport: before each delivery attempt it asks
+    ``faults.on_transport_send(send_idx, target, op)`` for an action —
+    drop the send, drop only the ack (deliver, then report failure so
+    the sender retries into the dedup cache), duplicate the delivery,
+    delay it against the message deadline, or fail the whole window as
+    a partition.  ``send_idx`` is the transport-wide monotone attempt
+    counter, so a fault schedule is deterministic for a deterministic
+    workload."""
+
+    faulty = True
+
+    def __init__(self, faults, **kwargs):
+        super().__init__(**kwargs)
+        self.faults = faults
+
+    def _attempt(self, target: Any, env: Envelope, fn: Callable[[], Any]) -> Any:
+        idx = self._send_idx
+        self._send_idx += 1
+        action = None
+        if self.faults is not None:
+            action = self.faults.on_transport_send(idx, target, env.op)
+        if action is None:
+            return self._deliver(target, env, fn)
+        kind = action[0]
+        if kind == "partition":
+            self.stats["partitioned"] += 1
+            raise PartitionedError(
+                f"target {target!r} unreachable: partition window (send {idx})"
+            )
+        if kind == "drop":
+            self.stats["drops"] += 1
+            raise TransportError(
+                f"transport {env.op} to {target!r} dropped in flight (send {idx})"
+            )
+        if kind == "delay":
+            by = float(action[1])
+            self.stats["delays"] += 1
+            if env.deadline is not None and self._clock() + by > env.deadline:
+                self.stats["timeouts"] += 1
+                raise TransportTimeout(
+                    f"transport {env.op} to {target!r} delayed {by}s past its "
+                    f"deadline (send {idx})"
+                )
+            self._sleep(by)
+            return self._deliver(target, env, fn)
+        if kind == "drop_ack":
+            # The request reached the target and ran — only the reply was
+            # lost.  The sender must retry and land in the dedup cache;
+            # this is the scenario that makes exactly-once load-bearing.
+            result_ok = False
+            try:
+                self._deliver(target, env, fn)
+                result_ok = True
+            finally:
+                if result_ok:
+                    self.stats["ack_drops"] += 1
+            raise TransportError(
+                f"ack for {env.op} to {target!r} (rid={env.rid}, "
+                f"seq={env.seq}) lost (send {idx})"
+            )
+        if kind == "dup":
+            # The network delivered the same envelope twice; the second
+            # copy must hit the dedup cache, not the application — and it
+            # arrives regardless of how the first copy's outcome went, so
+            # it replays a cached app exception rather than re-running.
+            self.stats["dup_deliveries"] += 1
+            try:
+                self._deliver(target, env, fn)
+            except Exception:
+                pass
+            return self._deliver(target, env, fn)
+        raise ValueError(f"unknown transport fault action {action!r}")

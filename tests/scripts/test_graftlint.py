@@ -426,6 +426,38 @@ def test_gl02_aot_module_is_hot_by_path(tmp_path):
     assert report.violations == []
 
 
+def test_gl02_transport_module_is_hot_by_path(tmp_path):
+    """ISSUE 18 satellite: the elastic-fabric transport seam is on the
+    GL02 hot-path list BY PATH — every router->replica and prefill->decode
+    interaction (submit, adopt, probe, handoff, restore) passes through
+    ``call()``/``_deliver()``, so an implicit coercion smuggled into a
+    future edit (say of a request's device key riding an envelope) trips
+    with no marker needed — and the shipped module scans clean."""
+    fixture = """\
+        import jax.numpy as jnp
+
+        def deliver(env, payload):
+            return float(jnp.sum(payload)) if env.rid >= 0 else 0.0
+        """
+    assert "GL02" in rules_of(
+        lint(tmp_path, fixture, name="serving/transport.py")
+    )
+    # an undocumented explicit device_get in the delivery path trips too —
+    # the seam must forward payloads untouched (it carries host callables,
+    # never device values)
+    v = lint(tmp_path, """\
+        import jax
+
+        def deliver(env, payload):
+            return jax.device_get(payload)
+        """, name="serving/transport.py")
+    assert any("device_get" in x.message for x in v if x.rule == "GL02")
+    shipped = os.path.join(PKG, "serving", "transport.py")
+    assert os.path.exists(shipped)
+    report = runner.scan([shipped], root=REPO_ROOT)
+    assert report.violations == []
+
+
 # --- GL03 recompile-hazard ----------------------------------------------------
 
 

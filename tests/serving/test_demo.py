@@ -191,6 +191,38 @@ def test_serving_demo_replicas_mode_runs():
 
 
 @pytest.mark.slow
+def test_serving_demo_kill_replica_rehomes():
+    """--kill-replica K (ISSUE 18): replica K is fenced mid-run and the
+    router re-homes its work — every request still completes."""
+    snap = _load_demo().main(
+        ["--requests", "6", "--slots", "2", "--replicas", "2",
+         "--max-new-tokens", "6", "--kill-replica", "0"]
+    )
+    assert snap["router"]["routed"] == 6
+    assert snap["router"]["rehomed_requests"] >= 1
+    assert snap["router"]["health"]["replica0"] == "halted"
+    total = sum(rep["completed"] for rep in snap["replicas"].values())
+    assert total == 6
+
+
+@pytest.mark.slow
+def test_serving_demo_kill_replica_restart():
+    """--kill-replica K --restart (ISSUE 18): the killed replica is
+    warm-restarted from its host-state snapshot — a fresh replica joins,
+    the restored work finishes there, nothing re-homes."""
+    snap = _load_demo().main(
+        ["--requests", "6", "--slots", "2", "--replicas", "2",
+         "--max-new-tokens", "6", "--kill-replica", "0", "--restart"]
+    )
+    assert snap["router"]["routed"] == 6
+    assert snap["router"]["replicas_restarted"] == 1
+    assert snap["router"]["rehomed_requests"] == 0
+    assert snap["router"]["health"]["replica2"] == "ok"
+    total = sum(rep["completed"] for rep in snap["replicas"].values())
+    assert total == 6
+
+
+@pytest.mark.slow
 def test_serving_demo_disaggregate_mode_runs():
     """--disaggregate (ISSUE 14): prefill workers hand contexts to the
     decode engine by page-table mapping — zero copy bytes, every request

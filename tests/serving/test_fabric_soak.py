@@ -1,0 +1,251 @@
+"""Cross-feature chaos soak (ISSUE 18 satellite): the elastic fabric must
+COMPOSE with everything underneath it. One bursty multi-tenant tape drives
+a router of replicas that stack the SLO scheduling policy (ISSUE 16), the
+paged KV layout (ISSUE 10), and — in the heavy matrix — disaggregated
+prefill (ISSUE 14), while the ChaosTransport duplicates/drops/delays
+messages and one replica dies mid-tape (halt-fence in one entry, watchdog
+partition-death in the other).
+
+The oracle is a plain fault-free FIFO row-layout engine replaying the SAME
+tape: every layer above it — policy reordering, paging, disaggregation,
+routing, re-homing, the transport's retries and dedup — is placement and
+recovery, never math, so per-arrival token streams must be IDENTICAL and
+``tokens_lost == 0``.
+
+Tier budget (PR 5/13 lean-core policy): the single-composition core slice
+is tier-1; the full matrix (longer tape, disagg entry) is ``slow``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig, generate
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.serving import (
+    ChaosTransport,
+    DisaggregatedServer,
+    FaultInjector,
+    RejectedError,
+    ReplicaRouter,
+    RequestState,
+    ServingEngine,
+    SloPolicy,
+    TenantProfile,
+    VirtualClock,
+    WatchdogConfig,
+    generate_tape,
+    replay,
+    tape_bytes,
+)
+from neuronx_distributed_tpu.serving.router import RID_STRIDE
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama(num_layers=2, hidden_size=32,
+                     intermediate_size=96, vocab_size=128)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+def _tenants():
+    return [
+        TenantProfile("chat", rate_rps=1.5, arrival="bursty",
+                      workload="chat", priority="interactive",
+                      temperature=0.8, burst_factor=4.0,
+                      burst_period_s=3.0, burst_duty=0.3),
+        TenantProfile("docs", rate_rps=0.6, arrival="poisson",
+                      workload="longdoc", priority="batch"),
+    ]
+
+
+def _reference_streams(model, params, tape):
+    """Fault-free FIFO row-layout oracle: the tape through ONE plain
+    engine; per-arrival token streams in tape order."""
+    clock = VirtualClock()
+    engine = ServingEngine(
+        model, params, num_slots=4, decode_chunk_size=2,
+        prefix_cache=None, time_fn=clock,
+    )
+    replay(engine, tape, clock, step_dt=0.05)
+    reqs = sorted(engine.scheduler.requests.values(), key=lambda r: r.rid)
+    assert len(reqs) == len(tape)
+    assert all(r.state is RequestState.DONE for r in reqs)
+    return [list(r.tokens) for r in reqs]
+
+
+def _replay_router(router, tape, clock, kill_at=None, kill_fn=None,
+                   step_dt=0.05, max_steps=100_000):
+    """Open-loop tape replay through a ReplicaRouter: arrivals submit at
+    their virtual times, each step costs ``step_dt``, idle gaps fast-
+    forward. ``kill_fn`` fires once, right after arrival ``kill_at``
+    submits — mid-tape, with work in flight."""
+    reqs = []
+    i = 0
+    steps = 0
+    killed = False
+    while i < len(tape) or router.has_work:
+        while i < len(tape) and tape[i].t <= clock.now:
+            a = tape[i]
+            i += 1
+            cfg = GenerationConfig(
+                max_new_tokens=a.max_new_tokens,
+                temperature=a.temperature, eos_token_id=None,
+            )
+            reqs.append(router.submit(
+                np.asarray(a.prompt, np.int32), cfg,
+                key=jax.random.PRNGKey(a.key_seed),
+                tenant=a.tenant, priority=a.priority,
+            ))
+            if not killed and kill_at is not None and len(reqs) > kill_at:
+                killed = True
+                kill_fn()
+        if not router.has_work:
+            if i < len(tape):
+                clock.advance_to(tape[i].t)
+                continue
+            break
+        if steps >= max_steps:
+            break
+        router.step()
+        steps += 1
+        clock.advance(step_dt)
+    return reqs
+
+
+def _chaos_faults():
+    """Scattered transport misbehavior across the whole run: duplicated,
+    dropped (retried), and delayed sends — none may lose or double-count
+    a token thanks to the retry policy + (rid, seq) dedup."""
+    return (
+        FaultInjector()
+        .dup_send(at=3, times=2)
+        .drop_send(at=9, times=2)
+        .delay_send(at=15, times=2, by=0.01)
+        .dup_send(at=24, times=1)
+        .drop_send(at=33, times=1)
+    )
+
+
+@pytest.mark.chaos
+def test_fabric_soak_core_slice(setup):
+    """Tier-1 core slice: SLO policy + paged KV replicas behind the
+    router, chaos transport (dup/drop/delay), replica 0 halt-fenced
+    mid-tape → re-home. Every stream matches the fault-free oracle."""
+    cfg, model, params = setup
+    tape = generate_tape(
+        _tenants(), duration_s=2.5, seed=18, vocab_size=cfg.vocab_size
+    )
+    assert tape_bytes(tape) == tape_bytes(generate_tape(
+        _tenants(), duration_s=2.5, seed=18, vocab_size=cfg.vocab_size
+    ))
+    refs = _reference_streams(model, params, tape)
+
+    clock = VirtualClock()
+    # tight fault windows: the short tape sends only a handful of messages
+    inj = (
+        FaultInjector()
+        .dup_send(at=1, times=1)
+        .drop_send(at=3, times=1)
+        .delay_send(at=5, times=1, by=0.01)
+    )
+    transport = ChaosTransport(inj, time_fn=clock)
+    router = ReplicaRouter.build(
+        model, params, 2, num_slots=2, decode_chunk_size=2,
+        prefix_cache=None, kv_page_size=8, scheduling=SloPolicy(),
+        time_fn=clock, transport=transport,
+    )
+    reqs = _replay_router(
+        router, tape, clock, kill_at=min(2, len(tape) - 1),
+        kill_fn=lambda: router.replicas[0].fence("soak kill"),
+    )
+    assert router.replicas[0].health().value == "halted"
+    assert router.stats["replicas_drained"] == 1
+    tokens_lost = 0
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        final = router.requests[req.rid]
+        assert final.state is RequestState.DONE, f"arrival {i} stranded"
+        if final.tokens != ref:
+            tokens_lost += 1
+    assert tokens_lost == 0
+    # the chaos really happened
+    assert inj.counters["dup_sends"] >= 1
+    assert inj.counters["dropped_sends"] >= 1
+    assert transport.stats["retries"] >= 1
+    assert transport.stats["dedup_hits"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("entry", ["halt_fence", "watchdog_partition_disagg"])
+def test_fabric_soak_matrix(setup, entry):
+    """The full matrix on a longer bursty tape. ``halt_fence``: paged +
+    SLO-policy replicas, replica 0 fenced mid-burst. ``
+    watchdog_partition_disagg``: the replicas are DISAGGREGATED servers
+    (prefill workers + page-table handoffs riding the same transport),
+    and replica 0 dies the REALISTIC way — a network partition the
+    watchdog walks to DEAD while the tape keeps arriving."""
+    cfg, model, params = setup
+    tape = generate_tape(
+        _tenants(), duration_s=6.0, seed=77, vocab_size=cfg.vocab_size
+    )
+    refs = _reference_streams(model, params, tape)
+
+    clock = VirtualClock()
+    inj = _chaos_faults()
+    transport = ChaosTransport(inj, time_fn=clock)
+    if entry == "halt_fence":
+        router = ReplicaRouter.build(
+            model, params, 2, num_slots=2, decode_chunk_size=2,
+            prefix_cache=None, kv_page_size=8, scheduling=SloPolicy(),
+            time_fn=clock, transport=transport,
+        )
+        kill = lambda: router.replicas[0].fence("soak kill")  # noqa: E731
+    else:
+        replicas = []
+        for i in range(2):
+            engine = ServingEngine(
+                model, params, num_slots=2, decode_chunk_size=2,
+                prefix_cache=None, kv_page_size=8,
+                scheduling=SloPolicy(), time_fn=clock,
+                rid_base=i * RID_STRIDE,
+            )
+            replicas.append(DisaggregatedServer(
+                engine, n_workers=1, transport=transport
+            ))
+        router = ReplicaRouter(
+            replicas, transport=transport,
+            watchdog=WatchdogConfig(), time_fn=clock,
+        )
+        # the watchdog finds the body: probes fail from here on and the
+        # replica walks suspect→degraded→dead, is fenced, and re-homes
+        kill = lambda: inj.partition(  # noqa: E731
+            0, at=transport._send_idx
+        )
+    reqs = _replay_router(router, tape, clock, kill_at=4, kill_fn=kill)
+    assert router.replicas[0].health().value == "halted"
+    assert router.stats["replicas_drained"] == 1
+    if entry != "halt_fence":
+        assert router.probe_states()["replica0"] == "dead"
+        assert router.stats["watchdog_deaths"] == 1
+    tokens_lost = 0
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        final = router.requests[req.rid]
+        assert final.state is RequestState.DONE, f"arrival {i} stranded"
+        if final.tokens != ref:
+            tokens_lost += 1
+    assert tokens_lost == 0
+    assert transport.stats["dedup_hits"] >= 1
+    # exactly-once across the whole fabric: a re-homed rid may be INDEXED
+    # on the dead replica and the survivor, but always as the SAME Request
+    # object — two distinct objects for one rid would mean a duplicated
+    # adopt double-admitted (and double-streamed) it
+    objects = {}
+    for e in router.replicas:
+        for rid, r in e.scheduler.requests.items():
+            objects.setdefault(rid, set()).add(id(r))
+    for rid, ids in objects.items():
+        assert len(ids) == 1, f"rid {rid} exists as {len(ids)} objects"

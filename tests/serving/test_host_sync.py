@@ -416,3 +416,49 @@ def test_sync_budget_unchanged_with_prewarm(setup):
     engine.run()
     assert req.state is RequestState.DONE and len(req.tokens) == 12
     assert engine.decode_compilations == 1  # the replay ate the compile
+
+
+def test_sync_budget_unchanged_with_fabric_transport_and_watchdog(setup):
+    """ISSUE 18 re-pin: the elastic fabric — every submit riding the
+    transport seam (envelope mint, dedup bookkeeping, retry wrapper) and
+    a live watchdog probing health through the same seam every step —
+    moves MESSAGES, never device values. Budgets identical to the bare
+    engine: submit=1, admission step=2 (with a probe in the same step),
+    steady chunk=1 (ditto)."""
+    from neuronx_distributed_tpu.serving import (
+        InProcessTransport,
+        ReplicaRouter,
+        VirtualClock,
+        WatchdogConfig,
+    )
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    transport = InProcessTransport(time_fn=clock)
+    router = ReplicaRouter.build(
+        model, params, 1, num_slots=2, decode_chunk_size=4,
+        prefix_cache=None, time_fn=clock,
+        transport=transport, watchdog=WatchdogConfig(),
+    )
+    prompt = np.arange(1, 7, dtype=np.int32)
+    gcfg = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    with _SyncCounter() as c:
+        req = router.submit(prompt, gcfg, key=jax.random.PRNGKey(7))
+    assert c.calls == 1, f"fabric submit must stay 1 sync, saw {c.calls}"
+    clock.advance(0.3)  # the watchdog probe fires inside this step
+    with _SyncCounter() as c:
+        router.step()
+    assert c.calls == 2, (
+        f"fabric admission (+probe) must stay 2 syncs, saw {c.calls}"
+    )
+    clock.advance(0.3)
+    with _SyncCounter() as c:
+        router.step()
+    assert c.calls == 1, (
+        f"fabric steady chunk (+probe) must stay 1 sync, saw {c.calls}"
+    )
+    router.run()
+    assert req.state is RequestState.DONE and len(req.tokens) == 12
+    # the messages really rode the seam: 1 submit + >=2 probes
+    assert transport.stats["messages"] >= 3
+    assert transport.stats["deliveries"] >= 3

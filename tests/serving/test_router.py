@@ -292,3 +292,319 @@ def test_shared_registry_scrapes_all_replicas(setup):
         snap["replicas"][k]["completed"] for k in snap["replicas"]
     )
     assert total == 4
+
+
+# --- elastic fabric (ISSUE 18): transport seam + watchdog + join/drain -------
+
+
+def _fabric(model, params, clock, faults=None, watchdog=None, n=2, **kw):
+    """Router over a ChaosTransport (or clean InProcessTransport when no
+    faults) with every clock — engines, transport, watchdog cadence —
+    driven by one VirtualClock, so probe timing is deterministic."""
+    from neuronx_distributed_tpu.serving import (
+        ChaosTransport,
+        InProcessTransport,
+        WatchdogConfig,
+    )
+
+    transport = (
+        ChaosTransport(faults, time_fn=clock)
+        if faults is not None
+        else InProcessTransport(time_fn=clock)
+    )
+    if watchdog is None:
+        watchdog = WatchdogConfig()
+    kw.setdefault("time_fn", clock)
+    router = _build(
+        model, params, n, transport=transport, watchdog=watchdog, **kw
+    )
+    return router, transport
+
+
+@pytest.mark.chaos
+def test_probe_death_fences_and_rehomes_bit_identical(setup):
+    """THE ISSUE 18 watchdog pin: a replica that stops answering probes
+    (transport partition — the engine itself is healthy but unreachable)
+    walks OK→SUSPECT→DEGRADED→DEAD, is FENCED (so the partitioned-but-
+    alive engine can never race its re-homed work), and its streams —
+    including requests mid-decode with tokens already out — complete on
+    the survivor bit-identical to solo ``generate()``: tokens_lost == 0."""
+    from neuronx_distributed_tpu.serving import VirtualClock
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    inj = FaultInjector()
+    router, transport = _fabric(model, params, clock, faults=inj)
+    rng = np.random.RandomState(21)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(4, 10)).astype(
+            np.int32
+        )
+        for _ in range(4)
+    ]
+    # long enough that replica 0 is still mid-decode after the probe
+    # rounds (it keeps stepping while merely partitioned) — the re-home
+    # must move LIVE work, not an empty queue
+    gcfg = GenerationConfig(max_new_tokens=18, temperature=0.0)
+    keys = [jax.random.PRNGKey(800 + i) for i in range(4)]
+    refs = [
+        _solo(model, params, p, k, gcfg) for p, k in zip(prompts, keys)
+    ]
+    reqs = [router.submit(p, gcfg, key=k) for p, k in zip(prompts, keys)]
+    for _ in range(3):  # tokens accrue on BOTH replicas pre-partition
+        router.step()
+    assert any(r.tokens for r in reqs if r.rid < RID_STRIDE)
+    # replica 0 becomes unreachable from HERE on — probes (and anything
+    # else addressed to it) fail with PartitionedError forever
+    inj.partition(0, at=transport._send_idx)
+    for _ in range(3):  # dead_after=3 consecutive probe failures
+        clock.advance(0.3)
+        router.step()
+    assert router.probe_states()["replica0"] == "dead"
+    assert router.stats["watchdog_deaths"] == 1
+    assert router.replicas[0].health().value == "halted"  # fenced
+    assert inj.counters["partitioned_sends"] >= 3
+    router.run()
+    tokens_lost = 0
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE, f"request {i} stranded"
+        if req.tokens != ref:
+            tokens_lost += 1
+    assert tokens_lost == 0
+    assert router.stats["rehomed_requests"] > 0
+    assert router.stats["probe_failures"] >= 3
+    assert router.health()["aggregate"] == "ok"
+
+
+def test_watchdog_hysteresis_holds_flapper_at_suspect(setup):
+    """A flapping replica (probe fail, probe ok, fail, ok, …) must neither
+    die NOR fully recover: every failure resets the success streak before
+    ``recover_after`` is reached, every success resets the failure streak
+    before ``dead_after`` is — so it is HELD at SUSPECT (still accepting,
+    still probed) instead of oscillating in and out of the rotation."""
+    from neuronx_distributed_tpu.serving import VirtualClock
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    inj = FaultInjector()
+    # probes go out in index order, two per round: replica 0's probe is
+    # every EVEN send. Partition exactly rounds 0, 2, 4 for replica 0.
+    for at in (0, 4, 8):
+        inj.partition(0, at=at, times=1)
+    router, transport = _fabric(model, params, clock, faults=inj)
+    for k in range(6):
+        clock.advance(0.3)
+        router.step()
+        assert router.probe_states()["replica0"] == "suspect", f"round {k}"
+        assert 0 in router._accepting()  # SUSPECT still takes work
+    assert router.stats["watchdog_deaths"] == 0
+    # flapping stops → two consecutive clean rounds step it back to ok
+    for _ in range(2):
+        clock.advance(0.3)
+        router.step()
+    assert router.probe_states()["replica0"] == "ok"
+
+
+def test_watchdog_recovery_climbs_one_level_per_streak(setup):
+    """Demotion is threshold-per-failure but recovery is EARNED: after two
+    consecutive failures (degraded) a replica needs ``recover_after``
+    clean probes per level — degraded→suspect→ok — and a probe-DEGRADED
+    replica drains around exactly like an engine-DEGRADED one."""
+    from neuronx_distributed_tpu.serving import VirtualClock
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    inj = FaultInjector()
+    for at in (0, 2):  # replica 0's probes in rounds 0 and 1
+        inj.partition(0, at=at, times=1)
+    router, transport = _fabric(model, params, clock, faults=inj)
+    clock.advance(0.3)
+    router.step()
+    assert router.probe_states()["replica0"] == "suspect"
+    clock.advance(0.3)
+    router.step()
+    assert router.probe_states()["replica0"] == "degraded"
+    assert router._accepting() == [1]  # drained around
+    for expect in ("degraded", "suspect", "suspect", "ok"):
+        clock.advance(0.3)
+        router.step()
+        assert router.probe_states()["replica0"] == expect
+    assert 0 in router._accepting()
+    assert router.stats["watchdog_deaths"] == 0
+
+
+@pytest.mark.chaos
+def test_rehome_keeps_original_deadline_budget(setup):
+    """Satellite regression: a re-homed request's deadline stays the
+    ABSOLUTE engine-clock value set at submit — the survivor enforces the
+    REMAINING budget, never a fresh one restarted at adopt time. A
+    request whose budget is already exhausted when its replica dies is
+    shed on the survivor, not granted a second life."""
+    from neuronx_distributed_tpu.serving import VirtualClock
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    router, transport = _fabric(model, params, clock, watchdog=None)
+    gcfg = GenerationConfig(max_new_tokens=10, temperature=0.0)
+    prompt_a = np.arange(1, 8, dtype=np.int32)
+    prompt_b = np.arange(3, 9, dtype=np.int32)
+    key_a, key_b = jax.random.PRNGKey(0), jax.random.PRNGKey(1)
+    ref_a = _solo(model, params, prompt_a, key_a, gcfg)
+    # park replica 1 so BOTH requests land on replica 0
+    router.replicas[1].drain()
+    req_a = router.submit(prompt_a, gcfg, key=key_a, deadline_s=50.0)
+    req_b = router.submit(prompt_b, gcfg, key=key_b, deadline_s=8.0)
+    router.replicas[1].resume()
+    assert req_a.deadline == 50.0 and req_b.deadline == 8.0
+    for _ in range(2):
+        router.step()
+    assert req_a.tokens and req_b.tokens
+    clock.advance(10.0)  # t=10: req_b's absolute deadline (8.0) has passed
+    router.replicas[0].fence("test kill")
+    router.step()  # re-home both to replica 1
+    assert req_a.rid in router.replicas[1].scheduler.requests
+    # the absolute deadline survived the adopt — 40s of budget left, not 50
+    assert req_a.deadline == 50.0
+    assert req_a.submit_time == 0.0
+    router.run()
+    assert req_a.state is RequestState.DONE and req_a.tokens == ref_a
+    assert req_b.state is RequestState.TIMED_OUT, (
+        "an over-deadline request must not get a fresh budget from adopt"
+    )
+    assert "deadline" in req_b.error
+
+
+def test_unreachable_replica_spills_submit(setup):
+    """A submit the transport cannot deliver (retries exhausted against a
+    partition) spills to the next candidate instead of failing the caller
+    — and counts as a transport failure, not a reject."""
+    from neuronx_distributed_tpu.serving import VirtualClock
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    inj = FaultInjector().partition(0, at=0)
+    router, transport = _fabric(model, params, clock, faults=inj,
+                                watchdog=None)
+    gcfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    key = jax.random.PRNGKey(5)
+    prompt = np.arange(1, 7, dtype=np.int32)
+    ref = _solo(model, params, prompt, key, gcfg)
+    req = router.submit(prompt, gcfg, key=key)
+    assert req.rid >= RID_STRIDE  # landed on replica 1
+    assert router.stats["transport_failures"] >= 1
+    assert router.stats["spillovers"] >= 1
+    router.run()
+    assert req.state is RequestState.DONE and req.tokens == ref
+
+
+@pytest.mark.slow
+def test_add_replica_joins_live_and_rebalances(setup):
+    """Live join: a third replica warm-spawned mid-burst takes rebalanced
+    backlog (queued never-admitted work moves through the transport adopt
+    path) without pausing survivors, and every stream still matches its
+    solo golden."""
+    from neuronx_distributed_tpu.serving import VirtualClock
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    router, transport = _fabric(model, params, clock, watchdog=None)
+    rng = np.random.RandomState(31)
+    gcfg = GenerationConfig(max_new_tokens=5, temperature=0.0)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(4, 10)).astype(
+            np.int32
+        )
+        for _ in range(8)
+    ]
+    keys = [jax.random.PRNGKey(900 + i) for i in range(8)]
+    refs = [
+        _solo(model, params, p, k, gcfg) for p, k in zip(prompts, keys)
+    ]
+    reqs = [router.submit(p, gcfg, key=k) for p, k in zip(prompts, keys)]
+    router.step()  # survivors are mid-flight when the newcomer joins
+    new_idx = router.add_replica()
+    assert new_idx == 2 and len(router.replicas) == 3
+    assert router.stats["replicas_joined"] == 1
+    assert router.stats["rebalanced_requests"] > 0
+    assert router.replicas[2].scheduler.queued > 0
+    # the newcomer mints from its own rid range (future submits disjoint)
+    assert router.replicas[2]._next_rid >= 2 * RID_STRIDE
+    router.run()
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE, f"request {i} stranded"
+        assert req.tokens == ref, f"request {i} diverged across the join"
+    done_on_new = [
+        r for r in router.replicas[2].scheduler.requests.values()
+        if r.finished
+    ]
+    assert done_on_new, "the joined replica should have served something"
+
+
+@pytest.mark.slow
+def test_remove_replica_drains_out_live(setup):
+    """Live drain-out: the removed replica finishes its admitted work
+    (DRAINING contract), its never-admitted queue re-homes to survivors,
+    new submits avoid it, and step() retires it once idle — streams all
+    bit-identical throughout."""
+    from neuronx_distributed_tpu.serving import VirtualClock
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    router, transport = _fabric(model, params, clock, watchdog=None)
+    rng = np.random.RandomState(41)
+    gcfg = GenerationConfig(max_new_tokens=5, temperature=0.0)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(4, 10)).astype(
+            np.int32
+        )
+        for _ in range(6)
+    ]
+    keys = [jax.random.PRNGKey(950 + i) for i in range(6)]
+    refs = [
+        _solo(model, params, p, k, gcfg) for p, k in zip(prompts, keys)
+    ]
+    reqs = [router.submit(p, gcfg, key=k) for p, k in zip(prompts, keys)]
+    router.step()
+    router.remove_replica(0)
+    assert router.replicas[0].health().value == "draining"
+    late = router.submit(
+        np.arange(1, 8, dtype=np.int32), gcfg, key=jax.random.PRNGKey(99)
+    )
+    assert late.rid >= RID_STRIDE  # never routed to the draining replica
+    router.run()
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE, f"request {i} stranded"
+        assert req.tokens == ref, f"request {i} diverged across the drain"
+    assert late.state is RequestState.DONE
+    assert router.stats["replicas_removed"] == 1
+    assert 0 in router._dead  # retired
+    with pytest.raises(RejectedError):
+        # sanity: the retired replica is out of every rotation
+        router.replicas[0].submit(
+            np.arange(1, 5, dtype=np.int32), gcfg,
+            key=jax.random.PRNGKey(1),
+        )
+
+
+@pytest.mark.slow
+def test_fabric_observability_exports(setup):
+    """registry= routers export the probe-state gauge per replica and the
+    transport counters; probe transitions land in the dead replica's
+    flight-recorder events."""
+    from neuronx_distributed_tpu.serving import VirtualClock
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    inj = FaultInjector().partition(0, at=0)
+    registry = MetricsRegistry()
+    router, transport = _fabric(
+        model, params, clock, faults=inj, registry=registry
+    )
+    for _ in range(3):
+        clock.advance(0.3)
+        router.step()
+    assert router.probe_states()["replica0"] == "dead"
+    text = registry.prometheus_text()
+    assert "router_probe_state" in text
+    assert "router_transport_events" in text
+    assert "router_rehome_latency_s" in text

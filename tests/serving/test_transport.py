@@ -1,0 +1,207 @@
+"""Unit tests for the fabric transport seam (serving/transport.py).
+
+Pure host-side tests: the transport never touches device state, so these
+run without building an engine. The contracts pinned here are the ones
+the router/disagg fabric leans on:
+
+* in-process transport is bit-identical to a direct call (target runs
+  exactly once, result unchanged, app exceptions propagate),
+* ``(rid, seq)`` idempotency: a retried or duplicated delivery returns
+  the cached outcome without re-running the target (exactly-once),
+* fault schedules (drop / drop_ack / dup / delay / partition) are
+  deterministic by send index and every fired fault is counted.
+"""
+
+import pytest
+
+from neuronx_distributed_tpu.serving.faults import FaultInjector
+from neuronx_distributed_tpu.serving.transport import (
+    ChaosTransport,
+    InProcessTransport,
+    PartitionedError,
+    TransportError,
+    TransportTimeout,
+)
+from neuronx_distributed_tpu.utils.retry import RetryPolicy
+
+
+class _Clock:
+    def __init__(self, start=0.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+class _Target:
+    """Counts invocations; optionally raises an app error first."""
+
+    def __init__(self, result="ok", raise_first=None):
+        self.calls = 0
+        self.result = result
+        self.raise_first = raise_first
+
+    def __call__(self):
+        self.calls += 1
+        if self.raise_first is not None:
+            e, self.raise_first = self.raise_first, None
+            raise e
+        return (self.result, self.calls)
+
+
+class TestInProcess:
+    def test_clean_call_is_direct(self):
+        t = InProcessTransport(time_fn=_Clock())
+        tgt = _Target()
+        assert t.call(0, "submit", tgt, rid=7) == ("ok", 1)
+        assert tgt.calls == 1
+        s = t.snapshot()
+        assert s["messages"] == 1 and s["deliveries"] == 1
+        assert s["retries"] == 0 and s["dedup_hits"] == 0
+
+    def test_app_exception_propagates_unwrapped(self):
+        t = InProcessTransport(time_fn=_Clock())
+        boom = ValueError("rid 3 already known")
+        with pytest.raises(ValueError, match="already known"):
+            t.call(1, "adopt", _Target(raise_first=boom), rid=3)
+        # app errors are outcomes, not faults: no retries burned
+        assert t.stats["retries"] == 0 and t.stats["deliveries"] == 1
+
+    def test_seq_is_per_message_not_per_attempt(self):
+        t = InProcessTransport(time_fn=_Clock())
+        t.call(0, "submit", _Target(), rid=1)
+        t.call(0, "submit", _Target(), rid=1)
+        # two logical messages to the same (target, op, rid) never collide
+        assert t.stats["dedup_hits"] == 0 and t.stats["deliveries"] == 2
+
+    def test_dedup_cache_is_bounded(self):
+        t = InProcessTransport(time_fn=_Clock(), dedup_capacity=4)
+        for i in range(10):
+            t.call(0, "submit", _Target(), rid=i)
+        assert t.snapshot()["dedup_entries"] == 4
+
+    def test_missed_deadline_is_terminal(self):
+        # attempt 0 is dropped; the retry backoff (sleep) carries the
+        # clock past the message deadline, so attempt 1's pre-delivery
+        # deadline check raises TransportTimeout — terminal, no more
+        # retries, target never ran.
+        clock = _Clock(start=100.0)
+        inj = FaultInjector().drop_send(at=0, times=1)
+        t = ChaosTransport(
+            inj, time_fn=clock,
+            sleep_fn=lambda s: setattr(clock, "now", clock.now + 6.0))
+        tgt = _Target()
+        with pytest.raises(TransportTimeout):
+            t.call(0, "submit", tgt, rid=1, deadline_s=5.0)
+        assert tgt.calls == 0
+        assert t.stats["timeouts"] == 1 and t.stats["retries"] == 1
+
+
+class TestChaos:
+    def test_drop_retries_and_delivers_once(self):
+        inj = FaultInjector().drop_send(at=0, times=2)
+        t = ChaosTransport(inj, time_fn=_Clock())
+        tgt = _Target()
+        assert t.call(0, "submit", tgt, rid=1) == ("ok", 1)
+        assert tgt.calls == 1
+        assert t.stats["drops"] == 2 and t.stats["retries"] == 2
+        assert inj.counters["dropped_sends"] == 2
+
+    def test_drop_exhausts_policy_and_gives_up(self):
+        inj = FaultInjector().drop_send(at=0, times=None)
+        t = ChaosTransport(inj, time_fn=_Clock(),
+                           retry=RetryPolicy(max_attempts=3, first_wait=0.0,
+                                             min_wait=0.0))
+        tgt = _Target()
+        with pytest.raises(TransportError):
+            t.call(0, "submit", tgt, rid=1)
+        assert tgt.calls == 0
+        assert t.stats["give_ups"] == 1 and t.stats["drops"] == 3
+
+    def test_lost_ack_retry_hits_dedup_exactly_once(self):
+        """The load-bearing contract: the target RAN but the reply was
+        lost — the retry must return the cached outcome, not re-run."""
+        inj = FaultInjector().drop_ack(at=0, times=1)
+        t = ChaosTransport(inj, time_fn=_Clock())
+        tgt = _Target()
+        assert t.call(0, "adopt", tgt, rid=5) == ("ok", 1)
+        assert tgt.calls == 1  # exactly once despite the retry
+        assert t.stats["ack_drops"] == 1
+        assert t.stats["retries"] == 1
+        assert t.stats["dedup_hits"] == 1
+        assert inj.counters["dropped_acks"] == 1
+
+    def test_duplicate_delivery_absorbed(self):
+        inj = FaultInjector().dup_send(at=0, times=1)
+        t = ChaosTransport(inj, time_fn=_Clock())
+        tgt = _Target()
+        assert t.call(0, "handoff", tgt, rid=2) == ("ok", 1)
+        assert tgt.calls == 1
+        assert t.stats["dup_deliveries"] == 1 and t.stats["dedup_hits"] == 1
+        assert inj.counters["dup_sends"] == 1
+
+    def test_duplicated_app_error_replayed_not_rerun(self):
+        inj = FaultInjector().dup_send(at=0, times=1)
+        t = ChaosTransport(inj, time_fn=_Clock())
+        tgt = _Target(raise_first=ValueError("rejected"))
+        with pytest.raises(ValueError, match="rejected"):
+            t.call(0, "adopt", tgt, rid=2)
+        # the duplicate saw the CACHED exception; the target ran once and
+        # would have succeeded on a true second run
+        assert tgt.calls == 1 and t.stats["dedup_hits"] == 1
+
+    def test_delay_within_deadline_delivers(self):
+        inj = FaultInjector().delay_send(at=0, times=1, by=0.5)
+        t = ChaosTransport(inj, time_fn=_Clock())
+        tgt = _Target()
+        assert t.call(0, "probe", tgt, deadline_s=2.0) == ("ok", 1)
+        assert t.stats["delays"] == 1 and t.stats["timeouts"] == 0
+
+    def test_delay_past_deadline_times_out(self):
+        inj = FaultInjector().delay_send(at=0, times=None, by=3.0)
+        t = ChaosTransport(inj, time_fn=_Clock())
+        tgt = _Target()
+        with pytest.raises(TransportTimeout):
+            t.probe(0, tgt, deadline_s=1.0)
+        assert tgt.calls == 0
+        assert t.stats["timeouts"] == 1
+        assert inj.counters["delayed_sends"] == 1
+
+    def test_partition_is_per_target(self):
+        inj = FaultInjector().partition(0, at=0, times=None)
+        t = ChaosTransport(
+            inj, time_fn=_Clock(),
+            retry=RetryPolicy(max_attempts=2, first_wait=0.0, min_wait=0.0))
+        ok_tgt, dead_tgt = _Target(), _Target()
+        with pytest.raises(PartitionedError):
+            t.call(0, "submit", dead_tgt, rid=1)
+        assert t.call(1, "submit", ok_tgt, rid=2) == ("ok", 1)
+        assert dead_tgt.calls == 0 and ok_tgt.calls == 1
+        assert inj.counters["partitioned_sends"] == 2  # both attempts
+
+    def test_partition_window_heals(self):
+        # window covers sends 0..2; retry policy has 5 attempts, so the
+        # 4th attempt (send 3) gets through.
+        inj = FaultInjector().partition("decode", at=0, times=3)
+        t = ChaosTransport(inj, time_fn=_Clock())
+        tgt = _Target()
+        assert t.call("decode", "handoff", tgt, rid=9) == ("ok", 1)
+        assert t.stats["retries"] == 3 and tgt.calls == 1
+
+    def test_probe_is_single_attempt(self):
+        inj = FaultInjector().partition(0, at=0, times=None)
+        t = ChaosTransport(inj, time_fn=_Clock())
+        with pytest.raises(PartitionedError):
+            t.probe(0, _Target(), deadline_s=1.0)
+        # one probe = one verdict: no retries burned masking the outage
+        assert t.stats["retries"] == 0
+
+    def test_schedule_is_deterministic(self):
+        def run():
+            inj = FaultInjector().drop_send(at=1, times=1).dup_send(at=4, times=1)
+            t = ChaosTransport(inj, time_fn=_Clock())
+            for i in range(4):
+                t.call(i % 2, "submit", _Target(), rid=i)
+            return dict(t.stats), dict(inj.counters)
+
+        assert run() == run()

@@ -1,0 +1,284 @@
+"""Warm replica restart (ISSUE 18 tentpole): ``snapshot_serving_state`` /
+``restore_serving_state`` serialize the HOST-current serving state — queue,
+per-request tokens/keys/cursors, deadlines, tenant attribution, SLO
+counters; never a device pytree — so a killed replica's work continues on a
+fresh engine BIT-IDENTICALLY to the uninterrupted run.
+
+The acceptance chaos pin: kill an engine mid-stream (fence — the same halt
+contract a watchdog death or dispatch-retry exhaustion lands in), snapshot,
+round-trip the snapshot through JSON (it must be wire-safe), restore into a
+freshly-built engine on a DIFFERENT clock origin, run — every stream equals
+its solo ``generate()`` golden and every remaining deadline budget is
+preserved to the second."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig, generate
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.serving import (
+    RejectedError,
+    RequestState,
+    ServingEngine,
+    VirtualClock,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama(num_layers=2, hidden_size=32,
+                     intermediate_size=96, vocab_size=128)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+def _solo(model, params, prompt, key, gcfg):
+    toks = np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], key, gcfg)
+    )[0].tolist()
+    if gcfg.eos_token_id is not None and gcfg.eos_token_id in toks:
+        toks = toks[: toks.index(gcfg.eos_token_id) + 1]
+    return toks
+
+
+def _engine(model, params, clock, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("decode_chunk_size", 2)
+    kw.setdefault("prefix_cache", None)
+    return ServingEngine(model, params, time_fn=clock, **kw)
+
+
+@pytest.mark.chaos
+def test_kill_snapshot_restore_streams_bit_identical(setup):
+    """THE warm-restart pin: mid-stream kill → JSON-round-tripped snapshot
+    → restore on a fresh engine at a different clock origin → every stream
+    (actives WITH tokens already out, plus a still-queued request)
+    completes bit-identical to solo ``generate()``. tokens_lost == 0."""
+    cfg, model, params = setup
+    clock_a = VirtualClock(start=0.0)
+    a = _engine(model, params, clock_a)
+    rng = np.random.RandomState(7)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(4, 10)).astype(
+            np.int32
+        )
+        for _ in range(3)
+    ]
+    gcfgs = [
+        GenerationConfig(max_new_tokens=10, temperature=0.0),
+        GenerationConfig(max_new_tokens=9, temperature=0.8, top_k=13),
+        GenerationConfig(max_new_tokens=8, temperature=0.0),
+    ]
+    keys = [jax.random.PRNGKey(100 + i) for i in range(3)]
+    refs = [
+        _solo(model, params, p, k, c)
+        for p, k, c in zip(prompts, keys, gcfgs)
+    ]
+    reqs = [
+        a.submit(p, c, key=k, tenant=f"t{i % 2}")
+        for i, (p, c, k) in enumerate(zip(prompts, gcfgs, keys))
+    ]
+    clock_a.advance(1.0)
+    for _ in range(2):  # 2 slots busy, request 2 still queued
+        a.step()
+    assert reqs[0].tokens and reqs[1].tokens and not reqs[2].tokens
+    mid = [list(r.tokens) for r in reqs]
+    clock_a.advance(9.0)  # t=10 at the kill
+    a.fence("chaos kill")
+    snap = json.loads(json.dumps(a.snapshot_serving_state()))
+    assert snap["halted"] and len(snap["requests"]) == 3
+    # stepping the fenced engine goes nowhere — the snapshot owns the work
+    a.step()
+    assert [list(r.tokens) for r in reqs] == mid
+
+    clock_b = VirtualClock(start=1000.0)
+    b = _engine(model, params, clock_b)
+    report = b.restore_serving_state(snap)
+    assert report["restored"] == 3
+    assert report["downtime_s"] == pytest.approx(990.0)
+    b.run()
+    for i, ref in enumerate(refs):
+        req = b.scheduler.requests[reqs[i].rid]
+        assert req.state is RequestState.DONE, f"request {i} stranded"
+        assert req.tokens == ref, f"request {i} diverged across the restart"
+        assert req.tokens[: len(mid[i])] == mid[i], (
+            "restored stream must CONTINUE the pre-kill tokens, not replay"
+        )
+        assert req.tenant == f"t{i % 2}"
+    msnap = b.metrics.snapshot()
+    assert msnap["restored"] == 3
+    assert msnap["completed"] == 3
+
+
+def test_restore_preserves_remaining_deadline_budget(setup):
+    """Absolute timestamps shift by the snapshot→restore clock delta: a
+    request with 40s of deadline budget left at the kill has exactly 40s
+    on the restored engine — measured from its ORIGINAL submit, not
+    re-granted at restore."""
+    cfg, model, params = setup
+    clock_a = VirtualClock(start=0.0)
+    a = _engine(model, params, clock_a)
+    gcfg = GenerationConfig(max_new_tokens=6, temperature=0.0)
+    req = a.submit(
+        np.arange(1, 8, dtype=np.int32), gcfg,
+        key=jax.random.PRNGKey(3), deadline_s=50.0,
+    )
+    a.step()
+    clock_a.advance(10.0)
+    a.fence("kill")
+    snap = a.snapshot_serving_state()
+
+    clock_b = VirtualClock(start=2000.0)
+    b = _engine(model, params, clock_b)
+    b.restore_serving_state(snap)
+    got = b.scheduler.requests[req.rid]
+    assert got.deadline == pytest.approx(2000.0 + 40.0)
+    assert got.submit_time == pytest.approx(2000.0 - 10.0)
+    # and an EXHAUSTED budget stays exhausted: advance past the shifted
+    # deadline before stepping — the restored request is shed, not revived
+    clock_b.advance(41.0)
+    b.step()
+    b.run()
+    assert got.state is RequestState.DONE or got.state is RequestState.TIMED_OUT
+    # (it may finish within the step that notices; what it must NOT have
+    # is a fresh 50s window)
+    assert got.deadline == pytest.approx(2040.0)
+
+
+def test_restore_is_exactly_once(setup):
+    """Restore composes with the transport idempotency contract: the same
+    snapshot cannot be admitted twice (duplicated restore message replayed
+    outside the dedup window), and a halted engine refuses restores."""
+    cfg, model, params = setup
+    clock_a = VirtualClock()
+    a = _engine(model, params, clock_a)
+    gcfg = GenerationConfig(max_new_tokens=5, temperature=0.0)
+    a.submit(np.arange(1, 7, dtype=np.int32), gcfg, key=jax.random.PRNGKey(0))
+    a.fence("kill")
+    snap = a.snapshot_serving_state()
+
+    b = _engine(model, params, VirtualClock(start=50.0))
+    b.restore_serving_state(snap)
+    with pytest.raises(ValueError, match="exactly once"):
+        b.restore_serving_state(snap)
+    c = _engine(model, params, VirtualClock())
+    c.fence("dead on arrival")
+    with pytest.raises(RejectedError):
+        c.restore_serving_state(snap)
+    with pytest.raises(ValueError, match="snapshot version"):
+        b.restore_serving_state({"version": 99})
+    b.run()
+
+
+def test_restore_carries_slo_and_prefix_index(setup):
+    """The snapshot carries the SLO tracker's decided counts (attainment
+    survives the restart — a restarted replica does not forget its week)
+    and the prefix-cache TOKEN index (which prefixes were hot), never KV
+    bytes."""
+    from neuronx_distributed_tpu.observability import SLOSpec
+
+    cfg, model, params = setup
+    clock_a = VirtualClock()
+    a = _engine(
+        model, params, clock_a, prefix_cache="auto",
+        slo={"acme": SLOSpec(ttft_p99_s=1e6, tpot_p99_s=1e6)},
+    )
+    gcfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    shared = np.arange(1, 12, dtype=np.int32)
+    done = a.submit(
+        np.concatenate([shared, np.asarray([30], np.int32)]), gcfg,
+        key=jax.random.PRNGKey(0), tenant="acme",
+    )
+    a.run()
+    assert done.state is RequestState.DONE
+    assert a.metrics.snapshot()["slo"]["attained"] == 1
+    live = a.submit(
+        np.concatenate([shared, np.asarray([31], np.int32)]), gcfg,
+        key=jax.random.PRNGKey(1), tenant="acme",
+    )
+    a.fence("kill")
+    snap = json.loads(json.dumps(a.snapshot_serving_state()))
+    assert snap["prefix_index"], "hot prefixes should be in the snapshot"
+    assert snap["slo"]["tenants"]["acme"]["attained"] == 1
+
+    b = _engine(
+        model, params, VirtualClock(start=500.0), prefix_cache="auto",
+        slo={"acme": SLOSpec(ttft_p99_s=1e6, tpot_p99_s=1e6)},
+    )
+    b.restore_serving_state(snap)
+    b.run()
+    msnap = b.metrics.snapshot()
+    assert b.scheduler.requests[live.rid].state is RequestState.DONE
+    # 1 carried from the dead replica's week + 1 decided here
+    assert msnap["slo"]["attained"] == 2
+    assert msnap["tenants"]["acme"]["completed"] == 1
+
+
+@pytest.mark.slow
+def test_router_restart_replica_end_to_end(setup):
+    """Router-level warm restart: fence replica 0 mid-burst, ``
+    restart_replica`` snapshots it, warm-spawns a replacement from the
+    build() recipe, restores, and REATTACHES the per-request streaming
+    callbacks — every stream completes bit-identical and every callback
+    saw every token exactly once. A replica whose work was already
+    re-homed refuses the restart (the survivors own it)."""
+    from neuronx_distributed_tpu.serving import ReplicaRouter
+
+    cfg, model, params = setup
+    clock = VirtualClock()
+    router = ReplicaRouter.build(
+        model, params, 2, num_slots=2, decode_chunk_size=2,
+        prefix_cache=None, time_fn=clock,
+    )
+    rng = np.random.RandomState(17)
+    gcfg = GenerationConfig(max_new_tokens=10, temperature=0.0)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(4, 10)).astype(
+            np.int32
+        )
+        for _ in range(4)
+    ]
+    keys = [jax.random.PRNGKey(300 + i) for i in range(4)]
+    refs = [
+        _solo(model, params, p, k, gcfg) for p, k in zip(prompts, keys)
+    ]
+    streamed = {}
+
+    def on_token(req, tok):
+        streamed.setdefault(req.rid, []).append(tok)
+
+    reqs = [
+        router.submit(p, gcfg, key=k, on_token=on_token)
+        for p, k in zip(prompts, keys)
+    ]
+    for _ in range(2):
+        router.step()
+    router.replicas[0].fence("chaos kill")
+    new_idx = router.restart_replica(0)
+    assert new_idx == 2
+    assert router.stats["replicas_restarted"] == 1
+    assert 0 in router._dead
+    router.run()
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        final = router.requests[req.rid]
+        assert final.state is RequestState.DONE, f"request {i} stranded"
+        assert final.tokens == ref, f"request {i} diverged"
+        assert streamed[req.rid] == ref, (
+            f"request {i}'s callback stream broke across the restart"
+        )
+    # the replacement actually served the dead replica's requests
+    assert any(
+        r.finished and r.rid < len(refs)
+        for r in router.replicas[2].scheduler.requests.values()
+    )
+    with pytest.raises(ValueError, match="add_replica"):
+        # replica 1 is healthy; kill it the re-home way first
+        router.replicas[1].fence("second kill")
+        router.step()  # re-homes to survivors
+        router.restart_replica(1)
