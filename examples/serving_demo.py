@@ -173,6 +173,13 @@ def parse_args(argv=None):
                    help="pool size in pages (default: the row-equivalent "
                         "HBM). Size it DOWN to see free-page admission "
                         "packing and the page-pressure wall")
+    p.add_argument("--kv-host-pages", type=int, default=None,
+                   help="host-RAM page tier size (tiered KV, ISSUE 19): "
+                        "the reclaim valve SPILLS cold prefix pages here "
+                        "instead of evicting, and admission prefetches "
+                        "them back on a match. Pair with a small "
+                        "--kv-pages to watch the eviction cliff become a "
+                        "host-tier hit-rate slope")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--inject-fault", default="none",
                    choices=["none", "dispatch", "halt", "poison", "prefill",
@@ -395,6 +402,7 @@ def _run_traffic(args, cfg, model, params):
             prefix_cache=None if args.no_prefix_cache else "auto",
             kv_page_size=page,
             kv_num_pages=args.kv_pages,
+            kv_host_pages=args.kv_host_pages,
             quantize=quant,
             slo=slo,
             time_fn=clock,
@@ -482,7 +490,8 @@ def _run_router(args, cfg, model, params):
         num_slots=args.slots, admission=args.admission,
         decode_chunk_size=args.decode_chunk,
         prefix_cache=None if args.no_prefix_cache else "auto",
-        kv_page_size=page, kv_num_pages=args.kv_pages, quantize=quant,
+        kv_page_size=page, kv_num_pages=args.kv_pages,
+        kv_host_pages=args.kv_host_pages, quantize=quant,
         tp=args.tp if args.tp > 1 else None,
     )
     shared = (
@@ -682,6 +691,7 @@ def main(argv=None):
         prefix_cache=None if args.no_prefix_cache else "auto",
         kv_page_size=page,
         kv_num_pages=args.kv_pages,
+        kv_host_pages=args.kv_host_pages,
         quantize=quant,
         tp=args.tp if args.tp > 1 else None,
         tp_comms=tp_comms,
@@ -823,6 +833,10 @@ def main(argv=None):
         snap["kv_pages_quarantined"] = engine.cache.alloc.pages_quarantined
         snap["prefix_copy_bytes"] = engine.cache.alloc.copy_bytes  # always 0
         engine.cache.check()  # page-leak invariant on the way out
+        if engine.tier is not None:
+            snap["kv_host_pages_used"] = engine.tier.used_pages
+            snap["kv_host_pages_max"] = engine.tier.max_pages
+            engine.tier.check()  # host-tier invariant too
     if engine.halt_reason:
         snap["halt_reason"] = engine.halt_reason
     if injector is not None:

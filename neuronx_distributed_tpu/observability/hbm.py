@@ -57,14 +57,17 @@ def _as_fn(source) -> Callable[[], int]:
 
 
 class _Resident:
-    __slots__ = ("name", "bytes_fn", "unit_bytes_fn", "count_fn", "unit")
+    __slots__ = ("name", "bytes_fn", "unit_bytes_fn", "count_fn", "unit",
+                 "tier")
 
-    def __init__(self, name, bytes_fn, unit_bytes_fn, count_fn, unit):
+    def __init__(self, name, bytes_fn, unit_bytes_fn, count_fn, unit,
+                 tier="device"):
         self.name = name
         self.bytes_fn = bytes_fn
         self.unit_bytes_fn = unit_bytes_fn
         self.count_fn = count_fn
         self.unit = unit
+        self.tier = tier
 
 
 class HBMLedger:
@@ -125,14 +128,23 @@ class HBMLedger:
     # --- residents -----------------------------------------------------------
 
     def add_resident(self, name: str, source, unit_bytes=None,
-                     count=None, unit: Optional[str] = None) -> None:
-        """Register (or replace) the byte source for resident ``name``."""
+                     count=None, unit: Optional[str] = None,
+                     tier: str = "device") -> None:
+        """Register (or replace) the byte source for resident ``name``.
+
+        ``tier`` places the resident in the device pool (``"device"``, the
+        default — counts against ``bytes_limit``) or the host spill tier
+        (``"host"`` — sized against an explicit ``plan(host_budget_bytes=)``
+        budget and never against device headroom)."""
+        if tier not in ("device", "host"):
+            raise ValueError(f"unknown resident tier {tier!r}")
         res = _Resident(
             name,
             _as_fn(source),
             None if unit_bytes is None else _as_fn(unit_bytes),
             None if count is None else _as_fn(count),
             unit,
+            tier,
         )
         fresh = name not in self._residents
         self._residents[name] = res
@@ -153,8 +165,13 @@ class HBMLedger:
         except Exception:
             return 0
 
-    def resident_bytes_total(self) -> int:
-        return sum(self.resident_bytes(n) for n in self._residents)
+    def resident_bytes_total(self, tier: str = "device") -> int:
+        """Sum of resident bytes in one tier. Device-tier by default —
+        host spill bytes never count against the device's limit math."""
+        return sum(
+            self.resident_bytes(n)
+            for n, res in self._residents.items() if res.tier == tier
+        )
 
     # --- device reconciliation ----------------------------------------------
 
@@ -181,6 +198,7 @@ class HBMLedger:
         deterministic for identical runs; device-derived fields degrade to
         UNAVAILABLE where the backend reports nothing."""
         residents = {}
+        host_total = 0
         for name, res in self._residents.items():
             entry: Dict[str, Any] = {"bytes": self.resident_bytes(name)}
             if res.unit_bytes_fn is not None:
@@ -195,8 +213,15 @@ class HBMLedger:
                     entry["count"] = int(res.count_fn())
                 except Exception:
                     entry["count"] = 0
+            if res.tier != "device":
+                # device entries stay schema-identical to the pre-tier
+                # ledger; only spill-tier residents carry the marker
+                entry["tier"] = res.tier
+                host_total += entry["bytes"]
             residents[name] = entry
-        total = sum(e["bytes"] for e in residents.values())
+        total = sum(
+            e["bytes"] for e in residents.values() if "tier" not in e
+        )
         stats = self.memory_stats() or {}
         limit = stats.get("bytes_limit")
         in_use = stats.get("bytes_in_use")
@@ -207,6 +232,7 @@ class HBMLedger:
             },
             "residents": residents,
             "resident_bytes_total": total,
+            "host_resident_bytes_total": host_total,
             "bytes_limit": int(limit) if limit else UNAVAILABLE,
             "bytes_in_use": (
                 int(in_use) if in_use is not None else UNAVAILABLE
@@ -224,22 +250,36 @@ class HBMLedger:
         }
         return out
 
-    def plan(self, budget_bytes: Optional[int] = None) -> dict:
+    def plan(self, budget_bytes: Optional[int] = None,
+             host_budget_bytes: Optional[int] = None) -> dict:
         """Capacity answers: with ``budget_bytes`` (total bytes the
-        residents may occupy; default ``bytes_limit``), how many MORE
-        units of each unit-declaring resident fit the remaining headroom?
-        Budget-less on a limit-less backend → explicit UNAVAILABLE."""
+        device residents may occupy; default ``bytes_limit``), how many
+        MORE units of each unit-declaring resident fit the remaining
+        headroom? Budget-less on a limit-less backend → explicit
+        UNAVAILABLE. ``host_budget_bytes`` is the spill tier's own
+        budget: host-tier residents are sized against it and NEVER
+        against device headroom, so one call answers "how many more
+        prefixes fit" per tier."""
         total = self.resident_bytes_total()
+        host_total = self.resident_bytes_total("host")
         if budget_bytes is None:
             stats = self.memory_stats() or {}
             budget_bytes = stats.get("bytes_limit") or None
-        if not budget_bytes:
+        if not budget_bytes and not host_budget_bytes:
             return {
                 "budget_bytes": UNAVAILABLE,
                 "free_bytes": UNAVAILABLE,
+                "host_budget_bytes": UNAVAILABLE,
+                "host_free_bytes": UNAVAILABLE,
                 "fits": {},
             }
-        free = max(0, int(budget_bytes) - total)
+        free = (
+            max(0, int(budget_bytes) - total) if budget_bytes else None
+        )
+        host_free = (
+            max(0, int(host_budget_bytes) - host_total)
+            if host_budget_bytes else None
+        )
         fits = {}
         for name, res in self._residents.items():
             if res.unit_bytes_fn is None:
@@ -252,12 +292,15 @@ class HBMLedger:
                 "unit_bytes": unit,
                 "unit": res.unit or name,
             }
-            if unit > 0:
-                entry["additional"] = free // unit
+            if res.tier != "device":
+                entry["tier"] = res.tier
+            tier_free = host_free if res.tier == "host" else free
+            if unit > 0 and tier_free is not None:
+                entry["additional"] = tier_free // unit
                 if res.count_fn is not None:
                     try:
                         entry["max_total"] = (
-                            int(res.count_fn()) + free // unit
+                            int(res.count_fn()) + tier_free // unit
                         )
                     except Exception:
                         pass
@@ -265,8 +308,17 @@ class HBMLedger:
                 entry["additional"] = UNAVAILABLE
             fits[name] = entry
         return {
-            "budget_bytes": int(budget_bytes),
-            "free_bytes": free,
+            "budget_bytes": (
+                int(budget_bytes) if budget_bytes else UNAVAILABLE
+            ),
+            "free_bytes": free if free is not None else UNAVAILABLE,
+            "host_budget_bytes": (
+                int(host_budget_bytes) if host_budget_bytes
+                else UNAVAILABLE
+            ),
+            "host_free_bytes": (
+                host_free if host_free is not None else UNAVAILABLE
+            ),
             "fits": fits,
         }
 
@@ -279,6 +331,7 @@ class HBMLedger:
             for name, entry in snap["residents"].items()
         }
         out["resident_bytes_total"] = snap["resident_bytes_total"]
+        out["host_resident_bytes_total"] = snap["host_resident_bytes_total"]
         out["bytes_limit"] = snap["bytes_limit"]
         out["bytes_in_use"] = snap["bytes_in_use"]
         out["utilization"] = snap["utilization"]

@@ -130,6 +130,12 @@ HOT_SUFFIXES = (
     # implicit coercion here (say of a request's device key riding an
     # envelope) would add a host sync to every message on the fabric
     "serving/transport.py",
+    # tiered KV (ISSUE 19): the host page store is consulted from the
+    # reclaim valve and the admission pre-pass — both inside the engine's
+    # steady loop — and must stay pure host numpy over already-host
+    # blocks; the tier's ONLY device->host transfer is the batched spill
+    # pull in paging.spill_pages behind its reasoned ok[GL02] pragma
+    "serving/tiering.py",
     # AOT serving (ISSUE 17): prewarm replays dispatch THROUGH the live
     # ledger proxies with manufactured dummy arguments, and the AOTProgram
     # shim wraps every dispatch of a deserialized program for the life of

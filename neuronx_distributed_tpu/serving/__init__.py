@@ -34,6 +34,12 @@ Layers:
   pins pages, hits map them — ``copy_bytes`` stays 0), and page-granular
   poison quarantine; streams stay bit-identical to the row layout and
   ``decode_compilations`` stays 1.
+* :mod:`tiering` — the host-RAM page tier (``ServingEngine(
+  kv_host_pages=)``, ISSUE 19): :class:`HostPageStore` holds spilled pool
+  pages as fingerprinted host numpy blocks; the reclaim valve spills cold
+  prefix entries there instead of evicting, and admission prefetches
+  matched pages back while the request queues — eviction cliff becomes a
+  hit-rate slope, streams stay bit-identical, host-sync budgets unchanged.
 * :mod:`metrics` — TTFT / decode throughput / queue wait / occupancy /
   preemption counters plus the fault-tolerance counters (sheds, rejects,
   quarantines, dispatch retries, health), exported as a plain dict snapshot
@@ -100,7 +106,9 @@ from neuronx_distributed_tpu.serving.faults import (
     InjectedDraftError,
     InjectedFault,
     InjectedHandoffError,
+    InjectedPrefetchError,
     InjectedPrefillError,
+    InjectedSpillError,
 )
 from neuronx_distributed_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_tpu.serving.paging import (
@@ -129,6 +137,7 @@ from neuronx_distributed_tpu.serving.scheduler import (
     RequestState,
     Scheduler,
 )
+from neuronx_distributed_tpu.serving.tiering import HostPageStore
 from neuronx_distributed_tpu.serving.transport import (
     ChaosTransport,
     Envelope,
@@ -158,12 +167,15 @@ __all__ = [
     "FaultInjector",
     "FeedbackConfig",
     "FifoPolicy",
+    "HostPageStore",
     "InProcessTransport",
     "InjectedDispatchError",
     "InjectedDraftError",
     "InjectedFault",
     "InjectedHandoffError",
+    "InjectedPrefetchError",
     "InjectedPrefillError",
+    "InjectedSpillError",
     "PageAllocator",
     "PageExhausted",
     "PagedCacheManager",

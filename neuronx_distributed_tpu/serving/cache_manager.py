@@ -303,6 +303,17 @@ class PrefixEntry:
     # (``tree`` is None) — just the ref-counted pool page ids its tokens
     # live in, mapped copy-on-write into a hitting slot's block table
     page_ids: Optional[Tuple[int, ...]] = None
+    # tiered KV (ISSUE 19): a SPILLED entry's pages live in the engine's
+    # HostPageStore under these ids instead (``page_ids`` is None while
+    # host-resident). The entry STAYS in the trie so lookups keep matching
+    # it; a prefetch re-homes it device-side (host_ids -> page_ids) before
+    # any slot maps it. Exactly one of page_ids/host_ids is set for a
+    # paged entry that still holds content
+    host_ids: Optional[Tuple[int, ...]] = None
+    # which tier the entry's NEXT hit is attributed to: "host" right after
+    # a prefetch (the hit only exists because the host tier kept the
+    # pages), reset to "device" once that hit is recorded
+    hit_tier: str = "device"
 
     @property
     def m(self) -> int:
@@ -435,6 +446,23 @@ class PrefixCache:
         if entry is None:  # unreachable for a live trie; be safe
             return None
         self._lru.move_to_end(entry.tokens)
+        return entry, m_use
+
+    def peek(self, tokens) -> Optional[Tuple[PrefixEntry, int]]:
+        """:meth:`lookup` without the LRU refresh — the tiered-KV
+        admission pre-pass (ISSUE 19) scans QUEUED requests for host-tier
+        entries worth prefetching, and a scan must not reorder recency for
+        requests that may never be admitted (the real lookup at admission
+        time still refreshes)."""
+        if not self.enabled:
+            return None
+        node, depth = self._walk(tokens)
+        m_use = min(depth, len(tokens) - 1)
+        if m_use < self.min_match:
+            return None
+        entry = self._subtree_entry(node)
+        if entry is None:
+            return None
         return entry, m_use
 
     def covers(self, tokens) -> bool:

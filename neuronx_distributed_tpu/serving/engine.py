@@ -250,7 +250,11 @@ from neuronx_distributed_tpu.serving.cache_manager import (
     PrefixCache,
     SlotCacheManager,
 )
-from neuronx_distributed_tpu.serving.paging import PagedCacheManager
+from neuronx_distributed_tpu.serving.paging import (
+    PagedCacheManager,
+    PageExhausted,
+)
+from neuronx_distributed_tpu.serving.tiering import HostPageStore
 from neuronx_distributed_tpu.serving.metrics import ServingMetrics
 from neuronx_distributed_tpu.serving.scheduler import (
     Request,
@@ -474,6 +478,7 @@ class ServingEngine:
         gamma: int = 4,
         kv_page_size: Optional[int] = None,
         kv_num_pages: Optional[int] = None,
+        kv_host_pages: Optional[int] = None,
         quantize=None,
         tp: Optional[int] = None,
         mesh=None,
@@ -716,7 +721,20 @@ class ServingEngine:
         else:
             if kv_num_pages is not None:
                 raise ValueError("kv_num_pages needs kv_page_size")
+            if kv_host_pages is not None:
+                raise ValueError("kv_host_pages needs kv_page_size")
             self.cache = SlotCacheManager(num_slots)
+        # tiered KV (ISSUE 19): a bounded host-RAM store behind the page
+        # pool — the reclaim valve spills cold prefix entries' pages there
+        # instead of dropping them, and the admission pre-pass prefetches
+        # matched pages back while the hitting request still queues. None
+        # (the default) keeps the single-tier engine byte-identical
+        self.tier = (
+            HostPageStore(kv_host_pages)
+            if kv_host_pages is not None else None
+        )
+        self._spill_index = 0     # spill-attempt index (chaos schedules)
+        self._prefetch_index = 0  # prefetch-attempt index (chaos schedules)
         if self._partitioner is not None:
             # KV storage commits to the mesh at allocation (kv-head axis
             # over tp where it divides); every donated successor keeps it
@@ -916,6 +934,16 @@ class ServingEngine:
                 unit_bytes=_res(lambda e: e.cache.page_nbytes),
                 count=_res(lambda e: e.cache.alloc.capacity), unit="page",
             )
+            if self.tier is not None:
+                # host-tier resident (ISSUE 19): spilled pages' host
+                # bytes, sized in pages against plan(host_budget_bytes=)
+                # — never against device headroom
+                self.hbm.add_resident(
+                    "kv_host_pages", _res(lambda e: e.tier.nbytes),
+                    unit_bytes=_res(lambda e: e.cache.page_nbytes),
+                    count=_res(lambda e: e.tier.used_pages), unit="page",
+                    tier="host",
+                )
         else:
             self.hbm.add_resident(
                 "kv_cache", _res(lambda e: e.cache.nbytes),
@@ -982,6 +1010,23 @@ class ServingEngine:
                 "serving_kv_pages_mapped",
                 help="KV pool pages mapped by some slot's block table",
             ).set_fn(_page_export(lambda c: c.pages_mapped))
+            if self.tier is not None:
+                def _tier_export(fn):
+                    def read():
+                        engine = ref()
+                        return (
+                            fn(engine.tier) if engine is not None else -1
+                        )
+                    return read
+
+                gauge(
+                    "serving_kv_host_pages_used",
+                    help="spilled KV pages resident in the host tier",
+                ).set_fn(_tier_export(lambda t: t.used_pages))
+                gauge(
+                    "serving_kv_host_bytes",
+                    help="host-RAM bytes held by spilled KV pages",
+                ).set_fn(_tier_export(lambda t: t.nbytes))
 
     def _fresh_slot_state(self):
         b = self.num_slots
@@ -1019,24 +1064,150 @@ class ServingEngine:
         """PrefixCache eviction hook: a PAGED entry leaving the store (LRU
         churn, poison, clear-on-swap) releases its pool page refs — pages
         still mapped by a decoding slot's block table survive through that
-        slot's own refs (CoW), pages held only by the entry free now."""
+        slot's own refs (CoW), pages held only by the entry free now.
+        Tiered: a host-resident entry drops its host pages too, and a
+        prefetched-but-unconsumed entry's device pages count as wasted
+        prefetch work before their holds are voided."""
         if entry.page_ids:
+            if self._page_size is not None and (
+                self.cache.prefetch_held(entry.page_ids)
+            ):
+                self.metrics.record_prefetch_wasted(len(entry.page_ids))
+                self.cache.release_prefetched(entry.page_ids)
             self.cache.unpin_pages(entry.page_ids)
             entry.page_ids = None
+        if entry.host_ids and self.tier is not None:
+            self.tier.drop(entry.host_ids)
+            entry.host_ids = None
 
     def _reclaim_prefix_entry(self) -> bool:
         """Page-pressure valve (installed as ``cache.reclaim``): evict the
         least-recently-used UNPINNED prefix entry so its pages can serve a
         new admission. Never frees a still-mapped page — eviction only
-        drops the entry's refs."""
+        drops the entry's refs. With a host tier the entry's pages are
+        SPILLED there first (one batched device->host pull) and the entry
+        stays in the trie host-resident; a full/failed spill degrades to
+        the plain eviction above. Entries whose pages a queued request's
+        prefetch already claimed are skipped — reclaiming them would
+        un-do work the admission fit math has already counted."""
         if self.prefix is None:
             return False
         for e in self.prefix.entries:  # LRU first
             if e.refs == 0 and e.page_ids:
+                if self.cache.prefetch_held(e.page_ids):
+                    continue
+                if self._spill_entry(e):
+                    return True
                 self.prefix.evict_entry(e)
                 self.metrics.record_prefix_eviction()
                 return True
         return False
+
+    def _spill_entry(self, entry) -> bool:
+        """Move one cold prefix entry's pages device->host. True = the
+        pages are free-able (the entry is now host-resident); False = no
+        tier / no room / spill fault — the caller falls back to plain
+        eviction. A failed spill NEVER leaks: nothing is unpinned until
+        the host copy is stored."""
+        if self.tier is None or not entry.page_ids:
+            return False
+        n = len(entry.page_ids)
+        if n > self.tier.free_pages:
+            return False
+        attempt = self._spill_index
+        self._spill_index += 1
+        try:
+            if self._faults is not None:
+                self._faults.on_spill(attempt)
+            items, nbytes = self.cache.spill_pages(entry.page_ids)
+            host_ids = self.tier.put(entry.page_ids, items)
+        except Exception:
+            self.metrics.record_spill_failure()
+            return False
+        self.cache.unpin_pages(entry.page_ids)
+        entry.page_ids = None
+        entry.host_ids = host_ids
+        entry.hit_tier = "host"
+        self.metrics.record_spill(n, nbytes)
+        if self.timeline is not None:
+            self.timeline.instant(
+                "kv_spill", "serving",
+                args={"pages": n, "bytes": nbytes},
+            )
+        return True
+
+    def _prefetch_entry(self, entry, late: bool = False) -> bool:
+        """Bring one host-resident prefix entry's pages back device-side.
+        The device write is the pool's existing import program — an async
+        host->device dispatch (zero syncs) that overlaps the in-flight
+        decode chunk. Returns True when the entry is device-resident
+        after the call. Fingerprint mismatch or an injected prefetch
+        fault evicts the entry (the admission falls back to a full
+        prefill — bit-identical by construction); pool exhaustion leaves
+        the entry host-resident to retry later."""
+        if self.tier is None or not entry.host_ids:
+            return entry.page_ids is not None
+        host_ids = entry.host_ids
+        n = len(host_ids)
+        attempt = self._prefetch_index
+        self._prefetch_index += 1
+        try:
+            if self._faults is not None:
+                self._faults.on_prefetch(
+                    attempt, store=self.tier, host_ids=host_ids
+                )
+            if not self.tier.verify(host_ids):
+                # corrupted host copy: reject the WHOLE fetch, drop the
+                # entry — the next admission re-prefills from tokens
+                self.metrics.record_host_page_poisoned()
+                self.metrics.record_prefix_validation_failure()
+                if self.timeline is not None:
+                    self.timeline.instant(
+                        "kv_host_poisoned", "serving", args={"pages": n}
+                    )
+                self.prefix.evict_entry(entry)
+                self.metrics.record_prefix_eviction()
+                return False
+            items, nbytes = self.tier.get(host_ids)
+            ids = self.cache.prefetch_pages(items, n)
+        except PageExhausted:
+            return False  # stay host-resident; retry on a later pass
+        except Exception:
+            self.metrics.record_prefetch_failure()
+            self.prefix.evict_entry(entry)
+            self.metrics.record_prefix_eviction()
+            return False
+        self.cache.hold_prefetched(ids)
+        entry.page_ids = tuple(int(i) for i in ids)
+        entry.host_ids = None
+        entry.hit_tier = "host"
+        self.tier.drop(host_ids)
+        self.metrics.record_prefetch(n, nbytes, late=late)
+        if self.timeline is not None:
+            self.timeline.instant(
+                "kv_prefetch", "serving",
+                args={"pages": n, "bytes": nbytes, "late": late},
+            )
+        return True
+
+    def _prefetch_for_queue(self) -> None:
+        """Admission pre-pass (ISSUE 19): peek the front of the queue and
+        start host->device prefetches for any matched SPILLED prefix
+        entries before the requests are admitted — the transfer rides the
+        pool's async import dispatch and overlaps the current chunk's
+        device time, so the hit is device-resident by rebind time.
+        Policy-blind and LRU-neutral (``peek`` does not refresh recency);
+        a prefetch for a request admitted later is merely early."""
+        if self.tier is None or self.prefix is None:
+            return
+        window = max(self.cache.free_slots, 1)
+        for req in self.scheduler.upcoming(window):
+            hit = self.prefix.peek(req.context_ids)
+            if hit is None:
+                continue
+            entry, m_use = hit
+            if entry.host_ids and m_use > 0:
+                self._prefetch_entry(entry)
 
     def _paged_layout(self, p: int, rem_cols: int, proj: int):
         """(padded, cursor target) for a paged admission at projected
@@ -1404,10 +1575,18 @@ class ServingEngine:
         pages while its queue still looked short. Worst-case accounting
         (per-request aligned spans, sharing ignored) — a value >= 1.0
         means the backlog cannot coexist and the replica will be churning
-        the preemption wall."""
+        the preemption wall.
+
+        Tiered (ISSUE 19): pages held by cold prefix entries that CAN
+        spill to host room are reclaimable-without-loss, so they relieve
+        pressure — capacity grows by min(host free, reclaimable). The
+        untiered math is byte-identical to the pre-tier engine."""
         if self._page_size is None:
             return 0.0
-        cap = max(self.cache.alloc.capacity, 1)
+        cap = self.cache.alloc.capacity
+        if self.tier is not None:
+            cap += min(self.tier.free_pages, self.cache.reclaimable_pages())
+        cap = max(cap, 1)
         span = 0
         live = [r for r in self._slot_req if r is not None]
         live += [r for r in self.scheduler.queued_requests]
@@ -1765,6 +1944,11 @@ class ServingEngine:
                 "hbm": self.hbm.halt_summary(),
                 "programs": self.programs.halt_summary(),
             }
+            if self.tier is not None:
+                # where the spill tier stood when the engine died — flat
+                # scalars (occupancy + lifetime traffic), same redaction
+                # contract as the hbm/programs tables above
+                extra["kv_host_tier"] = self.tier.summary()
             if self.metrics.slo is not None:
                 extra["slo"] = self.metrics.slo.per_tenant()
                 extra["slo_totals"] = self.metrics.slo.totals()
@@ -2143,6 +2327,12 @@ class ServingEngine:
             return  # the disaggregation server owns admission
         if self.cache.free_slots == 0 or self.scheduler.queued == 0:
             return
+        # tiered KV (ISSUE 19): start host->device prefetches for queued
+        # requests whose prefix match is host-resident BEFORE selection —
+        # the async import dispatch overlaps the current chunk's device
+        # time, and the pages' prefetch holds keep the fit math below
+        # honest (held pages are not reclaimable)
+        self._prefetch_for_queue()
         proj = self.cache.cursor
         maxrem = max(
             (r.remaining_new_tokens for r in self._slot_req if r is not None),
@@ -2232,10 +2422,26 @@ class ServingEngine:
                         target - p,
                         min(self.max_seq_len, target + window),
                     )
-                    if (
-                        eager_claimed + need
-                        > self.cache.available_pages()
-                    ):
+                    # in-flight prefetches (ISSUE 19): pages prefetched
+                    # FOR THIS REQUEST are device-resident under a hold —
+                    # excluded from available_pages() so the reclaim
+                    # valve cannot spill them back out, yet still counted
+                    # in ``need`` (the span covers the matched prefix's
+                    # columns). Credit them here or a tight pool
+                    # livelocks: the hold depresses availability below a
+                    # bar the adoption path never actually has to clear
+                    avail = self.cache.available_pages()
+                    if self.prefix is not None:
+                        peeked = self.prefix.peek(req.context_ids)
+                        if (
+                            peeked is not None
+                            and peeked[0].page_ids
+                            and self.cache.prefetch_held(
+                                peeked[0].page_ids
+                            )
+                        ):
+                            avail += len(peeked[0].page_ids)
+                    if eager_claimed + need > avail:
                         return False
                     eager_claimed += need
             proj = target
@@ -2646,6 +2852,25 @@ class ServingEngine:
                 )
             return None
         entry, m_use = hit
+        if (
+            self._page_size is not None and entry.page_ids is None
+            and entry.host_ids
+        ):
+            # tiered (ISSUE 19): the matched entry is still host-resident
+            # — the queue pre-pass missed it (or its device write was
+            # page-starved). One LATE prefetch attempt now; the transfer
+            # is still the async import dispatch, but the overlap window
+            # is gone (metrics mark it late). Failure leaves page_ids
+            # None and the floor-align below turns this into a miss —
+            # the admission falls back to the full prefill
+            self._prefetch_entry(entry, late=True)
+            if entry.page_ids is None:
+                self.metrics.record_prefix_miss()
+                if self.timeline is not None:
+                    self.timeline.instant(
+                        "prefix_miss", "serving", args={"prompt": p}
+                    )
+                return None
         if self._page_size is not None:
             # zero-copy CoW reuse is PAGE-granular: only whole pinned pages
             # are shareable, so the usable match floor-aligns to the page
@@ -2685,11 +2910,19 @@ class ServingEngine:
                 )
             return None
         self.prefix.pin(entry)
+        if self._page_size is not None and entry.page_ids:
+            # the hit is being consumed: the pin above protects the entry
+            # from reclaim, so any in-flight prefetch hold has done its
+            # job — void it (holds are claims for QUEUED work only)
+            self.cache.release_prefetched(entry.page_ids)
         chunk = _suffix_bucket(p - m_use, padded, self.max_seq_len)
-        self.metrics.record_prefix_hit(m_use, p)
+        tier = entry.hit_tier
+        entry.hit_tier = "device"  # resident again: later hits are device
+        self.metrics.record_prefix_hit(m_use, p, tier=tier)
         if self.timeline is not None:
             self.timeline.instant(
-                "prefix_hit", "serving", args={"matched": m_use, "prompt": p}
+                "prefix_hit", "serving",
+                args={"matched": m_use, "prompt": p, "tier": tier},
             )
         return entry, m_use, chunk
 

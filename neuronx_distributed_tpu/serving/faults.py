@@ -46,6 +46,21 @@ Injection points (all indices are 0-based and deterministic):
 * ``poison_draft(at=k, times=t)`` — the k-th speculative dispatches run
   with a corrupted COPY of the draft params (mid-chunk all-reject rounds:
   every proposal garbage); the stream must stay bit-identical regardless.
+* ``fail_spill(at=k, times=t)`` — TIERED engines (``kv_host_pages=``): the
+  k-th..(k+t-1)-th spill attempts raise ``InjectedSpillError`` before the
+  device->host pull runs. The engine must degrade to plain eviction (the
+  pre-tiering reclaim behavior) — never a leak, never a crash, streams
+  untouched.
+* ``fail_prefetch(at=k, times=t)`` — the k-th prefetch attempts raise
+  ``InjectedPrefetchError`` before anything is written device-side. The
+  engine must drop the host-tier entry and fall back to a full prefill,
+  bit-identically (K/V is position-relative — re-prefilling the tokens
+  rebuilds the same pages).
+* ``poison_host_page(at=k, times=t)`` — the k-th prefetch attempts find
+  their entry's FIRST host page corrupted in place (one byte flipped),
+  modeling host-RAM bit rot. The store's fingerprint verification must
+  reject the whole fetch and the engine must fall back to a full prefill
+  — corrupted host bytes never reach the pool.
 * ``drop_send / drop_ack / dup_send / delay_send / partition`` — transport
   fault schedules consulted by ``serving/transport.ChaosTransport`` per
   delivery-attempt index (transport-wide monotone, so deterministic for a
@@ -88,6 +103,18 @@ class InjectedHandoffError(InjectedFault):
     engine, streams bit-identical, zero tokens lost."""
 
 
+class InjectedSpillError(InjectedFault):
+    """Scheduled KV spill failure (ISSUE 19): the device->host pull of a
+    cold prefix entry's pages fails — the engine must degrade to plain
+    eviction, never a leak or a crash."""
+
+
+class InjectedPrefetchError(InjectedFault):
+    """Scheduled KV prefetch failure (ISSUE 19): the host->device re-home
+    of a spilled prefix entry fails — the engine must drop the host copy
+    and fall back to a full prefill, bit-identically."""
+
+
 class FaultInjector:
     """Schedule-driven fault source consulted by ``ServingEngine`` hooks."""
 
@@ -101,6 +128,10 @@ class FaultInjector:
         self._draft_poison_windows: List[Tuple[int, Optional[int]]] = []
         self._handoff_windows: List[Tuple[int, Optional[int]]] = []
         self._page_poisons: Dict[int, List[int]] = {}  # readback -> [slot]
+        # tiered KV (ISSUE 19), keyed by spill / prefetch attempt index
+        self._spill_windows: List[Tuple[int, Optional[int]]] = []
+        self._prefetch_windows: List[Tuple[int, Optional[int]]] = []
+        self._host_page_windows: List[Tuple[int, Optional[int]]] = []
         # transport fault schedules, all keyed by delivery-attempt index
         self._send_drops: List[Tuple[int, Optional[int]]] = []
         self._ack_drops: List[Tuple[int, Optional[int]]] = []
@@ -118,6 +149,9 @@ class FaultInjector:
             "poisoned_drafts": 0,
             "poisoned_pages": 0,
             "handoff_failures": 0,
+            "spill_failures": 0,
+            "prefetch_failures": 0,
+            "poisoned_host_pages": 0,
             "dropped_sends": 0,
             "dropped_acks": 0,
             "dup_sends": 0,
@@ -203,6 +237,65 @@ class FaultInjector:
             self.counters["handoff_failures"] += 1
             raise InjectedHandoffError(
                 f"injected handoff failure at attempt {attempt}"
+            )
+
+    def fail_spill(self, at: int = 0,
+                   times: Optional[int] = 1) -> "FaultInjector":
+        """The ``at``-th..(at+times-1)-th KV SPILL attempts (ISSUE 19)
+        raise :class:`InjectedSpillError` before the device->host pull —
+        nothing leaves the pool, the entry's pins are intact. The engine
+        must degrade to plain eviction: pins released, pages freed,
+        ``check()`` clean, streams untouched."""
+        end = None if times is None else at + times
+        self._spill_windows.append((at, end))
+        return self
+
+    def fail_prefetch(self, at: int = 0,
+                      times: Optional[int] = 1) -> "FaultInjector":
+        """The ``at``-th..(at+times-1)-th KV PREFETCH attempts (ISSUE 19)
+        raise :class:`InjectedPrefetchError` before any device write. The
+        engine must drop the host-tier entry (host pages released) and
+        serve the request through a full prefill — bit-identical, zero
+        tokens lost."""
+        end = None if times is None else at + times
+        self._prefetch_windows.append((at, end))
+        return self
+
+    def poison_host_page(self, at: int = 0,
+                         times: Optional[int] = 1) -> "FaultInjector":
+        """Corrupt one byte of the FIRST host page the ``at``-th..
+        (at+times-1)-th prefetch attempts are about to fetch (ISSUE 19) —
+        host-RAM bit rot, injected through the store's own ``corrupt``.
+        The fingerprint verification must reject the whole fetch and the
+        engine must fall back to a full prefill: corrupted host bytes
+        never reach the pool."""
+        end = None if times is None else at + times
+        self._host_page_windows.append((at, end))
+        return self
+
+    def on_spill(self, attempt: int) -> None:
+        """Called by TIERED engines with the 0-based spill attempt index
+        before the device->host pull."""
+        if self._hit(self._spill_windows, attempt):
+            self.counters["spill_failures"] += 1
+            raise InjectedSpillError(
+                f"injected spill failure at attempt {attempt}"
+            )
+
+    def on_prefetch(self, attempt: int, store=None, host_ids=()) -> None:
+        """Called with the 0-based prefetch attempt index, the host store
+        and the host ids about to be fetched, BEFORE verification. A
+        scheduled ``poison_host_page`` corrupts the first page in place
+        (the fingerprint check downstream must catch it); a scheduled
+        ``fail_prefetch`` raises."""
+        if self._hit(self._host_page_windows, attempt):
+            if store is not None and host_ids:
+                store.corrupt(host_ids[0])
+                self.counters["poisoned_host_pages"] += 1
+        if self._hit(self._prefetch_windows, attempt):
+            self.counters["prefetch_failures"] += 1
+            raise InjectedPrefetchError(
+                f"injected prefetch failure at attempt {attempt}"
             )
 
     def drop_send(self, at: int = 0, times: Optional[int] = 1) -> "FaultInjector":

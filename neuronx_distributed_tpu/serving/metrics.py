@@ -91,6 +91,19 @@ _COUNTERS = (
     # mapped copy-on-write into a hitting slot (zero bytes moved)
     ("page_quarantines", "serving_page_quarantines", True),
     ("prefix_pages_shared", "serving_prefix_pages_shared", True),
+    # tiered KV (ISSUE 19): host spill-tier traffic and its failure modes.
+    # late = prefetch issued at rebind time instead of the queue pre-pass
+    # (the overlap window was missed); wasted = prefetched pages whose
+    # entry was evicted before any request consumed them
+    ("kv_pages_spilled", "serving_kv_spill_pages", True),
+    ("kv_spill_bytes", "serving_kv_spill_bytes", True),
+    ("kv_spill_failures", "serving_kv_spill_failures", True),
+    ("kv_pages_prefetched", "serving_kv_spill_prefetch_pages", True),
+    ("kv_prefetch_bytes", "serving_kv_spill_prefetch_bytes", True),
+    ("kv_prefetch_late", "serving_kv_spill_prefetch_late", True),
+    ("kv_prefetch_wasted", "serving_kv_spill_prefetch_wasted", True),
+    ("kv_prefetch_failures", "serving_kv_spill_prefetch_failures", True),
+    ("kv_host_poisoned", "serving_kv_spill_host_poisoned", True),
     # elastic fabric (ISSUE 18): requests brought back by a warm restart
     ("restored", "serving_restored_requests", True),
     ("occupied_slot_steps", "serving_occupied_slot_steps", True),
@@ -195,6 +208,16 @@ class ServingMetrics:
             help="snapshot -> restore_serving_state clock gap (s): how "
                  "long a warm-restarted replica's work was dark",
         )
+        # tiered KV (ISSUE 19): transfer batch sizes — spill efficiency
+        # lives in pages-per-event, not event counts
+        self._h_spill_batch = own_histogram(
+            "serving_kv_spill_batch_pages",
+            help="pages moved device->host per spill event",
+        )
+        self._h_prefetch_batch = own_histogram(
+            "serving_kv_spill_prefetch_batch_pages",
+            help="pages moved host->device per prefetch event",
+        )
         self._g_cursor = self.view.gauge(
             "serving_cursor_high_water", help="highest shared cache cursor seen"
         )
@@ -221,6 +244,13 @@ class ServingMetrics:
         self._th_queue_wait = self.view.family(
             "histogram", "serving_tenant_queue_wait_s",
             help="submit -> first admission per tenant (s)",
+        )
+        # tiered KV (ISSUE 19): which tier the matched prefix entry's
+        # pages lived in when the hit was consumed — device (CoW share)
+        # or host (spilled, prefetched back)
+        self._f_hit_tier = self.view.family(
+            "counter", "serving_prefix_hit_tier", labels=("tier",),
+            help="prefix hits by residency tier of the matched entry",
         )
         self._tenants_seen = set()
         # SLO accounting (observability/slo.py): classify every request
@@ -461,11 +491,16 @@ class ServingMetrics:
 
     # --- prefix cache -------------------------------------------------------
 
-    def record_prefix_hit(self, matched: int, prompt_len: int) -> None:
+    def record_prefix_hit(self, matched: int, prompt_len: int,
+                          tier: str = "device") -> None:
         """An admission reused ``matched`` stored prefix tokens of a
-        ``prompt_len``-token context (only the tail was prefilled)."""
+        ``prompt_len``-token context (only the tail was prefilled).
+        ``tier`` is where the entry's pages lived when the hit was
+        consumed: ``"device"`` (resident, CoW share) or ``"host"``
+        (spilled to the host tier and prefetched back)."""
         self._inc("prefix_hits")
         self._inc("prefix_tokens_reused", matched)
+        self.view.child(self._f_hit_tier, tier).inc()
 
     def record_prefix_pages_shared(self, n: int) -> None:
         """A paged prefix hit mapped ``n`` pool pages copy-on-write into
@@ -487,6 +522,46 @@ class ServingMetrics:
         """A stored entry failed its reuse-time checksum/shape validation —
         it was evicted and the admission fell back to a full prefill."""
         self._inc("prefix_validation_failures")
+
+    # --- tiered KV (ISSUE 19) -----------------------------------------------
+
+    def record_spill(self, pages: int, nbytes: int) -> None:
+        """The reclaim valve moved a cold prefix entry's ``pages`` pool
+        pages (``nbytes`` total) device->host in one batched pull."""
+        self._inc("kv_pages_spilled", pages)
+        self._inc("kv_spill_bytes", nbytes)
+        self._h_spill_batch.observe(float(pages))
+
+    def record_spill_failure(self) -> None:
+        """A spill attempt failed (injected or real) — the entry degraded
+        to plain eviction, the pre-tiering behavior."""
+        self._inc("kv_spill_failures")
+
+    def record_prefetch(self, pages: int, nbytes: int,
+                        late: bool = False) -> None:
+        """``pages`` spilled pages were written back device-side.
+        ``late=True`` means the write happened at rebind time (the queue
+        pre-pass missed it) — correct, but the overlap window was lost."""
+        self._inc("kv_pages_prefetched", pages)
+        self._inc("kv_prefetch_bytes", nbytes)
+        if late:
+            self._inc("kv_prefetch_late")
+        self._h_prefetch_batch.observe(float(pages))
+
+    def record_prefetch_failure(self) -> None:
+        """A prefetch attempt failed — the entry was dropped and the
+        admission fell back to a full prefill."""
+        self._inc("kv_prefetch_failures")
+
+    def record_prefetch_wasted(self, pages: int) -> None:
+        """``pages`` prefetched pages were evicted before any request
+        consumed them — the prefetch's work was thrown away."""
+        self._inc("kv_prefetch_wasted", pages)
+
+    def record_host_page_poisoned(self, n: int = 1) -> None:
+        """A host-tier fetch was rejected because at least one of its
+        pages failed the fingerprint check (bit rot / chaos poison)."""
+        self._inc("kv_host_poisoned", n)
 
     def record_prefill_wall(self, seconds: float, kind: str = "full") -> None:
         """Wall time of one successful prefill dispatch (``kind`` is
@@ -668,6 +743,22 @@ class ServingMetrics:
             "prefix_validation_failures": self.prefix_validation_failures,
             "prefix_pages_shared": self.prefix_pages_shared,
             "page_quarantines": self.page_quarantines,
+            # tiered KV (ISSUE 19): spill/prefetch traffic, failure modes,
+            # and the per-tier split of consumed prefix hits
+            "kv_pages_spilled": self.kv_pages_spilled,
+            "kv_spill_bytes": self.kv_spill_bytes,
+            "kv_spill_failures": self.kv_spill_failures,
+            "kv_pages_prefetched": self.kv_pages_prefetched,
+            "kv_prefetch_bytes": self.kv_prefetch_bytes,
+            "kv_prefetch_late": self.kv_prefetch_late,
+            "kv_prefetch_wasted": self.kv_prefetch_wasted,
+            "kv_prefetch_failures": self.kv_prefetch_failures,
+            "kv_host_poisoned": self.kv_host_poisoned,
+            "prefix_hit_tier": {
+                tier: int(self.view.child(self._f_hit_tier, tier).value)
+                for tier in ("device", "host")
+                if self.view.has_child(self._f_hit_tier, tier)
+            },
             "prefill_count": self.prefill_count,
             "prefill_wall_s": self.prefill_wall_s,
             "prefill_mean_s": self._h_prefill.mean,

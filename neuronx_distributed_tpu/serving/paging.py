@@ -476,6 +476,14 @@ class PagedCacheManager:
         # page -> outstanding staged-context holds (disaggregated handoff);
         # counted into the leak invariant like pins
         self._staged: Dict[int, int] = {}
+        # tiered KV (ISSUE 19): pages written back by a host-tier prefetch
+        # for a QUEUED request — pinned (the pin IS their reference; check()
+        # reconciles them there) but additionally marked so the admission
+        # fit math and the reclaim valve treat them as claimed, not
+        # reclaimable: evicting a page the very next admission round is
+        # about to map would be pure churn. Holds clear when the entry is
+        # consumed or evicted (engine-side) and are VOID on pool recovery
+        self._prefetch_hold: set = set()
         _LIVE_MANAGERS.add(self)
 
     def register_programs(self, programs, prefix: str = "") -> None:
@@ -607,17 +615,28 @@ class PagedCacheManager:
         admission projection's per-slot page-span inputs)."""
         return [s for s in self._slot_start if s is not None]
 
-    def available_pages(self) -> int:
-        """Free pages plus what evicting every unpinned-by-flight prefix
-        entry could reclaim (pages pinned by entries and mapped by no
-        slot) — the eager-admission page budget."""
-        reclaimable = sum(
+    def reclaimable_pages(self) -> int:
+        """Pages an eviction (or spill-to-host) could actually free RIGHT
+        NOW: pinned by prefix entries, mapped by no slot, un-quarantined —
+        and not held by an in-flight prefetch (those are the opposite of
+        reclaimable: a queued request is about to map them). Feeds both
+        the eager-admission budget and the router's reclaimable-via-spill
+        capacity term."""
+        return sum(
             1 for pid, pins in self._pins.items()
             if pins > 0
             and self.alloc.refcount(pid) == pins
             and pid not in self.alloc._quarantined
+            and pid not in self._prefetch_hold
         )
-        return self.alloc.free_pages + reclaimable
+
+    def available_pages(self) -> int:
+        """Free pages plus what evicting every unpinned-by-flight prefix
+        entry could reclaim (pages pinned by entries and mapped by no
+        slot) — the eager-admission page budget. In-flight prefetch holds
+        are excluded on BOTH sides: held pages are neither free nor
+        reclaimable, so the fit math counts them as claimed (ISSUE 19)."""
+        return self.alloc.free_pages + self.reclaimable_pages()
 
     def _alloc_pages(self, n: int) -> List[int]:
         while self.alloc.free_pages < n and self.reclaim is not None:
@@ -643,6 +662,22 @@ class PagedCacheManager:
             else:
                 self._pins[pid] = pins - 1
             self.alloc.deref(pid)
+
+    def hold_prefetched(self, ids: Sequence[int]) -> None:
+        """Mark freshly prefetched (already pinned) pages as claimed by a
+        queued request — excluded from the reclaimable sum until released
+        (consumption or entry eviction)."""
+        self._prefetch_hold.update(int(pid) for pid in ids)
+
+    def release_prefetched(self, ids: Sequence[int]) -> None:
+        """Drop prefetch holds (no-op for pages that carry none)."""
+        self._prefetch_hold.difference_update(int(pid) for pid in ids)
+
+    def prefetch_held(self, ids: Sequence[int]) -> bool:
+        """Whether ANY of ``ids`` is claimed by an in-flight prefetch —
+        the reclaim valve skips entries whose pages are (evict-then-refetch
+        churn would waste the transfer the prefetch just paid for)."""
+        return any(int(pid) in self._prefetch_hold for pid in ids)
 
     def pages_live(self, ids: Sequence[int]) -> bool:
         """Reuse-time validation for a paged prefix entry: every page still
@@ -1017,6 +1052,71 @@ class PagedCacheManager:
             tuple(int(i) for i in ids), exported.p, exported.padded
         )
 
+    def spill_pages(self, ids: Sequence[int]):
+        """Tiered KV, device->host half (ISSUE 19): pull the pinned prefix
+        pages ``ids`` out of the pool as raw storage blocks — k/v pages
+        plus any quantized scale siblings, exactly ``export_pages``'s
+        layout — for the :class:`~neuronx_distributed_tpu.serving.tiering.
+        HostPageStore`. One batched gather per pool leaf, then ONE explicit
+        device->host pull of the whole batch. Returns ``(items, nbytes)``
+        with host-numpy blocks. Runs only on the reclaim valve (a page-
+        pressure event, never a steady chunk), so the pinned per-chunk
+        budgets are untouched."""
+        if self.cache is None:
+            raise RuntimeError("spill needs an allocated pool")
+        from neuronx_distributed_tpu.utils.tree import path_keys
+
+        dev_ids = jnp.asarray(np.asarray(ids, np.int32))
+        items = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            self.cache["pool"]
+        )[0]:
+            keys = tuple(path_keys(path))
+            base = pool_scale_base(keys[-1]) or keys[-1]
+            if base in ("k", "v"):
+                pax = leaf.ndim - 4
+                items.append((keys, jnp.take(leaf, dev_ids, axis=pax)))
+        # the spill's ONE sync: every leaf's gathered block rides a single
+        # batched pull (the gathers above dispatched async)
+        # graftlint: ok[GL02] tiered spill (ISSUE 19): one batched
+        # device->host pull per reclaim event — off the steady chunk path,
+        # the documented spill transfer
+        host_blocks = jax.device_get([block for _, block in items])
+        out = [
+            (keys, np.asarray(block))
+            for (keys, _), block in zip(items, host_blocks)
+        ]
+        return out, sum(int(b.nbytes) for _, b in out)
+
+    def prefetch_pages(self, items, n_pages: int) -> List[int]:
+        """Tiered KV, host->device half (ISSUE 19): write host-tier page
+        blocks back into the pool at freshly allocated ids and adopt each
+        page's born reference AS a prefix pin — the re-homed entry is then
+        indistinguishable from one whose pages never left the device
+        (``pages_live``/``unpin_pages``/``check()`` all reconcile
+        unchanged). The write rides the existing jitted import program:
+        host->device dispatch only, NO sync — it overlaps whatever decode
+        chunk is in flight, which is the whole point. NOT charged to
+        ``copy_bytes``: that meter proves device-side CoW sharing moved
+        nothing; tier traffic has its own accounting."""
+        if self.cache is None:
+            raise RuntimeError(
+                "prefetch needs an allocated pool — serve one admission "
+                "first (a host-tier hit before any pool exists would have "
+                "nothing to write into)"
+            )
+        from neuronx_distributed_tpu.modules.attention import _rebuild_tree
+
+        ids = self._alloc_pages(n_pages)
+        blocks = _rebuild_tree(list(items))
+        self.cache = self._import_fn(
+            self.cache, blocks, jnp.asarray(np.asarray(ids, np.int32))
+        )
+        for pid in ids:
+            # alloc born the page at refcount 1; that reference IS the pin
+            self._pins[int(pid)] = self._pins.get(int(pid), 0) + 1
+        return [int(pid) for pid in ids]
+
     def ensure_decode_window(self, active_slots, width: int) -> bool:
         """Map real pages under every active slot's next write window
         (columns ``[cursor, cursor + width)``) before a chunk dispatch.
@@ -1097,6 +1197,10 @@ class PagedCacheManager:
             for _ in range(holds):
                 self.alloc.deref(pid)
         self._staged.clear()
+        # prefetch holds are VOID too: on pool loss the engine clears the
+        # prefix store, whose eviction hook releases the pins themselves —
+        # a surviving hold would permanently shrink the reclaimable sum
+        self._prefetch_hold.clear()
         if consumed:
             self.cache = None
             return False
@@ -1166,4 +1270,11 @@ class PagedCacheManager:
                 f"page {pid} is not exactly one of free/referenced/"
                 f"quarantined: free={pid in free} refs={have} "
                 f"quarantined={pid in a._quarantined}"
+            )
+        # tiered KV (ISSUE 19): a prefetch hold is an overlay on a PINNED
+        # page, never a reference of its own — a hold on an unpinned page
+        # means the release path lost track of a claimed prefetch
+        for pid in self._prefetch_hold:
+            assert self._pins.get(pid, 0) > 0, (
+                f"page {pid} carries a prefetch hold but no prefix pin"
             )

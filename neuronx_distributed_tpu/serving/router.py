@@ -530,7 +530,12 @@ class ReplicaRouter:
                 if e.page_pressure() >= self.affinity_overcommit:
                     # the overcommit guard: a page-saturated replica's
                     # resident prefix is not worth living at its
-                    # preemption wall — balance instead
+                    # preemption wall — balance instead. Tiered replicas
+                    # (ISSUE 19) clear this bar longer: page_pressure
+                    # counts spill-reclaimable capacity, and a SPILLED
+                    # prefix still matches below (the trie keeps
+                    # host-resident entries), so affinity keeps steering
+                    # at shared prefixes the host tier can serve
                     continue
                 m = e.prefix.match_len(prompt)
                 if m > best_m:

@@ -254,6 +254,24 @@ class Scheduler:
     def queued(self) -> int:
         return len(self.queued_requests)
 
+    def upcoming(self, n: int) -> List[Request]:
+        """The first ``n`` live queued requests in plain queue order —
+        the tiered-KV prefetch pre-pass's peek window (ISSUE 19).
+        READ-only and policy-blind on purpose: it never consults
+        ``policy.select``, so it cannot perturb admission. Prefetching
+        for a request the policy admits later (or never) merely moves
+        pages early — correctness never depends on this ordering."""
+        if n <= 0:
+            return []
+        out: List[Request] = []
+        for r in self._queue:
+            if r.finished:
+                continue
+            out.append(r)
+            if len(out) >= n:
+                break
+        return out
+
     def queued_by_tenant(self) -> Dict[str, int]:
         """Live queue depth per tenant, tenant-sorted — who is waiting
         (and, at halt time, who was being starved: the flight recorder

@@ -1,0 +1,244 @@
+"""Host-RAM page tier behind the device page pool (ISSUE 19).
+
+The paged KV pool and prefix cache are HBM-bound; production prefix working
+sets (system prompts x tenants x long docs) are not. Without a second tier a
+cold prefix entry under page pressure is simply evicted and its prefill work
+redone on the next hit — an eviction CLIFF at device pool capacity.
+:class:`HostPageStore` turns that into a SLOPE: the engine's reclaim valve
+spills a cold entry's pages here (one batched device->host pull of the raw
+pool blocks — k/v pages plus any quantized scale siblings, in pool storage
+form), and the admission pre-pass prefetches matched pages back device-side
+while the hitting request still queues, overlapped with the current decode
+chunk's device time.
+
+Integrity contract: every spilled page carries a content fingerprint
+(CRC-32 over its blocks' bytes, computed at put time from the same host
+copy that is stored). ``verify()`` recomputes it host-side before a
+prefetch writes anything back to the pool — a corrupted host page (bit
+rot, a chaos schedule's ``poison_host_page``) is rejected and the engine
+falls back to a full prefill, which is bit-identical by construction: K/V
+content is position-relative, so re-prefilling the same tokens rebuilds
+the same pages.
+
+Everything in this module is host-side numpy over host-resident blocks —
+zero device work, zero syncs (graftlint GL02 lists this module; the one
+device->host transfer of the spill path lives in
+``PagedCacheManager.spill_pages`` behind an explicit pragma).
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["HostPageStore"]
+
+
+def _page_fingerprint(blocks) -> int:
+    """CRC-32 chained over the page's per-leaf blocks in storage order
+    (the flatten order is deterministic for a fixed pool layout, so the
+    same bytes always hash the same)."""
+    fp = 0
+    for _, block in blocks:
+        fp = zlib.crc32(np.ascontiguousarray(block).tobytes(), fp)
+    return fp
+
+
+class _HostPage:
+    __slots__ = ("pid", "blocks", "fingerprint", "nbytes")
+
+    def __init__(self, pid: int, blocks, fingerprint: int, nbytes: int):
+        self.pid = pid          # the device page id this was spilled from
+        self.blocks = blocks    # [(path_keys, np block)] — page axis size 1
+        self.fingerprint = fingerprint
+        self.nbytes = nbytes
+
+
+class HostPageStore:
+    """Bounded host-RAM store of spilled KV pool pages.
+
+    Pages are keyed by MINTED host ids (monotone — device page ids recycle
+    the moment a spill frees them, so they cannot be the store's key; each
+    record still carries the device pid it was spilled from for the
+    post-mortem story). A page is a list of ``(path_keys, block)`` pairs —
+    one per k/v pool leaf including quantized scale siblings, page axis
+    kept at size 1 — so a multi-page fetch is a plain per-leaf
+    ``np.concatenate`` in the exact layout ``PagedCacheManager``'s import
+    program scatters back.
+
+    ``max_pages`` bounds host bytes the same way the pool bounds HBM: a
+    spill that does not fit is the caller's cue to degrade to plain
+    eviction (the pre-tiering behavior) — the store never grows past its
+    budget and never throws for being full.
+    """
+
+    def __init__(self, max_pages: int):
+        if max_pages < 1:
+            raise ValueError(f"max_pages must be >= 1, got {max_pages}")
+        self.max_pages = max_pages
+        self._pages: "OrderedDict[int, _HostPage]" = OrderedDict()
+        self._next_id = 1
+        # lifetime counters (the engine's metrics read events as they
+        # happen; these totals feed summary()/halt post-mortems)
+        self.pages_spilled_total = 0
+        self.pages_fetched_total = 0
+        self.pages_dropped_total = 0
+        self.spill_bytes_total = 0
+        self.fetch_bytes_total = 0
+        self.verify_failures_total = 0
+
+    # --- capacity ------------------------------------------------------------
+
+    @property
+    def used_pages(self) -> int:
+        return len(self._pages)
+
+    @property
+    def free_pages(self) -> int:
+        return self.max_pages - len(self._pages)
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes currently resident — the HBM ledger's host-tier
+        resident source (host metadata, no device involved)."""
+        return sum(p.nbytes for p in self._pages.values())
+
+    def __len__(self) -> int:
+        return len(self._pages)
+
+    # --- spill / fetch -------------------------------------------------------
+
+    def put(self, pids: Sequence[int], items) -> Tuple[int, ...]:
+        """Store ``len(pids)`` spilled pages. ``items`` is the spill
+        pull's per-leaf block list ``[(path_keys, np block)]`` with the
+        page axis (``block.ndim - 4``) of size ``len(pids)`` — exactly
+        what ``PagedCacheManager.spill_pages`` returns. Splits per page,
+        fingerprints each, returns the minted host ids in page order.
+        Raises when the store lacks room (callers check ``free_pages``
+        first — the degrade-to-eviction decision is theirs)."""
+        n = len(pids)
+        if n > self.free_pages:
+            raise ValueError(
+                f"host tier full: {n} pages offered, {self.free_pages} free"
+            )
+        host_ids: List[int] = []
+        for i, pid in enumerate(pids):
+            blocks = []
+            for keys, block in items:
+                pax = block.ndim - 4
+                blocks.append(
+                    (keys, np.take(block, [i], axis=pax))
+                )
+            hid = self._next_id
+            self._next_id += 1
+            page = _HostPage(
+                int(pid), blocks, _page_fingerprint(blocks),
+                sum(int(b.nbytes) for _, b in blocks),
+            )
+            self._pages[hid] = page
+            host_ids.append(hid)
+            self.pages_spilled_total += 1
+            self.spill_bytes_total += page.nbytes
+        return tuple(host_ids)
+
+    def get(self, host_ids: Sequence[int]):
+        """Reassemble the pages at ``host_ids`` into per-leaf blocks for
+        the pool's import program: ``([(path_keys, np block)], nbytes)``
+        with each block's page axis of size ``len(host_ids)``, in id
+        order. READ-only (the pages stay resident — the caller drops them
+        once the device write is dispatched)."""
+        pages = [self._require(h) for h in host_ids]
+        items = []
+        for j, (keys, first) in enumerate(pages[0].blocks):
+            pax = first.ndim - 4
+            items.append((keys, np.concatenate(
+                [p.blocks[j][1] for p in pages], axis=pax
+            )))
+        nbytes = sum(p.nbytes for p in pages)
+        self.pages_fetched_total += len(pages)
+        self.fetch_bytes_total += nbytes
+        return items, nbytes
+
+    def verify(self, host_ids: Sequence[int]) -> bool:
+        """Recompute every page's fingerprint against its stored bytes —
+        pure host work, run before a prefetch writes anything device-side.
+        False means at least one page's content no longer matches what was
+        spilled: the caller must reject the WHOLE fetch (a partial rebind
+        would mix good and corrupt pages into one context)."""
+        ok = True
+        for h in host_ids:
+            page = self._pages.get(h)
+            if page is None or _page_fingerprint(page.blocks) != page.fingerprint:
+                ok = False
+        if not ok:
+            self.verify_failures_total += 1
+        return ok
+
+    def drop(self, host_ids: Sequence[int]) -> None:
+        """Release pages (fetched back device-side, or their entry was
+        evicted). Unknown ids are skipped — eviction races with nothing
+        here, but the defensive shape matches the pool's release paths."""
+        for h in host_ids:
+            if self._pages.pop(h, None) is not None:
+                self.pages_dropped_total += 1
+
+    def clear(self) -> int:
+        n = len(self._pages)
+        self.pages_dropped_total += n
+        self._pages.clear()
+        return n
+
+    # --- chaos / invariants --------------------------------------------------
+
+    def corrupt(self, host_id: int) -> None:
+        """Flip one byte of a stored page's first block IN PLACE — the
+        ``poison_host_page`` chaos schedule's hand, modeling host-RAM bit
+        rot. ``verify()`` must catch it."""
+        page = self._require(host_id)
+        keys, block = page.blocks[0]
+        flat = np.ascontiguousarray(block)
+        raw = bytearray(flat.tobytes())
+        raw[0] ^= 0xFF
+        page.blocks[0] = (
+            keys,
+            np.frombuffer(bytes(raw), dtype=block.dtype).reshape(block.shape),
+        )
+
+    def contains(self, host_ids: Sequence[int]) -> bool:
+        return all(h in self._pages for h in host_ids)
+
+    def check(self) -> None:
+        """Host-tier half of the leak invariant: occupancy within budget,
+        ids unique by construction, every page's byte count consistent
+        with its blocks. AssertionError on violation."""
+        assert len(self._pages) <= self.max_pages, (
+            f"host tier over budget: {len(self._pages)} > {self.max_pages}"
+        )
+        for hid, page in self._pages.items():
+            have = sum(int(b.nbytes) for _, b in page.blocks)
+            assert have == page.nbytes, (
+                f"host page {hid}: recorded {page.nbytes} bytes, holds {have}"
+            )
+
+    def summary(self) -> Dict[str, int]:
+        """Flat scalars for halt post-mortems (depth-redaction safe)."""
+        return {
+            "host_pages_used": self.used_pages,
+            "host_pages_max": self.max_pages,
+            "host_bytes": self.nbytes,
+            "host_pages_spilled_total": self.pages_spilled_total,
+            "host_pages_fetched_total": self.pages_fetched_total,
+            "host_pages_dropped_total": self.pages_dropped_total,
+            "host_spill_bytes_total": self.spill_bytes_total,
+            "host_fetch_bytes_total": self.fetch_bytes_total,
+            "host_verify_failures_total": self.verify_failures_total,
+        }
+
+    def _require(self, host_id: int) -> _HostPage:
+        page = self._pages.get(host_id)
+        if page is None:
+            raise KeyError(f"host page {host_id} is not resident")
+        return page

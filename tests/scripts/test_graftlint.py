@@ -426,6 +426,37 @@ def test_gl02_aot_module_is_hot_by_path(tmp_path):
     assert report.violations == []
 
 
+def test_gl02_tiering_module_is_hot_by_path(tmp_path):
+    """ISSUE 19 satellite: the host-RAM page tier module is on the GL02
+    hot-path list BY PATH — it sits on the engine's admission/reclaim
+    path but is PURE host numpy (the only device->host transfer in the
+    whole tier is the pragma'd batched pull in ``paging.spill_pages``),
+    so any jax coercion or device_get smuggled into a future edit trips
+    with no marker needed — and the shipped module scans clean."""
+    fixture = """\
+        import jax.numpy as jnp
+
+        def fingerprint(page, blocks):
+            return float(jnp.sum(blocks[0])) if page else 0.0
+        """
+    assert "GL02" in rules_of(
+        lint(tmp_path, fixture, name="serving/tiering.py")
+    )
+    # an undocumented explicit device_get trips too — the store speaks
+    # numpy blocks the POOL already pulled; a second pull is a new sync
+    v = lint(tmp_path, """\
+        import jax
+
+        def put(store, pids, items):
+            return store._put(pids, jax.device_get(items))
+        """, name="serving/tiering.py")
+    assert any("device_get" in x.message for x in v if x.rule == "GL02")
+    shipped = os.path.join(PKG, "serving", "tiering.py")
+    assert os.path.exists(shipped)
+    report = runner.scan([shipped], root=REPO_ROOT)
+    assert report.violations == []
+
+
 def test_gl02_transport_module_is_hot_by_path(tmp_path):
     """ISSUE 18 satellite: the elastic-fabric transport seam is on the
     GL02 hot-path list BY PATH — every router->replica and prefill->decode

@@ -462,3 +462,70 @@ def test_sync_budget_unchanged_with_fabric_transport_and_watchdog(setup):
     # the messages really rode the seam: 1 submit + >=2 probes
     assert transport.stats["messages"] >= 3
     assert transport.stats["deliveries"] >= 3
+
+
+def test_sync_budget_unchanged_with_tiering(setup):
+    """ISSUE 19 re-pin: the host-RAM page tier. Steady budgets are
+    IDENTICAL to the bare paged engine — submit=1, admission step=2,
+    steady chunk=1 — because prefetch is a host->device DISPATCH (the
+    import program; zero readbacks) and spill only ever fires on a
+    reclaim event, off the steady path. The reclaim event itself is
+    pinned too: ONE batched device->host pull per spill, so an admission
+    that evicts-to-host pays exactly 2+1 syncs, and an admission that
+    prefetches a spilled prefix back pays the plain 2."""
+    from neuronx_distributed_tpu.serving import PrefixCache
+
+    cfg, model, params = setup
+    sys0 = (np.arange(1, 18, dtype=np.int32) % (cfg.vocab_size - 1)) + 1
+    sys1 = (np.arange(41, 58, dtype=np.int32) % (cfg.vocab_size - 1)) + 1
+    suf = np.arange(30, 34, dtype=np.int32)
+    # pool of 5 usable pages: entry A (2 pages) + the next prompt's
+    # working set cannot coexist, so B's admission MUST reclaim->spill
+    engine = ServingEngine(
+        model, params, num_slots=2, decode_chunk_size=4, kv_page_size=8,
+        kv_num_pages=6, kv_host_pages=16, admission="eager",
+        prefix_cache=PrefixCache(min_match=8),
+    )
+    g4 = GenerationConfig(max_new_tokens=4, temperature=0.0)
+    g12 = GenerationConfig(max_new_tokens=12, temperature=0.0)
+    # warm entry A, then drain — the pool now pins A's 2 pages
+    engine.submit(np.concatenate([sys0, suf]), g4,
+                  key=jax.random.PRNGKey(7))
+    engine.run()
+
+    # movement 1: admission that spills A to host = 2 + ONE batched pull
+    with _SyncCounter() as c:
+        rb = engine.submit(np.concatenate([sys1, suf]), g12,
+                           key=jax.random.PRNGKey(8))
+    assert c.calls == 1, f"tiered submit must stay 1 sync, saw {c.calls}"
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 3, (
+        f"spilling admission must be 2+1 syncs (one batched "
+        f"device->host pull), saw {c.calls}"
+    )
+    assert engine.metrics.snapshot()["kv_pages_spilled"] == 2
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 1, f"tiered steady chunk must stay 1 sync, saw {c.calls}"
+    engine.run()
+    assert rb.state is RequestState.DONE and len(rb.tokens) == 12
+
+    # movement 2: admission that prefetches A back from host = plain 2
+    with _SyncCounter() as c:
+        rc = engine.submit(np.concatenate([sys0, suf + 9]), g4,
+                           key=jax.random.PRNGKey(9))
+    assert c.calls == 1
+    with _SyncCounter() as c:
+        engine.step()
+    assert c.calls == 2, (
+        f"prefetching admission must stay 2 syncs (the import is a "
+        f"dispatch, not a readback), saw {c.calls}"
+    )
+    engine.run()
+    assert rc.state is RequestState.DONE and len(rc.tokens) == 4
+    m = engine.metrics.snapshot()
+    assert m["kv_pages_prefetched"] == 2 and m["kv_prefetch_late"] == 0
+    assert m["prefix_hit_tier"] == {"host": 1}
+    engine.cache.check()
+    engine.tier.check()

@@ -1,0 +1,420 @@
+"""Tiered KV cache (ISSUE 19): host-RAM page spill + asynchronous prefetch.
+
+The load-bearing contracts, each pinned here:
+
+* ``HostPageStore`` algebra — put/get round-trips page bytes exactly,
+  fingerprints reject corruption (the WHOLE fetch, not just the bad
+  page), capacity is a hard bound, drop/clear release, ``check()``
+  catches internal rot;
+* the eviction CLIFF becomes a hit-rate SLOPE: the same working set
+  (~2x the device pool) that scores ZERO prefix hits with tiering off
+  scores host-tier hits with tiering on — and the streams are
+  BIT-IDENTICAL between the two runs (host round-trip is byte-exact;
+  re-prefill of the same tokens rebuilds the same pages);
+* ``copy_bytes`` stays 0 — spill/prefetch move pages between tiers,
+  never duplicate them inside the pool;
+* chaos (spill failure -> plain eviction; prefetch failure -> full
+  prefill; host bit-rot -> fingerprint rejection -> full prefill): every
+  leg bit-identical, zero tokens lost, allocator + store checks clean;
+* ``HBMLedger`` speaks both tiers: host residents sized against
+  ``plan(host_budget_bytes=)``, the ``tier`` key appearing ONLY on
+  non-device entries (the device-only snapshot schema is pinned
+  elsewhere and must not move);
+* two identical tiered runs are deterministic to the byte (streams AND
+  metric snapshots).
+
+Tier budget: the acceptance core (cliff-vs-slope + bit-identity, chaos,
+store algebra, ledger schema) stays tier-1; the sampled and disagg
+composition legs are ``slow``.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.observability.hbm import HBMLedger, UNAVAILABLE
+from neuronx_distributed_tpu.serving import (
+    FaultInjector,
+    HostPageStore,
+    PagedCacheManager,
+    PrefixCache,
+    RequestState,
+    ServingEngine,
+)
+
+PS = 8  # page size used throughout
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama()
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+# --- HostPageStore ------------------------------------------------------------
+
+
+def _leaf_items(rng, n_pages, n_leaves=4, shape=(2, 4, 3)):
+    """Per-leaf spill blocks shaped like spill_pages output: a list of
+    ``(path_keys, block)`` with each block's page axis (ndim-4, here 0)
+    of size ``n_pages``."""
+    return [
+        ((f"layer{j}", "k" if j % 2 == 0 else "v"),
+         rng.standard_normal((n_pages,) + shape).astype(np.float32))
+        for j in range(n_leaves)
+    ]
+
+
+def test_store_put_get_roundtrip_bytes_exact():
+    rng = np.random.default_rng(0)
+    store = HostPageStore(4)
+    items = _leaf_items(rng, 3)
+    hids = store.put([11, 12, 13], items)
+    assert len(hids) == 3 and store.used_pages == 3 and store.free_pages == 1
+    assert store.contains(hids) and store.verify(hids)
+    out, nbytes = store.get(hids)
+    assert nbytes == sum(int(b.nbytes) for _, b in items)
+    for (ik, ib), (ok, ob) in zip(items, out):
+        assert ik == ok
+        np.testing.assert_array_equal(ob, ib)
+    # partial fetch in a DIFFERENT order: page rows follow the id order
+    out2, _ = store.get([hids[2], hids[0]])
+    for (ik, ib), (ok, ob) in zip(items, out2):
+        np.testing.assert_array_equal(ob, np.take(ib, [2, 0], axis=0))
+    store.check()
+    assert store.clear() == 3 and store.used_pages == 0
+
+
+def test_store_host_ids_are_minted_not_recycled_device_pids():
+    """Device pids recycle through the free list; host ids must not —
+    two spills of the same pid get distinct host identities."""
+    rng = np.random.default_rng(1)
+    store = HostPageStore(4)
+    items = _leaf_items(rng, 1)
+    (a,) = store.put([5], items)
+    store.drop([a])
+    (b,) = store.put([5], items)
+    assert a != b and not store.contains([a]) and store.contains([b])
+    store.clear()
+
+
+def test_store_capacity_is_a_hard_bound():
+    rng = np.random.default_rng(2)
+    store = HostPageStore(2)
+    items = _leaf_items(rng, 3)
+    with pytest.raises(ValueError, match="full"):
+        store.put([1, 2, 3], items)
+    assert store.used_pages == 0  # rejected whole, nothing partial
+
+
+def test_store_corruption_rejects_the_whole_fetch():
+    rng = np.random.default_rng(3)
+    store = HostPageStore(4)
+    hids = store.put([1, 2], _leaf_items(rng, 2))
+    store.corrupt(hids[1])
+    assert not store.verify(hids)          # one bad page fails the batch
+    assert not store.verify([hids[1]])
+    assert store.verify([hids[0]])         # the clean page alone still passes
+    assert store.verify_failures_total == 2
+    store.clear()
+    with pytest.raises(KeyError):
+        store.get(hids)
+
+
+# --- manager invariants -------------------------------------------------------
+
+
+def test_check_prefetch_hold_requires_pin():
+    """A prefetch hold is an overlay on PINNED pages — check() catches a
+    hold left on a page whose pins are gone (the leak class the release-
+    at-pin-time path must never create)."""
+    mgr = PagedCacheManager(num_slots=1, max_seq_len=32, page_size=PS)
+    (pid,) = mgr.alloc.alloc(1)
+    mgr._pins[pid] = mgr._pins.get(pid, 0) + 1
+    assert mgr.reclaimable_pages() == 1    # pinned-only page: reclaimable
+    mgr.hold_prefetched([pid])
+    assert mgr.prefetch_held([pid])
+    assert mgr.reclaimable_pages() == 0    # ...until a prefetch holds it
+    mgr.check()  # pinned + held: fine
+    mgr.release_prefetched([pid])
+    assert not mgr.prefetch_held([pid])
+    mgr.hold_prefetched([pid])
+    del mgr._pins[pid]
+    mgr.alloc.deref(pid)  # drop the pin's ref; hold now dangles
+    with pytest.raises(AssertionError, match="hold"):
+        mgr.check()
+    mgr.release_prefetched([pid])
+
+
+# --- HBM ledger two-tier planning --------------------------------------------
+
+
+def test_hbm_plan_two_tier_schema_and_math():
+    hbm = HBMLedger()
+    hbm.add_resident(
+        "kv_pages", lambda: 8 * 1024, unit_bytes=1024, count=8, unit="page"
+    )
+    hbm.add_resident(
+        "kv_host_pages", lambda: 4 * 2048, unit_bytes=2048, count=4,
+        unit="page", tier="host",
+    )
+    snap = hbm.snapshot()
+    # the device entry keeps the EXACT pre-tiering schema (no "tier" key);
+    # host entries carry it explicitly
+    assert snap["residents"]["kv_pages"] == {
+        "bytes": 8192, "unit_bytes": 1024, "unit": "page", "count": 8
+    }
+    assert snap["residents"]["kv_host_pages"]["tier"] == "host"
+    assert snap["resident_bytes_total"] == 8192          # device tier only
+    assert snap["host_resident_bytes_total"] == 4 * 2048
+    assert hbm.resident_bytes_total() == 8192
+    assert hbm.resident_bytes_total(tier="host") == 4 * 2048
+    # each tier sized against ITS budget, never the other's headroom
+    plan = hbm.plan(budget_bytes=8192 + 2 * 1024,
+                    host_budget_bytes=4 * 2048 + 3 * 2048)
+    assert plan["free_bytes"] == 2 * 1024
+    assert plan["host_free_bytes"] == 3 * 2048
+    dev = plan["fits"]["kv_pages"]
+    host = plan["fits"]["kv_host_pages"]
+    assert "tier" not in dev and dev["additional"] == 2
+    assert host["tier"] == "host" and host["additional"] == 3
+    assert host["max_total"] == 7
+    # one budget only: the other tier's fit degrades to UNAVAILABLE
+    p2 = hbm.plan(budget_bytes=8192 + 1024)
+    assert p2["fits"]["kv_pages"]["additional"] == 1
+    assert p2["fits"]["kv_host_pages"]["additional"] == UNAVAILABLE
+    assert p2["host_budget_bytes"] == UNAVAILABLE
+    assert "host_resident_bytes_total" in hbm.halt_summary()
+    with pytest.raises(ValueError, match="tier"):
+        hbm.add_resident("x", lambda: 1, tier="disk")
+
+
+# --- the cliff-vs-slope engine scenario ---------------------------------------
+#
+# Four distinct 17-token system prefixes (2 whole pages each once floor-
+# aligned to 16 tokens) rotate through a pool of 8 usable pages that can
+# pin at most ~3 of them: with tiering OFF every revisit is a miss (the
+# reclaim valve evicted the entry); with the host tier ON the valve
+# spills instead and the revisit is a HOST-tier hit.
+
+
+def _tiered_workload(cfg):
+    sys_prefixes = [
+        (np.arange(1 + 40 * j, 18 + 40 * j, dtype=np.int32)
+         % (cfg.vocab_size - 1)) + 1
+        for j in range(4)
+    ]
+    rng = np.random.RandomState(3)
+    suffixes = [
+        rng.randint(1, cfg.vocab_size, size=4).astype(np.int32)
+        for _ in range(8)
+    ]
+    waves = [0, 1, 2, 3, 0, 1]
+    prompts = [
+        np.concatenate([sys_prefixes[w], suffixes[i]])
+        for i, w in enumerate(waves)
+    ]
+    return prompts
+
+
+def _run_tiered(model, params, prompts, gcfg=None, *, serial=True, **kw):
+    """Submit the wave workload SERIALLY (run() between submits) so the
+    pool is quiet at every allocation — evictions/spills then happen at
+    deterministic points. Returns (engine, streams)."""
+    gcfg = gcfg or GenerationConfig(max_new_tokens=4, temperature=0.0)
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("decode_chunk_size", 4)
+    kw.setdefault("kv_page_size", PS)
+    kw.setdefault("admission", "eager")
+    kw.setdefault("prefix_cache", PrefixCache(min_match=8))
+    eng = ServingEngine(model, params, **kw)
+    reqs = []
+    for i, p in enumerate(prompts):
+        reqs.append(eng.submit(p, gcfg, key=jax.random.PRNGKey(100 + i)))
+        if serial:
+            eng.run()
+    if not serial:
+        eng.run()
+    assert all(r.state is RequestState.DONE for r in reqs)
+    return eng, [r.tokens for r in reqs]
+
+
+def test_cliff_becomes_slope_and_streams_bit_identical(setup):
+    """THE acceptance pin: at a fixed device pool a working set ~2x its
+    size scores 0 prefix hits with tiering off (eviction cliff) and
+    host-tier hits with tiering on (slope) — streams byte-equal, zero
+    copies, clean checks on both the pool and the store."""
+    cfg, model, params = setup
+    prompts = _tiered_workload(cfg)
+    off, off_toks = _run_tiered(model, params, prompts, kv_num_pages=9)
+    m_off = off.metrics.snapshot()
+    assert m_off["prefix_hits"] == 0 and m_off["prefix_evictions"] > 0
+
+    on, on_toks = _run_tiered(model, params, prompts,
+                              kv_num_pages=9, kv_host_pages=16)
+    m_on = on.metrics.snapshot()
+    assert on_toks == off_toks                       # bit-identical streams
+    assert m_on["prefix_hits"] >= 2
+    assert m_on["prefix_hit_tier"].get("host", 0) == m_on["prefix_hits"]
+    assert m_on["kv_pages_spilled"] >= 4
+    assert m_on["kv_pages_prefetched"] >= 4
+    assert m_on["kv_spill_bytes"] > 0 and m_on["kv_prefetch_bytes"] > 0
+    assert m_on["kv_prefetch_late"] == 0             # overlap, not stall
+    assert on.cache.alloc.copy_bytes == 0            # tiers move, never copy
+    on.cache.check()
+    on.tier.check()
+    # host tier shows up in the ledger's two-tier snapshot
+    snap = on.hbm.snapshot()
+    assert snap["residents"]["kv_host_pages"]["tier"] == "host"
+    assert "host_resident_bytes_total" in snap
+
+
+def test_untiered_engine_has_no_host_tier_surface(setup):
+    """kv_host_pages=None keeps the engine byte-identical to pre-tiering:
+    no tier object, no host resident, no tier key on kv_pages."""
+    cfg, model, params = setup
+    prompts = _tiered_workload(cfg)[:2]
+    eng, _ = _run_tiered(model, params, prompts, kv_num_pages=17)
+    assert eng.tier is None
+    snap = eng.hbm.snapshot()
+    assert "kv_host_pages" not in snap["residents"]
+    assert "tier" not in snap["residents"]["kv_pages"]
+    with pytest.raises(ValueError, match="kv_page_size"):
+        ServingEngine(model, params, num_slots=1, kv_host_pages=8)
+
+
+def test_two_run_determinism_with_tiering_on(setup):
+    """Two identical tiered runs: streams AND metric snapshots equal —
+    spill/prefetch decisions are functions of the workload alone."""
+    cfg, model, params = setup
+    prompts = _tiered_workload(cfg)
+    runs = []
+    for _ in range(2):
+        eng, toks = _run_tiered(model, params, prompts,
+                                kv_num_pages=9, kv_host_pages=16)
+        m = eng.metrics.snapshot()
+        runs.append((toks, {
+            k: m[k] for k in (
+                "prefix_hits", "prefix_hit_tier", "kv_pages_spilled",
+                "kv_pages_prefetched", "kv_prefetch_late",
+                "kv_spill_failures", "kv_prefetch_failures",
+                "kv_host_poisoned",
+            )
+        }, eng.tier.summary()))
+    assert runs[0] == runs[1]
+
+
+@pytest.mark.slow
+def test_sampled_streams_bit_identical_with_tiering(setup):
+    """Sampled decoding (temperature + top_k) through the same spill/
+    prefetch churn: per-request keys make the comparison exact."""
+    cfg, model, params = setup
+    prompts = _tiered_workload(cfg)
+    gcfg = GenerationConfig(max_new_tokens=4, temperature=0.9, top_k=17)
+    _, off_toks = _run_tiered(model, params, prompts, gcfg,
+                              kv_num_pages=9)
+    on, on_toks = _run_tiered(model, params, prompts, gcfg,
+                              kv_num_pages=9, kv_host_pages=16)
+    assert on_toks == off_toks
+    assert on.metrics.snapshot()["kv_pages_spilled"] > 0
+
+
+# --- chaos --------------------------------------------------------------------
+
+
+def test_spill_failure_degrades_to_plain_eviction(setup):
+    """fail_spill: nothing leaves the pool, the reclaim valve falls back
+    to eviction (exactly the tiering-off behaviour for those entries) —
+    streams bit-identical, pool + store clean, no leak."""
+    cfg, model, params = setup
+    prompts = _tiered_workload(cfg)
+    _, base = _run_tiered(model, params, prompts, kv_num_pages=9)
+    inj = FaultInjector().fail_spill(at=0, times=2)
+    eng, toks = _run_tiered(model, params, prompts, kv_num_pages=9,
+                            kv_host_pages=16, fault_injector=inj)
+    m = eng.metrics.snapshot()
+    assert toks == base
+    assert m["kv_spill_failures"] == 2 == inj.counters["spill_failures"]
+    assert m["prefix_evictions"] >= 2        # degraded path = eviction
+    eng.cache.check()
+    eng.tier.check()
+
+
+def test_prefetch_failure_falls_back_to_full_prefill(setup):
+    """fail_prefetch: the host entry is dropped (host pages released, no
+    orphan) and the request re-prefills from scratch — bit-identical."""
+    cfg, model, params = setup
+    prompts = _tiered_workload(cfg)
+    _, base = _run_tiered(model, params, prompts, kv_num_pages=9)
+    inj = FaultInjector().fail_prefetch(at=0, times=1)
+    eng, toks = _run_tiered(model, params, prompts, kv_num_pages=9,
+                            kv_host_pages=16, fault_injector=inj)
+    m = eng.metrics.snapshot()
+    assert toks == base
+    assert m["kv_prefetch_failures"] == 1
+    assert m["prefix_hits"] <= 1             # the failed one became a miss
+    eng.cache.check()
+    eng.tier.check()
+    assert eng.cache.alloc.copy_bytes == 0
+
+
+def test_host_bit_rot_rejected_by_fingerprint(setup):
+    """poison_host_page: corrupted host bytes NEVER reach the pool — the
+    fingerprint check rejects the fetch, the entry is evicted, and the
+    request's full prefill rebuilds the same pages bit-identically."""
+    cfg, model, params = setup
+    prompts = _tiered_workload(cfg)
+    _, base = _run_tiered(model, params, prompts, kv_num_pages=9)
+    inj = FaultInjector().poison_host_page(at=0, times=1)
+    eng, toks = _run_tiered(model, params, prompts, kv_num_pages=9,
+                            kv_host_pages=16, fault_injector=inj)
+    m = eng.metrics.snapshot()
+    assert toks == base
+    assert m["kv_host_poisoned"] == 1
+    assert inj.counters["poisoned_host_pages"] == 1
+    assert m["prefix_validation_failures"] >= 1
+    eng.cache.check()
+    eng.tier.check()
+
+
+# --- composition --------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_tiering_composes_with_disagg_handoff(setup):
+    """A tiered decode engine behind the disaggregated prefill path: the
+    handoff plants prefix entries exactly like solo admission, the valve
+    spills them under pressure, and streams stay bit-identical to the
+    untiered disagg run."""
+    from neuronx_distributed_tpu.serving import DisaggregatedServer
+
+    cfg, model, params = setup
+    prompts = _tiered_workload(cfg)
+    gcfg = GenerationConfig(max_new_tokens=4, temperature=0.0)
+
+    def run(**kw):
+        eng = ServingEngine(
+            model, params, num_slots=2, decode_chunk_size=4,
+            kv_page_size=PS, admission="eager",
+            prefix_cache=PrefixCache(min_match=8), **kw,
+        )
+        srv = DisaggregatedServer(eng, n_workers=1)
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(srv.submit(p, gcfg,
+                                   key=jax.random.PRNGKey(100 + i)))
+            srv.run()
+        assert all(r.state is RequestState.DONE for r in reqs)
+        return eng, [r.tokens for r in reqs]
+
+    _, base = run(kv_num_pages=9)
+    eng, toks = run(kv_num_pages=9, kv_host_pages=16)
+    assert toks == base
+    assert eng.cache.alloc.copy_bytes == 0
+    eng.cache.check()
+    eng.tier.check()
