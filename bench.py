@@ -61,6 +61,7 @@ SERVING_TIMEOUT_S = 420
 FAULTS_TIMEOUT_S = 300
 PREFIX_TIMEOUT_S = 420
 TRAIN_FAULTS_TIMEOUT_S = 420
+INTEGRITY_TIMEOUT_S = 420
 OBSERVE_TIMEOUT_S = 300
 SPEC_TIMEOUT_S = 540
 PAGED_TIMEOUT_S = 540
@@ -745,6 +746,114 @@ def _measure_train_faults(devs):
         "resume_bit_identical": resumed == clean_losses,
         "resumed_tokens_lost": int(_divergence_lost(clean_losses, resumed)),
         "resumed_steps_run": int(tr_r.steps_run),
+    }
+
+
+def _measure_integrity(devs):
+    """SDC sentinel overhead + detection (``--child-integrity``): the SAME
+    short training run with the sentinel OFF vs ON (vote mode over the
+    CPU proxy's dp replicas, ``check_every=16``), comparing trimmed mean
+    step wall — the ≤2% budget — and proving determinism (the loss
+    streams must be bit-identical: fingerprinting is observation, never
+    perturbation). Then an injected single-bit params flip mid-window
+    measures detection latency in steps and the rollback count."""
+    import time as _t
+
+    import jax
+
+    from neuronx_distributed_tpu.integrity import SentinelConfig
+    from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+    from neuronx_distributed_tpu.observability.flight_recorder import (
+        FlightRecorder,
+    )
+    from neuronx_distributed_tpu.parallel import mesh as mesh_lib
+    from neuronx_distributed_tpu.trainer import OptimizerConfig
+    from neuronx_distributed_tpu.trainer.data import SyntheticTokens
+    from neuronx_distributed_tpu.trainer.faults import FaultInjector
+    from neuronx_distributed_tpu.trainer.loop import Trainer
+
+    if not mesh_lib.model_parallel_is_initialized():
+        mesh_lib.initialize_model_parallel()
+    cfg = tiny_llama(num_layers=2, max_seq_len=32)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    STEPS, BS, SEQ, CHECK = 32, 8, 16, 16
+    FLIP_AT = 20  # mid window: the idx-31 check is the first to see it
+
+    class Rec:
+        def __init__(self):
+            self.losses, self.times = [], []
+
+        def on_train_start(self, t):
+            pass
+
+        def on_step_end(self, t, m):
+            self.losses.append(float(m["loss"]))
+            self.times.append(_t.perf_counter())
+
+        def on_train_end(self, t):
+            pass
+
+    def run(integrity=None, injector=None, flight=None, steps=STEPS):
+        rec = Rec()
+        tr = Trainer(
+            model=model, optimizer_config=OptimizerConfig(zero1=False),
+            callbacks=[rec], fault_injector=injector, integrity=integrity,
+            flight_recorder=flight,
+        )
+        t0 = _t.perf_counter()
+        tr.fit(
+            SyntheticTokens(cfg.vocab_size, BS, SEQ, seed=11),
+            jax.random.PRNGKey(0), max_steps=steps,
+        )
+        rec.times.insert(0, t0)
+        return tr, rec
+
+    def step_ms(rec):
+        # trimmed mean: drop the two slowest steps (first-step train
+        # compile / first-check fingerprint compile), average the rest —
+        # the steady-state per-step wall the 2% budget is about
+        deltas = sorted(
+            b - a for a, b in zip(rec.times, rec.times[1:])
+        )[:-2]
+        return 1000.0 * sum(deltas) / max(len(deltas), 1)
+
+    run(steps=2)  # compile the train step outside every timed window
+    tr_off, rec_off = run()
+    tr_on, rec_on = run(integrity=SentinelConfig(check_every=CHECK))
+    off_ms, on_ms = step_ms(rec_off), step_ms(rec_on)
+    overhead_pct = (
+        100.0 * (on_ms - off_ms) / off_ms if off_ms > 0 else 0.0
+    )
+
+    fl = FlightRecorder(subsystem="bench")
+    inj = FaultInjector().flip_bits("params", at=FLIP_AT, device=1)
+    tr_d, _ = run(
+        integrity=SentinelConfig(check_every=CHECK), injector=inj,
+        flight=fl,
+    )
+    detected = [e for e in fl.events() if e["kind"] == "sdc_detected"]
+    det_step = int(detected[0]["step"]) if detected else None
+
+    return {
+        "steps": STEPS,
+        "check_every": CHECK,
+        "mode": tr_on._sentinel.mode,
+        "dp_replicas": len(devs),
+        "step_ms_off": round(off_ms, 4),
+        "step_ms_on": round(on_ms, 4),
+        "overhead_pct": round(overhead_pct, 2),
+        "within_budget": overhead_pct <= 2.0,
+        "checks_run": int(tr_on._sentinel.counters["integrity_checks"]),
+        "false_positives": int(tr_on._sentinel.counters["sdc_detected"]),
+        "deterministic": rec_on.losses == rec_off.losses,
+        "injected_flip_step": FLIP_AT,
+        "detected_step": det_step,
+        "detection_latency_steps": (
+            det_step - FLIP_AT if det_step is not None else None
+        ),
+        "rollbacks": int(tr_d._sentinel.counters["sdc_rollbacks"]),
+        "quarantined_devices": list(tr_d._sentinel.quarantined_devices),
+        "final_step": int(tr_d.step),
     }
 
 
@@ -3253,6 +3362,40 @@ def child_train_faults() -> None:
         )
 
 
+def child_integrity() -> None:
+    """SDC sentinel child (``--child-integrity``): sentinel-off vs
+    sentinel-on step wall on the CPU proxy (vote mode, check_every=16,
+    the ≤2% budget), loss-stream determinism, and detection latency for
+    an injected single-bit params flip. Prints one JSON line; merged into
+    the BENCH artifact as ``extras.integrity``."""
+    os.environ.setdefault("BENCH_FORCE_PLATFORM", "cpu")
+    # vote mode needs dp replicas: 8 virtual CPU devices, like the other
+    # mesh-driven children
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax = _child_setup_jax()
+    try:
+        devs = jax.devices()
+        _emit(
+            {
+                "metric": "integrity",
+                "unit": "sentinel overhead + detection latency",
+                "platform": devs[0].platform,
+                **_measure_integrity(devs),
+            }
+        )
+    except Exception as e:
+        _emit(
+            {
+                "metric": "integrity",
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+            }
+        )
+
+
 def _measure_efficiency(devs) -> dict:
     """Device-efficiency snapshot (``--child-efficiency``): a ledgered
     serving engine with ``memory_analysis=True`` (the AOT-compile opt-in —
@@ -3785,6 +3928,11 @@ def main() -> None:
             if train_faults_result is not None
             else {"error": "train-faults child did not finish"}
         )
+        extras["integrity"] = (
+            integrity_result
+            if integrity_result is not None
+            else {"error": "integrity child did not finish"}
+        )
         extras["observability"] = (
             observe_result
             if observe_result is not None
@@ -3976,6 +4124,16 @@ def main() -> None:
     else:
         train_faults_result = {"error": f"train-faults child: {err}"}
 
+    # 8b. SDC-sentinel child: sentinel-off vs -on step wall + detection
+    #     latency for an injected bit flip (wall-clock comparison —
+    #     serialized for the same core-contention reason).
+    integ, err = _run_child("--child-integrity", INTEGRITY_TIMEOUT_S)
+    if integ is not None:
+        integ.pop("metric", None)
+        integrity_result = integ
+    else:
+        integrity_result = {"error": f"integrity child: {err}"}
+
     # 9. Observability-overhead child: instrumented vs bare decode wall +
     #    histogram percentile error (serialized last for the same
     #    core-contention reason — it is itself a wall-clock comparison).
@@ -4113,6 +4271,8 @@ if __name__ == "__main__":
         child_spec()
     elif "--child-train-faults" in sys.argv:
         child_train_faults()
+    elif "--child-integrity" in sys.argv:
+        child_integrity()
     elif "--child-faults" in sys.argv:
         child_faults()
     elif "--child-prefix" in sys.argv:

@@ -183,12 +183,16 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--inject-fault", default="none",
                    choices=["none", "dispatch", "halt", "poison", "prefill",
-                            "skew", "draft", "page"],
+                            "skew", "draft", "page", "bitflip"],
                    help="drive a recovery path through the FaultInjector: "
                         "one dispatch failure (recover), all dispatches "
                         "(HALTED), a poisoned readback (quarantine), a "
-                        "prefill OOM (fail one request), or clock skew "
-                        "(trip --deadline/--queue-timeout instantly)")
+                        "prefill OOM (fail one request), clock skew "
+                        "(trip --deadline/--queue-timeout instantly), or "
+                        "'bitflip' — one silent bit flipped inside a "
+                        "pooled KV page; the reuse-time page fingerprints "
+                        "reject it and the engine falls back to a full "
+                        "prefill (needs --shared-prefix > 0)")
     p.add_argument("--deadline", type=float, default=None,
                    help="per-request end-to-end deadline in seconds "
                         "(missed → TIMED_OUT at the next chunk boundary, "
@@ -648,6 +652,17 @@ def main(argv=None):
                     "--inject-fault page needs the paged layout"
                 )
             injector.poison_page(at=2, slot=0)  # page-granular quarantine
+        if args.inject_fault == "bitflip":
+            if args.row_cache or not args.kv_page_size:
+                raise SystemExit(
+                    "--inject-fault bitflip needs the paged layout"
+                )
+            if args.shared_prefix <= 0 or args.no_prefix_cache:
+                raise SystemExit(
+                    "--inject-fault bitflip needs --shared-prefix > 0 "
+                    "with the prefix cache on (a KV reuse to corrupt)"
+                )
+            injector.flip_bits("kv_pool", at=0)  # first prefix reuse
         if args.inject_fault == "dispatch":
             injector.fail_dispatch(at=2, times=1)  # one mid-run failure
         elif args.inject_fault == "halt":

@@ -111,14 +111,17 @@ def parse_args(argv=None):
 
     f = p.add_argument_group("fault injection (chaos demo)")
     f.add_argument("--inject-fault", default=None,
-                   choices=["nan", "spike", "dispatch", "ckpt", "sigterm"],
+                   choices=["nan", "spike", "dispatch", "ckpt", "sigterm",
+                            "bitflip"],
                    help="drive one deterministic fault through the trainer's "
                         "recovery machinery: 'nan' (NaN loss skipped on "
                         "device), 'spike' (grad-norm spike skipped), "
                         "'dispatch' (train-step dispatch failure, retried), "
                         "'ckpt' (checkpoint corrupted after save — resume "
                         "falls back), 'sigterm' (real SIGTERM: finish step, "
-                        "checkpoint, exit cleanly)")
+                        "checkpoint, exit cleanly), 'bitflip' (one silent "
+                        "weight-bit flip — the SDC sentinel detects it, "
+                        "rolls back to the last verified step, re-trains)")
     f.add_argument("--fault-at", type=int, default=2,
                    help="0-based step (or dispatch attempt) the fault fires at")
     f.add_argument("--anomaly-budget", type=int, default=25,
@@ -333,8 +336,23 @@ def main(argv=None):
             injector.corrupt_checkpoint(f"step_{last_tag}")
         elif args.inject_fault == "sigterm":
             injector.deliver_sigterm(at=at)
+        elif args.inject_fault == "bitflip":
+            # under dp the vote localizes ONE corrupt device copy; solo
+            # runs flip every copy and the canary's re-execution catches
+            # the divergence at the (every-step) check
+            injector.flip_bits("params", at=at,
+                               device=1 if dp >= 2 else None)
 
     from neuronx_distributed_tpu.trainer import AnomalyGuardConfig
+
+    integrity = None
+    if args.inject_fault == "bitflip":
+        from neuronx_distributed_tpu.integrity import SentinelConfig
+
+        # SDC sentinel demo: every step is a check (detection latency 1
+        # step in either mode) so the short chaos run detects, rolls
+        # back to the last verified step, and re-trains
+        integrity = SentinelConfig(check_every=1)
 
     trace_path = args.trace or args.timeline
     trainer = Trainer(
@@ -358,6 +376,7 @@ def main(argv=None):
             ),
         ),
         emergency_dir=args.ckpt_dir,
+        integrity=integrity,
     )
     data = make_data_iter(args, cfg, batch_size, seq_len)
 
@@ -392,6 +411,15 @@ def main(argv=None):
             f"preempted={trainer.preempted} "
             f"injected={getattr(injector, 'counters', {})}"
         )
+        sentinel = getattr(trainer, "_sentinel", None)
+        if sentinel is not None:
+            print(
+                f"sdc summary: mode={sentinel.mode} "
+                f"checks={sentinel.counters['integrity_checks']} "
+                f"detected={sentinel.counters['sdc_detected']} "
+                f"rollbacks={sentinel.counters['sdc_rollbacks']} "
+                f"quarantined={sentinel.quarantined_devices}"
+            )
     if trainer.preempted:
         print(
             f"preempted cleanly at step {trainer.step} — resume with "

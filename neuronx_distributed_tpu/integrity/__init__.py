@@ -1,0 +1,33 @@
+"""Silent-data-corruption sentinel (ISSUE 20).
+
+Every fault layer below this one is *loud* — a dispatch raises, a probe
+times out, a replica halts. This package defends against the quiet
+failure mode: a chip that keeps executing and returns wrong bits. Four
+pieces, one per trust boundary:
+
+* :mod:`~neuronx_distributed_tpu.integrity.sentinel` — the trainer-side
+  sentinel: periodic on-device fingerprints of params/opt-state read
+  through the anomaly guard's deferred readback (zero added host syncs),
+  cross-replica voting under dp, a re-execution canary for solo runs,
+  and known-good snapshot management for fence-and-continue rollback.
+* :mod:`~neuronx_distributed_tpu.integrity.voting` — the pure host vote:
+  majority wins, divergent devices are convicted, ties are detected but
+  unlocalized.
+* :mod:`~neuronx_distributed_tpu.integrity.checkpoint` — verified
+  checkpoints: per-file CRC manifests written with every save, verified
+  before any restore donates buffers.
+* :mod:`~neuronx_distributed_tpu.integrity.chaos` — deterministic
+  bit-flip hands used by both FaultInjectors (`flip_bits` schedules).
+
+The fingerprint math itself lives in ``utils/fingerprint.py`` — one
+owner shared with the host page tier and the prefix cache.
+"""
+
+from neuronx_distributed_tpu.integrity.sentinel import (  # noqa: F401
+    SentinelConfig,
+    TrainerSentinel,
+)
+from neuronx_distributed_tpu.integrity.voting import (  # noqa: F401
+    VoteVerdict,
+    vote,
+)

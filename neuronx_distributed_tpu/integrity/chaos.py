@@ -1,0 +1,104 @@
+"""Deterministic bit-flip hands for the ``flip_bits`` chaos schedules.
+
+Both FaultInjectors (trainer and serving) delegate here so the two sides
+flip bits the exact same way. Everything in this module is chaos-only
+and host-mediated: it pulls device buffers to host, flips ONE bit, and
+rebuilds the array — syncs are the point (this module is deliberately
+NOT on graftlint's hot list; the injectors consult it outside the
+measured hot paths, and chaos tests own the budget assertions).
+
+The flip is always ``byte[0] ^= 0x01`` of the target buffer's raw bytes:
+the least significant mantissa bit of the first element — numerically
+almost invisible (loss math barely moves), which is exactly the silent
+corruption the sentinel's bit-level fingerprints must catch where a
+loss/grad-norm guard never would.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "flip_array_bit",
+    "flip_leaf_bit",
+    "flip_replicated_leaf_on_device",
+    "flip_tree_bit",
+]
+
+
+def flip_array_bit(host_array: np.ndarray, byte_index: int = 0,
+                   bit: int = 0) -> np.ndarray:
+    """Return a copy of ``host_array`` with one bit flipped in its raw
+    bytes (dtype/shape preserved)."""
+    a = np.ascontiguousarray(host_array)
+    raw = bytearray(a.tobytes())
+    raw[byte_index % max(len(raw), 1)] ^= (1 << bit)
+    # reshape to the ORIGINAL shape — ascontiguousarray promotes 0-d
+    # scalars (e.g. Adam's count leaf) to 1-d, which would break shard
+    # reassembly for scalar leaves
+    return np.frombuffer(bytes(raw), dtype=a.dtype).reshape(
+        np.shape(host_array)
+    )
+
+
+def flip_leaf_bit(leaf, byte_index: int = 0):
+    """Flip one bit of EVERY physical copy of a device array (the
+    uniform-corruption model — solo-canary territory): host round-trip,
+    re-placed with the original sharding."""
+    flipped = flip_array_bit(np.asarray(jax.device_get(leaf)), byte_index)
+    # jnp.copy forces XLA-owned device buffers: device_put of host numpy
+    # memory can be ZERO-COPY on CPU backends, and the flipped array is
+    # about to enter a donating dispatch — donation writing into host-
+    # owned (refcounted, possibly freed) memory segfaults intermittently
+    return jnp.copy(jax.device_put(flipped, leaf.sharding))
+
+
+def flip_replicated_leaf_on_device(leaf, device_index: int = 0,
+                                   byte_index: int = 0):
+    """Flip one bit of ONE device's copy of a replicated (or partially
+    replicated) array, leaving every other copy untouched — the broken-
+    replication SDC model the dp vote must localize. Rebuilds the array
+    from its per-device buffers, so XLA's replication *assumption* now
+    disagrees with physical reality, exactly like real corruption."""
+    shards = leaf.addressable_shards
+    target = shards[device_index % len(shards)].device
+    bufs = []
+    for s in shards:
+        # a DISTINCT host copy per device (np.array, not np.asarray): the
+        # CPU backend zero-copies both device_get and device_put, so view
+        # semantics here would alias one memory block across "separate"
+        # per-device buffers — the next donated dispatch then overwrites
+        # shared memory concurrently and corrupts devices the schedule
+        # never targeted (observed as flaky multi-device convictions)
+        data = np.array(jax.device_get(s.data))
+        if s.device == target:
+            data = flip_array_bit(data, byte_index)
+        # jnp.copy: same XLA-owned-buffer guarantee as flip_leaf_bit —
+        # a zero-copy device_put here would hand the donation path a
+        # buffer backed by this loop's transient host memory
+        bufs.append(jnp.copy(jax.device_put(data, s.device)))
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, bufs
+    )
+
+
+def flip_tree_bit(tree, leaf_index: int = 0,
+                  device_index: Optional[int] = None):
+    """Flip one bit in the ``leaf_index``-th leaf (deterministic pytree
+    flatten order) of ``tree``. ``device_index=None`` corrupts every
+    copy; an integer corrupts that one device's copy only."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    i = leaf_index % len(leaves)
+    leaf = leaves[i]
+    leaves[i] = (
+        flip_leaf_bit(leaf)
+        if device_index is None
+        else flip_replicated_leaf_on_device(leaf, device_index)
+    )
+    return jax.tree_util.tree_unflatten(treedef, leaves)

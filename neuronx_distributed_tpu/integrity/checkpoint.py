@@ -1,0 +1,89 @@
+"""Verified checkpoints — per-file CRC manifests (ISSUE 20).
+
+Every save writes ``integrity.json`` into its tag directory AFTER the
+tensor payload lands and BEFORE the ``done`` marker commits, so a tag
+carrying a done marker always carries a complete manifest of what was
+on disk at commit time. Restore verifies every manifested file before
+orbax touches (and the trainer donates) a single byte; a mismatch means
+the bytes rotted AFTER a successful commit — silent storage corruption,
+the case the done-marker protocol cannot see — and the restore falls
+back to the previous good tag instead of training on garbage.
+
+Digests are CRC-32 (``utils.fingerprint.bytes_fingerprint``) — the same
+corruption-not-cryptography contract as every other fingerprint in the
+repo. Checkpoints written before this PR have no manifest and verify as
+``legacy`` (trusted, logged) so old runs keep resuming.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Tuple
+
+from neuronx_distributed_tpu.utils.fingerprint import bytes_fingerprint
+from neuronx_distributed_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+INTEGRITY_MANIFEST = "integrity.json"
+
+__all__ = [
+    "INTEGRITY_MANIFEST",
+    "compute_digests",
+    "write_manifest",
+    "verify_manifest",
+]
+
+
+def compute_digests(storage, tag: str) -> Dict[str, int]:
+    """CRC-32 of every file currently under ``tag`` (relative paths),
+    excluding the manifest itself and the commit-protocol markers (the
+    ``done`` marker is written after the manifest by design; ``newest``
+    lives outside tags)."""
+    digests = {}
+    for rel in storage.list_files(tag):
+        if rel == INTEGRITY_MANIFEST:
+            continue
+        digests[rel] = bytes_fingerprint(
+            storage.load_bytes(os.path.join(tag, rel))
+        )
+    return digests
+
+
+def write_manifest(storage, tag: str) -> None:
+    """Digest the tag's current on-disk payload and persist the manifest.
+    Runs inside the save path (sync: between the tensor flush and
+    ``_commit``; async: inside the commit worker after
+    ``wait_until_finished``) — the manifest always describes exactly the
+    bytes the done marker is about to bless."""
+    manifest = {"version": 1, "files": compute_digests(storage, tag)}
+    storage.save_text(
+        json.dumps(manifest), os.path.join(tag, INTEGRITY_MANIFEST)
+    )
+
+
+def verify_manifest(storage, tag: str) -> Tuple[bool, str]:
+    """Re-digest the tag against its manifest. Returns ``(ok, detail)``:
+    ``(True, "legacy")`` when no manifest exists (pre-PR checkpoint),
+    ``(True, "verified <n> files")`` on a clean match, ``(False, ...)``
+    naming the first missing/mismatched file otherwise."""
+    path = os.path.join(tag, INTEGRITY_MANIFEST)
+    if not storage.file_exists(path):
+        return True, "legacy"
+    try:
+        manifest = json.loads(storage.load_text(path))
+        files = manifest["files"]
+    except Exception as e:  # unreadable manifest IS corruption
+        return False, f"unreadable manifest: {type(e).__name__}: {e}"
+    for rel, want in sorted(files.items()):
+        full = os.path.join(tag, rel)
+        if not storage.file_exists(full):
+            return False, f"missing file {rel!r}"
+        have = bytes_fingerprint(storage.load_bytes(full))
+        if have != int(want):
+            return False, (
+                f"digest mismatch on {rel!r}: "
+                f"manifest {int(want):#010x}, on disk {have:#010x}"
+            )
+    return True, f"verified {len(files)} files"

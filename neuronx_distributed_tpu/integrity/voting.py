@@ -1,0 +1,71 @@
+"""Cross-replica fingerprint voting — pure host arithmetic.
+
+Under dp, replicated params/opt-state must fingerprint identically on
+every device: GSPMD never re-syncs a replicated value across replicas,
+so each device's copy of the "replicated" fingerprint scalar is computed
+from that device's own copy of the data. A divergent copy convicts its
+device — majority wins, no golden recompute needed.
+
+This module sees only HOST integers (the trainer loop performs the one
+deferred ``device_get``; the serving router's probe returns ints over the
+transport). It never touches a device value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Dict, Sequence, Tuple
+
+__all__ = ["VoteVerdict", "vote", "vote_sequence"]
+
+
+@dataclasses.dataclass(frozen=True)
+class VoteVerdict:
+    """Outcome of one fingerprint vote.
+
+    ``clean`` — every voter agreed. ``convicted`` — voter keys holding a
+    strict-minority value (empty when clean OR when no strict majority
+    exists). ``localized`` — False for the tie case: corruption is
+    *detected* (values disagree) but no voter can be blamed, so the
+    caller must fall back to the coarse remedy (roll back everything /
+    refuse the probe round) rather than fencing an innocent."""
+
+    clean: bool
+    convicted: Tuple = ()
+    localized: bool = True
+    quorum_value: int = 0
+    values: Dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def detected(self) -> bool:
+        return not self.clean
+
+
+def vote(values: Dict) -> VoteVerdict:
+    """Majority vote over ``{voter_key: fingerprint_int}``.
+
+    One distinct value → clean. A strict-majority value → every voter
+    holding anything else is convicted. No strict majority (1-1, 2-2,
+    three-way splits) → detected but unlocalized."""
+    if not values:
+        return VoteVerdict(clean=True)
+    counts = Counter(values.values())
+    if len(counts) == 1:
+        (only,) = counts
+        return VoteVerdict(clean=True, quorum_value=only, values=dict(values))
+    majority, n_major = counts.most_common(1)[0]
+    if n_major * 2 > len(values):
+        convicted = tuple(k for k, v in values.items() if v != majority)
+        return VoteVerdict(
+            clean=False, convicted=convicted, localized=True,
+            quorum_value=majority, values=dict(values),
+        )
+    return VoteVerdict(
+        clean=False, convicted=(), localized=False, values=dict(values)
+    )
+
+
+def vote_sequence(pairs: Sequence[Tuple]) -> VoteVerdict:
+    """Convenience for callers holding ``[(voter_key, value)]`` pairs."""
+    return vote(dict(pairs))

@@ -707,29 +707,15 @@ def _fused_paged_decode(frame, q, k_cache, v_cache, q_pos, kv_valid):
 
 
 def cache_fingerprint(cache):
-    """Cheap integrity fingerprint of a cache(-prefix) tree: a float32
-    reduction over every leaf, position-weighted along the column axis so a
-    corrupted element OR a shifted block changes the value. Recomputed on
-    the same data by the same program it is bit-deterministic, so the
-    serving engine's prefix-reuse validation compares it with exact float
-    equality — this is corruption detection (bit flips, injected poison),
-    not cryptographic integrity."""
-    total = jnp.zeros((), jnp.float32)
-    flat, _ = jax.tree_util.tree_flatten_with_path(cache)
-    for path, leaf in flat:
-        name = cache_leaf_name(path)
-        ax = cache_batch_axis(name, leaf.ndim)
-        x = jnp.abs(leaf.astype(jnp.float32)) if jnp.issubdtype(
-            leaf.dtype, jnp.floating
-        ) else leaf.astype(jnp.float32)
-        if ax is not None:
-            col = ax + 1
-            shape = [1] * leaf.ndim
-            shape[col] = leaf.shape[col]
-            w = (1.0 + jnp.arange(leaf.shape[col], dtype=jnp.float32)).reshape(shape)
-            x = x * w
-        total = total + jnp.sum(x)
-    return total
+    """Cheap integrity fingerprint of a cache(-prefix) tree — now owned by
+    ``utils/fingerprint.py`` (one home for every integrity hash; see the
+    SDC sentinel); this name stays as the historical import site for the
+    serving engine's prefix validation."""
+    from neuronx_distributed_tpu.utils.fingerprint import (
+        cache_fingerprint as _impl,
+    )
+
+    return _impl(cache)
 
 
 # cache length at which decode switches from the fused einsum to the Pallas

@@ -142,6 +142,18 @@ HOT_SUFFIXES = (
     # the engine — an implicit coercion in either would add a per-dispatch
     # host sync to every program the prewarm touched
     "inference/aot.py",
+    # integrity sentinel (ISSUE 20): the fingerprint reductions trace
+    # inside jitted programs the trainer/engine dispatch on the hot path,
+    # and the sentinel's pre/post-dispatch hooks plus the voting
+    # arithmetic run inside the training loop every check step — all must
+    # stay sync-free (the ONE fingerprint readback rides the anomaly
+    # guard's existing deferred device_get in trainer/loop.py; the
+    # serving probe's readback is the router-cadence pragma in engine.py).
+    # integrity/chaos.py is deliberately NOT here: its host round-trips
+    # ARE the injected fault, consulted only by chaos schedules
+    "utils/fingerprint.py",
+    "integrity/sentinel.py",
+    "integrity/voting.py",
 )
 HOT_MARKER = "graftlint: hot-path"
 

@@ -303,6 +303,13 @@ class PrefixEntry:
     # (``tree`` is None) — just the ref-counted pool page ids its tokens
     # live in, mapped copy-on-write into a hitting slot's block table
     page_ids: Optional[Tuple[int, ...]] = None
+    # integrity sentinel (ISSUE 20): per-page content fingerprints of
+    # ``page_ids`` recorded at insert (device uint32 vector, bucketed —
+    # positions past ``len(page_ids)`` are padding). Reuse recomputes the
+    # used prefix and compares bit-exactly, closing the content gap that
+    # host-side ``pages_live`` accounting cannot see (an HBM bit flip
+    # leaves allocation/pins/quarantine perfectly healthy)
+    page_fp: Any = None
     # tiered KV (ISSUE 19): a SPILLED entry's pages live in the engine's
     # HostPageStore under these ids instead (``page_ids`` is None while
     # host-resident). The entry STAYS in the trie so lookups keep matching

@@ -696,6 +696,7 @@ class ServingEngine:
             # must release those refs or the pool leaks
             prefix_cache.on_evict = self._on_prefix_evict
         self._prefix_reuses = 0  # reuse-attempt index (poison-hook schedule)
+        self._steps_seen = 0  # step() index (flip_bits("params") schedule)
         self._prefill_model, self._decode_model = serving_clones(model)
         # scheduling policy (ISSUE 16): "fifo" (default — bit-identical to
         # the pre-policy engine), "slo" (priority tiers + DWRR token
@@ -914,6 +915,13 @@ class ServingEngine:
         self._fingerprint_fn = self.programs.wrap(
             "prefix_fingerprint", jax.jit(lambda tree: cache_fingerprint(tree))
         )
+        # integrity sentinel (ISSUE 20): bit-level fingerprint programs,
+        # built LAZILY — an engine that is never probed (and a pool whose
+        # paged entries never reach fingerprint validation) compiles
+        # nothing extra, so existing workloads' compile ledgers and
+        # host-sync budgets are untouched
+        self._integrity_fp_fn = None
+        self._pages_fp_fn = None
         # HBM ledger (ISSUE 12): the engine's static residents registered
         # as weakref closures over live trees — bytes are leaf.nbytes
         # metadata (readable even mid-donation), reconciled against
@@ -1836,6 +1844,32 @@ class ServingEngine:
 
     # --- health / drain -----------------------------------------------------
 
+    def integrity_fingerprint(self) -> int:
+        """Bit-level uint32 fingerprint of this replica's PARAMS — the
+        router's cross-replica integrity evidence (ISSUE 20). Params only,
+        deliberately: replicas serving the same model must hold
+        bit-identical weights, while KV/slot state legitimately diverges
+        with each replica's traffic (KV integrity is covered separately,
+        by per-page reuse validation and the page-quarantine path). The
+        jitted reduction compiles on FIRST probe (lazy — un-probed engines
+        compile nothing); the readback is one uint32 scalar per probe
+        period, never per chunk."""
+        if self._integrity_fp_fn is None:
+            from neuronx_distributed_tpu.utils.fingerprint import (
+                tree_fingerprint,
+            )
+
+            # per-engine lambda (see the _extract_fn note: jitting the
+            # module-level helper directly would share _cache_size across
+            # engines in this jax)
+            self._integrity_fp_fn = self.programs.wrap(
+                "integrity_fingerprint",
+                jax.jit(lambda tree: tree_fingerprint(tree)),
+            )
+        # graftlint: ok[GL02] periodic watchdog probe readback — one uint32
+        # scalar per probe period (router cadence), not a per-chunk sync
+        return int(jax.device_get(self._integrity_fp_fn(self._params)))
+
     def health(self) -> EngineHealth:
         """Current health state (``OK/DEGRADED/DRAINING/HALTED``)."""
         if self._halted:
@@ -2218,6 +2252,15 @@ class ServingEngine:
         whether work remains."""
         if self._halted:
             return self.has_work
+        if self._faults is not None:
+            # chaos (ISSUE 20): a scheduled silent bit flip lands on the
+            # bound weights here — every program after this step serves
+            # from the corrupted tree, exactly like real HBM rot, until
+            # the router's fingerprint vote fences this replica
+            self._params = self._faults.on_engine_params(
+                self._steps_seen, self._params
+            )
+        self._steps_seen += 1
         now = self._now()
         self._reap_cancelled(now)
         self._shed_expired(now)
@@ -2887,15 +2930,27 @@ class ServingEngine:
         reuse = self._prefix_reuses
         self._prefix_reuses += 1
         if self._faults is not None:
-            self._faults.on_prefix_reuse(reuse, entry)
-        if self._page_size is not None:
-            # paged validation is host accounting: the entry's pages must
-            # still be allocated, pinned, and un-quarantined (the content
-            # never left the pool — poisoned pages route through the
-            # page-quarantine path, which evicts pinning entries)
-            valid = self.cache.pages_live(
-                entry.page_ids[:m_use // self._page_size]
+            self._faults.on_prefix_reuse(
+                reuse, entry,
+                cache=self.cache if self._page_size is not None else None,
             )
+        if self._page_size is not None:
+            # paged validation: host accounting first (the entry's pages
+            # must still be allocated, pinned, and un-quarantined), then
+            # CONTENT (ISSUE 20) — the used page prefix's fingerprints
+            # recomputed on device against the insert-time record. An HBM
+            # bit flip leaves the accounting perfectly healthy; only the
+            # bit-level check catches it before the pages map into a slot
+            used = entry.page_ids[:m_use // self._page_size]
+            valid = self.cache.pages_live(used)
+            if valid and entry.page_fp is not None and entry.hit_tier != "host":
+                # a host-tier hit's bytes were CRC-verified by the store
+                # at prefetch moments ago — re-validating on device would
+                # re-check just-verified content and charge the prefetch
+                # admission an extra sync (its budget is pinned at the
+                # bare 2). The device-resident case is the one with an
+                # open HBM-rot window, and it pays the one readback
+                valid = self._validate_pages(entry, len(used))
         else:
             valid = self._validate_prefix(entry)
         if not valid:
@@ -2950,6 +3005,52 @@ class ServingEngine:
                 (self._fingerprint_fn(entry.tree), entry.fingerprint)
             )
             return float(fp_new) == float(fp_stored)
+        except Exception:
+            return False
+
+    def _pages_fingerprint(self, ids):
+        """Per-page content fingerprints of pool pages ``ids`` as a DEVICE
+        uint32 vector — nothing syncs here (insert-time recording stays
+        async, like the dense path's entry fingerprint). The id vector
+        pads to the next power of two with repeats of the first id so the
+        jitted program compiles once per bucket, not once per page count;
+        padded positions are ignored by the caller's comparison."""
+        if self._pages_fp_fn is None:
+            from neuronx_distributed_tpu.utils.fingerprint import (
+                pool_pages_fingerprint,
+            )
+
+            # per-engine lambda (see the _extract_fn note above)
+            self._pages_fp_fn = self.programs.wrap(
+                "page_fingerprint",
+                jax.jit(lambda pool, pids: pool_pages_fingerprint(pool, pids)),
+            )
+        n = len(ids)
+        bucket = 1
+        while bucket < n:
+            bucket <<= 1
+        padded = np.asarray(
+            tuple(int(i) for i in ids) + (int(ids[0]),) * (bucket - n),
+            np.int32,
+        )
+        return self._pages_fp_fn(self.cache.cache["pool"], jnp.asarray(padded))
+
+    def _validate_pages(self, entry, n_pages: int) -> bool:
+        """Reuse-time CONTENT check of a paged entry's used page prefix:
+        recompute the per-page fingerprints and compare bit-exactly with
+        the insert-time record (per-page fingerprints are independent, so
+        a prefix of the stored vector validates a prefix reuse). Cost is
+        one small-vector readback per paged prefix hit — the same sync
+        contract as the dense path's ``_validate_prefix``."""
+        try:
+            fp_now = self._pages_fingerprint(entry.page_ids[:n_pages])
+            # graftlint: ok[GL02] reuse-time integrity check: one bucketed
+            # uint32 vector readback per paged prefix hit, the documented
+            # validation sync (admission syncs for the first token anyway)
+            now_v, stored_v = jax.device_get((fp_now, entry.page_fp))
+            return bool(np.array_equal(
+                np.asarray(now_v)[:n_pages], np.asarray(stored_v)[:n_pages]
+            ))
         except Exception:
             return False
 
@@ -3021,6 +3122,12 @@ class ServingEngine:
             self.cache.unpin_pages(ids)
         else:
             entry.page_ids = tuple(int(i) for i in ids)
+            # integrity (ISSUE 20): record the pages' content fingerprints
+            # now, while the context region is final (decode writes land
+            # beyond the aligned context by construction). Stays a device
+            # vector — no sync on the miss-admission path; first reuse
+            # floats it alongside the recomputation
+            entry.page_fp = self._pages_fingerprint(entry.page_ids)
         if evicted:
             self.metrics.record_prefix_eviction(evicted)
             if self.timeline is not None:

@@ -61,6 +61,16 @@ Injection points (all indices are 0-based and deterministic):
   modeling host-RAM bit rot. The store's fingerprint verification must
   reject the whole fetch and the engine must fall back to a full prefill
   — corrupted host bytes never reach the pool.
+* ``flip_bits(target=..., at=k, times=t)`` — SILENT data corruption
+  (ISSUE 20): flips ONE low-order bit, numerically near-invisible, so no
+  loud guard (readback garbage, NaN logits) ever fires and only bit-level
+  integrity fingerprints can catch it. ``target="params"`` corrupts the
+  engine's bound weights at the start of the k-th..(k+t-1)-th ``step()``
+  calls — the replica keeps serving plausibly-wrong tokens until the
+  router's cross-replica fingerprint vote fences it. ``target="kv_pool"``
+  corrupts the first pool page of the entry the k-th prefix *reuse
+  attempt* maps (before validation) — the engine's per-page fingerprint
+  check must reject the reuse and fall back to a full prefill.
 * ``drop_send / drop_ack / dup_send / delay_send / partition`` — transport
   fault schedules consulted by ``serving/transport.ChaosTransport`` per
   delivery-attempt index (transport-wide monotone, so deterministic for a
@@ -132,6 +142,10 @@ class FaultInjector:
         self._spill_windows: List[Tuple[int, Optional[int]]] = []
         self._prefetch_windows: List[Tuple[int, Optional[int]]] = []
         self._host_page_windows: List[Tuple[int, Optional[int]]] = []
+        # silent bit flips (ISSUE 20): params keyed by engine step index,
+        # kv_pool keyed by prefix reuse-attempt index
+        self._params_flip_windows: List[Tuple[int, Optional[int]]] = []
+        self._pool_flip_windows: List[Tuple[int, Optional[int]]] = []
         # transport fault schedules, all keyed by delivery-attempt index
         self._send_drops: List[Tuple[int, Optional[int]]] = []
         self._ack_drops: List[Tuple[int, Optional[int]]] = []
@@ -152,6 +166,7 @@ class FaultInjector:
             "spill_failures": 0,
             "prefetch_failures": 0,
             "poisoned_host_pages": 0,
+            "bit_flips": 0,
             "dropped_sends": 0,
             "dropped_acks": 0,
             "dup_sends": 0,
@@ -271,6 +286,25 @@ class FaultInjector:
         never reach the pool."""
         end = None if times is None else at + times
         self._host_page_windows.append((at, end))
+        return self
+
+    def flip_bits(self, target: str, at: int = 0,
+                  times: Optional[int] = 1) -> "FaultInjector":
+        """Schedule single-bit SILENT corruption (ISSUE 20). ``target`` is
+        ``params`` (flip one low-order bit of the engine's bound weights
+        at the ``at``-th..(at+times-1)-th ``step()`` calls — the
+        router-probe/fence path's model) or ``kv_pool`` (flip one bit of
+        the first pool page the ``at``-th prefix reuse attempts map,
+        BEFORE validation — the per-page fingerprint check's model)."""
+        end = None if times is None else at + times
+        if target == "params":
+            self._params_flip_windows.append((at, end))
+        elif target == "kv_pool":
+            self._pool_flip_windows.append((at, end))
+        else:
+            raise ValueError(
+                f"flip_bits target must be params|kv_pool, got {target!r}"
+            )
         return self
 
     def on_spill(self, attempt: int) -> None:
@@ -499,19 +533,44 @@ class FaultInjector:
                 "(RESOURCE_EXHAUSTED: out of memory)"
             )
 
-    def on_prefix_reuse(self, reuse: int, entry) -> None:
-        """Called with the 0-based prefix REUSE-attempt index and the
-        matched ``PrefixEntry`` the engine is about to copy from, BEFORE
-        validation. When the schedule says this reuse is poisoned, the
-        entry's stored KV block is corrupted IN PLACE (every float leaf
-        perturbed, shapes untouched) — so the test proves the engine's
-        checksum validation catches silent data corruption, not a shape
-        mismatch."""
+    def on_engine_params(self, step: int, params):
+        """Called with the 0-based engine ``step()`` index and the bound
+        (sharded) params pytree. When a ``flip_bits("params")`` window
+        hits, returns the tree with ONE low-order bit of its first leaf
+        flipped on every device copy — numerically near-invisible SDC
+        only a bit-level fingerprint probe can see; otherwise returns the
+        tree untouched."""
+        if not self._hit(self._params_flip_windows, step):
+            return params
+        from neuronx_distributed_tpu.integrity.chaos import flip_tree_bit
+
+        self.counters["bit_flips"] += 1
+        return flip_tree_bit(params)
+
+    def on_prefix_reuse(self, reuse: int, entry, cache=None) -> None:
+        """Called with the 0-based prefix REUSE-attempt index, the matched
+        ``PrefixEntry`` the engine is about to reuse, and (paged engines)
+        the cache manager — BEFORE validation. A scheduled
+        ``poison_prefix`` corrupts a dense entry's stored KV block IN
+        PLACE (every float leaf perturbed, shapes untouched) — so the
+        test proves the engine's checksum validation catches silent data
+        corruption, not a shape mismatch. A scheduled
+        ``flip_bits("kv_pool")`` instead flips ONE bit inside the first
+        pool page a PAGED entry maps — the per-page fingerprint check
+        must reject the reuse."""
+        if (
+            cache is not None
+            and getattr(entry, "page_ids", None)
+            and self._hit(self._pool_flip_windows, reuse)
+        ):
+            self._flip_pool_page(cache, int(entry.page_ids[0]))
+            self.counters["bit_flips"] += 1
         if not self._hit(self._prefix_windows, reuse):
             return
         if getattr(entry, "tree", None) is None:
             # paged CoW entry: no host-managed KV copy to corrupt — page
-            # corruption is poison_page's territory
+            # corruption is poison_page's / flip_bits("kv_pool")'s
+            # territory
             return
         import jax
         import jax.numpy as jnp
@@ -523,6 +582,43 @@ class FaultInjector:
 
         entry.tree = jax.tree_util.tree_map(corrupt, entry.tree)
         self.counters["poisoned_prefixes"] += 1
+
+    @staticmethod
+    def _flip_pool_page(cache, pid: int) -> None:
+        """Flip one bit of pool page ``pid``'s content in the first
+        page-carrying pool leaf (host round-trip, re-placed with the
+        original sharding — HBM bit rot, modeled from the host)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from neuronx_distributed_tpu.integrity.chaos import flip_array_bit
+        from neuronx_distributed_tpu.modules.attention import (
+            cache_leaf_name,
+            pool_scale_base,
+        )
+
+        pool = cache.cache["pool"]
+        flat, treedef = jax.tree_util.tree_flatten_with_path(pool)
+        leaves = [leaf for _, leaf in flat]
+        for i, (path, leaf) in enumerate(flat):
+            name = cache_leaf_name(path)
+            if (pool_scale_base(name) or name) not in ("k", "v"):
+                continue
+            pax = leaf.ndim - 4
+            host = np.array(jax.device_get(leaf))
+            idx = (slice(None),) * pax + (pid,)
+            host[idx] = flip_array_bit(host[idx])
+            # jnp.copy forces an XLA-owned buffer: device_put of host
+            # numpy can be zero-copy on CPU backends, and the pool is
+            # about to be donated by the decode dispatch (see
+            # integrity/chaos.flip_leaf_bit for the full story)
+            leaves[i] = jnp.copy(jax.device_put(host, leaf.sharding))
+            break
+        cache.cache = dict(
+            cache.cache,
+            pool=jax.tree_util.tree_unflatten(treedef, leaves),
+        )
 
     def now(self, real_now: float) -> float:
         """Clock hook: the engine's view of time, skewed per schedule."""

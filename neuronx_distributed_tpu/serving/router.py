@@ -51,6 +51,15 @@ in-process replica set never needed:
   guarantees it stops making progress) and its work re-homes through the
   existing halt/adopt contract with remaining deadline budgets and
   tenant/priority attribution intact.
+* **Integrity vote** (ISSUE 20) — with
+  ``WatchdogConfig(integrity_interval_s=...)``, the watchdog pass also
+  fingerprints every routable replica's PARAMS (one bit-level uint32 per
+  replica per period, through the transport) and votes: a strict-minority
+  fingerprint is silent data corruption — the replica answers health
+  probes OK while streaming plausibly-wrong tokens — and is fenced and
+  re-homed exactly like a probe-death (``tokens_lost == 0``). Two
+  replicas disagreeing is detected but unlocalized: recorded, never
+  fenced.
 * **Live join/drain** — :meth:`add_replica` warm-spawns a replica (AOT
   ``prewarm`` when a cache dir is given) and rebalances queued backlog
   onto it without pausing survivors; :meth:`remove_replica` drains one
@@ -118,6 +127,13 @@ class WatchdogConfig:
     degraded_after: int = 2
     dead_after: int = 3
     recover_after: int = 2
+    # integrity sentinel (ISSUE 20): every ``integrity_interval_s`` the
+    # router fingerprints every routable replica's PARAMS through the
+    # transport and votes — a strict-minority replica holds silently
+    # corrupted weights (liveness probes see nothing wrong with it) and
+    # is fenced + re-homed like a probe-death. None disables. Two-replica
+    # disagreement detects but cannot localize: recorded, never fenced
+    integrity_interval_s: Optional[float] = None
 
     def __post_init__(self):
         if not (1 <= self.suspect_after <= self.degraded_after
@@ -195,7 +211,12 @@ class ReplicaRouter:
             "replicas_removed": 0,
             "replicas_restarted": 0,
             "rebalanced_requests": 0,
+            # integrity sentinel (ISSUE 20)
+            "integrity_probes": 0,
+            "integrity_fences": 0,
+            "integrity_disagreements": 0,
         }
+        self._next_integrity = 0.0
         self.routed_by_replica = [0] * len(replicas)
         # fabric observability (registry=): probe-state gauge children,
         # re-home / restart latency histograms, transport event gauges
@@ -681,6 +702,74 @@ class ReplicaRouter:
         )
         self._rehome(idx)
 
+    def _run_integrity(self, now: float) -> None:
+        """Periodic cross-replica PARAMS fingerprint vote (ISSUE 20) —
+        the health-evidence source for corruption liveness probes cannot
+        see: a replica with one flipped weight bit answers every health
+        probe OK and keeps streaming plausibly-wrong tokens. Rides the
+        watchdog's virtual clock and the transport (one uint32 readback
+        per replica per period — never per chunk). A strict-minority
+        fingerprint convicts its replica: fenced (STONITH) and re-homed
+        through the same halt/adopt contract as a probe-death, so
+        ``tokens_lost == 0``. No strict majority (two replicas
+        disagreeing): detected, recorded, nobody fenced — fencing an
+        innocent replica would be worse than routing around neither."""
+        cfg = self.watchdog
+        if cfg.integrity_interval_s is None or now < self._next_integrity:
+            return
+        self._next_integrity = now + cfg.integrity_interval_s
+        values: Dict[int, int] = {}
+        for i in self._live():
+            if self._probe[i].state == "dead":
+                continue
+            self.stats["integrity_probes"] += 1
+            try:
+                values[i] = int(self.transport.probe(
+                    i,
+                    lambda e=self.replicas[i]: e.integrity_fingerprint(),
+                    deadline_s=cfg.probe_timeout_s,
+                ))
+            except TransportError:
+                # unreachable is the LIVENESS ladder's evidence, not
+                # corruption evidence — the next _run_watchdog pass
+                # handles it; the vote proceeds over who answered
+                continue
+        from neuronx_distributed_tpu.integrity.voting import vote
+
+        verdict = vote(values)
+        if verdict.clean:
+            return
+        if not verdict.localized:
+            self.stats["integrity_disagreements"] += 1
+            for i, v in verdict.values.items():
+                flight = getattr(self.replicas[i], "flight", None)
+                if flight is not None:
+                    flight.record(
+                        "integrity_disagreement", replica=i,
+                        fingerprint=v, voters=len(verdict.values),
+                    )
+            return
+        for i in verdict.convicted:
+            self._declare_corrupt(
+                i,
+                f"params fingerprint {values[i]:#010x} vs quorum "
+                f"{verdict.quorum_value:#010x} "
+                f"({len(values) - 1} of {len(values)} replicas agree)",
+            )
+
+    def _declare_corrupt(self, idx: int, why: str) -> None:
+        """Integrity conviction: straight to DEAD (no SUSPECT ladder —
+        corrupted weights don't flap, and every chunk served meanwhile is
+        wrong), fence, and re-home through the standard halt/adopt path."""
+        self.stats["integrity_fences"] += 1
+        ps = self._probe[idx]
+        flight = getattr(self.replicas[idx], "flight", None)
+        if flight is not None:
+            flight.record("integrity_conviction", replica=idx, why=why)
+        self._probe_transition(idx, ps, "dead", why)
+        self.replicas[idx].fence(f"integrity: {why}")
+        self._rehome(idx)
+
     # --- stepping / fault handling ------------------------------------------
 
     def _rehome(self, dead_idx: int) -> int:
@@ -737,7 +826,9 @@ class ReplicaRouter:
         every live replica that has work, and retire replicas that
         finished draining out. Returns whether work remains anywhere."""
         if self.watchdog is not None:
-            self._run_watchdog(self._now())
+            now = self._now()
+            self._run_watchdog(now)
+            self._run_integrity(now)
         for i in self._live():
             if self.replicas[i].health() is EngineHealth.HALTED:
                 self._rehome(i)

@@ -79,6 +79,19 @@ class BaseCheckpointStorage:
     def load_text(self, filename: str) -> str:
         raise NotImplementedError
 
+    # --- byte-level access (integrity manifests, ISSUE 20) --------------------
+
+    def list_files(self, prefix: str) -> List[str]:
+        """Relative paths (to ``prefix``) of every FILE under it,
+        recursive, sorted — the digest surface of a checkpoint tag."""
+        raise NotImplementedError
+
+    def save_bytes(self, data: bytes, filename: str) -> None:
+        raise NotImplementedError
+
+    def load_bytes(self, filename: str) -> bytes:
+        raise NotImplementedError
+
     def list_checkpoint_tags(self) -> List[str]:
         raise NotImplementedError
 
@@ -124,6 +137,30 @@ class FilesystemCheckpointStorage(BaseCheckpointStorage):
 
     def load_text(self, filename: str) -> str:
         with open(os.path.join(self._dirname, filename)) as f:
+            return f.read()
+
+    def list_files(self, prefix: str) -> List[str]:
+        root = os.path.join(self._dirname, prefix)
+        if not os.path.isdir(root):
+            return []
+        out = []
+        for dirpath, _, names in os.walk(root):
+            for name in names:
+                out.append(
+                    os.path.relpath(os.path.join(dirpath, name), root)
+                )
+        return sorted(out)
+
+    def save_bytes(self, data: bytes, filename: str) -> None:
+        path = os.path.join(self._dirname, filename)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def load_bytes(self, filename: str) -> bytes:
+        with open(os.path.join(self._dirname, filename), "rb") as f:
             return f.read()
 
     def list_checkpoint_tags(self) -> List[str]:
@@ -264,6 +301,34 @@ class FsspecCheckpointStorage(BaseCheckpointStorage):
 
         return _with_retries(get, f"load_text({filename})")
 
+    def list_files(self, prefix: str) -> List[str]:
+        def ls():
+            root = self._path(prefix)
+            if not self._fs.exists(root):
+                return []
+            out = []
+            for p in self._fs.find(root):
+                rel = p[len(root):].lstrip("/")
+                if rel:
+                    out.append(rel)
+            return sorted(out)
+
+        return _with_retries(ls, f"list_files({prefix})")
+
+    def save_bytes(self, data: bytes, filename: str) -> None:
+        def put():
+            with self._fs.open(self._path(filename), "wb") as f:
+                f.write(data)
+
+        _with_retries(put, f"save_bytes({filename})")
+
+    def load_bytes(self, filename: str) -> bytes:
+        def get():
+            with self._fs.open(self._path(filename), "rb") as f:
+                return f.read()
+
+        return _with_retries(get, f"load_bytes({filename})")
+
     def list_checkpoint_tags(self) -> List[str]:
         def ls():
             if not self._fs.exists(self._root):
@@ -371,8 +436,15 @@ class CheckpointIOState:
         import concurrent.futures
 
         def _finish() -> None:
+            from neuronx_distributed_tpu.integrity.checkpoint import (
+                write_manifest,
+            )
+
             try:
                 checkpointer.wait_until_finished()
+                # digest what tensorstore just flushed BEFORE the done
+                # marker blesses it (ISSUE 20 verified checkpoints)
+                write_manifest(storage, tag)
                 _commit(storage, tag, num_kept_ckpts, current_tag=tag)
                 logger.info("async checkpoint '%s' committed", tag)
             finally:
@@ -527,6 +599,11 @@ def save_checkpoint(
             return  # _finish unregisters after commit
         with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as checkpointer:
             checkpointer.save(target, args=args)
+        from neuronx_distributed_tpu.integrity.checkpoint import write_manifest
+
+        # digest the flushed payload BEFORE the done marker blesses it
+        # (ISSUE 20 verified checkpoints)
+        write_manifest(storage, tag)
         _commit(storage, tag, num_kept_ckpts, current_tag=tag)
     finally:
         if not async_save:
@@ -586,6 +663,8 @@ def load_checkpoint(
     checkpoint_dir: str,
     tag: Optional[str] = None,
     items_target: Optional[Mapping[str, Any]] = None,
+    verify_integrity: bool = True,
+    on_corrupt: Optional[Any] = None,
 ) -> Tuple[Dict[str, Any], Optional[Dict[str, Any]], str]:
     """Load ``(items, user_content, tag)`` from ``checkpoint_dir``.
 
@@ -595,6 +674,15 @@ def load_checkpoint(
     supplying shardings from a *different* mesh layout reshards on read
     (replacing the reference's offline zero-1/TP reshard converters for
     on-line cases). Omitted items are restored as host numpy arrays.
+
+    With ``verify_integrity`` (the default), the tag's ``integrity.json``
+    manifest is re-digested BEFORE orbax reads a byte: a mismatch (bytes
+    rotted after a successful commit — the corruption the done-marker
+    protocol cannot see) quarantines the tag by stripping its done marker,
+    calls ``on_corrupt(tag, detail)`` when given, and falls back to the
+    previous completed tag via ``latest_checkpoint_tag``'s corrupt-tag
+    cleanup. Runs out of good tags → ``FileNotFoundError``. Pre-manifest
+    checkpoints verify as legacy and load as before.
     """
     ocp = _orbax()
     storage = create_checkpoint_storage(checkpoint_dir)
@@ -604,6 +692,35 @@ def load_checkpoint(
             raise FileNotFoundError(f"no completed checkpoint under {checkpoint_dir}")
     if not storage.file_exists(os.path.join(tag, DONE_MARKER)):
         raise FileNotFoundError(f"checkpoint '{tag}' has no done marker (corrupted?)")
+    if verify_integrity:
+        from neuronx_distributed_tpu.integrity.checkpoint import verify_manifest
+
+        while True:
+            ok, detail = verify_manifest(storage, tag)
+            if ok:
+                if detail == "legacy":
+                    logger.info(
+                        "checkpoint '%s' predates integrity manifests — "
+                        "loading unverified", tag,
+                    )
+                break
+            logger.error(
+                "checkpoint '%s' FAILED integrity verification (%s) — "
+                "quarantining it and falling back to the previous "
+                "completed tag", tag, detail,
+            )
+            if on_corrupt is not None:
+                on_corrupt(tag, detail)
+            # stripping the done marker makes the tag invisible to
+            # latest_checkpoint_tag, whose corrupt-newest cleanup then
+            # removes it and repoints `newest` at the fallback
+            storage.remove_file(os.path.join(tag, DONE_MARKER))
+            tag = latest_checkpoint_tag(checkpoint_dir)
+            if tag is None:
+                raise FileNotFoundError(
+                    f"no completed checkpoint under {checkpoint_dir} "
+                    "passes integrity verification"
+                )
 
     target = storage.items_url(tag)
     item_names = (

@@ -30,6 +30,17 @@ Injection points (step/attempt indices are 0-based and deterministic):
 * ``corrupt_checkpoint(tag)`` — deletes ``tag``'s ``done`` marker right
   after its save commits, simulating a run killed mid-save; drives the
   ``load_checkpoint`` newest-pointer fallback.
+* ``flip_bits(target=..., at=k, times=t, device=d)`` — SILENT data
+  corruption (ISSUE 20): flips ONE low-order bit, so loud guards (NaN,
+  grad spikes) never fire and only the integrity sentinel's bit-level
+  fingerprints can catch it. ``target="params"``/``"opt_state"`` corrupts
+  the live TrainState right after step k's dispatch — ``device=d``
+  corrupts ONE device's copy of the first leaf (the broken-replication
+  model the dp vote localizes), ``device=None`` corrupts every copy (the
+  solo canary's uniform model). ``target="checkpoint_shard"`` flips one
+  byte of the largest committed payload file of the k-th..(k+t-1)-th
+  checkpoint saves — the post-commit storage rot the restore-time
+  manifest verification must reject.
 * ``deliver_sigterm(at=k)`` — delivers a REAL ``SIGTERM`` to this process
   at the start of step k (``os.kill``), so the graceful-preemption path is
   tested through the actual signal handler, not a simulation.
@@ -64,12 +75,20 @@ class FaultInjector:
         self._dispatch_windows: List[Tuple[int, Optional[int]]] = []
         self._corrupt_tags: Set[str] = set()
         self._sigterm_steps: Set[int] = set()
+        # flip_bits schedules: state targets keyed by step window, shard
+        # target keyed by save-event window (0-based count of commits)
+        # mutable [at, end, target, device, remaining_fires] entries —
+        # see flip_bits for why the budget slot exists
+        self._flip_state_windows: List[list] = []
+        self._flip_shard_windows: List[Tuple[int, Optional[int]]] = []
+        self._saves_seen = 0
         self.counters: Dict[str, int] = {
             "nan_losses": 0,
             "spiked_grads": 0,
             "dispatch_failures": 0,
             "corrupted_checkpoints": 0,
             "sigterms": 0,
+            "bit_flips": 0,
         }
 
     # --- schedule construction ----------------------------------------------
@@ -94,6 +113,31 @@ class FaultInjector:
         self._corrupt_tags.add(tag)
         return self
 
+    def flip_bits(self, target: str, at: int = 0,
+                  times: Optional[int] = 1,
+                  device: Optional[int] = None) -> "FaultInjector":
+        """Schedule single-bit flips. ``target`` is one of ``params``,
+        ``opt_state`` (live-state flips at the ``at``-th..(at+times-1)-th
+        steps, right after dispatch; ``device`` selects one device's copy,
+        None flips every copy) or ``checkpoint_shard`` (post-commit byte
+        flip of the ``at``-th..(at+times-1)-th checkpoint saves)."""
+        end = None if times is None else at + times
+        if target in ("params", "opt_state"):
+            # mutable entry: the trailing slot is the remaining-fires
+            # budget. A bit flip models a transient physical event, not a
+            # property of the step index — when the sentinel rolls the run
+            # back and the window's steps re-train, an exhausted schedule
+            # must NOT re-corrupt them (None = unlimited, the soak mode)
+            self._flip_state_windows.append([at, end, target, device, times])
+        elif target == "checkpoint_shard":
+            self._flip_shard_windows.append((at, end))
+        else:
+            raise ValueError(
+                f"flip_bits target must be params|opt_state|"
+                f"checkpoint_shard, got {target!r}"
+            )
+        return self
+
     def deliver_sigterm(self, at: int) -> "FaultInjector":
         self._sigterm_steps.add(at)
         return self
@@ -103,6 +147,13 @@ class FaultInjector:
         the save path drains async commits first so the corruption hits a
         checkpoint that actually exists (see ``on_checkpoint_saved``)."""
         return tag in self._corrupt_tags
+
+    def pending_shard_flip(self) -> bool:
+        """True when a ``flip_bits("checkpoint_shard")`` window covers the
+        NEXT committed save — same async-drain contract as
+        ``pending_corruption``: the flip must land on committed,
+        manifested bytes, not race the background commit."""
+        return self._hit(self._flip_shard_windows, self._saves_seen)
 
     # --- trainer hooks -------------------------------------------------------
 
@@ -157,6 +208,38 @@ class FaultInjector:
         batch["loss_mask"] = mask
         return batch
 
+    def on_state(self, step: int, state):
+        """Called with the live TrainState right after step ``step``'s
+        dispatch (before the sentinel's check): a scheduled flip corrupts
+        the first leaf of params/opt-state by one low-order bit — the
+        silent model no loss/grad guard can see. Returns the (possibly
+        corrupted) state; a no-op unless a schedule hits."""
+        hits = []
+        for w in self._flip_state_windows:
+            at, end, tgt, dev, left = w
+            if step < at or (end is not None and step >= end) or left == 0:
+                continue
+            if left is not None:
+                w[4] = left - 1
+            hits.append((tgt, dev))
+        if not hits:
+            return state
+        from neuronx_distributed_tpu.integrity.chaos import flip_tree_bit
+
+        for target, device in hits:
+            if target == "params":
+                state = state.replace(
+                    params=flip_tree_bit(state.params, device_index=device)
+                )
+            else:
+                state = state.replace(
+                    opt_state=flip_tree_bit(
+                        state.opt_state, device_index=device
+                    )
+                )
+            self.counters["bit_flips"] += 1
+        return state
+
     def on_dispatch(self, attempt: int) -> None:
         """Called with the 0-based dispatch ATTEMPT index (failed attempts
         count, so a retry schedule is deterministic). Raises when the
@@ -170,12 +253,18 @@ class FaultInjector:
 
     def on_checkpoint_saved(self, checkpoint_dir: str, tag: str) -> None:
         """Called after a checkpoint for ``tag`` commits. A scheduled
-        corruption deletes its ``done`` marker — the on-disk state of a run
-        killed between the tensor flush and the marker write."""
-        if tag not in self._corrupt_tags:
+        ``corrupt_checkpoint`` deletes its ``done`` marker — the on-disk
+        state of a run killed between the tensor flush and the marker
+        write. A scheduled ``flip_bits("checkpoint_shard")`` instead flips
+        one byte of the committed payload — storage rot AFTER a clean
+        commit, which only the integrity manifest can catch."""
+        shard_hit = self._hit(self._flip_shard_windows, self._saves_seen)
+        if tag not in self._corrupt_tags and not shard_hit:
+            self._saves_seen += 1
             return
         from neuronx_distributed_tpu.trainer.checkpoint import (
             DONE_MARKER,
+            _ITEMS_DIRNAME,
             create_checkpoint_storage,
         )
 
@@ -186,6 +275,23 @@ class FaultInjector:
             # schedule armed rather than "corrupting" nothing and letting
             # the background commit write a pristine marker afterwards
             return
-        self._corrupt_tags.discard(tag)
-        storage.remove_file(marker)
-        self.counters["corrupted_checkpoints"] += 1
+        self._saves_seen += 1
+        if tag in self._corrupt_tags:
+            self._corrupt_tags.discard(tag)
+            storage.remove_file(marker)
+            self.counters["corrupted_checkpoints"] += 1
+        if shard_hit:
+            # flip one byte in the LARGEST payload file — the actual
+            # tensor bytes, not a tiny metadata json
+            items = os.path.join(tag, _ITEMS_DIRNAME)
+            files = storage.list_files(items)
+            if files:
+                victim = max(
+                    files,
+                    key=lambda f: len(storage.load_bytes(os.path.join(items, f))),
+                )
+                path = os.path.join(items, victim)
+                raw = bytearray(storage.load_bytes(path))
+                raw[len(raw) // 2] ^= 0x01
+                storage.save_bytes(bytes(raw), path)
+                self.counters["bit_flips"] += 1

@@ -338,6 +338,12 @@ class Trainer:
     # (main thread only; a second signal falls through to the original
     # handler).
     handle_signals: bool = True
+    # SDC sentinel (integrity/sentinel.SentinelConfig; None = off). Covers
+    # the monolithic step only — pipeline adapters build their own step,
+    # exactly like the anomaly guard's scope. Fingerprints are read
+    # through the guard's deferred readback, so the host-sync budget
+    # stays one device_get call per step with the sentinel fully ON.
+    integrity: Optional[Any] = None
 
     step: int = 0
     state: Any = None
@@ -351,6 +357,8 @@ class Trainer:
     dispatch_retries: int = 0
     emergency_checkpoints: int = 0
     callback_errors: int = 0
+    # restores that failed digest verification and fell back (ISSUE 20)
+    checkpoint_integrity_failures: int = 0
     tokens_seen: int = 0
     train_seconds: float = 0.0
     halt_reason: Optional[str] = None
@@ -501,14 +509,18 @@ class Trainer:
             num_kept_ckpts=num_kept,
             async_save=async_save,
         )
+        inj = self.fault_injector
         if (
             async_save
-            and self.fault_injector is not None
-            and getattr(self.fault_injector, "pending_corruption", lambda _: False)(tag)
+            and inj is not None
+            and (
+                getattr(inj, "pending_corruption", lambda _: False)(tag)
+                or getattr(inj, "pending_shard_flip", lambda: False)()
+            )
         ):
-            # a scheduled corrupt_checkpoint must hit a COMMITTED save —
-            # drain the async commit first (chaos-only; clean saves keep
-            # the non-blocking path)
+            # a scheduled corrupt_checkpoint or checkpoint_shard flip must
+            # hit a COMMITTED save — drain the async commit first
+            # (chaos-only; clean saves keep the non-blocking path)
             from neuronx_distributed_tpu.trainer.checkpoint import (
                 finalize_checkpoints,
             )
@@ -577,7 +589,16 @@ class Trainer:
                 "dispatch_retries": self.dispatch_retries,
                 "callback_errors": self.callback_errors,
                 "tokens_seen": self.tokens_seen,
+                "checkpoint_integrity_failures":
+                    self.checkpoint_integrity_failures,
             }
+            sentinel = getattr(self, "_sentinel", None)
+            if sentinel is not None:
+                # flat scalars (survive the recorder's depth-3 redaction)
+                extra["integrity"] = dict(sentinel.counters)
+                extra["integrity"]["quarantined_devices"] = ",".join(
+                    str(d) for d in sentinel.quarantined_devices
+                )
             # device-efficiency context (ISSUE 12): where HBM went and
             # which programs were hot when training died — flat scalar
             # tables (survive the recorder's depth-3 redaction); cost
@@ -672,18 +693,27 @@ class Trainer:
         next step has been dispatched, so the tiny readback overlaps device
         compute — the clean path adds no stall, and the jitted step itself
         never syncs. Detection therefore lags one step; an anomalous step
-        is already harmless (its update was skipped on device)."""
+        is already harmless (its update was skipped on device).
+
+        The SDC sentinel's fingerprint scalars (ISSUE 20) ride the SAME
+        ``device_get`` call: a check step appends a few uint32 leaves to
+        this readback, so the pinned one-call-per-step budget holds with
+        the sentinel fully ON."""
         pending = self._pending_guard
-        if pending is None:
+        ipending = self._pending_integrity
+        if pending is None and ipending is None:
             return
         self._pending_guard = None
-        at_step, good_dev, skips_dev = pending
+        self._pending_integrity = None
+        guard_leaves = () if pending is None else (pending[1], pending[2])
+        fp_leaves = () if ipending is None else tuple(ipending)
         try:
             # graftlint: ok[GL02] the PR 5 deferred guard readback: the
-            # PREVIOUS step's tiny flag pair, read only after the next step
-            # dispatched so it overlaps device compute — tests/trainer/
-            # test_faults.py pins it at exactly one scalar-pair get per step
-            good, skips = jax.device_get((good_dev, skips_dev))
+            # PREVIOUS step's tiny flag pair (plus, on sentinel check
+            # steps, its uint32 fingerprint scalars), read only after the
+            # next step dispatched so it overlaps device compute — tests/
+            # trainer/test_faults.py pins it at exactly one get per step
+            vals = jax.device_get(guard_leaves + fp_leaves)
         except (KeyboardInterrupt, TrainerHalted):
             raise
         except Exception as e:
@@ -699,25 +729,108 @@ class Trainer:
                 "the last checkpoint",
                 save=False,
             )
-        skips = int(skips)
-        if not bool(good):
-            self._last_fault_step = self.step
-            self._tl.instant(
-                "anomaly_skip", "trainer",
-                args={"step": at_step, "skips": skips},
-            )
-            self._flight_record("anomaly_skip", step=at_step, skips=skips)
-            logger.warning(
-                "anomalous step %d skipped on device (%d skips total)",
-                at_step, skips,
-            )
-        self.anomaly_skips = skips
-        budget = self.anomaly_guard.budget if self.anomaly_guard else None
-        if budget is not None and skips > budget:
+        fp_vals = vals[len(guard_leaves):]
+        if pending is not None:
+            at_step = pending[0]
+            good, skips = vals[0], vals[1]
+            skips = int(skips)
+            if not bool(good):
+                self._last_fault_step = self.step
+                self._tl.instant(
+                    "anomaly_skip", "trainer",
+                    args={"step": at_step, "skips": skips},
+                )
+                self._flight_record("anomaly_skip", step=at_step, skips=skips)
+                logger.warning(
+                    "anomalous step %d skipped on device (%d skips total)",
+                    at_step, skips,
+                )
+            self.anomaly_skips = skips
+            budget = self.anomaly_guard.budget if self.anomaly_guard else None
+            if budget is not None and skips > budget:
+                self._halt(
+                    f"anomaly budget exceeded: {skips} skipped steps > "
+                    f"budget {budget}"
+                )
+        if fp_leaves:
+            verdict = self._sentinel.judge(fp_vals)
+            if verdict is not None and verdict.detected:
+                self._handle_sdc(verdict)
+
+    def _handle_sdc(self, verdict) -> None:
+        """A fingerprint check failed: silent corruption is live in the
+        TrainState. Fence and continue — quarantine the convicted devices
+        in the flight recorder, restore the last verified known-good
+        ``(state, step, data cursor, tokens)``, and keep looping (the
+        discarded steps re-run deterministically, so the final state is
+        bit-identical to a run that never saw the corruption). No rollback
+        point → the TrainerHalted/resume contract takes over."""
+        s = self._sentinel
+        self._last_fault_step = self.step
+        convicted = ",".join(str(d) for d in verdict.convicted_devices)
+        self._tl.instant(
+            "sdc_detected", "trainer",
+            args={"step": verdict.step, "mode": verdict.mode,
+                  "localized": verdict.localized, "devices": convicted},
+        )
+        self._flight_record(
+            "sdc_detected", step=verdict.step, mode=verdict.mode,
+            localized=verdict.localized, devices=convicted,
+        )
+        for d in verdict.convicted_devices:
+            # the quarantine record: which physical device returned wrong
+            # bits — the post-mortem's pointer for draining/replacing it
+            self._flight_record("device_quarantined", device=int(d),
+                                step=verdict.step)
+        logger.error(
+            "silent data corruption detected at step %d (%s vote%s) — "
+            "convicted device(s): %s",
+            verdict.step, verdict.mode,
+            "" if verdict.localized else ", UNLOCALIZED",
+            convicted or "<none>",
+        )
+        if not s.can_rollback() or self._data_source is None:
             self._halt(
-                f"anomaly budget exceeded: {skips} skipped steps > "
-                f"budget {budget}"
+                f"silent data corruption detected at step {verdict.step} "
+                f"({verdict.mode} check) with no in-memory rollback point "
+                "— resume from the last verified checkpoint"
             )
+        rb = s.rollback()
+        self.state = rb["state"]
+        self.step = rb["step"]
+        self.tokens_seen = rb["tokens_seen"]
+        if rb["data_state"] is not None:
+            self._data_source.restore(rb["data_state"])
+            self._data_state_prepull = rb["data_state"]
+        # the in-flight guard flags / metrics belong to a discarded step
+        self._pending_guard = None
+        self._drop_pending_guard = True
+        self._flight_record("sdc_rollback", to_step=self.step,
+                            detected_at=verdict.step)
+        self._tl.instant(
+            "sdc_rollback", "trainer",
+            args={"to_step": self.step, "detected_at": verdict.step},
+        )
+        logger.warning(
+            "rolled back to verified step %d — re-training the discarded "
+            "window (bit-identical by determinism)", self.step,
+        )
+
+    def _on_checkpoint_corrupt(self, tag: str, detail: str) -> None:
+        """load_checkpoint's on_corrupt hook: a tag failed digest
+        verification at resume and was quarantined."""
+        self.checkpoint_integrity_failures += 1
+        self._last_fault_step = self.step
+        self._tl.instant(
+            "checkpoint_integrity_failure", "trainer",
+            args={"tag": tag, "detail": str(detail)[:200]},
+        )
+        self._flight_record("checkpoint_integrity_failure", tag=tag,
+                            detail=str(detail))
+        logger.error(
+            "checkpoint '%s' failed integrity verification (%s) — "
+            "falling back to the previous completed tag", tag, detail,
+        )
 
     # --- signals ------------------------------------------------------------
 
@@ -869,6 +982,9 @@ class Trainer:
         self._consecutive_dispatch_failures = 0
         self._last_fault_step = None
         self._pending_guard = None
+        self._pending_integrity = None
+        self._drop_pending_guard = False
+        self._sentinel = None
         self._dispatch_policy = self.dispatch_retry or RetryPolicy(
             max_attempts=3, first_wait=0.05, min_wait=0.01
         )
@@ -997,6 +1113,84 @@ class Trainer:
         self.hbm.add_resident("anomaly_guard", _res(
             lambda t: tree_nbytes(t.state.guard)
         ))
+        # SDC sentinel (ISSUE 20): jitted fingerprint + state-copy programs
+        # registered through the ledger like every other program; the
+        # sentinel itself never syncs — its scalars ride _account_guard's
+        # one deferred device_get
+        if self.integrity is not None and self.pipeline is not None:
+            logger.warning(
+                "integrity sentinel covers the monolithic step only — "
+                "disabled under a pipeline adapter (like the anomaly guard)"
+            )
+        elif self.integrity is not None:
+            from neuronx_distributed_tpu.integrity.sentinel import (
+                TrainerSentinel,
+            )
+            from neuronx_distributed_tpu.utils.fingerprint import (
+                tree_fingerprint,
+            )
+
+            _jit_fp = self.programs.wrap(
+                "integrity_fingerprint",
+                jax.jit(lambda t: tree_fingerprint(t)),
+            )
+            dp_size = mesh_lib.get_data_parallel_size()
+            _mode = self.integrity.mode
+            if _mode == "auto":
+                _mode = "vote" if dp_size > 1 else "canary"
+            if _mode == "vote" and dp_size > 1:
+                # ZeRO-1 shards opt-state leaves over the dp axes; a
+                # fingerprint touching such a leaf needs a CROSS-replica
+                # reduction, and that one collective uniformizes the whole
+                # combined scalar — every device reports the same value
+                # even when one replica's (replicated!) params copy is
+                # corrupt, blinding the vote entirely. Strip dp-sharded
+                # leaves from the vote fingerprint; they are covered by
+                # checkpoint shard digests instead. Shardings are stable
+                # across steps, so the stripped structure jit-caches once.
+                from neuronx_distributed_tpu.parallel.mesh import DATA_AXES
+
+                _dp_names = set(DATA_AXES)
+
+                def _dp_sharded(leaf):
+                    spec = getattr(
+                        getattr(leaf, "sharding", None), "spec", None
+                    )
+                    if spec is None:
+                        return False
+                    names = set()
+                    for entry in spec:
+                        if entry is None:
+                            continue
+                        if isinstance(entry, (tuple, list)):
+                            names.update(entry)
+                        else:
+                            names.add(entry)
+                    return bool(names & _dp_names)
+
+                def fp_fn(tree):
+                    return _jit_fp(jax.tree.map(
+                        lambda l: None if _dp_sharded(l) else l, tree
+                    ))
+            else:
+                fp_fn = _jit_fp
+            copy_fn = self.programs.wrap(
+                "integrity_copy",
+                jax.jit(lambda t: jax.tree.map(jnp.copy, t)),
+            )
+            self._sentinel = TrainerSentinel(
+                self.integrity,
+                dp_size=mesh_lib.get_data_parallel_size(),
+                fingerprint_fn=fp_fn,
+                copy_fn=copy_fn,
+            )
+            # the retained known-good/candidate snapshots are real HBM:
+            # account for them next to params/opt-state
+            self.hbm.add_resident("integrity_snapshots", _res(
+                lambda t: sum(
+                    tree_nbytes(s) for s in t._sentinel.snapshot_states()
+                )
+            ))
         pending = first if sample_batch is None else None
         # the probe pull advanced the cursor past a batch nothing has
         # trained on yet — checkpoints written before it is consumed must
@@ -1020,6 +1214,9 @@ class Trainer:
                         "model": self.state.params,
                         "optimizer": self.state.opt_state,
                     },
+                    # digest verification with quarantine-and-fall-back
+                    # (ISSUE 20): never donate restored garbage
+                    on_corrupt=self._on_checkpoint_corrupt,
                 )
                 if not hasattr(jax, "shard_map"):
                     # jax < 0.5 only: a persistent-cache-deserialized CPU
@@ -1076,6 +1273,16 @@ class Trainer:
                     pending = None
                     self._pending_untrained = False
                 logger.info("resumed from '%s' at step %d", tag, self.step)
+        if self._sentinel is not None:
+            # first known-good point: the verified state this fit starts
+            # from (fresh init, or a digest-verified checkpoint restore);
+            # the cursor pairs it with the batch step self.step will pull
+            self._sentinel.set_baseline(
+                self.state, self.step,
+                self._data_state_prepull
+                if self._data_source is not None else None,
+                self.tokens_seen,
+            )
         meter = ThroughputMeter(batch_size=first["input_ids"].shape[0])
         # shape is host metadata on np AND jax arrays — np.asarray here used
         # to copy the whole batch to host just to read it (GL02-class bug)
@@ -1090,77 +1297,115 @@ class Trainer:
         halted: Optional[TrainerHalted] = None
         error: Optional[BaseException] = None
         try:
-            while self.step < max_steps:
-                if inj is not None:
-                    inj.on_step_start(self.step)
-                if self._preempt_signum is not None:
-                    self._graceful_preempt()
-                    break
-                if pending is not None:
-                    batch = pending
-                    pending = None
-                    # the probe batch is now entering training; from here
-                    # _mid_step/_data_state_prepull carry the truth
-                    self._pending_untrained = False
-                else:
-                    if self._data_source is not None:
-                        self._data_state_prepull = self._data_source.state()
-                    batch = next(data_iter)
-                # the batch has left the iterator: from here until the
-                # dispatch lands, any exit (corrupt_batch raising, profiler
-                # failure, dispatch halt) must checkpoint the PRE-pull
-                # cursor or resume would silently skip this batch
-                self._mid_step = True
-                if inj is not None:
-                    batch = inj.corrupt_batch(self.step, batch)
-                if self.profile_dir is not None:
-                    if self.steps_run == 2 and not profiling:
-                        jax.profiler.start_trace(self.profile_dir)
-                        profiling = True
-                    elif self.steps_run == 5 and profiling:
-                        jax.profiler.stop_trace()
-                        profiling = False
-                with tl.event("train_step"):
-                    self.state, metrics = self._dispatch(
-                        train_step, prepare(batch)
-                    )
-                self._mid_step = False
-                self.step += 1
-                self.steps_run += 1
-                self.tokens_seen += batch_tokens
-                # per-step roofline feed: the inter-step wall (host clock
-                # the loop already owns — dispatch is async, so steady-state
-                # iteration time IS the step wall). The first iteration and
-                # any compile-bearing step are skipped so MFU never
-                # averages in trace+compile time
-                now_wall = time.perf_counter()
-                if self.steps_run > 1 and not getattr(
-                    train_step, "last_call_compiled", True
-                ):
-                    self.programs.observe_wall(
-                        "train_step", now_wall - self._step_wall_t0
-                    )
-                self._step_wall_t0 = now_wall
-                # budget-check the PREVIOUS step's guard flags now that this
-                # step is dispatched — the readback overlaps device compute
+            while True:
+                while self.step < max_steps:
+                    if inj is not None:
+                        inj.on_step_start(self.step)
+                    if self._preempt_signum is not None:
+                        self._graceful_preempt()
+                        break
+                    if pending is not None:
+                        batch = pending
+                        pending = None
+                        # the probe batch is now entering training; from here
+                        # _mid_step/_data_state_prepull carry the truth
+                        self._pending_untrained = False
+                    else:
+                        if self._data_source is not None:
+                            self._data_state_prepull = self._data_source.state()
+                        batch = next(data_iter)
+                    # the batch has left the iterator: from here until the
+                    # dispatch lands, any exit (corrupt_batch raising, profiler
+                    # failure, dispatch halt) must checkpoint the PRE-pull
+                    # cursor or resume would silently skip this batch
+                    self._mid_step = True
+                    if inj is not None:
+                        batch = inj.corrupt_batch(self.step, batch)
+                    if self.profile_dir is not None:
+                        if self.steps_run == 2 and not profiling:
+                            jax.profiler.start_trace(self.profile_dir)
+                            profiling = True
+                        elif self.steps_run == 5 and profiling:
+                            jax.profiler.stop_trace()
+                            profiling = False
+                    prepared = prepare(batch)
+                    if (
+                        self._sentinel is not None
+                        and self._sentinel.wants_pre_copy(self.step)
+                    ):
+                        # canary mode: retain the pre-step state + batch so
+                        # post_dispatch can re-execute this exact step
+                        self._sentinel.pre_dispatch(self.state, prepared)
+                    with tl.event("train_step"):
+                        self.state, metrics = self._dispatch(
+                            train_step, prepared
+                        )
+                    if inj is not None and hasattr(inj, "on_state"):
+                        # chaos (ISSUE 20): scheduled silent bit flips land on
+                        # the live state here — after dispatch, before the
+                        # sentinel's check — so detection latency is measured
+                        # from the step the corruption actually struck
+                        self.state = inj.on_state(self.step, self.state)
+                    self._mid_step = False
+                    self.step += 1
+                    self.steps_run += 1
+                    self.tokens_seen += batch_tokens
+                    # per-step roofline feed: the inter-step wall (host clock
+                    # the loop already owns — dispatch is async, so steady-state
+                    # iteration time IS the step wall). The first iteration and
+                    # any compile-bearing step are skipped so MFU never
+                    # averages in trace+compile time
+                    now_wall = time.perf_counter()
+                    if self.steps_run > 1 and not getattr(
+                        train_step, "last_call_compiled", True
+                    ):
+                        self.programs.observe_wall(
+                            "train_step", now_wall - self._step_wall_t0
+                        )
+                    self._step_wall_t0 = now_wall
+                    # budget-check the PREVIOUS step's guard flags now that this
+                    # step is dispatched — the readback overlaps device compute
+                    self._account_guard()
+                    metrics = dict(metrics)
+                    metrics["throughput_seq_s"] = meter.update()
+                    metrics["dispatch_retries"] = self.dispatch_retries
+                    metrics["emergency_checkpoints"] = self.emergency_checkpoints
+                    metrics["callback_errors"] = self.callback_errors
+                    # a rollback inside _account_guard discarded this step —
+                    # its metrics/flags describe state that no longer exists
+                    sdc_rolled = self._drop_pending_guard
+                    self._drop_pending_guard = False
+                    if guard_cfg is not None and not sdc_rolled:
+                        self._pending_guard = (
+                            self.step - 1,
+                            metrics["good_step"],
+                            metrics["anomaly_skips"],
+                        )
+                    if (
+                        self._sentinel is not None
+                        and not sdc_rolled
+                        and self._sentinel.is_check_step(self.step - 1)
+                    ):
+                        # stage this check's fingerprint scalars; they ride
+                        # the NEXT _account_guard's single device_get
+                        self._pending_integrity = self._sentinel.post_dispatch(
+                            train_step, self.state, self.step,
+                            self._data_source.state()
+                            if self._data_source is not None else None,
+                            self.tokens_seen,
+                        )
+                    for cb in self.callbacks:
+                        self._safe_callback(cb, "on_step_end", self, metrics)
+                    if self._preempt_signum is not None:
+                        self._graceful_preempt()
+                        break
+                # the final step's flags (and any staged fingerprint): a
+                # sentinel rollback HERE lowers self.step below max_steps, so
+                # the outer loop re-enters training and the run still
+                # completes its full schedule bit-identically
                 self._account_guard()
-                metrics = dict(metrics)
-                metrics["throughput_seq_s"] = meter.update()
-                metrics["dispatch_retries"] = self.dispatch_retries
-                metrics["emergency_checkpoints"] = self.emergency_checkpoints
-                metrics["callback_errors"] = self.callback_errors
-                if guard_cfg is not None:
-                    self._pending_guard = (
-                        self.step - 1,
-                        metrics["good_step"],
-                        metrics["anomaly_skips"],
-                    )
-                for cb in self.callbacks:
-                    self._safe_callback(cb, "on_step_end", self, metrics)
-                if self._preempt_signum is not None:
-                    self._graceful_preempt()
+                if self.preempted or self.step >= max_steps:
                     break
-            self._account_guard()  # the final step's flags
         except TrainerHalted as e:
             halted = e
         except KeyboardInterrupt:

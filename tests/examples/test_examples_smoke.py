@@ -72,6 +72,20 @@ def test_train_example_resume(train_mod, tmp_path):
     assert float(metrics["loss"]) > 0
 
 
+def test_train_example_bitflip_sentinel(train_mod, capsys):
+    """--inject-fault bitflip (ISSUE 20): one silently flipped weight bit
+    is detected by the SDC sentinel's fingerprint vote, rolled back, and
+    the run completes — the fault/sdc summaries land on stdout."""
+    metrics = train_mod.main([
+        "--model", "tiny", "--steps", "4", "--seq-len", "32",
+        "--inject-fault", "bitflip", "--fault-at", "2",
+    ])
+    assert float(metrics["loss"]) > 0
+    out = capsys.readouterr().out
+    assert "sdc summary" in out
+    assert "detected=1" in out and "rollbacks=1" in out
+
+
 def test_inference_example_generate(infer_mod):
     out = infer_mod.main([
         "--model", "tiny", "--mode", "generate", "--prompt-len", "8",

@@ -1,0 +1,69 @@
+"""Cross-replica fingerprint voting: pure host arithmetic, every branch."""
+
+from neuronx_distributed_tpu.integrity.voting import (
+    VoteVerdict,
+    vote,
+    vote_sequence,
+)
+
+
+def test_unanimous_is_clean():
+    v = vote({0: 7, 1: 7, 2: 7, 3: 7})
+    assert v.clean and not v.detected
+    assert v.convicted == () and v.localized
+    assert v.quorum_value == 7
+    assert v.values == {0: 7, 1: 7, 2: 7, 3: 7}
+
+
+def test_empty_vote_is_clean():
+    assert vote({}).clean
+
+
+def test_single_voter_is_clean():
+    # a 1-device "vote" can never detect anything — mode selection must
+    # route solo runs to the canary, but the vote itself stays well-defined
+    assert vote({0: 123}).clean
+
+
+def test_strict_minority_is_convicted():
+    v = vote({0: 7, 1: 7, 2: 9, 3: 7})
+    assert v.detected and v.localized
+    assert v.convicted == (2,)
+    assert v.quorum_value == 7
+
+
+def test_multiple_divergent_devices_convicted():
+    # two corrupt devices holding DIFFERENT wrong values: the majority
+    # still stands, both outliers are convicted
+    v = vote({0: 7, 1: 8, 2: 9, 3: 7, 4: 7})
+    assert v.detected and v.localized
+    assert set(v.convicted) == {1, 2}
+    assert v.quorum_value == 7
+
+
+def test_even_split_detected_but_unlocalized():
+    v = vote({0: 7, 1: 7, 2: 9, 3: 9})
+    assert v.detected
+    assert not v.localized and v.convicted == ()
+
+
+def test_two_replica_disagreement_unlocalized():
+    # dp=2 can detect but never blame — the caller's coarse remedy
+    v = vote({0: 7, 1: 9})
+    assert v.detected and not v.localized and v.convicted == ()
+
+
+def test_three_way_split_unlocalized():
+    v = vote({0: 1, 1: 2, 2: 3})
+    assert v.detected and not v.localized and v.convicted == ()
+
+
+def test_vote_sequence_matches_dict_vote():
+    pairs = [("a", 5), ("b", 5), ("c", 6)]
+    v = vote_sequence(pairs)
+    assert v.convicted == ("c",) and v.quorum_value == 5
+
+
+def test_verdict_detected_property():
+    assert not VoteVerdict(clean=True).detected
+    assert VoteVerdict(clean=False).detected

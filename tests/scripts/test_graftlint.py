@@ -1307,3 +1307,65 @@ def test_gl02_fstring_of_host_metadata_clean(tmp_path):
             return f"shape={x.shape} n={n} host={host} w={w}"
     """)
     assert "GL02" not in rules_of(v)
+
+
+# --- GL02 integrity sentinel modules (ISSUE 20) -------------------------------
+
+
+def test_gl02_integrity_modules_are_hot_by_path(tmp_path):
+    """ISSUE 20 satellite: the integrity sentinel's sync-free modules are
+    on the GL02 hot-path list BY PATH — the fingerprint reductions trace
+    inside jitted programs on the trainer/engine hot paths, and the
+    sentinel's hooks plus the voting arithmetic run inside the training
+    loop every check step (the ONE readback rides the anomaly guard's
+    deferred device_get in trainer/loop.py) — so an implicit coercion or
+    undocumented device_get smuggled into a future edit trips with no
+    marker needed, and the shipped modules scan clean."""
+    fixture = """\
+        import jax.numpy as jnp
+
+        def leaf_fp(leaf, report):
+            return float(jnp.sum(leaf)) if report else 0.0
+        """
+    for name in (
+        "utils/fingerprint.py",
+        "integrity/sentinel.py",
+        "integrity/voting.py",
+    ):
+        assert "GL02" in rules_of(lint(tmp_path, fixture, name=name)), name
+    # an undocumented explicit device_get trips too — the sentinel's
+    # fingerprint scalars must ride the loop's existing deferred readback,
+    # never force their own
+    v = lint(tmp_path, """\
+        import jax
+
+        def post_dispatch(self, state):
+            return jax.device_get(self._fp(state))
+        """, name="integrity/sentinel.py")
+    assert any("device_get" in x.message for x in v if x.rule == "GL02")
+    for rel in (
+        ("utils", "fingerprint.py"),
+        ("integrity", "sentinel.py"),
+        ("integrity", "voting.py"),
+    ):
+        shipped = os.path.join(PKG, *rel)
+        assert os.path.exists(shipped)
+        report = runner.scan([shipped], root=REPO_ROOT)
+        assert report.violations == [], rel
+
+
+def test_gl02_integrity_chaos_module_is_not_hot(tmp_path):
+    """integrity/chaos.py is deliberately NOT hot-listed: its host
+    round-trips ARE the injected fault (pull, flip one bit, re-place),
+    consulted only by chaos schedules outside the measured hot paths —
+    the same coercions that trip in the sentinel stay quiet here."""
+    fixture = """\
+        import jax
+        import numpy as np
+
+        def flip(leaf):
+            return np.asarray(jax.device_get(leaf))
+        """
+    assert "GL02" not in rules_of(
+        lint(tmp_path, fixture, name="integrity/chaos.py")
+    )

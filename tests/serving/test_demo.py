@@ -81,6 +81,19 @@ def test_serving_demo_programs_mode_runs(capsys):
 
 @pytest.mark.slow  # heavy demo traffic variant (tier-1 budget, PR 5/13
 # lean-core policy): the base demo smoke stays tier-1 via
+def test_serving_demo_bitflip_runs():
+    """--inject-fault bitflip (ISSUE 20): one bit flipped inside a pooled
+    KV page at the first prefix reuse — the reuse-time page fingerprints
+    reject it and the engine falls back to a full prefill; every request
+    still completes."""
+    snap = _load_demo().main(
+        ["--requests", "4", "--slots", "2", "--max-new-tokens", "6",
+         "--shared-prefix", "24", "--inject-fault", "bitflip"]
+    )
+    assert snap["completed"] == 4
+    assert snap["prefix_validation_failures"] == 1
+
+
 # test_serving_demo_runs, tape determinism via
 # test_traffic.py::test_same_seed_identical_slo_report
 def test_serving_demo_traffic_mode_runs():

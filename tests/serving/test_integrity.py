@@ -1,0 +1,202 @@
+"""Serving-side SDC sentinel (ISSUE 20): the router's cross-replica
+params fingerprint vote and the paged engine's per-page KV content
+validation.
+
+A replica with one flipped weight bit answers every liveness probe OK
+and keeps serving plausibly-wrong tokens — the corruption class the
+ISSUE 18 watchdog cannot see. The vote convicts the strict minority,
+fences it straight to DEAD (no SUSPECT ladder: corrupted weights don't
+flap), and re-homes its work through the standard halt/adopt contract
+with zero tokens lost. Two replicas disagreeing detects but cannot
+blame: recorded, nobody fenced. On the KV side, a bit flipped inside a
+pooled page is caught by the reuse-time per-page fingerprint check and
+the engine falls back to a full prefill, stream bit-identical."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neuronx_distributed_tpu.inference import GenerationConfig, generate
+from neuronx_distributed_tpu.models.llama import LlamaForCausalLM, tiny_llama
+from neuronx_distributed_tpu.serving import (
+    FaultInjector,
+    InProcessTransport,
+    PrefixCache,
+    ReplicaRouter,
+    RequestState,
+    ServingEngine,
+    VirtualClock,
+    WatchdogConfig,
+)
+from neuronx_distributed_tpu.serving.router import RID_STRIDE
+
+pytestmark = pytest.mark.chaos
+
+PS = 8
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_llama(num_layers=2, hidden_size=32,
+                     intermediate_size=96, vocab_size=128)
+    model = LlamaForCausalLM(cfg, attention_impl="xla")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 1, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), ids)
+    return cfg, model, params
+
+
+def _solo(model, params, prompt, key, gcfg):
+    toks = np.asarray(
+        generate(model, params, jnp.asarray(prompt)[None], key, gcfg)
+    )[0].tolist()
+    if gcfg.eos_token_id is not None and gcfg.eos_token_id in toks:
+        toks = toks[: toks.index(gcfg.eos_token_id) + 1]
+    return toks
+
+
+def _fleet(model, params, clock, injectors, interval=0.5, **kw):
+    """N replicas (N = len(injectors); None = clean) with PER-REPLICA
+    fault injectors — ``ReplicaRouter.build`` clones one kwarg set, so
+    corrupt-one-replica schedules need hand-built engines."""
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("decode_chunk_size", 2)
+    kw.setdefault("prefix_cache", None)
+    engines = [
+        ServingEngine(
+            model, params, rid_base=i * RID_STRIDE, time_fn=clock,
+            fault_injector=inj, **kw
+        )
+        for i, inj in enumerate(injectors)
+    ]
+    return ReplicaRouter(
+        engines,
+        transport=InProcessTransport(time_fn=clock),
+        watchdog=WatchdogConfig(integrity_interval_s=interval),
+        time_fn=clock,
+    )
+
+
+def _workload(cfg, router, model, params, n, seed, max_new=12):
+    rng = np.random.RandomState(seed)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, size=rng.randint(4, 10)).astype(
+            np.int32
+        )
+        for _ in range(n)
+    ]
+    gcfg = GenerationConfig(max_new_tokens=max_new, temperature=0.0)
+    keys = [jax.random.PRNGKey(900 + i) for i in range(n)]
+    refs = [_solo(model, params, p, k, gcfg) for p, k in zip(prompts, keys)]
+    reqs = [router.submit(p, gcfg, key=k) for p, k in zip(prompts, keys)]
+    return reqs, refs
+
+
+def test_params_flip_convicted_fenced_rehomed_zero_tokens_lost(setup):
+    """THE serving pin: one replica of three silently flips a weight bit
+    mid-service. Liveness never blinks — the next fingerprint vote
+    convicts it 2-vs-1, fences it straight to DEAD, and its work adopts
+    onto the survivors: every stream completes bit-identical to solo
+    ``generate()``, tokens_lost == 0."""
+    cfg, model, params = setup
+    clock = VirtualClock()
+    inj0 = FaultInjector().flip_bits("params", at=1)
+    router = _fleet(model, params, clock, [inj0, None, None])
+    reqs, refs = _workload(cfg, router, model, params, n=6, seed=31)
+    # round 1: vote over clean fingerprints, then replica 0's step 0
+    router.step()
+    assert router.stats["integrity_fences"] == 0
+    # round 2: still-clean vote, then replica 0's step 1 fires the flip
+    clock.advance(0.6)
+    router.step()
+    assert inj0.counters["bit_flips"] == 1
+    assert router.probe_states()["replica0"] == "ok"  # liveness is blind
+    # round 3: the vote sees the divergent fingerprint → fence + re-home
+    clock.advance(0.6)
+    router.step()
+    assert router.stats["integrity_fences"] == 1
+    assert router.probe_states()["replica0"] == "dead"
+    assert router.replicas[0].health().value == "halted"  # fenced
+    assert router.stats["watchdog_deaths"] == 0  # not a liveness death
+    router.run()
+    tokens_lost = 0
+    for i, (req, ref) in enumerate(zip(reqs, refs)):
+        assert req.state is RequestState.DONE, f"request {i} stranded"
+        if req.tokens != ref:
+            tokens_lost += 1
+    assert tokens_lost == 0
+    assert router.stats["rehomed_requests"] > 0
+    assert router.stats["integrity_probes"] >= 3 * 2 + 2
+    assert router.stats["integrity_disagreements"] == 0
+
+
+def test_two_replica_disagreement_detected_never_fenced(setup):
+    """dp=2 of the serving world: two fingerprints disagreeing prove
+    corruption exists but not where — fencing an innocent replica would
+    be worse than routing around neither, so the router records the
+    disagreement and keeps both replicas in rotation."""
+    cfg, model, params = setup
+    clock = VirtualClock()
+    inj0 = FaultInjector().flip_bits("params", at=0)
+    router = _fleet(model, params, clock, [inj0, None])
+    reqs, _ = _workload(cfg, router, model, params, n=4, seed=33)
+    router.step()  # replica 0's step 0 fires the flip
+    assert inj0.counters["bit_flips"] == 1
+    clock.advance(0.6)
+    router.step()
+    assert router.stats["integrity_disagreements"] >= 1
+    assert router.stats["integrity_fences"] == 0
+    assert "dead" not in router.probe_states().values()
+    router.run()
+    assert all(r.state is RequestState.DONE for r in reqs)
+
+
+def test_clean_fleet_no_false_positives(setup):
+    """Fingerprint probes over a healthy fleet must never fire: replicas
+    built from one params host copy fingerprint identically, streams stay
+    bit-identical with the sentinel fully ON."""
+    cfg, model, params = setup
+    clock = VirtualClock()
+    router = _fleet(model, params, clock, [None, None])
+    reqs, refs = _workload(cfg, router, model, params, n=4, seed=35,
+                           max_new=8)
+    while any(r.state is not RequestState.DONE for r in reqs):
+        clock.advance(0.6)
+        if not router.step():
+            break
+    assert router.stats["integrity_probes"] >= 4
+    assert router.stats["integrity_fences"] == 0
+    assert router.stats["integrity_disagreements"] == 0
+    for req, ref in zip(reqs, refs):
+        assert req.state is RequestState.DONE and req.tokens == ref
+
+
+def test_kv_pool_bit_flip_rejected_falls_back_bit_identical(setup):
+    """A bit flipped inside a pooled KV page (HBM rot) is caught by the
+    reuse-time per-page content fingerprints: the entry is evicted, the
+    request falls back to a full prefill, and its stream is bit-identical
+    — corrupted KV never maps into a slot. The store then recovers: the
+    fallback re-inserted a clean entry and the next reuse hits."""
+    cfg, model, params = setup
+    prompt = np.arange(2, 18, dtype=np.int32)  # 16 tokens = 2 pages
+    gcfg = GenerationConfig(max_new_tokens=8, temperature=0.8, top_k=13)
+    ref = _solo(model, params, prompt, jax.random.PRNGKey(71), gcfg)
+    inj = FaultInjector().flip_bits("kv_pool", at=0)
+    engine = ServingEngine(
+        model, params, num_slots=1, kv_page_size=PS, fault_injector=inj,
+        prefix_cache=PrefixCache(max_entries=4, min_match=4),
+    )
+    r1 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(71))
+    engine.run()  # seeds the paged entry (miss)
+    r2 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(71))
+    engine.run()  # reuse attempt 0: page flipped → reject → full prefill
+    assert inj.counters["bit_flips"] == 1
+    snap = engine.metrics.snapshot()
+    assert snap["prefix_validation_failures"] == 1
+    assert snap["prefix_hits"] == 0  # the corrupt reuse never counted
+    assert r1.tokens == ref
+    assert r2.tokens == ref  # bit-identical through the fallback
+    r3 = engine.submit(prompt, gcfg, key=jax.random.PRNGKey(71))
+    engine.run()
+    assert r3.tokens == ref
+    assert engine.metrics.snapshot()["prefix_hits"] == 1
